@@ -1,0 +1,1 @@
+from dgraph_tpu.codec.uidpack import UidPack, encode, decode, split_segments

@@ -1,0 +1,237 @@
+"""UID pack codec: block-compressed sorted u64 UID lists, device-friendly.
+
+TPU-native replacement for the reference's group-varint delta codec
+(/root/reference/codec/codec.go:36 Encoder / :139 Decoder, 256-UID blocks,
+per-block u64 Base, blocks split when the 32 MSBs differ, codec.go:117).
+
+Design difference (deliberate, per SURVEY.md §2.7(1)): group-varint decode is
+a byte-serial SSE trick that does not map to the TPU. We instead store, per
+256-UID block, the u64 base plus *absolute* uint32 offsets from that base
+(`uid - base`, guaranteed < 2^32 by the same 32-MSB split rule). Offsets are
+random-access (no prefix-sum on decode) and upload to the device as plain
+uint32 lanes. On disk, offsets are bit-packed to the block's max width
+(serialize/deserialize below), giving compression comparable to the
+reference's group-varint for clustered UIDs while keeping decode a pure
+shift/mask that XLA vectorizes.
+
+Segments: for device set-ops, a pack is viewed as segments keyed by the high
+32 bits. Within one segment all UIDs share the hi-32 word, so set algebra
+runs in 32-bit local space (ops/setops.py); cross-segment ops align segments
+host-side (matching the reference's per-block Base comparisons in
+algo/packed.go).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+BLOCK_SIZE = 256
+_MAGIC = b"UPK1"
+
+
+@dataclass
+class UidPack:
+    """Block-compressed sorted u64 UID list.
+
+    bases:   (nblocks,) uint64 — first UID of each block
+    counts:  (nblocks,) int32  — #UIDs in each block (<= BLOCK_SIZE)
+    offsets: (nblocks, BLOCK_SIZE) uint32 — uid - base, padded with 0xFFFFFFFF
+    num_uids: total count
+    """
+
+    bases: np.ndarray
+    counts: np.ndarray
+    offsets: np.ndarray
+    num_uids: int
+
+    def __len__(self) -> int:
+        return self.num_uids
+
+    @property
+    def nblocks(self) -> int:
+        return self.bases.shape[0]
+
+    def approx_bytes(self) -> int:
+        """On-disk size estimate (bit-packed)."""
+        total = len(_MAGIC) + 12 + self.nblocks * 11
+        for i in range(self.nblocks):
+            c = int(self.counts[i])
+            w = _width_bits(self.offsets[i, :c])
+            total += (c * w + 7) // 8
+        return total
+
+
+def _width_bits(offsets: np.ndarray) -> int:
+    if offsets.size == 0:
+        return 0
+    m = int(offsets.max())
+    return max(1, m.bit_length())
+
+
+def encode(uids: np.ndarray) -> UidPack:
+    """Encode a sorted (strictly increasing) u64 array into a UidPack.
+
+    Blocks hold up to BLOCK_SIZE UIDs and never span a hi-32 boundary
+    (mirrors codec.go:117's split rule so offsets always fit uint32).
+    """
+    uids = np.asarray(uids, dtype=np.uint64)
+    n = uids.shape[0]
+    if n == 0:
+        return UidPack(
+            bases=np.zeros((0,), np.uint64),
+            counts=np.zeros((0,), np.int32),
+            offsets=np.zeros((0, BLOCK_SIZE), np.uint32),
+            num_uids=0,
+        )
+    hi = (uids >> np.uint64(32)).astype(np.uint64)
+    # block boundary every BLOCK_SIZE elements or at hi-32 changes
+    seg_starts = np.flatnonzero(np.concatenate([[True], hi[1:] != hi[:-1]]))
+    starts: List[int] = []
+    seg_bounds = list(seg_starts) + [n]
+    for si in range(len(seg_bounds) - 1):
+        s, e = int(seg_bounds[si]), int(seg_bounds[si + 1])
+        starts.extend(range(s, e, BLOCK_SIZE))
+    nb = len(starts)
+    bases = np.zeros((nb,), np.uint64)
+    counts = np.zeros((nb,), np.int32)
+    offsets = np.full((nb, BLOCK_SIZE), 0xFFFFFFFF, np.uint32)
+    bounds = starts + [n]
+    for bi in range(nb):
+        s = bounds[bi]
+        e = min(bounds[bi + 1], s + BLOCK_SIZE)
+        blk = uids[s:e]
+        # Base is the first UID (not hi-masked): offsets stay small for
+        # clustered blocks, minimizing the bit-pack width. Safe because a
+        # block never spans a hi-32 boundary, so offsets always fit uint32.
+        bases[bi] = blk[0]
+        counts[bi] = e - s
+        offsets[bi, : e - s] = (blk - bases[bi]).astype(np.uint32)
+    return UidPack(bases=bases, counts=counts, offsets=offsets, num_uids=n)
+
+
+def decode(pack: UidPack) -> np.ndarray:
+    """Decode a UidPack back to a sorted u64 array. Ref codec.go:444 Decode."""
+    if pack.num_uids == 0:
+        return np.zeros((0,), np.uint64)
+    out = np.empty((pack.num_uids,), np.uint64)
+    pos = 0
+    for bi in range(pack.nblocks):
+        c = int(pack.counts[bi])
+        out[pos : pos + c] = pack.bases[bi] + pack.offsets[bi, :c].astype(
+            np.uint64
+        )
+        pos += c
+    return out
+
+
+def split_segments(uids: np.ndarray) -> Dict[int, np.ndarray]:
+    """Split a sorted u64 array into {hi32: sorted uint32 lo-array} segments."""
+    uids = np.asarray(uids, dtype=np.uint64)
+    out: Dict[int, np.ndarray] = {}
+    if uids.size == 0:
+        return out
+    hi = (uids >> np.uint64(32)).astype(np.uint64)
+    starts = np.flatnonzero(np.concatenate([[True], hi[1:] != hi[:-1]]))
+    bounds = list(starts) + [uids.size]
+    for si in range(len(bounds) - 1):
+        s, e = int(bounds[si]), int(bounds[si + 1])
+        out[int(hi[s])] = (uids[s:e] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return out
+
+
+def join_segments(segments: Dict[int, np.ndarray]) -> np.ndarray:
+    """Inverse of split_segments."""
+    parts = []
+    for h in sorted(segments):
+        lo = segments[h].astype(np.uint64)
+        parts.append((np.uint64(h) << np.uint64(32)) | lo)
+    if not parts:
+        return np.zeros((0,), np.uint64)
+    return np.concatenate(parts)
+
+
+# ---------------------------------------------------------------------------
+# Serialization: bit-packed per-block offsets (disk/wire format).
+# ---------------------------------------------------------------------------
+
+
+def serialize(pack: UidPack) -> bytes:
+    """Bit-pack each block's offsets to its max width. Ref codec.go:393 Encode
+    (group-varint there; fixed-width lanes here — see module docstring)."""
+    parts = [_MAGIC, struct.pack("<QI", pack.num_uids, pack.nblocks)]
+    for bi in range(pack.nblocks):
+        c = int(pack.counts[bi])
+        offs = pack.offsets[bi, :c]
+        w = _width_bits(offs)
+        parts.append(struct.pack("<QHB", int(pack.bases[bi]), c, w))
+        parts.append(_bitpack(offs, w))
+    return b"".join(parts)
+
+
+def deserialize(data: bytes) -> UidPack:
+    if data[:4] != _MAGIC:
+        raise ValueError("bad UidPack magic")
+    num_uids, nb = struct.unpack_from("<QI", data, 4)
+    pos = 4 + 12
+    bases = np.zeros((nb,), np.uint64)
+    counts = np.zeros((nb,), np.int32)
+    offsets = np.full((nb, BLOCK_SIZE), 0xFFFFFFFF, np.uint32)
+    for bi in range(nb):
+        base, c, w = struct.unpack_from("<QHB", data, pos)
+        pos += 11
+        nbytes = (c * w + 7) // 8
+        if pos + nbytes > len(data):
+            raise ValueError("truncated UidPack block data")
+        offs = _bitunpack(data[pos : pos + nbytes], c, w)
+        pos += nbytes
+        bases[bi] = base
+        counts[bi] = c
+        offsets[bi, :c] = offs
+    if int(counts.sum()) != num_uids:
+        raise ValueError(
+            f"corrupt UidPack: header num_uids={num_uids} != "
+            f"sum of block counts {int(counts.sum())}"
+        )
+    return UidPack(bases=bases, counts=counts, offsets=offsets, num_uids=num_uids)
+
+
+def _bitpack(vals: np.ndarray, width: int) -> bytes:
+    """Pack uint32 values into `width`-bit little-endian lanes."""
+    if width == 0 or vals.size == 0:
+        return b""
+    v = vals.astype(np.uint64)
+    nbits = vals.size * width
+    nbytes = (nbits + 7) // 8
+    buf = np.zeros((nbytes,), np.uint8)
+    bitpos = np.arange(vals.size, dtype=np.uint64) * np.uint64(width)
+    # write each value byte-by-byte (width <= 32 so spans <= 5 bytes)
+    for byte_i in range(5):
+        byte_idx = (bitpos >> np.uint64(3)) + np.uint64(byte_i)
+        shift = (bitpos & np.uint64(7)).astype(np.uint64)
+        chunk = ((v << shift) >> np.uint64(8 * byte_i)) & np.uint64(0xFF)
+        valid = byte_idx < nbytes
+        np.bitwise_or.at(
+            buf, byte_idx[valid].astype(np.int64), chunk[valid].astype(np.uint8)
+        )
+    return buf.tobytes()
+
+
+def _bitunpack(data: bytes, count: int, width: int) -> np.ndarray:
+    if width == 0 or count == 0:
+        return np.zeros((count,), np.uint32)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    # read 8 bytes window per value via padded u64 gather
+    padded = np.zeros((buf.size + 8,), np.uint8)
+    padded[: buf.size] = buf
+    bitpos = np.arange(count, dtype=np.uint64) * np.uint64(width)
+    byte_idx = (bitpos >> np.uint64(3)).astype(np.int64)
+    shift = (bitpos & np.uint64(7)).astype(np.uint64)
+    window = np.zeros((count,), np.uint64)
+    for b in range(8):
+        window |= padded[byte_idx + b].astype(np.uint64) << np.uint64(8 * b)
+    mask = (np.uint64(1) << np.uint64(width)) - np.uint64(1)
+    return ((window >> shift) & mask).astype(np.uint32)

@@ -1,0 +1,10 @@
+from dgraph_tpu.ops.setops import (
+    membership,
+    intersect,
+    union,
+    difference,
+    merge_sorted,
+    compact,
+    pad_sorted,
+    UINT32_MAX,
+)

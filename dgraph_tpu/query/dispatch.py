@@ -1,0 +1,162 @@
+"""Batched set-op dispatcher: the device boundary of the query engine.
+
+The reference fans out one goroutine per UID-chunk per attribute
+(/root/reference/worker/task.go:816 x.DivideAndRule, query/query.go:2459
+child goroutines) and runs scalar intersect loops. Here the SubGraph
+executor *collects* every set operation of a query level and hands the whole
+batch to this dispatcher, which:
+
+  1. splits u64 operands into hi-32 segments (codec/uidpack.py) so kernels
+     run in uint32 local space,
+  2. buckets operand pairs by padded (pow2) shapes to bound XLA
+     recompilation,
+  3. runs one vmapped kernel per bucket (ops/setops.py),
+  4. falls back to numpy for tiny batches where PCIe/dispatch overhead
+     exceeds the work (the reference's CPU does a 10-vs-1M intersect in
+     ~2.4us — algo/benchmarks:45 — so small singleton ops stay host-side).
+
+This is the TPU analog of the adaptive strategy choice in
+algo/uidlist.go:142-168 (linear/jump/binary by ratio): we pick host-numpy vs
+device-batch by total work and batch width.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops import setops
+
+# Below this much total work, numpy wins (dispatch overhead dominates).
+_DEVICE_MIN_TOTAL = int(os.environ.get("DGRAPH_TPU_DEVICE_MIN_TOTAL", 1 << 15))
+_FORCE_DEVICE = os.environ.get("DGRAPH_TPU_FORCE_DEVICE", "") == "1"
+_MIN_PAD = 8
+
+
+def _pow2(n: int) -> int:
+    return max(_MIN_PAD, 1 << (max(1, n) - 1).bit_length())
+
+
+def _np_op(op: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    if op == "intersect":
+        return np.intersect1d(a, b, assume_unique=True)
+    if op == "difference":
+        return np.setdiff1d(a, b, assume_unique=True)
+    if op == "union":
+        return np.union1d(a, b)
+    raise ValueError(op)
+
+
+def _split_segments32(a: np.ndarray) -> Dict[int, np.ndarray]:
+    from dgraph_tpu.codec.uidpack import split_segments
+
+    return split_segments(a)
+
+
+class SetOpDispatcher:
+    """Batches pairwise sorted-set ops onto the device."""
+
+    def __init__(self):
+        self._jit_cache: Dict[Tuple[str, int, int], object] = {}
+
+    # -- public API ---------------------------------------------------------
+
+    def run_pairs(
+        self, op: str, pairs: Sequence[Tuple[np.ndarray, np.ndarray]]
+    ) -> List[np.ndarray]:
+        """Apply `op` to each (a, b) pair of sorted u64 arrays.
+
+        Returns sorted u64 result arrays, one per pair.
+        """
+        if not pairs:
+            return []
+        total = sum(len(a) + len(b) for a, b in pairs)
+        if not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL:
+            return [_np_op(op, a, b) for a, b in pairs]
+        return self._run_pairs_device(op, pairs)
+
+    def intersect_pairs(self, pairs):
+        return self.run_pairs("intersect", pairs)
+
+    def union_pairs(self, pairs):
+        return self.run_pairs("union", pairs)
+
+    def difference_pairs(self, pairs):
+        return self.run_pairs("difference", pairs)
+
+    # -- device path --------------------------------------------------------
+
+    def _run_pairs_device(self, op, pairs):
+        from dgraph_tpu.codec.uidpack import join_segments
+
+        # Explode u64 pairs into u32 segment sub-jobs.
+        sub: List[Tuple[int, int, np.ndarray, np.ndarray]] = []  # (pair, hi, a, b)
+        passthrough: List[Tuple[int, int, np.ndarray]] = []  # (pair, hi, lo)
+        for pi, (a, b) in enumerate(pairs):
+            sa = _split_segments32(np.asarray(a, np.uint64))
+            sb = _split_segments32(np.asarray(b, np.uint64))
+            his = set(sa) | set(sb)
+            for hi in his:
+                la, lb = sa.get(hi), sb.get(hi)
+                if la is not None and lb is not None:
+                    sub.append((pi, hi, la, lb))
+                elif la is not None and op in ("union", "difference"):
+                    passthrough.append((pi, hi, la))
+                elif lb is not None and op == "union":
+                    passthrough.append((pi, hi, lb))
+
+        # Bucket sub-jobs by padded shapes.
+        buckets: Dict[Tuple[int, int], List[int]] = {}
+        for i, (_, _, a, b) in enumerate(sub):
+            buckets.setdefault((_pow2(len(a)), _pow2(len(b))), []).append(i)
+
+        # Regroup per pair in one pass. A (pair, hi) key lands either in a
+        # device sub-job (segment present in both operands) or in
+        # passthrough (present in exactly one) — never both.
+        by_pair: List[Dict[int, np.ndarray]] = [dict() for _ in pairs]
+        for (pa, pb), idxs in buckets.items():
+            outs = self._run_bucket(op, pa, pb, [sub[i] for i in idxs])
+            for (pi, hi, _, _), res in zip((sub[i] for i in idxs), outs):
+                by_pair[pi][hi] = res
+        for pi, hi, lo in passthrough:
+            by_pair[pi][hi] = lo
+        return [join_segments(segs) for segs in by_pair]
+
+    def _get_jitted(self, op: str, pa: int, pb: int):
+        key = (op, pa, pb)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            base = {
+                "intersect": setops.intersect,
+                "difference": setops.difference,
+                "union": setops.union,
+            }[op]
+            fn = jax.jit(jax.vmap(base))
+            self._jit_cache[key] = fn
+        return fn
+
+    def _run_bucket(self, op, pa, pb, jobs):
+        n = len(jobs)
+        nb = _pow2(n)
+        A = np.full((nb, pa), setops.UINT32_MAX, np.uint32)
+        B = np.full((nb, pb), setops.UINT32_MAX, np.uint32)
+        LA = np.zeros((nb,), np.int32)
+        LB = np.zeros((nb,), np.int32)
+        for i, (_, _, a, b) in enumerate(jobs):
+            A[i, : len(a)] = a
+            B[i, : len(b)] = b
+            LA[i] = len(a)
+            LB[i] = len(b)
+        fn = self._get_jitted(op, pa, pb)
+        out, cnt = fn(jnp.asarray(A), jnp.asarray(LA), jnp.asarray(B), jnp.asarray(LB))
+        out = np.asarray(out)
+        cnt = np.asarray(cnt)
+        return [out[i, : cnt[i]] for i in range(n)]
+
+
+# Module-level singleton used by the executor.
+DISPATCHER = SetOpDispatcher()

@@ -1,0 +1,36 @@
+"""Test configuration: force a virtual 8-device CPU mesh.
+
+The real bench runs on TPU; tests exercise the same code paths on a CPU
+backend with 8 virtual devices so multi-chip sharding is validated without
+TPU hardware (mirrors the reference's docker-on-one-host integration
+strategy, /root/reference TESTING.md).
+
+The environment's sitecustomize registers a remote-TPU PJRT plugin at
+interpreter start (when PALLAS_AXON_POOL_IPS is set) and pins
+JAX_PLATFORMS=axon; every test process would then dial the TPU tunnel —
+and hang whenever the tunnel is busy or down. sitecustomize has already
+imported jax by the time conftest runs, so env vars are too late; instead
+we unregister the axon backend factory and flip the platform config to
+cpu before any backend is initialized.
+"""
+
+import os
+
+# XLA_FLAGS is read at CPU client creation (first backend init), which
+# happens after conftest — still in time to set it here.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Persistent XLA compile cache: this box is 1-core, each compile is seconds.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dgraph_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
