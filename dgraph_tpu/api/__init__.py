@@ -1,0 +1,1 @@
+from dgraph_tpu.api.server import Server, TxnHandle

@@ -1,0 +1,312 @@
+"""In-process API front-end: the edgraph.Server equivalent.
+
+Mirrors /root/reference/edgraph/server.go: Query (doQuery:1396),
+Mutate (doMutate:575), Alter (:355 schema & drop ops),
+CommitOrAbort (:2108) — single-process round 1 with the ZeroLite seam
+standing in for the Zero cluster (ref hooks/config.go ZeroHooks).
+
+Mutations accept RDF text (set/delete) or structured edges; blank nodes
+(`_:x`) get fresh uids (ref query/mutation.go:187 AssignUids). Queries run
+through dql.parse -> query.Executor -> JsonEncoder.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dgraph_tpu import dql
+from dgraph_tpu.loaders.rdf import NQuad, parse_rdf
+from dgraph_tpu.posting.lists import LocalCache, Txn
+from dgraph_tpu.posting.mutation import DirectedEdge, apply_edge, delete_entity_attr
+from dgraph_tpu.posting.pl import OP_DEL, OP_SET
+from dgraph_tpu.query.outputjson import JsonEncoder
+from dgraph_tpu.query.subgraph import Executor
+from dgraph_tpu.schema.schema import State, parse_schema
+from dgraph_tpu.storage.kv import KV, open_kv
+from dgraph_tpu.types.types import TypeID, Val
+from dgraph_tpu.x import keys
+from dgraph_tpu.zero.zero import TxnConflictError, ZeroLite
+
+
+class TxnHandle:
+    """Client-side transaction handle (dgo Txn equivalent)."""
+
+    def __init__(self, server: "Server", read_only: bool = False):
+        self.server = server
+        self.start_ts = server.zero.next_ts()
+        self.txn = Txn(server.kv, self.start_ts)
+        self.read_only = read_only
+        self.finished = False
+
+    def query(self, q: str) -> dict:
+        return self.server._query(q, self.txn.cache)
+
+    def mutate_rdf(
+        self, set_rdf: str = "", del_rdf: str = "", commit_now: bool = False
+    ) -> Dict[str, str]:
+        uids = self.server._apply_rdf(self.txn, set_rdf, del_rdf)
+        if commit_now:
+            self.commit()
+        return uids
+
+    def mutate_json(self, set_obj=None, del_obj=None, commit_now: bool = False):
+        uids = self.server._apply_json(self.txn, set_obj, del_obj)
+        if commit_now:
+            self.commit()
+        return uids
+
+    def commit(self) -> int:
+        if self.finished:
+            raise RuntimeError("transaction already finished")
+        self.finished = True
+        return self.server._commit(self.txn)
+
+    def discard(self):
+        self.finished = True
+        self.server.zero.abort(self.start_ts)
+
+
+class Server:
+    """Single-node engine (Alpha + embedded Zero-lite)."""
+
+    def __init__(self, data_dir: Optional[str] = None):
+        self.kv: KV = open_kv(data_dir)
+        self.zero = ZeroLite()
+        self.schema = State()
+        self.vector_indexes: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._bootstrap_schema()
+
+    def _bootstrap_schema(self):
+        # system predicates (ref schema/schema.go initialSchema)
+        for su in parse_schema(
+            "dgraph.type: [string] @index(exact) .\n"
+            "dgraph.xid: string @index(exact) .\n"
+        )[0]:
+            self.schema.set(su)
+
+    # -- alter (ref edgraph/server.go:355) -----------------------------------
+
+    def alter(self, schema_text: str = "", drop_attr: str = "", drop_all: bool = False):
+        with self._lock:
+            if drop_all:
+                ts = self.zero.next_ts()
+                for pred in self.schema.predicates():
+                    self.kv.drop_prefix(keys.PredicatePrefix(pred))
+                self.schema = State()
+                self._bootstrap_schema()
+                self.vector_indexes.clear()
+                return
+            if drop_attr:
+                self.kv.drop_prefix(keys.PredicatePrefix(drop_attr))
+                self.schema.delete(drop_attr)
+                self.vector_indexes.pop(drop_attr, None)
+                return
+            preds, types = parse_schema(schema_text)
+            for su in preds:
+                old = self.schema.get(su.predicate)
+                self.schema.set(su)
+                if su.vector_specs:
+                    self._ensure_vector_index(su)
+                if old is not None and (
+                    old.tokenizers != su.tokenizers
+                ):
+                    self._reindex(su)
+            for tu in types:
+                self.schema.set_type(tu)
+
+    def _ensure_vector_index(self, su):
+        from dgraph_tpu.models.vector import VectorIndex
+
+        if su.predicate not in self.vector_indexes:
+            self.vector_indexes[su.predicate] = VectorIndex(
+                pred=su.predicate,
+                metric=su.vector_specs[0].metric,
+            )
+
+    def _reindex(self, su):
+        """Full index rebuild for a predicate (ref posting/index.go:1115
+        IndexRebuild): drop index range, re-tokenize all values."""
+        pred = su.predicate
+        self.kv.drop_prefix(keys.IndexPrefix(pred))
+        ts = self.zero.next_ts()
+        read = LocalCache(self.kv, ts)
+        from dgraph_tpu.posting.pl import Posting
+        from dgraph_tpu.tok.tok import build_tokens
+
+        tokenizers = su.tokenizer_objs()
+        if not tokenizers:
+            return
+        writes = []
+        for k, _, _ in self.kv.iterate(keys.DataPrefix(pred), ts):
+            pk = keys.parse_key(k)
+            for p in read.values(k):
+                for tokb in build_tokens(p.val(), tokenizers):
+                    ikey = keys.IndexKey(pred, tokb)
+                    from dgraph_tpu.posting.pl import encode_delta
+
+                    writes.append((ikey, ts, encode_delta([Posting(uid=pk.uid, op=OP_SET)])))
+        self.kv.put_batch(writes)
+
+    # -- transactions ---------------------------------------------------------
+
+    def new_txn(self, read_only: bool = False) -> TxnHandle:
+        return TxnHandle(self, read_only)
+
+    def _commit(self, txn: Txn) -> int:
+        commit_ts = self.zero.commit(txn.start_ts, txn.conflict_keys)
+        txn.write_deltas(self.kv, commit_ts)
+        # vector index ingestion at commit (factory seam)
+        for key, posts in txn.cache.deltas.items():
+            pk = keys.parse_key(key)
+            vidx = self.vector_indexes.get(pk.attr)
+            if vidx is not None and pk.is_data:
+                for p in posts:
+                    if p.is_value and p.op == OP_SET:
+                        vidx.insert(pk.uid, p.val().value)
+                    elif p.op == OP_DEL:
+                        vidx.remove(pk.uid)
+        return commit_ts
+
+    # -- mutations -------------------------------------------------------------
+
+    def _apply_rdf(self, txn: Txn, set_rdf: str, del_rdf: str) -> Dict[str, str]:
+        blank: Dict[str, int] = {}
+
+        def resolve(ref: str) -> int:
+            if ref.startswith("_:"):
+                if ref not in blank:
+                    blank[ref] = self.zero.assign_uids(1)
+                return blank[ref]
+            if ref.startswith("0x"):
+                return int(ref, 16)
+            return int(ref)
+
+        for nq in parse_rdf(set_rdf):
+            self._apply_nquad(txn, nq, resolve, OP_SET)
+        for nq in parse_rdf(del_rdf):
+            self._apply_nquad(txn, nq, resolve, OP_DEL)
+        return {k[2:]: hex(v) for k, v in blank.items()}
+
+    def _apply_nquad(self, txn: Txn, nq: NQuad, resolve, op: int):
+        subj = resolve(nq.subject)
+        if nq.star:
+            if op != OP_DEL:
+                raise ValueError("S P * only valid in delete")
+            delete_entity_attr(txn, self.schema, subj, nq.predicate)
+            return
+        if nq.object_id:
+            edge = DirectedEdge(
+                subj,
+                nq.predicate,
+                value_id=resolve(nq.object_id),
+                facets=nq.facets,
+                op=op,
+            )
+        else:
+            edge = DirectedEdge(
+                subj,
+                nq.predicate,
+                value=nq.object_value,
+                lang=nq.lang,
+                facets=nq.facets,
+                op=op,
+            )
+        apply_edge(txn, self.schema, edge)
+
+    def _apply_json(self, txn: Txn, set_obj, del_obj) -> Dict[str, str]:
+        """JSON mutation format (ref chunker/json_parser.go): nested objects
+        with "uid" refs; blank nodes via "_:name"."""
+        blank: Dict[str, int] = {}
+
+        def resolve(ref) -> int:
+            if isinstance(ref, int):
+                return ref
+            if ref.startswith("_:"):
+                if ref not in blank:
+                    blank[ref] = self.zero.assign_uids(1)
+                return blank[ref]
+            return int(ref, 16) if ref.startswith("0x") else int(ref)
+
+        def walk(obj, op) -> int:
+            uid = resolve(obj.get("uid", f"_:auto{id(obj)}"))
+            for k, v in obj.items():
+                if k == "uid":
+                    continue
+                if k == "dgraph.type":
+                    vs = v if isinstance(v, list) else [v]
+                    for t in vs:
+                        apply_edge(
+                            txn,
+                            self.schema,
+                            DirectedEdge(
+                                uid, "dgraph.type",
+                                value=Val(TypeID.STRING, t), op=op,
+                            ),
+                        )
+                    continue
+                lang = ""
+                pred = k
+                if "@" in k:
+                    pred, lang = k.split("@", 1)
+                vs = v if isinstance(v, list) else [v]
+                for item in vs:
+                    if isinstance(item, dict):
+                        child = walk(item, op)
+                        apply_edge(
+                            txn,
+                            self.schema,
+                            DirectedEdge(uid, pred, value_id=child, op=op),
+                        )
+                    else:
+                        val = _json_to_val(item)
+                        apply_edge(
+                            txn,
+                            self.schema,
+                            DirectedEdge(uid, pred, value=val, lang=lang, op=op),
+                        )
+            return uid
+
+        for obj in _as_list(set_obj):
+            walk(obj, OP_SET)
+        for obj in _as_list(del_obj):
+            walk(obj, OP_DEL)
+        return {k[2:]: hex(v) for k, v in blank.items()}
+
+    # -- queries ----------------------------------------------------------------
+
+    def query(self, q: str, read_ts: Optional[int] = None) -> dict:
+        """Run a read-only query at a fresh (or given) read ts."""
+        ts = read_ts if read_ts is not None else self.zero.read_ts()
+        return self._query(q, LocalCache(self.kv, ts))
+
+    def _query(self, q: str, cache: LocalCache) -> dict:
+        blocks = dql.parse(q)
+        ex = Executor(
+            cache, self.schema, vector_indexes=self.vector_indexes
+        )
+        nodes = ex.process(blocks)
+        enc = JsonEncoder(val_vars=ex.val_vars)
+        return {"data": enc.encode_blocks(nodes)}
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return x if isinstance(x, list) else [x]
+
+
+def _json_to_val(item) -> Val:
+    if isinstance(item, bool):
+        return Val(TypeID.BOOL, item)
+    if isinstance(item, int):
+        return Val(TypeID.INT, item)
+    if isinstance(item, float):
+        return Val(TypeID.FLOAT, item)
+    if isinstance(item, list):
+        return Val(TypeID.VFLOAT, np.asarray(item, dtype=np.float32))
+    return Val(TypeID.STRING, str(item))

@@ -176,6 +176,9 @@ def deserialize(data: bytes) -> UidPack:
     if data[:4] != _MAGIC:
         raise ValueError("bad UidPack magic")
     num_uids, nb = struct.unpack_from("<QI", data, 4)
+    # bound-check untrusted header before allocating (disk/wire input)
+    if nb * 11 + 16 > len(data):
+        raise ValueError(f"corrupt UidPack: {nb} blocks exceeds data size")
     pos = 4 + 12
     bases = np.zeros((nb,), np.uint64)
     counts = np.zeros((nb,), np.int32)
@@ -183,6 +186,10 @@ def deserialize(data: bytes) -> UidPack:
     for bi in range(nb):
         base, c, w = struct.unpack_from("<QHB", data, pos)
         pos += 11
+        if c > BLOCK_SIZE or w > 32:
+            raise ValueError(
+                f"corrupt UidPack block: count={c} width={w}"
+            )
         nbytes = (c * w + 7) // 8
         if pos + nbytes > len(data):
             raise ValueError("truncated UidPack block data")

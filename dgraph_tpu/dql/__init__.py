@@ -1,0 +1,1 @@
+from dgraph_tpu.dql.parser import parse, GraphQuery, FilterTree, FuncSpec, ParseError

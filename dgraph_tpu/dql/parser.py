@@ -1,0 +1,584 @@
+"""DQL parser: query text -> GraphQuery AST.
+
+Hand-rolled tokenizer + recursive descent mirroring the grammar of
+/root/reference/dql/parser.go (states in dql/state.go, lexer lex/lexer.go).
+Covers the core read grammar:
+
+  { name: blockName(func: f(...), first: N, offset: N, after: uid,
+                    orderasc: pred | orderdesc: pred)
+      @filter(tree of f(...) AND/OR/NOT, parens)
+      @recurse(depth: N, loop: false)
+      @cascade
+    { alias: pred @filter(...) (first/offset/orderasc...) { ... }
+      uid | expand(_all_) | count(pred) | count(uid)
+      v as pred         # value/uid variables
+      val(v) | min(val(v)) | max(val(v)) | sum(val(v)) | avg(val(v))
+      shortest(from:, to:, numpaths:) blocks
+    } }
+
+Root funcs (ref dql/parser.go:1884 similar_to incl. options;
+worker/task.go:230 parseFuncType): eq, le, lt, ge, gt, between, has, uid,
+uid_in, type, anyofterms, allofterms, anyoftext, alloftext, regexp, match,
+similar_to, near, within, alloftermsfacets... (geo near/within take
+coordinates).
+
+Variables: `uid` vars (`x as friend`) and value vars (`a as age`), consumed
+by uid(x)/val(a) — dependency ordering handled by the executor
+(ref query/query.go:2899 canExecute).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from dgraph_tpu.types.types import TypeID, Val
+
+
+class ParseError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|\#[^\n]*)
+  | (?P<string>"(?:\\.|[^"\\])*")
+  | (?P<regex>/(?:\\.|[^/\\])+/[i]?)
+  | (?P<num>0x[0-9a-fA-F]+|-?\d+\.\d+|-?\d+)
+  | (?P<name>~?[a-zA-Z_][\w.\-~]*|<[^>]+>|\$[a-zA-Z_]\w*)
+  | (?P<punct>@|\(|\)|\{|\}|\[|\]|:|,|=|\*)
+""",
+    re.VERBOSE,
+)
+
+
+@dataclass
+class Tok:
+    kind: str
+    text: str
+    pos: int
+
+
+def tokenize(s: str) -> List[Tok]:
+    out: List[Tok] = []
+    pos = 0
+    n = len(s)
+    while pos < n:
+        m = _TOKEN_RE.match(s, pos)
+        if not m:
+            raise ParseError(f"unexpected character {s[pos]!r} at {pos}")
+        kind = m.lastgroup
+        if kind != "ws":
+            out.append(Tok(kind, m.group(), pos))
+        pos = m.end()
+    out.append(Tok("eof", "", n))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FuncSpec:
+    """A function application: name, attr, args (ref dql Function)."""
+
+    name: str
+    attr: str = ""
+    lang: str = ""
+    args: List[Any] = field(default_factory=list)
+    # named options for similar_to etc (ref parser.go:1884-1990)
+    options: Dict[str, Any] = field(default_factory=dict)
+    uid_var: str = ""  # for uid(x)
+    val_var: str = ""  # for eq(val(x), ...)
+
+
+@dataclass
+class FilterTree:
+    """AND/OR/NOT tree over FuncSpecs (ref dql FilterTree)."""
+
+    op: str = ""  # "and" | "or" | "not" | "" (leaf)
+    children: List["FilterTree"] = field(default_factory=list)
+    func: Optional[FuncSpec] = None
+
+
+@dataclass
+class Order:
+    attr: str
+    desc: bool = False
+    lang: str = ""
+    val_var: str = ""
+
+
+@dataclass
+class GraphQuery:
+    """One query block or child attribute (ref dql.GraphQuery)."""
+
+    attr: str = ""  # predicate (children) or block name (roots)
+    alias: str = ""
+    func: Optional[FuncSpec] = None
+    filter: Optional[FilterTree] = None
+    children: List["GraphQuery"] = field(default_factory=list)
+    # pagination / order
+    first: Optional[int] = None
+    offset: Optional[int] = None
+    after: Optional[int] = None
+    order: List[Order] = field(default_factory=list)
+    # variables
+    var_name: str = ""  # `x as pred`
+    is_var_block: bool = False  # root declared with `var(func:...)`
+    # aggregation/count/val
+    is_count: bool = False
+    is_uid: bool = False  # `uid` leaf
+    aggregator: str = ""  # min/max/sum/avg
+    val_var: str = ""  # val(x) read
+    expand: str = ""  # expand(_all_) / expand(TypeName)
+    # directives
+    cascade: bool = False
+    recurse: bool = False
+    recurse_depth: int = 0
+    recurse_loop: bool = False
+    normalize: bool = False
+    # facets
+    facets: bool = False
+    facet_names: List[str] = field(default_factory=list)
+    facet_order: str = ""
+    facet_order_desc: bool = False
+    # lang tag on predicate: name@en
+    lang: str = ""
+    # shortest-path args
+    shortest_from: Optional[Any] = None
+    shortest_to: Optional[Any] = None
+    num_paths: int = 1
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+
+class _P:
+    def __init__(self, toks: List[Tok], text: str):
+        self.toks = toks
+        self.i = 0
+        self.text = text
+
+    def peek(self) -> Tok:
+        return self.toks[self.i]
+
+    def next(self) -> Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, text: str) -> Tok:
+        t = self.next()
+        if t.text != text:
+            raise ParseError(f"expected {text!r}, got {t.text!r} at {t.pos}")
+        return t
+
+    def accept(self, text: str) -> bool:
+        if self.peek().text == text:
+            self.i += 1
+            return True
+        return False
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    return re.sub(
+        r"\\(.)",
+        lambda m: {"n": "\n", "t": "\t", '"': '"', "\\": "\\"}.get(
+            m.group(1), m.group(1)
+        ),
+        body,
+    )
+
+
+def _strip_angle(s: str) -> str:
+    return s[1:-1] if s.startswith("<") else s
+
+
+def _parse_value(t: Tok):
+    if t.kind == "regex":
+        # /pattern/flags -> ("regex", pattern, flags)
+        end = t.text.rindex("/")
+        return ("regex", t.text[1:end], t.text[end + 1 :])
+    if t.kind == "string":
+        return _unquote(t.text)
+    if t.kind == "num":
+        if t.text.startswith("0x"):
+            return int(t.text, 16)
+        if "." in t.text:
+            return float(t.text)
+        return int(t.text)
+    if t.text in ("true", "false"):
+        return t.text == "true"
+    if t.kind == "name":
+        return t.text
+    if t.text == "*":
+        return "*"
+    raise ParseError(f"unexpected value token {t.text!r} at {t.pos}")
+
+
+def _parse_name_with_lang(p: _P) -> tuple[str, str]:
+    name = _strip_angle(p.next().text)
+    lang = ""
+    if p.peek().text == "@" and p.toks[p.i + 1].kind == "name":
+        # name@en  (no whitespace semantics enforced; lexer-level in ref)
+        p.next()
+        lang = p.next().text
+    return name, lang
+
+
+def parse_func(p: _P) -> FuncSpec:
+    name = p.next().text.lower()
+    p.expect("(")
+    fn = FuncSpec(name=name)
+    if name == "uid":
+        # uid(0x1, 0x2) or uid(varname)
+        args = []
+        while p.peek().text != ")":
+            t = p.next()
+            if t.kind == "num":
+                args.append(int(t.text, 16) if t.text.startswith("0x") else int(t.text))
+            elif t.kind == "name":
+                fn.uid_var = t.text
+            p.accept(",")
+        p.expect(")")
+        fn.args = args
+        return fn
+    if name == "uid_in":
+        attr, lang = _parse_name_with_lang(p)
+        fn.attr, fn.lang = attr, lang
+        p.expect(",")
+        while p.peek().text != ")":
+            t = p.next()
+            if t.kind == "num":
+                fn.args.append(
+                    int(t.text, 16) if t.text.startswith("0x") else int(t.text)
+                )
+            elif t.text == "uid":
+                p.expect("(")
+                fn.uid_var = p.next().text
+                p.expect(")")
+            p.accept(",")
+        p.expect(")")
+        return fn
+
+    # first arg: attr, val(x), or type name
+    if p.peek().text == "val":
+        p.next()
+        p.expect("(")
+        fn.val_var = p.next().text
+        p.expect(")")
+    else:
+        fn.attr, fn.lang = _parse_name_with_lang(p)
+
+    while p.accept(","):
+        # named option? name: value (similar_to opts, between second arg...)
+        t = p.peek()
+        if (
+            t.kind == "name"
+            and self_is_option(p)
+        ):
+            key = p.next().text
+            p.expect(":")
+            fn.options[key] = _parse_value(p.next())
+            continue
+        if t.text == "[":
+            fn.args.append(_parse_list(p))
+            continue
+        if t.text == "$":
+            raise ParseError("GraphQL variables not yet supported")
+        fn.args.append(_parse_value(p.next()))
+    p.expect(")")
+    return fn
+
+
+def self_is_option(p: _P) -> bool:
+    # lookahead: name ':' value  (but not 'val(' etc.)
+    return (
+        p.toks[p.i + 1].text == ":"
+        if p.i + 1 < len(p.toks)
+        else False
+    )
+
+
+def _parse_list(p: _P) -> list:
+    p.expect("[")
+    out = []
+    while p.peek().text != "]":
+        out.append(_parse_value(p.next()))
+        p.accept(",")
+    p.expect("]")
+    return out
+
+
+def parse_filter(p: _P) -> FilterTree:
+    """@filter( tree )  with AND/OR/NOT and parens."""
+    p.expect("(")
+    tree = _parse_or(p)
+    p.expect(")")
+    return tree
+
+
+def _parse_or(p: _P) -> FilterTree:
+    left = _parse_and(p)
+    while p.peek().text.upper() == "OR":
+        p.next()
+        right = _parse_and(p)
+        if left.op == "or":
+            left.children.append(right)
+        else:
+            left = FilterTree(op="or", children=[left, right])
+    return left
+
+
+def _parse_and(p: _P) -> FilterTree:
+    left = _parse_unary(p)
+    while p.peek().text.upper() == "AND":
+        p.next()
+        right = _parse_unary(p)
+        if left.op == "and":
+            left.children.append(right)
+        else:
+            left = FilterTree(op="and", children=[left, right])
+    return left
+
+
+def _parse_unary(p: _P) -> FilterTree:
+    if p.peek().text.upper() == "NOT":
+        p.next()
+        return FilterTree(op="not", children=[_parse_unary(p)])
+    if p.accept("("):
+        t = _parse_or(p)
+        p.expect(")")
+        return t
+    fn = parse_func(p)
+    return FilterTree(func=fn)
+
+
+_PAGINATION_ARGS = ("first", "offset", "after", "orderasc", "orderdesc", "depth", "loop")
+
+
+def _parse_args_into(p: _P, gq: GraphQuery, stop: str = ")"):
+    """Parse `first: N, offset: N, orderasc: pred, ...` until `stop`."""
+    while p.peek().text != stop:
+        key = p.next().text
+        p.expect(":")
+        if key in ("first", "offset"):
+            setattr(gq, key, int(p.next().text))
+        elif key == "after":
+            t = p.next().text
+            gq.after = int(t, 16) if t.startswith("0x") else int(t)
+        elif key in ("orderasc", "orderdesc"):
+            if p.peek().text == "val":
+                p.next()
+                p.expect("(")
+                var = p.next().text
+                p.expect(")")
+                gq.order.append(
+                    Order(attr="", desc=key == "orderdesc", val_var=var)
+                )
+            else:
+                attr, lang = _parse_name_with_lang(p)
+                gq.order.append(
+                    Order(attr=attr, desc=key == "orderdesc", lang=lang)
+                )
+        elif key == "func":
+            gq.func = parse_func(p)
+        elif key == "from":
+            gq.shortest_from = _parse_uid_or_var(p)
+        elif key == "to":
+            gq.shortest_to = _parse_uid_or_var(p)
+        elif key == "numpaths":
+            gq.num_paths = int(p.next().text)
+        elif key == "depth":
+            gq.recurse_depth = int(p.next().text)
+        elif key == "loop":
+            gq.recurse_loop = p.next().text == "true"
+        else:
+            raise ParseError(f"unknown query arg {key!r}")
+        p.accept(",")
+    p.expect(stop)
+
+
+def _parse_uid_or_var(p: _P):
+    t = p.next()
+    if t.kind == "num":
+        return int(t.text, 16) if t.text.startswith("0x") else int(t.text)
+    if t.text == "uid":
+        p.expect("(")
+        v = p.next().text
+        p.expect(")")
+        return ("var", v)
+    return ("var", t.text)
+
+
+def _parse_directives(p: _P, gq: GraphQuery):
+    while p.peek().text == "@":
+        p.next()
+        d = p.next().text
+        if d == "filter":
+            gq.filter = parse_filter(p)
+        elif d == "cascade":
+            gq.cascade = True
+        elif d == "normalize":
+            gq.normalize = True
+        elif d == "recurse":
+            gq.recurse = True
+            if p.accept("("):
+                _parse_args_into(p, gq, stop=")")
+        elif d == "facets":
+            gq.facets = True
+            if p.accept("("):
+                while p.peek().text != ")":
+                    t = p.next()
+                    if t.text in ("orderasc", "orderdesc"):
+                        p.expect(":")
+                        gq.facet_order = p.next().text
+                        gq.facet_order_desc = t.text == "orderdesc"
+                    else:
+                        gq.facet_names.append(t.text)
+                    p.accept(",")
+                p.expect(")")
+        else:
+            raise ParseError(f"unknown directive @{d}")
+
+
+def parse_selection_set(p: _P, gq: GraphQuery):
+    p.expect("{")
+    while not p.accept("}"):
+        gq.children.append(parse_child(p))
+
+
+def parse_child(p: _P) -> GraphQuery:
+    gq = GraphQuery()
+    t = p.next()
+    name = _strip_angle(t.text)
+
+    # `x as pred` variable definition
+    if p.peek().text == "as":
+        p.next()
+        gq.var_name = name
+        t2 = p.next()
+        name = _strip_angle(t2.text)
+
+    # alias: `alias: pred`
+    if p.peek().text == ":" and name not in ("count",):
+        p.next()
+        gq.alias = name
+        name = _strip_angle(p.next().text)
+
+    if name == "count":
+        p.expect("(")
+        inner = _strip_angle(p.next().text)
+        if inner == "uid":
+            gq.attr = "uid"
+            gq.is_count = True
+        else:
+            gq.attr = inner
+            gq.is_count = True
+            if p.peek().text == "@":  # count(pred @filter(...)) unsupported
+                raise ParseError("filter inside count() not supported")
+        p.expect(")")
+        return gq
+
+    if name in ("min", "max", "sum", "avg"):
+        p.expect("(")
+        p.expect("val")
+        p.expect("(")
+        gq.val_var = p.next().text
+        p.expect(")")
+        p.expect(")")
+        gq.aggregator = name
+        return gq
+
+    if name == "val":
+        p.expect("(")
+        gq.val_var = p.next().text
+        p.expect(")")
+        gq.attr = "val"
+        return gq
+
+    if name == "uid":
+        gq.is_uid = True
+        gq.attr = "uid"
+        return gq
+
+    if name == "expand":
+        p.expect("(")
+        gq.expand = p.next().text
+        p.expect(")")
+        gq.attr = "expand"
+        if p.peek().text == "{":
+            parse_selection_set(p, gq)
+        return gq
+
+    gq.attr = name
+    # lang tag
+    if p.peek().text == "@" and p.toks[p.i + 1].kind == "name" and p.toks[p.i + 1].text not in ("filter", "facets", "cascade", "normalize", "recurse"):
+        p.next()
+        gq.lang = p.next().text
+
+    # (first: N, ...) argument list
+    if p.accept("("):
+        _parse_args_into(p, gq, stop=")")
+
+    _parse_directives(p, gq)
+
+    if p.peek().text == "{":
+        parse_selection_set(p, gq)
+    return gq
+
+
+def parse_query_block(p: _P) -> GraphQuery:
+    gq = GraphQuery()
+    t = p.next()
+    name = t.text
+
+    # `x as var(func: ...)` or `name as shortest(...)`?
+    if p.peek().text == "as":
+        p.next()
+        gq.var_name = name
+        name = p.next().text
+
+    gq.attr = name
+    if name == "var":
+        gq.is_var_block = True
+    if p.peek().text == ":" :
+        # block alias `q: something(...)` — treat name as alias
+        p.next()
+        gq.alias = name
+        gq.attr = p.next().text
+
+    if gq.attr == "shortest":
+        p.expect("(")
+        _parse_args_into(p, gq, stop=")")
+        parse_selection_set(p, gq)
+        return gq
+
+    p.expect("(")
+    _parse_args_into(p, gq, stop=")")
+    _parse_directives(p, gq)
+    parse_selection_set(p, gq)
+    return gq
+
+
+def parse(text: str) -> List[GraphQuery]:
+    """Parse a DQL read query -> list of root blocks."""
+    p = _P(tokenize(text), text)
+    p.expect("{")
+    blocks: List[GraphQuery] = []
+    while not p.accept("}"):
+        blocks.append(parse_query_block(p))
+    if p.peek().kind != "eof":
+        raise ParseError(f"trailing input at {p.peek().pos}")
+    return blocks

@@ -1,0 +1,1 @@
+from dgraph_tpu.loaders.rdf import parse_rdf, NQuad

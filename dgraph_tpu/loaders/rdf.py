@@ -1,0 +1,160 @@
+"""RDF N-Quad parser (mirrors /root/reference/chunker/rdf_parser.go).
+
+Supports the dgraph RDF dialect:
+  <0x1> <name> "Alice"@en .
+  _:blank <friend> <0x2> (since=2006-01-02T15:04:05, weight=0.5) .
+  <0x1> <age> "25"^^<xs:int> .
+  uid(v) <pred> val(w) .           # upsert references (handled upstream)
+  <0x1> <name> * .                 # delete-all-values
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dgraph_tpu.types.types import TypeID, Val, parse_datetime
+
+
+@dataclass
+class NQuad:
+    subject: str  # "0x1" | "_:b" | "uid(v)"
+    predicate: str
+    object_id: str = ""  # uid ref if edge
+    object_value: Optional[Val] = None
+    lang: str = ""
+    facets: Dict[str, Val] = field(default_factory=dict)
+    star: bool = False  # object is *
+
+
+_XSD_TYPES = {
+    "xs:int": TypeID.INT,
+    "xs:integer": TypeID.INT,
+    "xs:positiveInteger": TypeID.INT,
+    "xs:float": TypeID.FLOAT,
+    "xs:double": TypeID.FLOAT,
+    "xs:string": TypeID.STRING,
+    "xs:boolean": TypeID.BOOL,
+    "xs:dateTime": TypeID.DATETIME,
+    "xs:date": TypeID.DATETIME,
+    "geo:geojson": TypeID.GEO,
+    "xs:password": TypeID.PASSWORD,
+    "http://www.w3.org/2001/XMLSchema#int": TypeID.INT,
+    "http://www.w3.org/2001/XMLSchema#integer": TypeID.INT,
+    "http://www.w3.org/2001/XMLSchema#float": TypeID.FLOAT,
+    "http://www.w3.org/2001/XMLSchema#double": TypeID.FLOAT,
+    "http://www.w3.org/2001/XMLSchema#string": TypeID.STRING,
+    "http://www.w3.org/2001/XMLSchema#boolean": TypeID.BOOL,
+    "http://www.w3.org/2001/XMLSchema#dateTime": TypeID.DATETIME,
+    "float32vector": TypeID.VFLOAT,
+}
+
+_LINE_RE = re.compile(
+    r"""^\s*
+    (?P<subj><[^>]+>|_:[\w.\-]+|uid\(\w+\))\s+
+    (?P<pred><[^>]+>|[\w.~\-]+)\s+
+    (?P<obj>
+        <[^>]+>
+      | _:[\w.\-]+
+      | "(?:\\.|[^"\\])*"(?:@(?P<lang>[\w\-]+)|\^\^<(?P<dtype>[^>]+)>)?
+      | uid\(\w+\)
+      | val\(\w+\)
+      | \*
+    )
+    (?:\s+\((?P<facets>[^)]*)\))?
+    \s*\.\s*(?:\#.*)?$""",
+    re.VERBOSE,
+)
+
+
+def _strip(s: str) -> str:
+    return s[1:-1] if s.startswith("<") else s
+
+
+def _unquote(s: str) -> str:
+    return re.sub(
+        r"\\(.)",
+        lambda m: {"n": "\n", "t": "\t", '"': '"', "\\": "\\"}.get(
+            m.group(1), m.group(1)
+        ),
+        s[1:-1],
+    )
+
+
+def _facet_val(raw: str) -> Val:
+    raw = raw.strip()
+    if raw.startswith('"') and raw.endswith('"'):
+        return Val(TypeID.STRING, raw[1:-1])
+    if raw in ("true", "false"):
+        return Val(TypeID.BOOL, raw == "true")
+    try:
+        return Val(TypeID.INT, int(raw))
+    except ValueError:
+        pass
+    try:
+        return Val(TypeID.FLOAT, float(raw))
+    except ValueError:
+        pass
+    try:
+        return Val(TypeID.DATETIME, parse_datetime(raw))
+    except ValueError:
+        pass
+    return Val(TypeID.STRING, raw)
+
+
+def parse_nquad(line: str) -> Optional[NQuad]:
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    m = _LINE_RE.match(line)
+    if not m:
+        raise ValueError(f"bad N-Quad: {line!r}")
+    subj = _strip(m.group("subj"))
+    pred = _strip(m.group("pred"))
+    obj = m.group("obj")
+    nq = NQuad(subject=subj, predicate=pred)
+    if m.group("facets"):
+        for part in m.group("facets").split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                nq.facets[k.strip()] = _facet_val(v)
+    if obj == "*":
+        nq.star = True
+        return nq
+    if obj.startswith("<") or obj.startswith("_:") or obj.startswith("uid("):
+        nq.object_id = _strip(obj)
+        return nq
+    if obj.startswith("val("):
+        nq.object_id = obj
+        return nq
+    # literal
+    lang = m.group("lang") or ""
+    dtype = m.group("dtype")
+    raw = _unquote(obj[: obj.rindex('"') + 1])
+    if dtype:
+        tid = _XSD_TYPES.get(dtype, TypeID.STRING)
+        sval = Val(TypeID.STRING, raw)
+        if tid == TypeID.VFLOAT:
+            from dgraph_tpu.types.types import convert
+
+            nq.object_value = convert(sval, TypeID.VFLOAT)
+        elif tid == TypeID.STRING:
+            nq.object_value = sval
+        else:
+            from dgraph_tpu.types.types import convert
+
+            nq.object_value = convert(sval, tid)
+    else:
+        nq.object_value = Val(TypeID.DEFAULT, raw)
+    nq.lang = lang
+    return nq
+
+
+def parse_rdf(text: str) -> List[NQuad]:
+    out = []
+    for line in text.split("\n"):
+        nq = parse_nquad(line)
+        if nq is not None:
+            out.append(nq)
+    return out

@@ -1,0 +1,1 @@
+from dgraph_tpu.models.vector import VectorIndex

@@ -1,0 +1,2 @@
+from dgraph_tpu.posting.pl import Posting, PostingList, OP_SET, OP_DEL, VALUE_UID
+from dgraph_tpu.posting.lists import LocalCache, Txn

@@ -1,0 +1,80 @@
+"""Transaction-local posting cache + Txn object.
+
+Mirrors /root/reference/posting/lists.go:63 LocalCache (per-txn view that
+layers uncommitted deltas over the store) and posting/oracle.go:40 Txn.
+Commit writes one delta record per touched key at the commit ts
+(ref posting/mvcc.go:266 CommitToDisk).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dgraph_tpu.posting.pl import (
+    Posting,
+    PostingList,
+    encode_delta,
+    fingerprint64,
+)
+from dgraph_tpu.storage.kv import KV
+
+
+class LocalCache:
+    """Per-txn read-through cache with uncommitted delta overlay."""
+
+    def __init__(self, kv: KV, read_ts: int):
+        self.kv = kv
+        self.read_ts = read_ts
+        self._plists: Dict[bytes, PostingList] = {}
+        self.deltas: Dict[bytes, List[Posting]] = {}
+
+    def get(self, key: bytes) -> PostingList:
+        pl = self._plists.get(key)
+        if pl is None:
+            versions = self.kv.versions(key, self.read_ts)
+            pl = PostingList.from_versions(key, versions)
+            self._plists[key] = pl
+        return pl
+
+    # -- reads (uncommitted deltas visible to this txn) ----------------------
+
+    def uids(self, key: bytes) -> np.ndarray:
+        return self.get(key).uids(self.deltas.get(key))
+
+    def value(self, key: bytes, lang: str = ""):
+        return self.get(key).get_value(lang, self.deltas.get(key))
+
+    def values(self, key: bytes) -> List[Posting]:
+        return self.get(key).get_all_values(self.deltas.get(key))
+
+    def has(self, key: bytes) -> bool:
+        return not self.get(key).is_empty(self.deltas.get(key))
+
+    # -- writes --------------------------------------------------------------
+
+    def add_delta(self, key: bytes, p: Posting):
+        self.deltas.setdefault(key, []).append(p)
+
+
+class Txn:
+    """A read-write transaction (ref posting/oracle.go:40 Txn)."""
+
+    def __init__(self, kv: KV, start_ts: int):
+        self.start_ts = start_ts
+        self.cache = LocalCache(kv, start_ts)
+        self.conflict_keys: set[int] = set()
+        self.committed = False
+        self.aborted = False
+
+    def add_conflict_key(self, key: bytes, extra: bytes = b""):
+        """Fingerprint written keys for oracle conflict detection
+        (ref posting/list.go:842 GetConflictKey)."""
+        self.conflict_keys.add(fingerprint64(key + b"|" + extra))
+
+    def write_deltas(self, kv: KV, commit_ts: int):
+        """Persist all pending deltas at commit_ts (CommitToDisk)."""
+        for key, posts in self.cache.deltas.items():
+            if posts:
+                kv.put(key, commit_ts, encode_delta(posts))

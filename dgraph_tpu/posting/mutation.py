@@ -1,0 +1,223 @@
+"""Edge application with index maintenance: the mutation write path.
+
+Mirrors /root/reference/posting/index.go: AddMutationWithIndex (:585) —
+apply a DirectedEdge to the data key, and maintain the index keys
+(addIndexMutations :84), reverse edges (:276), and count index (:431)
+according to the predicate's schema.
+
+An edge is (entity uid, attr, value_id target | typed value, lang, facets,
+op). Value changes first delete the old value's index tokens, then insert
+the new ones (ref index.go:497 addMutationHelper's current-value read).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from dgraph_tpu.posting.lists import LocalCache, Txn
+from dgraph_tpu.posting.pl import (
+    OP_DEL,
+    OP_SET,
+    Posting,
+    lang_uid,
+    value_uid,
+)
+from dgraph_tpu.schema.schema import SchemaUpdate, State
+from dgraph_tpu.tok.tok import build_tokens
+from dgraph_tpu.types.types import TypeID, Val, convert, to_binary
+from dgraph_tpu.x import keys
+
+
+class DirectedEdge:
+    """Ref protos/pb.proto DirectedEdge."""
+
+    __slots__ = (
+        "entity",
+        "attr",
+        "value",
+        "value_type",
+        "value_id",
+        "lang",
+        "facets",
+        "op",
+        "ns",
+    )
+
+    def __init__(
+        self,
+        entity: int,
+        attr: str,
+        value: Optional[Val] = None,
+        value_id: Optional[int] = None,
+        lang: str = "",
+        facets=None,
+        op: int = OP_SET,
+        ns: int = keys.GALAXY_NS,
+    ):
+        self.entity = entity
+        self.attr = attr
+        self.value = value
+        self.value_id = value_id
+        self.lang = lang
+        self.facets = facets or {}
+        self.op = op
+        self.ns = ns
+
+
+def _facet_bytes(facets) -> tuple[dict, dict]:
+    fb, ft = {}, {}
+    for k, v in (facets or {}).items():
+        if not isinstance(v, Val):
+            raise TypeError("facets must be types.Val")
+        fb[k] = to_binary(v)
+        ft[k] = v.tid
+    return fb, ft
+
+
+def apply_edge(
+    txn: Txn, st: State, edge: DirectedEdge, update_schema: bool = True
+) -> None:
+    """Apply one edge to the txn's local cache with index maintenance."""
+    su = st.get(edge.attr)
+    if su is None:
+        if not update_schema:
+            raise ValueError(f"no schema for predicate {edge.attr!r}")
+        tid = (
+            TypeID.UID
+            if edge.value_id is not None
+            else (edge.value.tid if edge.value else TypeID.DEFAULT)
+        )
+        su = st.ensure_default(edge.attr, tid)
+
+    data_key = keys.DataKey(edge.attr, edge.entity, edge.ns)
+    cache = txn.cache
+
+    if su.is_uid or edge.value_id is not None:
+        _apply_uid_edge(txn, su, edge, data_key)
+    else:
+        _apply_value_edge(txn, su, edge, data_key)
+
+    if su.count:
+        _update_count_index(txn, su, edge, data_key)
+
+
+def _apply_uid_edge(txn: Txn, su: SchemaUpdate, edge: DirectedEdge, data_key):
+    if edge.value_id is None:
+        raise ValueError(f"predicate {edge.attr!r} expects a uid edge")
+    p = Posting(uid=edge.value_id, op=edge.op)
+    fb, ft = _facet_bytes(edge.facets)
+    p.facets, p.facet_types = fb, ft
+    txn.cache.add_delta(data_key, p)
+    txn.add_conflict_key(data_key if su.upsert else data_key + b"#u",
+                         str(edge.value_id).encode())
+
+    if su.directive_reverse:
+        rkey = keys.ReverseKey(edge.attr, edge.value_id, edge.ns)
+        rp = Posting(uid=edge.entity, op=edge.op)
+        rp.facets, rp.facet_types = fb, ft
+        txn.cache.add_delta(rkey, rp)
+        txn.add_conflict_key(rkey, str(edge.entity).encode())
+
+
+def _apply_value_edge(txn: Txn, su: SchemaUpdate, edge: DirectedEdge, data_key):
+    if edge.value is None:
+        raise ValueError(f"predicate {edge.attr!r}: missing value")
+    # convert to the schema's storage type (ref mutation.go ValidateAndConvert)
+    stored = (
+        convert(edge.value, su.value_type)
+        if su.value_type != TypeID.DEFAULT
+        else edge.value
+    )
+    vbytes = to_binary(stored)
+
+    if su.is_list:
+        puid = value_uid(vbytes)
+    else:
+        puid = lang_uid(edge.lang if su.lang else "")
+
+    tokenizers = su.tokenizer_objs()
+
+    # deindex old value(s) being overwritten
+    if tokenizers:
+        if su.is_list:
+            old_posts = (
+                [p for p in txn.cache.values(data_key) if p.uid == puid]
+                if edge.op == OP_DEL
+                else []
+            )
+        else:
+            old_posts = [
+                p
+                for p in txn.cache.values(data_key)
+                if p.uid == puid
+            ]
+        for old in old_posts:
+            for tokb in build_tokens(old.val(), tokenizers):
+                ikey = keys.IndexKey(edge.attr, tokb, edge.ns)
+                txn.cache.add_delta(
+                    ikey, Posting(uid=edge.entity, op=OP_DEL)
+                )
+                txn.add_conflict_key(ikey)
+
+    p = Posting(
+        uid=puid,
+        op=edge.op,
+        value=vbytes,
+        value_type=stored.tid,
+        lang=edge.lang,
+    )
+    fb, ft = _facet_bytes(edge.facets)
+    p.facets, p.facet_types = fb, ft
+    txn.cache.add_delta(data_key, p)
+    # value writes always conflict at (entity, pred) granularity; @upsert
+    # additionally conflicts on index keys (ref posting/list.go:842)
+    txn.add_conflict_key(data_key if su.upsert else data_key + b"#v")
+
+    if tokenizers and edge.op == OP_SET:
+        for tokb in build_tokens(stored, tokenizers):
+            ikey = keys.IndexKey(edge.attr, tokb, edge.ns)
+            txn.cache.add_delta(ikey, Posting(uid=edge.entity, op=OP_SET))
+            if su.upsert:
+                txn.add_conflict_key(ikey)
+
+    # vector index maintenance handled by models/ at commit (factory seam,
+    # ref tok/index/index.go boundary); the engine registers vector preds.
+
+
+def _update_count_index(txn: Txn, su: SchemaUpdate, edge: DirectedEdge, data_key):
+    """Maintain @count index: move entity between count buckets
+    (ref posting/index.go:431 updateCount)."""
+    before = len(txn.cache.uids(data_key))
+    # Note: this runs *after* add_delta, so 'before' includes the new edge;
+    # recompute prior count from ops in this txn is simplified: we recount
+    # from the cache (correct because deltas are applied in order).
+    after = before
+    prior = after - (1 if edge.op == OP_SET else -1)
+    if prior >= 0:
+        okey = keys.CountKey(edge.attr, prior, False, edge.ns)
+        txn.cache.add_delta(okey, Posting(uid=edge.entity, op=OP_DEL))
+    nkey = keys.CountKey(edge.attr, after, False, edge.ns)
+    txn.cache.add_delta(nkey, Posting(uid=edge.entity, op=OP_SET))
+
+
+def delete_entity_attr(txn: Txn, st: State, entity: int, attr: str, ns=keys.GALAXY_NS):
+    """S P * deletion: drop all postings of (entity, attr)
+    (ref posting/index.go deleteEntries path for star deletes)."""
+    su = st.get(attr)
+    data_key = keys.DataKey(attr, entity, ns)
+    tokenizers = su.tokenizer_objs() if su else []
+    for p in txn.cache.values(data_key):
+        for tokb in build_tokens(p.val(), tokenizers):
+            ikey = keys.IndexKey(attr, tokb, ns)
+            txn.cache.add_delta(ikey, Posting(uid=entity, op=OP_DEL))
+    for uid in txn.cache.uids(data_key):
+        txn.cache.add_delta(data_key, Posting(uid=int(uid), op=OP_DEL))
+        if su and su.directive_reverse:
+            rkey = keys.ReverseKey(attr, int(uid), ns)
+            txn.cache.add_delta(rkey, Posting(uid=entity, op=OP_DEL))
+    for p in txn.cache.values(data_key):
+        txn.cache.add_delta(
+            data_key,
+            Posting(uid=p.uid, op=OP_DEL, value=p.value, value_type=p.value_type),
+        )
+    txn.add_conflict_key(data_key)

@@ -1,0 +1,323 @@
+"""Posting lists: MVCC layered edge/value storage per (predicate, uid) key.
+
+Mirrors /root/reference/posting/list.go semantics with a simplified layer
+model (SURVEY.md §7.2):
+
+  - a *rollup* record is the complete immutable state at some commit ts —
+    UID edges as a block-compressed UidPack (codec/uidpack.py) plus value
+    postings (ref list.go:66 `plist` with UidPack + postings),
+  - *delta* records are per-txn changes written at their commit ts
+    (ref posting/mvcc.go:266 CommitToDisk),
+  - a read at `read_ts` walks KV versions newest->oldest until a rollup,
+    then applies the deltas above it in ts order
+    (ref posting/mvcc.go:641 ReadPostingList),
+  - rollup() recompacts layers into a new rollup record
+    (ref list.go:1416 Rollup; incremental trigger posting/mvcc.go:41).
+
+Value postings use the reference's uid conventions: a scalar value posting
+has uid VALUE_UID (math.MaxUint64, ref posting/index.go fingerprinting); a
+language-tagged or list value posting uses a 64-bit fingerprint of the
+lang/value so multiple values coexist in one sorted list.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dgraph_tpu.codec import uidpack
+from dgraph_tpu.types.types import TypeID, Val, from_binary, to_binary
+
+OP_SET = 1
+OP_DEL = 2
+
+VALUE_UID = (1 << 64) - 1  # plain scalar value posting
+
+
+def fingerprint64(data: bytes) -> int:
+    h = hashlib.blake2b(data, digest_size=8).digest()
+    v = struct.unpack("<Q", h)[0]
+    return v or 1  # avoid uid 0
+
+
+def lang_uid(lang: str) -> int:
+    if not lang:
+        return VALUE_UID
+    return fingerprint64(b"lang:" + lang.encode("utf-8"))
+
+
+def value_uid(value_bytes: bytes) -> int:
+    return fingerprint64(b"val:" + value_bytes)
+
+
+@dataclass
+class Posting:
+    uid: int
+    op: int = OP_SET
+    value: Optional[bytes] = None  # None => pure uid edge
+    value_type: TypeID = TypeID.DEFAULT
+    lang: str = ""
+    facets: Dict[str, bytes] = field(default_factory=dict)
+    facet_types: Dict[str, TypeID] = field(default_factory=dict)
+
+    @property
+    def is_value(self) -> bool:
+        return self.value is not None
+
+    def val(self) -> Val:
+        return from_binary(self.value_type, self.value)
+
+    def get_facets(self) -> Dict[str, Val]:
+        return {
+            k: from_binary(self.facet_types.get(k, TypeID.DEFAULT), v)
+            for k, v in self.facets.items()
+        }
+
+
+# ---------------------------------------------------------------------------
+# Record serialization (KV value bytes).
+# ---------------------------------------------------------------------------
+
+KIND_ROLLUP = 0
+KIND_DELTA = 1
+
+
+def _enc_posting(p: Posting, out: List[bytes]):
+    flags = (1 if p.is_value else 0) | (p.op << 1)
+    out.append(struct.pack("<BQB", flags, p.uid, int(p.value_type)))
+    lang = p.lang.encode("utf-8")
+    out.append(struct.pack("<B", len(lang)))
+    out.append(lang)
+    v = p.value if p.value is not None else b""
+    out.append(struct.pack("<I", len(v)))
+    out.append(v)
+    out.append(struct.pack("<H", len(p.facets)))
+    for k in sorted(p.facets):
+        kb = k.encode("utf-8")
+        fv = p.facets[k]
+        out.append(
+            struct.pack(
+                "<BBH", len(kb), int(p.facet_types.get(k, TypeID.DEFAULT)), len(fv)
+            )
+        )
+        out.append(kb)
+        out.append(fv)
+
+
+def _dec_posting(data: bytes, pos: int) -> Tuple[Posting, int]:
+    flags, uid, tid = struct.unpack_from("<BQB", data, pos)
+    pos += 10
+    (llen,) = struct.unpack_from("<B", data, pos)
+    pos += 1
+    lang = data[pos : pos + llen].decode("utf-8")
+    pos += llen
+    (vlen,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    value = data[pos : pos + vlen]
+    pos += vlen
+    (nf,) = struct.unpack_from("<H", data, pos)
+    pos += 2
+    facets: Dict[str, bytes] = {}
+    ftypes: Dict[str, TypeID] = {}
+    for _ in range(nf):
+        klen, ftid, fvlen = struct.unpack_from("<BBH", data, pos)
+        pos += 4
+        k = data[pos : pos + klen].decode("utf-8")
+        pos += klen
+        facets[k] = data[pos : pos + fvlen]
+        ftypes[k] = TypeID(ftid)
+        pos += fvlen
+    is_value = flags & 1
+    p = Posting(
+        uid=uid,
+        op=(flags >> 1) & 0x3,
+        value=value if is_value else None,
+        value_type=TypeID(tid),
+        lang=lang,
+        facets=facets,
+        facet_types=ftypes,
+    )
+    return p, pos
+
+
+def encode_rollup(pack: uidpack.UidPack, postings: List[Posting]) -> bytes:
+    pb = uidpack.serialize(pack)
+    out = [struct.pack("<BI", KIND_ROLLUP, len(pb)), pb]
+    out.append(struct.pack("<I", len(postings)))
+    for p in postings:
+        _enc_posting(p, out)
+    return b"".join(out)
+
+
+def encode_delta(postings: List[Posting]) -> bytes:
+    out = [struct.pack("<BI", KIND_DELTA, len(postings))]
+    for p in postings:
+        _enc_posting(p, out)
+    return b"".join(out)
+
+
+def decode_record(data: bytes):
+    """Returns (kind, pack_or_None, postings)."""
+    kind, n = struct.unpack_from("<BI", data, 0)
+    pos = 5
+    if kind == KIND_ROLLUP:
+        pack = uidpack.deserialize(data[pos : pos + n])
+        pos += n
+        (cnt,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        postings = []
+        for _ in range(cnt):
+            p, pos = _dec_posting(data, pos)
+            postings.append(p)
+        return KIND_ROLLUP, pack, postings
+    postings = []
+    for _ in range(n):
+        p, pos = _dec_posting(data, pos)
+        postings.append(p)
+    return KIND_DELTA, None, postings
+
+
+# ---------------------------------------------------------------------------
+# PostingList: reconstruct-at-ts + mutate + rollup.
+# ---------------------------------------------------------------------------
+
+
+class PostingList:
+    """A posting list reconstructed at a read timestamp.
+
+    Layers, like ref posting/list.go:66: `pack`+`value_postings` form the
+    immutable layer; `deltas` (commit_ts-ordered) are the committed mutable
+    layer; uncommitted postings for the reading txn are merged by LocalCache.
+    """
+
+    def __init__(
+        self,
+        key: bytes,
+        pack: Optional[uidpack.UidPack] = None,
+        value_postings: Optional[List[Posting]] = None,
+        deltas: Optional[List[Tuple[int, List[Posting]]]] = None,
+        min_ts: int = 0,
+    ):
+        self.key = key
+        self.pack = pack or uidpack.encode(np.zeros((0,), np.uint64))
+        self.value_postings = value_postings or []
+        # committed deltas above the rollup, ascending commit_ts
+        self.deltas = deltas or []
+        self.min_ts = min_ts  # ts of the rollup layer
+
+    # -- construction from KV versions --------------------------------------
+
+    @classmethod
+    def from_versions(
+        cls, key: bytes, versions: List[Tuple[int, bytes]]
+    ) -> "PostingList":
+        """versions: (ts, record) newest first (KV.versions contract)."""
+        deltas: List[Tuple[int, List[Posting]]] = []
+        pack = None
+        value_postings: List[Posting] = []
+        min_ts = 0
+        for ts, rec in versions:
+            kind, pk, posts = decode_record(rec)
+            if kind == KIND_DELTA:
+                deltas.append((ts, posts))
+            else:
+                pack = pk
+                value_postings = posts
+                min_ts = ts
+                break
+        deltas.reverse()  # ascending commit_ts
+        return cls(
+            key,
+            pack=pack,
+            value_postings=value_postings,
+            deltas=deltas,
+            min_ts=min_ts,
+        )
+
+    # -- reads ---------------------------------------------------------------
+
+    def uids(self, extra_deltas: Optional[List[Posting]] = None) -> np.ndarray:
+        """Materialized sorted u64 uid set (ref list.go:1758 Uids)."""
+        base = uidpack.decode(self.pack)
+        # last-writer-wins per uid across layers in commit order
+        final_op: Dict[int, int] = {}
+        for _, posts in self.deltas:
+            for p in posts:
+                if not p.is_value:
+                    final_op[p.uid] = p.op
+        for p in extra_deltas or []:
+            if not p.is_value:
+                final_op[p.uid] = p.op
+        if not final_op:
+            return base
+        adds = [u for u, op in final_op.items() if op == OP_SET]
+        dels = [u for u, op in final_op.items() if op == OP_DEL]
+        if dels:
+            base = np.setdiff1d(
+                base, np.array(dels, np.uint64), assume_unique=False
+            )
+        if adds:
+            base = np.union1d(base, np.array(adds, np.uint64))
+        return base.astype(np.uint64)
+
+    def _merged_postings(
+        self, extra_deltas: Optional[List[Posting]] = None
+    ) -> Dict[int, Posting]:
+        """uid -> winning posting (last writer wins by layer order)."""
+        merged: Dict[int, Posting] = {p.uid: p for p in self.value_postings}
+        for _, posts in self.deltas:
+            for p in posts:
+                merged[p.uid] = p
+        for p in extra_deltas or []:
+            merged[p.uid] = p
+        return merged
+
+    def get_value(
+        self, lang: str = "", extra_deltas=None
+    ) -> Optional[Val]:
+        """Scalar value read (ref list.go Value/ValueForTag)."""
+        merged = self._merged_postings(extra_deltas)
+        p = merged.get(lang_uid(lang))
+        if p is not None and p.op != OP_DEL and p.is_value:
+            return p.val()
+        if not lang:
+            # fall back to any language (ref list.go:1990 ValueWithLockHeld)
+            for uid in sorted(merged):
+                p = merged[uid]
+                if p.op != OP_DEL and p.is_value:
+                    return p.val()
+        return None
+
+    def get_all_values(self, extra_deltas=None) -> List[Posting]:
+        """All live value postings (list predicates / lang variants)."""
+        merged = self._merged_postings(extra_deltas)
+        return [
+            merged[uid]
+            for uid in sorted(merged)
+            if merged[uid].op != OP_DEL and merged[uid].is_value
+        ]
+
+    def is_empty(self, extra_deltas=None) -> bool:
+        return (
+            len(self.uids(extra_deltas)) == 0
+            and not self.get_all_values(extra_deltas)
+        )
+
+    # -- rollup --------------------------------------------------------------
+
+    def rollup(self) -> Tuple[bytes, int]:
+        """Compact all layers into a fresh rollup record.
+
+        Returns (record_bytes, ts). Ref posting/list.go:1416 Rollup.
+        """
+        uids = self.uids()
+        pack = uidpack.encode(uids)
+        values = self.get_all_values()
+        ts = max(
+            [self.min_ts] + [t for t, _ in self.deltas]
+        )
+        return encode_rollup(pack, values), ts

@@ -29,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from dgraph_tpu.codec.uidpack import join_segments, split_segments
 from dgraph_tpu.ops import setops
 
 # Below this much total work, numpy wins (dispatch overhead dominates).
@@ -49,12 +50,6 @@ def _np_op(op: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
     if op == "union":
         return np.union1d(a, b)
     raise ValueError(op)
-
-
-def _split_segments32(a: np.ndarray) -> Dict[int, np.ndarray]:
-    from dgraph_tpu.codec.uidpack import split_segments
-
-    return split_segments(a)
 
 
 class SetOpDispatcher:
@@ -91,14 +86,12 @@ class SetOpDispatcher:
     # -- device path --------------------------------------------------------
 
     def _run_pairs_device(self, op, pairs):
-        from dgraph_tpu.codec.uidpack import join_segments
-
         # Explode u64 pairs into u32 segment sub-jobs.
         sub: List[Tuple[int, int, np.ndarray, np.ndarray]] = []  # (pair, hi, a, b)
         passthrough: List[Tuple[int, int, np.ndarray]] = []  # (pair, hi, lo)
         for pi, (a, b) in enumerate(pairs):
-            sa = _split_segments32(np.asarray(a, np.uint64))
-            sb = _split_segments32(np.asarray(b, np.uint64))
+            sa = split_segments(np.asarray(a, np.uint64))
+            sb = split_segments(np.asarray(b, np.uint64))
             his = set(sa) | set(sb)
             for hi in his:
                 la, lb = sa.get(hi), sb.get(hi)

@@ -1,0 +1,487 @@
+"""Root/filter function execution — the worker/task.go equivalent.
+
+Mirrors /root/reference/worker/task.go function dispatch (parseFuncType:230,
+processTask:1012): each function produces a sorted uid set, either from an
+index range (eq/inequality/terms/fulltext/trigram/geo/vector) or by value
+tests over candidate uids (compare-without-index, regexp verify). Filter
+application then reduces to batched set ops on the device
+(query/dispatch.py), replacing the reference's per-goroutine scalar loops.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dgraph_tpu.dql.parser import FuncSpec
+from dgraph_tpu.posting.lists import LocalCache
+from dgraph_tpu.schema.schema import State
+from dgraph_tpu.tok.tok import build_tokens, get_tokenizer
+from dgraph_tpu.types.types import TypeID, Val, compare_vals, convert
+from dgraph_tpu.x import keys
+
+
+class QueryError(Exception):
+    pass
+
+
+def _as_uids(xs) -> np.ndarray:
+    return np.array(sorted(set(int(x) for x in xs)), dtype=np.uint64)
+
+
+EMPTY = np.zeros((0,), np.uint64)
+
+
+class FuncRunner:
+    """Executes FuncSpecs against a LocalCache + schema state."""
+
+    def __init__(self, cache: LocalCache, st: State, ns: int = keys.GALAXY_NS,
+                 vector_indexes=None, uid_vars=None, val_vars=None):
+        self.cache = cache
+        self.st = st
+        self.ns = ns
+        self.vector_indexes = vector_indexes or {}
+        self.uid_vars = uid_vars or {}
+        self.val_vars = val_vars or {}
+
+    # -- helpers -------------------------------------------------------------
+
+    def _schema(self, attr: str):
+        su = self.st.get(attr)
+        if su is None:
+            raise QueryError(f"predicate {attr!r} not in schema")
+        return su
+
+    def _index_uids(self, attr: str, token: bytes) -> np.ndarray:
+        return self.cache.uids(keys.IndexKey(attr, token, self.ns))
+
+    def _eq_tokenizer(self, su):
+        """Pick a non-lossy tokenizer for eq (ref tok.go:372 pickTokenizer)."""
+        toks = su.tokenizer_objs()
+        for t in toks:
+            if not t.is_lossy:
+                return t, False
+        for t in toks:
+            if t.name == "term":
+                return t, True  # lossy: needs value verification
+        return (toks[0], True) if toks else (None, True)
+
+    def _value_of(self, attr: str, uid: int, lang: str = "") -> Optional[Val]:
+        return self.cache.value(keys.DataKey(attr, int(uid), self.ns), lang)
+
+    def _scan_data_uids(self, attr: str) -> np.ndarray:
+        """All entities having attr (full tablet scan; ref has at root
+        task.go:2679 handleHasFunction)."""
+        out = []
+        prefix = keys.DataPrefix(attr, self.ns)
+        for k, _, _ in self.cache.kv.iterate(prefix, self.cache.read_ts):
+            pk = keys.parse_key(k)
+            if not self.cache.get(k).is_empty(self.cache.deltas.get(k)):
+                out.append(pk.uid)
+        return _as_uids(out)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def run_root(self, fn: FuncSpec) -> np.ndarray:
+        """Execute a root function -> sorted uids."""
+        return self._run(fn, src=None)
+
+    def run_filter(self, fn: FuncSpec, src: np.ndarray) -> np.ndarray:
+        """Evaluate as filter over candidate uids -> surviving uids."""
+        return self._run(fn, src=src)
+
+    def _run(self, fn: FuncSpec, src: Optional[np.ndarray]) -> np.ndarray:
+        name = fn.name
+        if name == "uid":
+            uids = list(fn.args)
+            if fn.uid_var:
+                uids.extend(int(u) for u in self.uid_vars.get(fn.uid_var, []))
+            out = _as_uids(uids)
+            if src is not None:
+                out = np.intersect1d(out, src, assume_unique=True)
+            return out
+        if name == "uid_in":
+            return self._uid_in(fn, src)
+        if name == "type":
+            return self._type(fn, src)
+        if name == "has":
+            return self._has(fn, src)
+        if name == "eq":
+            return self._eq(fn, src)
+        if name in ("le", "lt", "ge", "gt"):
+            return self._compare(fn, name, src)
+        if name == "between":
+            return self._between(fn, src)
+        if name in ("anyofterms", "allofterms"):
+            return self._terms(fn, src, "term", name.startswith("all"))
+        if name in ("anyoftext", "alloftext"):
+            return self._terms(fn, src, "fulltext", name.startswith("all"))
+        if name == "regexp":
+            return self._regexp(fn, src)
+        if name == "match":
+            return self._match(fn, src)
+        if name == "similar_to":
+            return self._similar_to(fn, src)
+        if name in ("near", "within"):
+            return self._geo(fn, name, src)
+        raise QueryError(f"function {name!r} not supported")
+
+    # -- implementations -----------------------------------------------------
+
+    def _has(self, fn: FuncSpec, src) -> np.ndarray:
+        attr = fn.attr
+        if src is not None:
+            out = [
+                int(u)
+                for u in src
+                if self.cache.has(keys.DataKey(attr, int(u), self.ns))
+            ]
+            return _as_uids(out)
+        return self._scan_data_uids(attr)
+
+    def _type(self, fn: FuncSpec, src) -> np.ndarray:
+        # dgraph.type is an exact-indexed string predicate (ref systems schema)
+        token = b"\x02" + fn.attr.encode("utf-8")
+        out = self._index_uids("dgraph.type", token)
+        if src is not None:
+            out = np.intersect1d(out, src, assume_unique=True)
+        return out
+
+    def _uid_in(self, fn: FuncSpec, src) -> np.ndarray:
+        targets = set(int(x) for x in fn.args)
+        if fn.uid_var:
+            targets |= set(int(u) for u in self.uid_vars.get(fn.uid_var, []))
+        cands = src if src is not None else self._scan_data_uids(fn.attr)
+        out = []
+        for u in cands:
+            nbrs = self.cache.uids(keys.DataKey(fn.attr, int(u), self.ns))
+            if len(np.intersect1d(nbrs, _as_uids(targets), assume_unique=True)):
+                out.append(int(u))
+        return _as_uids(out)
+
+    def _eq(self, fn: FuncSpec, src) -> np.ndarray:
+        su = self._schema(fn.attr)
+        if fn.val_var:
+            raise QueryError("eq(val(..)) handled by executor")
+        vals = fn.args
+        out = EMPTY
+        tok, needs_verify = (None, True)
+        if su.directive_index:
+            tok, needs_verify = self._eq_tokenizer(su)
+        for v in vals:
+            val = _coerce(v, su.value_type)
+            if tok is not None:
+                cand = EMPTY
+                for tb in build_tokens(val, [tok]):
+                    cand = np.union1d(cand, self._index_uids(fn.attr, tb))
+            else:
+                # unindexed eq over src or full scan (ref requires index at
+                # root; as filter we value-test)
+                cand = src if src is not None else self._scan_data_uids(fn.attr)
+                needs_verify = True
+            if needs_verify:
+                cand = _as_uids(
+                    [
+                        int(u)
+                        for u in cand
+                        if _val_eq(self._value_of(fn.attr, u, fn.lang), val)
+                    ]
+                )
+            out = np.union1d(out, cand)
+        if src is not None:
+            out = np.intersect1d(out, src, assume_unique=True)
+        return out.astype(np.uint64)
+
+    def _compare(self, fn: FuncSpec, op: str, src) -> np.ndarray:
+        su = self._schema(fn.attr)
+        val = _coerce(fn.args[0], su.value_type)
+        # indexed range scan over sortable tokenizer (ref sortWithIndex path)
+        sortable = None
+        if su.directive_index:
+            for t in su.tokenizer_objs():
+                if t.is_sortable:
+                    sortable = t
+                    break
+        if sortable is not None and src is None:
+            return self._range_scan(fn.attr, sortable, op, val)
+        cands = src if src is not None else self._scan_data_uids(fn.attr)
+        out = []
+        for u in cands:
+            got = self._value_of(fn.attr, u, fn.lang)
+            if got is None:
+                continue
+            try:
+                c = compare_vals(convert(got, val.tid), val)
+            except ValueError:
+                continue
+            if (
+                (op == "le" and c <= 0)
+                or (op == "lt" and c < 0)
+                or (op == "ge" and c >= 0)
+                or (op == "gt" and c > 0)
+            ):
+                out.append(int(u))
+        return _as_uids(out)
+
+    def _range_scan(self, attr: str, tok, op: str, val: Val) -> np.ndarray:
+        """Walk the sortable index range (ref worker/task.go:1881 eq-planning
+        and sort.go:189 sortWithIndex bucket walk)."""
+        target = build_tokens(convert(val, tok.type_id), [tok])[0]
+        prefix = keys.IndexPrefix(attr, self.ns) + tok.prefix()
+        out = []
+        for k, _, _ in self.cache.kv.iterate(prefix, self.cache.read_ts):
+            token = k[len(keys.IndexPrefix(attr, self.ns)) :]
+            if (
+                (op == "le" and token <= target)
+                or (op == "lt" and token < target)
+                or (op == "ge" and token >= target)
+                or (op == "gt" and token > target)
+            ):
+                uids = self.cache.uids(k)
+                out.append(uids)
+        if not out:
+            return EMPTY
+        merged = np.unique(np.concatenate(out)).astype(np.uint64)
+        if tok.is_lossy:
+            # verify by value (e.g. float tokenizer buckets at int granularity)
+            merged = _as_uids(
+                [
+                    int(u)
+                    for u in merged
+                    if self._cmp_ok(attr, u, op, val)
+                ]
+            )
+        return merged
+
+    def _cmp_ok(self, attr, uid, op, val) -> bool:
+        got = self._value_of(attr, uid)
+        if got is None:
+            return False
+        try:
+            c = compare_vals(convert(got, val.tid), val)
+        except ValueError:
+            return False
+        return (
+            (op == "le" and c <= 0)
+            or (op == "lt" and c < 0)
+            or (op == "ge" and c >= 0)
+            or (op == "gt" and c > 0)
+        )
+
+    def _between(self, fn: FuncSpec, src) -> np.ndarray:
+        lo = FuncSpec(name="ge", attr=fn.attr, args=[fn.args[0]], lang=fn.lang)
+        hi = FuncSpec(name="le", attr=fn.attr, args=[fn.args[1]], lang=fn.lang)
+        a = self._compare(lo, "ge", src)
+        b = self._compare(hi, "le", src)
+        return np.intersect1d(a, b, assume_unique=True)
+
+    def _terms(self, fn: FuncSpec, src, tokname: str, require_all: bool) -> np.ndarray:
+        su = self._schema(fn.attr)
+        if tokname not in su.tokenizers:
+            raise QueryError(
+                f"predicate {fn.attr!r} needs @index({tokname}) for {fn.name}"
+            )
+        tok = get_tokenizer(tokname)
+        text = Val(TypeID.STRING, str(fn.args[0]))
+        toks = build_tokens(text, [tok])
+        if not toks:
+            return EMPTY
+        lists = [self._index_uids(fn.attr, tb) for tb in toks]
+        out = lists[0]
+        for l in lists[1:]:
+            out = (
+                np.intersect1d(out, l, assume_unique=True)
+                if require_all
+                else np.union1d(out, l)
+            )
+        if src is not None:
+            out = np.intersect1d(out, src, assume_unique=True)
+        return out.astype(np.uint64)
+
+    def _regexp(self, fn: FuncSpec, src) -> np.ndarray:
+        su = self._schema(fn.attr)
+        arg = fn.args[0]
+        if not (isinstance(arg, tuple) and arg[0] == "regex"):
+            raise QueryError("regexp expects /pattern/flags")
+        pattern, flags = arg[1], arg[2]
+        rx = re.compile(pattern, re.IGNORECASE if "i" in flags else 0)
+        # trigram prefilter (ref worker/task.go:1240 + tok trigram)
+        cands = None
+        if "trigram" in su.tokenizers:
+            plain = _required_trigrams(pattern)
+            if plain:
+                tok = get_tokenizer("trigram")
+                lists = []
+                for tri in plain:
+                    lists.append(
+                        self._index_uids(fn.attr, tok.prefix() + tri.encode())
+                    )
+                cands = lists[0]
+                for l in lists[1:]:
+                    cands = np.intersect1d(cands, l, assume_unique=True)
+        if cands is None:
+            cands = src if src is not None else self._scan_data_uids(fn.attr)
+        out = []
+        for u in cands:
+            got = self._value_of(fn.attr, u, fn.lang)
+            if got is not None and rx.search(str(got.value)):
+                out.append(int(u))
+        res = _as_uids(out)
+        if src is not None:
+            res = np.intersect1d(res, src, assume_unique=True)
+        return res
+
+    def _match(self, fn: FuncSpec, src) -> np.ndarray:
+        """Fuzzy match by levenshtein distance over trigram candidates
+        (ref worker/task.go:1526 matchFuzzy)."""
+        su = self._schema(fn.attr)
+        text = str(fn.args[0])
+        max_dist = int(fn.args[1]) if len(fn.args) > 1 else 8
+        cands = None
+        if "trigram" in su.tokenizers:
+            tok = get_tokenizer("trigram")
+            lists = [
+                self._index_uids(fn.attr, tb)
+                for tb in tok.tokens(Val(TypeID.STRING, text))
+            ]
+            if lists:
+                cands = lists[0]
+                for l in lists[1:]:
+                    cands = np.union1d(cands, l)
+        if cands is None:
+            cands = src if src is not None else self._scan_data_uids(fn.attr)
+        out = []
+        for u in cands:
+            got = self._value_of(fn.attr, u, fn.lang)
+            if got is not None and _levenshtein(str(got.value).lower(), text.lower()) <= max_dist:
+                out.append(int(u))
+        res = _as_uids(out)
+        if src is not None:
+            res = np.intersect1d(res, src, assume_unique=True)
+        return res
+
+    def _similar_to(self, fn: FuncSpec, src) -> np.ndarray:
+        import json as _json
+
+        attr = fn.attr
+        idx = self.vector_indexes.get(attr)
+        if idx is None:
+            raise QueryError(f"no vector index on predicate {attr!r}")
+        k = int(fn.args[0])
+        qarg = fn.args[1]
+        if isinstance(qarg, str):
+            qvec = np.asarray(_json.loads(qarg), dtype=np.float32)
+        elif isinstance(qarg, (int,)):
+            got = self._value_of(attr, qarg)
+            if got is None:
+                return EMPTY
+            qvec = np.asarray(got.value, dtype=np.float32)
+        else:
+            qvec = np.asarray(qarg, dtype=np.float32)
+        uids = idx.search(
+            qvec,
+            k,
+            ef=fn.options.get("ef"),
+            distance_threshold=fn.options.get("distance_threshold"),
+            allowed=src,
+        )
+        return _as_uids(uids)
+
+    def _geo(self, fn: FuncSpec, op: str, src) -> np.ndarray:
+        from dgraph_tpu.tok.tok import GeoTokenizer
+
+        su = self._schema(fn.attr)
+        if "geo" not in su.tokenizers:
+            raise QueryError(f"predicate {fn.attr!r} needs @index(geo)")
+        if op == "near":
+            coords, dist_m = fn.args[0], float(fn.args[1])
+            lon, lat = float(coords[0]), float(coords[1])
+            # degree radius approximation; verify with haversine after
+            deg = dist_m / 111_000.0
+            cand_cells = set()
+            lvl = GeoTokenizer.MAX_LEVEL
+            step = deg / 2 if deg > 0 else 0.001
+            g = np.arange(lon - deg, lon + deg + 1e-9, max(step, 1e-4))
+            gy = np.arange(lat - deg, lat + deg + 1e-9, max(step, 1e-4))
+            for x in g:
+                for y in gy:
+                    cand_cells.add(GeoTokenizer.cell_at(float(x), float(y), lvl))
+            tok = get_tokenizer("geo")
+            lists = [
+                self._index_uids(fn.attr, tok.prefix() + c) for c in cand_cells
+            ]
+            cands = np.unique(np.concatenate(lists)) if lists else EMPTY
+            out = []
+            for u in cands:
+                got = self._value_of(fn.attr, u)
+                if got is None:
+                    continue
+                pt = got.value.get("coordinates", [None, None])
+                if pt[0] is None:
+                    continue
+                if _haversine_m(lat, lon, float(pt[1]), float(pt[0])) <= dist_m:
+                    out.append(int(u))
+            res = _as_uids(out)
+            if src is not None:
+                res = np.intersect1d(res, src, assume_unique=True)
+            return res
+        raise QueryError(f"geo function {op!r} not supported yet")
+
+
+def _coerce(arg, tid: TypeID) -> Val:
+    if isinstance(arg, Val):
+        v = arg
+    elif isinstance(arg, bool):
+        v = Val(TypeID.BOOL, arg)
+    elif isinstance(arg, int):
+        v = Val(TypeID.INT, arg)
+    elif isinstance(arg, float):
+        v = Val(TypeID.FLOAT, arg)
+    else:
+        v = Val(TypeID.STRING, str(arg))
+    if tid not in (TypeID.DEFAULT,) and v.tid != tid:
+        return convert(v, tid)
+    return v
+
+
+def _val_eq(got: Optional[Val], want: Val) -> bool:
+    if got is None:
+        return False
+    try:
+        return compare_vals(convert(got, want.tid), want) == 0
+    except ValueError:
+        return False
+
+
+def _required_trigrams(pattern: str) -> List[str]:
+    """Longest literal run in the regex -> trigrams (ref uses a full regexp
+    automaton analysis, vendor cockroach regexp lib; literal-run subset)."""
+    lit = max(re.split(r"[\.\*\+\?\[\]\(\)\|\\\^\$\{\}]", pattern), key=len, default="")
+    if len(lit) < 3:
+        return []
+    return [lit[i : i + 3] for i in range(len(lit) - 2)]
+
+
+def _levenshtein(a: str, b: str) -> int:
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[-1] + 1, prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+def _haversine_m(lat1, lon1, lat2, lon2) -> float:
+    import math
+
+    r = 6_371_000.0
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dp = math.radians(lat2 - lat1)
+    dl = math.radians(lon2 - lon1)
+    a = math.sin(dp / 2) ** 2 + math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2
+    return 2 * r * math.asin(math.sqrt(a))

@@ -1,0 +1,200 @@
+"""Result encoding: ExecNode tree -> the reference's JSON response shape.
+
+Mirrors /root/reference/query/outputnode.go semantics (ToJson:40): uid
+predicates encode as arrays of objects, scalar predicates as values, list
+predicates as arrays, counts as {"count": n} / "count(pred)" fields, facets
+as "pred|facet" keys, uids as hex strings. @normalize flattens aliased
+leaves (outputnode.go normalize handling).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dgraph_tpu.query.subgraph import ExecNode
+from dgraph_tpu.types.types import TypeID, Val
+
+
+def _json_val(v: Val) -> Any:
+    x = v.value
+    if isinstance(x, _dt.datetime):
+        return x.isoformat()
+    if v.tid == TypeID.VFLOAT:
+        return [float(f) for f in x]
+    if isinstance(x, bytes):
+        import base64
+
+        return base64.b64encode(x).decode()
+    if isinstance(x, np.floating):
+        return float(x)
+    if isinstance(x, np.integer):
+        return int(x)
+    from decimal import Decimal
+
+    if isinstance(x, Decimal):
+        return float(x)
+    return x
+
+
+def _display_name(c: ExecNode) -> str:
+    gq = c.gq
+    if gq.alias:
+        return gq.alias
+    if gq.aggregator:
+        return f"{gq.aggregator}(val({gq.val_var}))"
+    if gq.val_var and not gq.aggregator:
+        return f"val({gq.val_var})"
+    if gq.is_count:
+        return "count" if gq.attr == "uid" else f"count({gq.attr})"
+    name = gq.attr
+    if gq.lang:
+        name = f"{name}@{gq.lang}"
+    return name
+
+
+def encode_uid(u: int) -> str:
+    return hex(int(u))
+
+
+class JsonEncoder:
+    def __init__(self, val_vars=None):
+        self.val_vars = val_vars or {}
+
+    def encode_blocks(self, nodes: List[ExecNode]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for node in nodes:
+            if node is None or node.gq.is_var_block:
+                continue
+            name = node.gq.alias or node.gq.attr
+            if node.attr == "_path_":
+                name = "_path_"  # ref query/outputnode.go shortest block key
+            arr = self.encode_node_list(node)
+            out[name] = arr
+        return out
+
+    def encode_node_list(self, node: ExecNode) -> List[Dict[str, Any]]:
+        out = []
+        # block-level aggregates / count(uid) become standalone objects
+        # (ref outputnode: aggregations emit their own fastJson nodes)
+        for c in node.children:
+            if c.gq.aggregator:
+                vals = self.val_vars.get(c.gq.val_var, {})
+                xs = [
+                    vals[int(u)]
+                    for u in node.dest_uids
+                    if int(u) in vals
+                ]
+                out.append({_display_name(c): _aggregate(c.gq.aggregator, xs)})
+            elif c.gq.is_count and c.gq.attr == "uid":
+                out.append({_display_name(c): int(len(node.dest_uids))})
+
+        if getattr(node, "paths", None):
+            # shortest-path block: emit the path uid chains (ref _path_)
+            return [
+                {"_path_": [{"uid": encode_uid(u)} for u in p]}
+                for p in node.paths  # type: ignore[attr-defined]
+            ]
+
+        for i, u in enumerate(node.dest_uids):
+            obj = self.encode_entity(node, int(u), i)
+            if obj:
+                if node.gq.normalize:
+                    for flat in _normalize_flatten(obj):
+                        out.append(flat)
+                else:
+                    out.append(obj)
+        return out
+
+    def encode_entity(
+        self, node: ExecNode, uid: int, row: int
+    ) -> Dict[str, Any]:
+        obj: Dict[str, Any] = {}
+        for c in node.children:
+            name = _display_name(c)
+            gq = c.gq
+            if gq.is_uid:
+                obj["uid"] = encode_uid(uid)
+            elif gq.aggregator:
+                continue  # emitted at list level
+            elif gq.val_var and not gq.aggregator:
+                v = self.val_vars.get(gq.val_var, {}).get(uid)
+                if v is not None:
+                    obj[name] = _json_val(v)
+            elif gq.is_count:
+                if gq.attr == "uid":
+                    continue
+                obj[name] = c.counts.get(uid, 0)
+            elif c.is_uid_pred:
+                kids = []
+                r = c.uid_matrix[row] if row < len(c.uid_matrix) else []
+                dest_idx = {int(x): j for j, x in enumerate(c.dest_uids)}
+                for v in r:
+                    kid = (
+                        self.encode_entity(c, int(v), dest_idx.get(int(v), 0))
+                        if c.children
+                        else {}
+                    )
+                    if not c.children:
+                        kid = {"uid": encode_uid(int(v))}
+                    if kid:
+                        kids.append(kid)
+                if kids:
+                    obj[name] = kids
+            else:
+                posts = c.values.get(uid)
+                if posts:
+                    su_is_list = len(posts) > 1
+                    vals = [_json_val(p.val()) for p in posts]
+                    obj[name] = vals if su_is_list else vals[0]
+                    if gq.facets:
+                        for p in posts:
+                            for fk, fv in p.get_facets().items():
+                                if (
+                                    c.gq.facet_names
+                                    and fk not in c.gq.facet_names
+                                ):
+                                    continue
+                                obj[f"{name}|{fk}"] = _json_val(fv)
+        return obj
+
+
+def _aggregate(op: str, xs: List[Val]):
+    if not xs:
+        return None
+    nums = [x.value for x in xs]
+    if op == "min":
+        return _json_val(min(xs, key=lambda v: v.value))
+    if op == "max":
+        return _json_val(max(xs, key=lambda v: v.value))
+    if op == "sum":
+        s = sum(nums)
+        return float(s) if isinstance(s, float) else s
+    if op == "avg":
+        return float(sum(nums)) / len(nums)
+    raise ValueError(op)
+
+
+def _normalize_flatten(obj: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Flatten nested objects into combinations of leaf fields
+    (ref outputnode.go normalize: cartesian of nested lists)."""
+    scalars = {}
+    lists: List[tuple[str, List[Dict[str, Any]]]] = []
+    for k, v in obj.items():
+        if isinstance(v, list) and v and isinstance(v[0], dict):
+            lists.append((k, v))
+        elif isinstance(v, dict):
+            lists.append((k, [v]))
+        else:
+            scalars[k] = v
+    if not lists:
+        return [scalars]
+    out = [scalars]
+    for _, items in lists:
+        flat_items: List[Dict[str, Any]] = []
+        for it in items:
+            flat_items.extend(_normalize_flatten(it))
+        out = [{**a, **b} for a in out for b in flat_items]
+    return out

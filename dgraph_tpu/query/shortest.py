@@ -1,0 +1,96 @@
+"""K-shortest paths via batched frontier expansion.
+
+The reference runs a Dijkstra-style priority queue issuing per-node tasks
+(/root/reference/query/shortest.go:457 shortestPath, expandOut:141). The
+TPU-first formulation (SURVEY.md §7.6): BFS levels where each level expands
+the whole frontier as one batched uid fan-out (frontier -> union of
+neighbor lists), which is exactly the batched set-union the device kernels
+cover. Unweighted edges round 1 (uniform cost, like the reference's default
+when no facet weights are used).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dgraph_tpu.posting.lists import LocalCache
+from dgraph_tpu.schema.schema import State
+from dgraph_tpu.types.types import TypeID
+from dgraph_tpu.x import keys
+
+
+def k_shortest_paths(
+    cache: LocalCache,
+    st: State,
+    src: int,
+    dst: int,
+    preds: List[str],
+    num_paths: int = 1,
+    ns: int = keys.GALAXY_NS,
+    max_depth: int = 10,
+) -> List[List[int]]:
+    """Returns up to num_paths uid-paths from src to dst (shortest first)."""
+    if src == dst:
+        return [[src]]
+
+    upreds = [
+        p for p in preds if (st.get(p.lstrip("~")) or None) is not None
+        and st.get(p.lstrip("~")).value_type == TypeID.UID
+    ]
+    if not upreds:
+        return []
+
+    def neighbors(u: int) -> np.ndarray:
+        outs = []
+        for p in upreds:
+            key = (
+                keys.ReverseKey(p[1:], u, ns)
+                if p.startswith("~")
+                else keys.DataKey(p, u, ns)
+            )
+            outs.append(cache.uids(key))
+        outs = [o for o in outs if len(o)]
+        if not outs:
+            return np.zeros((0,), np.uint64)
+        return np.unique(np.concatenate(outs))
+
+    # BFS with parent sets (supports multiple shortest paths)
+    parents: Dict[int, set] = {src: set()}
+    frontier = {src}
+    found_depth = None
+    depth = 0
+    while frontier and depth < max_depth:
+        depth += 1
+        nxt: Dict[int, set] = {}
+        for u in frontier:
+            for v in neighbors(u):
+                v = int(v)
+                if v in parents:
+                    continue
+                nxt.setdefault(v, set()).add(u)
+        for v, ps in nxt.items():
+            parents[v] = ps
+        if dst in nxt:
+            found_depth = depth
+            break
+        frontier = set(nxt)
+
+    if found_depth is None:
+        return []
+
+    # reconstruct up to num_paths paths (DFS over parent sets)
+    paths: List[List[int]] = []
+
+    def walk(u: int, acc: List[int]):
+        if len(paths) >= num_paths:
+            return
+        if u == src:
+            paths.append([src] + list(reversed(acc)))
+            return
+        for p in sorted(parents.get(u, ())):
+            walk(p, acc + [u])
+
+    walk(dst, [])
+    return paths[:num_paths]

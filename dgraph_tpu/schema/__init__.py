@@ -1,0 +1,1 @@
+from dgraph_tpu.schema.schema import SchemaUpdate, TypeUpdate, State, parse_schema

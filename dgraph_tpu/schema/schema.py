@@ -1,0 +1,208 @@
+"""Schema state & schema-text parser.
+
+Mirrors /root/reference/schema/: per-predicate SchemaUpdate (directives
+@index(tokenizers), @reverse, @count, @upsert, @lang, @unique; list types;
+vector index specs — ref protos/pb.proto:479 SchemaUpdate, :505
+VectorIndexSpec) plus type definitions, and the schema text parser
+(schema/parse.go) for the dgraph schema DSL:
+
+    name: string @index(term, exact) @lang .
+    age: int @index(int) .
+    friend: [uid] @reverse @count .
+    embedding: float32vector @index(hnsw(metric:"euclidean")) .
+    type Person { name age friend }
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dgraph_tpu.types.types import TypeID, type_from_name
+from dgraph_tpu.tok.tok import get_tokenizer
+
+
+@dataclass
+class VectorSpec:
+    """Vector index factory spec (ref pb.proto:505 VectorIndexSpec)."""
+
+    name: str = "hnsw"  # accepted for compat; executes as brute/IVF on TPU
+    options: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def metric(self) -> str:
+        return self.options.get("metric", "euclidean")
+
+
+@dataclass
+class SchemaUpdate:
+    predicate: str
+    value_type: TypeID = TypeID.DEFAULT
+    is_list: bool = False
+    directive_index: bool = False
+    tokenizers: List[str] = field(default_factory=list)
+    directive_reverse: bool = False
+    count: bool = False
+    upsert: bool = False
+    lang: bool = False
+    unique: bool = False
+    no_conflict: bool = False
+    vector_specs: List[VectorSpec] = field(default_factory=list)
+
+    @property
+    def is_uid(self) -> bool:
+        return self.value_type == TypeID.UID
+
+    def tokenizer_objs(self):
+        return [get_tokenizer(n) for n in self.tokenizers]
+
+
+@dataclass
+class TypeUpdate:
+    name: str
+    fields: List[str] = field(default_factory=list)
+
+
+class State:
+    """In-memory schema cache (ref schema/schema.go:59 state)."""
+
+    def __init__(self):
+        self._preds: Dict[str, SchemaUpdate] = {}
+        self._types: Dict[str, TypeUpdate] = {}
+
+    def set(self, su: SchemaUpdate):
+        self._preds[su.predicate] = su
+
+    def set_type(self, tu: TypeUpdate):
+        self._types[tu.name] = tu
+
+    def get(self, pred: str) -> Optional[SchemaUpdate]:
+        return self._preds.get(pred)
+
+    def get_type(self, name: str) -> Optional[TypeUpdate]:
+        return self._types.get(name)
+
+    def predicates(self) -> List[str]:
+        return list(self._preds)
+
+    def types(self) -> List[str]:
+        return list(self._types)
+
+    def delete(self, pred: str):
+        self._preds.pop(pred, None)
+
+    def ensure_default(self, pred: str, tid: TypeID = TypeID.DEFAULT) -> SchemaUpdate:
+        """Auto-create schema on first mutation (reference behavior when no
+        schema declared: type inferred from first value)."""
+        su = self._preds.get(pred)
+        if su is None:
+            su = SchemaUpdate(predicate=pred, value_type=tid)
+            self._preds[pred] = su
+        return su
+
+
+# ---------------------------------------------------------------------------
+# Parser for the schema DSL (ref schema/parse.go).
+# ---------------------------------------------------------------------------
+
+_PRED_RE = re.compile(
+    r"""^\s*
+    (?P<name><[^>]+>|[\w.~\-]+)\s*:\s*
+    (?P<list>\[)?\s*(?P<type>\w+)\s*\]?\s*
+    (?P<directives>(?:@[\w]+(?:\((?:[^()]|\([^()]*\))*\))?\s*)*)
+    \.\s*$""",
+    re.VERBOSE,
+)
+_DIR_RE = re.compile(r"@(\w+)(?:\(((?:[^()]|\([^()]*\))*)\))?")
+_TYPE_RE = re.compile(r"type\s+(?P<name>[\w.]+)\s*\{(?P<body>[^}]*)\}", re.DOTALL)
+
+
+def _strip_angle(name: str) -> str:
+    if name.startswith("<") and name.endswith(">"):
+        return name[1:-1]
+    return name
+
+
+def parse_schema(text: str) -> tuple[List[SchemaUpdate], List[TypeUpdate]]:
+    preds: List[SchemaUpdate] = []
+    types: List[TypeUpdate] = []
+
+    # strip comments
+    text = re.sub(r"#[^\n]*", "", text)
+
+    # extract type blocks first
+    def _take_type(m):
+        fields = [f.strip() for f in m.group("body").split() if f.strip()]
+        fields = [_strip_angle(f) for f in fields]
+        types.append(TypeUpdate(name=m.group("name"), fields=fields))
+        return ""
+
+    text = _TYPE_RE.sub(_take_type, text)
+
+    for line in text.split("\n"):
+        line = line.strip()
+        if not line:
+            continue
+        m = _PRED_RE.match(line)
+        if not m:
+            raise ValueError(f"cannot parse schema line: {line!r}")
+        su = SchemaUpdate(
+            predicate=_strip_angle(m.group("name")),
+            value_type=type_from_name(m.group("type")),
+            is_list=bool(m.group("list")),
+        )
+        for dm in _DIR_RE.finditer(m.group("directives") or ""):
+            dname, dargs = dm.group(1), dm.group(2)
+            if dname == "index":
+                su.directive_index = True
+                for tokspec in _split_args(dargs or ""):
+                    tokspec = tokspec.strip()
+                    if not tokspec:
+                        continue
+                    fm = re.match(r"(\w+)\((.*)\)$", tokspec)
+                    if fm:  # factory spec e.g. hnsw(metric:"euclidean")
+                        opts = {}
+                        for kv in fm.group(2).split(","):
+                            if ":" in kv:
+                                k, v = kv.split(":", 1)
+                                opts[k.strip()] = v.strip().strip('"')
+                        su.vector_specs.append(
+                            VectorSpec(name=fm.group(1), options=opts)
+                        )
+                    else:
+                        su.tokenizers.append(tokspec)
+            elif dname == "reverse":
+                su.directive_reverse = True
+            elif dname == "count":
+                su.count = True
+            elif dname == "upsert":
+                su.upsert = True
+            elif dname == "lang":
+                su.lang = True
+            elif dname == "unique":
+                su.unique = True
+            elif dname == "noconflict":
+                su.no_conflict = True
+            else:
+                raise ValueError(f"unknown schema directive @{dname}")
+        preds.append(su)
+    return preds, types
+
+
+def _split_args(s: str) -> List[str]:
+    """Split on commas not inside parens."""
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out

@@ -1,0 +1,1 @@
+from dgraph_tpu.storage.kv import KV, MemKV, open_kv

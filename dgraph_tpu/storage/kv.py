@@ -1,0 +1,221 @@
+"""Host key-value store: the BadgerDB-equivalent storage engine.
+
+The reference stores everything in BadgerDB v4 (LSM + value log, MVCC via
+version-suffixed keys; opened at /root/reference/worker/server_state.go:95).
+Per SURVEY.md §2.7(2) this is host-side storage and is NOT TPU work: we
+provide a versioned KV interface with the operations the posting layer
+actually uses:
+
+  - put(key, ts, value)            — write a version
+  - versions(key, read_ts)         — versions at/below read_ts, newest first
+    (posting-list reconstruction walks newest->oldest until a full rollup,
+    ref posting/mvcc.go:641 ReadPostingList)
+  - iterate(prefix, read_ts)       — latest version per key under prefix
+    (index range scans, rebuilds, exports; ref badger Stream framework)
+  - delete_below(key, ts)          — GC old versions after rollup
+
+Backends:
+  - MemKV: sorted in-memory versioned map with an append-only WAL for
+    durability + snapshot/restore. Single-writer, snapshot-isolated reads
+    (MVCC by ts) — the concurrency model matches how the engine serializes
+    applies through the Raft/oracle path anyway.
+  - (later rounds) C++ LSM or sqlite-backed store behind the same interface.
+"""
+
+from __future__ import annotations
+
+import bisect
+import io
+import os
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class KV:
+    """Interface. All values are bytes; ts is a u64 commit timestamp."""
+
+    def put(self, key: bytes, ts: int, value: bytes) -> None:
+        raise NotImplementedError
+
+    def put_batch(self, items) -> None:
+        for k, ts, v in items:
+            self.put(k, ts, v)
+
+    def get(self, key: bytes, read_ts: int) -> Optional[Tuple[int, bytes]]:
+        """Latest (ts, value) with ts <= read_ts, else None."""
+        raise NotImplementedError
+
+    def versions(self, key: bytes, read_ts: int) -> List[Tuple[int, bytes]]:
+        raise NotImplementedError
+
+    def iterate(
+        self, prefix: bytes, read_ts: int
+    ) -> Iterator[Tuple[bytes, int, bytes]]:
+        raise NotImplementedError
+
+    def delete_below(self, key: bytes, ts: int) -> None:
+        raise NotImplementedError
+
+    def drop_prefix(self, prefix: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+_WAL_REC = struct.Struct("<IQI")  # key_len, ts, val_len
+
+
+class MemKV(KV):
+    """In-memory versioned sorted map + optional WAL durability."""
+
+    def __init__(self, wal_path: Optional[str] = None):
+        # key -> list[(ts, value)] ascending by ts
+        self._data: Dict[bytes, List[Tuple[int, bytes]]] = {}
+        self._keys: List[bytes] = []  # sorted key index
+        self._keys_dirty = False
+        self._wal = None
+        self._wal_path = wal_path
+        if wal_path:
+            if os.path.exists(wal_path):
+                self._replay_wal(wal_path)
+            self._wal = open(wal_path, "ab")
+
+    # -- writes -------------------------------------------------------------
+
+    def put(self, key: bytes, ts: int, value: bytes) -> None:
+        self._put_mem(key, ts, value)
+        if self._wal is not None:
+            self._wal.write(_WAL_REC.pack(len(key), ts, len(value)))
+            self._wal.write(key)
+            self._wal.write(value)
+
+    def sync(self):
+        if self._wal is not None:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
+    def _put_mem(self, key: bytes, ts: int, value: bytes) -> None:
+        vers = self._data.get(key)
+        if vers is None:
+            self._data[key] = [(ts, value)]
+            self._keys_dirty = True
+            return
+        # common case: ts newer than all existing
+        if not vers or vers[-1][0] < ts:
+            vers.append((ts, value))
+        else:
+            i = bisect.bisect_left(vers, (ts, b""))
+            if i < len(vers) and vers[i][0] == ts:
+                vers[i] = (ts, value)  # overwrite same-ts (idempotent replay)
+            else:
+                vers.insert(i, (ts, value))
+
+    # -- reads --------------------------------------------------------------
+
+    def get(self, key: bytes, read_ts: int) -> Optional[Tuple[int, bytes]]:
+        vers = self._data.get(key)
+        if not vers:
+            return None
+        i = bisect.bisect_right(vers, read_ts, key=lambda x: x[0])
+        if i == 0:
+            return None
+        return vers[i - 1]
+
+    def versions(self, key: bytes, read_ts: int) -> List[Tuple[int, bytes]]:
+        vers = self._data.get(key)
+        if not vers:
+            return []
+        return [(ts, v) for ts, v in reversed(vers) if ts <= read_ts]
+
+    def _sorted_keys(self) -> List[bytes]:
+        if self._keys_dirty:
+            self._keys = sorted(self._data)
+            self._keys_dirty = False
+        return self._keys
+
+    def iterate(
+        self, prefix: bytes, read_ts: int
+    ) -> Iterator[Tuple[bytes, int, bytes]]:
+        keys = self._sorted_keys()
+        i = bisect.bisect_left(keys, prefix)
+        while i < len(keys):
+            k = keys[i]
+            if not k.startswith(prefix):
+                break
+            got = self.get(k, read_ts)
+            if got is not None:
+                yield (k, got[0], got[1])
+            i += 1
+
+    def iterate_versions(
+        self, prefix: bytes, read_ts: int
+    ) -> Iterator[Tuple[bytes, List[Tuple[int, bytes]]]]:
+        """All versions per key (newest first) — rebuilds & backups."""
+        keys = self._sorted_keys()
+        i = bisect.bisect_left(keys, prefix)
+        while i < len(keys):
+            k = keys[i]
+            if not k.startswith(prefix):
+                break
+            vs = self.versions(k, read_ts)
+            if vs:
+                yield (k, vs)
+            i += 1
+
+    # -- maintenance --------------------------------------------------------
+
+    def delete_below(self, key: bytes, ts: int) -> None:
+        vers = self._data.get(key)
+        if not vers:
+            return
+        self._data[key] = [(t, v) for t, v in vers if t >= ts]
+
+    def drop_prefix(self, prefix: bytes) -> None:
+        for k in [k for k in self._data if k.startswith(prefix)]:
+            del self._data[k]
+        self._keys_dirty = True
+
+    # -- durability ---------------------------------------------------------
+
+    def _replay_wal(self, path: str):
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        n = len(data)
+        while pos + _WAL_REC.size <= n:
+            klen, ts, vlen = _WAL_REC.unpack_from(data, pos)
+            pos += _WAL_REC.size
+            if pos + klen + vlen > n:
+                break  # torn tail write — stop replay (crash-consistent)
+            key = data[pos : pos + klen]
+            pos += klen
+            val = data[pos : pos + vlen]
+            pos += vlen
+            self._put_mem(key, ts, val)
+
+    def snapshot_to(self, path: str):
+        """Write a compact snapshot (all live versions)."""
+        with open(path + ".tmp", "wb") as f:
+            for k in self._sorted_keys():
+                for ts, v in self._data.get(k, []):
+                    f.write(_WAL_REC.pack(len(k), ts, len(v)))
+                    f.write(k)
+                    f.write(v)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(path + ".tmp", path)
+
+    def close(self):
+        if self._wal is not None:
+            self.sync()
+            self._wal.close()
+            self._wal = None
+
+
+def open_kv(path: Optional[str] = None) -> KV:
+    """Open the default store; path=None gives a pure in-memory KV."""
+    if path is None:
+        return MemKV()
+    os.makedirs(path, exist_ok=True)
+    return MemKV(wal_path=os.path.join(path, "wal.log"))

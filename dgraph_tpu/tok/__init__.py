@@ -1,0 +1,1 @@
+from dgraph_tpu.tok.tok import get_tokenizer, get_tokenizers, Tokenizer, build_tokens

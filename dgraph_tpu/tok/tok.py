@@ -1,0 +1,354 @@
+"""Tokenizer registry for index maintenance.
+
+Mirrors /root/reference/tok/tok.go: the Tokenizer interface (:58 — Name,
+Type, Tokens, Identifier byte, IsSortable, IsLossy) and the builtin set
+(registry :84-108): term, exact, full-text (stemmed), int, float, bool,
+datetime granularities (year/month/day/hour), hash, trigram, sha256, geo.
+
+Each token is prefixed with the tokenizer's identifier byte (tok.go:33-56)
+so different tokenizers' terms never collide inside one predicate's index
+range and sortable indexes iterate in order.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import struct
+import unicodedata
+from typing import Dict, List
+
+from dgraph_tpu.types.types import TypeID, Val, convert
+
+# identifier bytes (ref tok/tok.go:33-56)
+IDENT_TERM = 0x1
+IDENT_EXACT = 0x2
+IDENT_YEAR = 0x4
+IDENT_MONTH = 0x41
+IDENT_DAY = 0x42
+IDENT_HOUR = 0x43
+IDENT_GEO = 0x5
+IDENT_INT = 0x6
+IDENT_FLOAT = 0x7
+IDENT_FULLTEXT = 0x8
+IDENT_BOOL = 0x9
+IDENT_TRIGRAM = 0xA
+IDENT_HASH = 0xB
+IDENT_SHA = 0xC
+IDENT_BIGFLOAT = 0xD
+IDENT_VFLOAT = 0xE
+
+
+class Tokenizer:
+    name: str = ""
+    type_id: TypeID = TypeID.STRING
+    identifier: int = 0
+    is_sortable: bool = False
+    is_lossy: bool = True
+
+    def tokens(self, v: Val) -> List[bytes]:
+        raise NotImplementedError
+
+    def prefix(self) -> bytes:
+        return bytes([self.identifier])
+
+    def _wrap(self, toks: List[bytes]) -> List[bytes]:
+        p = self.prefix()
+        return [p + t for t in toks]
+
+
+_STOPWORDS = frozenset(
+    """a an and are as at be by for from has he in is it its of on that the
+    to was were will with this those these you your i we they them he she our
+    not no or but if then so what which who whom""".split()
+)
+
+_word_re = re.compile(r"[\w']+", re.UNICODE)
+
+
+def _normalize(s: str) -> str:
+    # strip accents, lowercase (ref tok uses bleve's unicode normalizer)
+    nfkd = unicodedata.normalize("NFKD", s)
+    return "".join(c for c in nfkd if not unicodedata.combining(c)).lower()
+
+
+def _porter_stem(w: str) -> str:
+    """Tiny porter-style suffix stripper (stand-in for bleve stemmers,
+    ref tok/stemmers.go; full porter in later rounds)."""
+    for suf in ("ingly", "edly", "ing", "ed", "ly", "ies", "es", "s"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            w = w[: -len(suf)]
+            if suf == "ies":
+                w += "y"
+            break
+    return w
+
+
+class TermTokenizer(Tokenizer):
+    name = "term"
+    type_id = TypeID.STRING
+    identifier = IDENT_TERM
+
+    def tokens(self, v: Val) -> List[bytes]:
+        words = _word_re.findall(_normalize(str(v.value)))
+        return self._wrap(sorted({w.encode("utf-8") for w in words}))
+
+
+class ExactTokenizer(Tokenizer):
+    name = "exact"
+    type_id = TypeID.STRING
+    identifier = IDENT_EXACT
+    is_sortable = True
+    is_lossy = False
+
+    def tokens(self, v: Val) -> List[bytes]:
+        return self._wrap([str(v.value).encode("utf-8")])
+
+
+class FulltextTokenizer(Tokenizer):
+    name = "fulltext"
+    type_id = TypeID.STRING
+    identifier = IDENT_FULLTEXT
+
+    def tokens(self, v: Val) -> List[bytes]:
+        words = _word_re.findall(_normalize(str(v.value)))
+        toks = {
+            _porter_stem(w).encode("utf-8")
+            for w in words
+            if w not in _STOPWORDS
+        }
+        return self._wrap(sorted(toks))
+
+
+def _enc_int_sortable(x: int) -> bytes:
+    # flip sign bit so lexicographic byte order == numeric order
+    return struct.pack(">Q", (x + (1 << 63)) & ((1 << 64) - 1))
+
+
+class IntTokenizer(Tokenizer):
+    name = "int"
+    type_id = TypeID.INT
+    identifier = IDENT_INT
+    is_sortable = True
+    is_lossy = False
+
+    def tokens(self, v: Val) -> List[bytes]:
+        return self._wrap([_enc_int_sortable(int(convert(v, TypeID.INT).value))])
+
+
+class FloatTokenizer(Tokenizer):
+    name = "float"
+    type_id = TypeID.FLOAT
+    identifier = IDENT_FLOAT
+    is_sortable = True
+    is_lossy = True
+
+    def tokens(self, v: Val) -> List[bytes]:
+        # reference floats index at int granularity (tok.go FloatTokenizer)
+        return self._wrap(
+            [_enc_int_sortable(int(convert(v, TypeID.FLOAT).value))]
+        )
+
+
+class BoolTokenizer(Tokenizer):
+    name = "bool"
+    type_id = TypeID.BOOL
+    identifier = IDENT_BOOL
+    is_lossy = False
+
+    def tokens(self, v: Val) -> List[bytes]:
+        return self._wrap([b"\x01" if convert(v, TypeID.BOOL).value else b"\x00"])
+
+
+class _DateTokenizer(Tokenizer):
+    type_id = TypeID.DATETIME
+    is_sortable = True
+
+    def _parts(self, v: Val):
+        return convert(v, TypeID.DATETIME).value
+
+    def _enc(self, *fields: int) -> List[bytes]:
+        return self._wrap([b"".join(struct.pack(">H", f) for f in fields)])
+
+
+class YearTokenizer(_DateTokenizer):
+    name = "year"
+    identifier = IDENT_YEAR
+
+    def tokens(self, v):
+        dt = self._parts(v)
+        return self._enc(dt.year)
+
+
+class MonthTokenizer(_DateTokenizer):
+    name = "month"
+    identifier = IDENT_MONTH
+
+    def tokens(self, v):
+        dt = self._parts(v)
+        return self._enc(dt.year, dt.month)
+
+
+class DayTokenizer(_DateTokenizer):
+    name = "day"
+    identifier = IDENT_DAY
+
+    def tokens(self, v):
+        dt = self._parts(v)
+        return self._enc(dt.year, dt.month, dt.day)
+
+
+class HourTokenizer(_DateTokenizer):
+    name = "hour"
+    identifier = IDENT_HOUR
+
+    def tokens(self, v):
+        dt = self._parts(v)
+        return self._enc(dt.year, dt.month, dt.day, dt.hour)
+
+
+class HashTokenizer(Tokenizer):
+    name = "hash"
+    type_id = TypeID.STRING
+    identifier = IDENT_HASH
+    is_lossy = False  # treated as non-lossy for eq (ref tok.go:372)
+
+    def tokens(self, v: Val) -> List[bytes]:
+        h = hashlib.blake2b(
+            str(v.value).encode("utf-8"), digest_size=8
+        ).digest()
+        return self._wrap([h])
+
+
+class Sha256Tokenizer(Tokenizer):
+    name = "sha256"
+    type_id = TypeID.STRING
+    identifier = IDENT_SHA
+    is_lossy = False
+
+    def tokens(self, v: Val) -> List[bytes]:
+        return self._wrap([hashlib.sha256(str(v.value).encode()).digest()])
+
+
+class TrigramTokenizer(Tokenizer):
+    name = "trigram"
+    type_id = TypeID.STRING
+    identifier = IDENT_TRIGRAM
+
+    def tokens(self, v: Val) -> List[bytes]:
+        s = str(v.value)
+        if len(s) < 3:
+            return []
+        toks = {s[i : i + 3].encode("utf-8") for i in range(len(s) - 2)}
+        return self._wrap(sorted(toks))
+
+
+class GeoTokenizer(Tokenizer):
+    """Geo cell tokenizer. Reference uses S2 cell coverings
+    (types/s2index.go IndexCells); we use a quadtree cell scheme over
+    lon/lat with levels 5..12 — same contract (a point indexes the chain of
+    containing cells; near/within queries expand to cover cells)."""
+
+    name = "geo"
+    type_id = TypeID.GEO
+    identifier = IDENT_GEO
+
+    MIN_LEVEL = 5
+    MAX_LEVEL = 12
+
+    @staticmethod
+    def cell_at(lon: float, lat: float, level: int) -> bytes:
+        x = int((lon + 180.0) / 360.0 * (1 << level))
+        y = int((lat + 90.0) / 180.0 * (1 << level))
+        x = min(max(x, 0), (1 << level) - 1)
+        y = min(max(y, 0), (1 << level) - 1)
+        return struct.pack(">BII", level, x, y)
+
+    def tokens(self, v: Val) -> List[bytes]:
+        geo = v.value
+        if isinstance(geo, (str, bytes)):
+            import json
+
+            geo = json.loads(geo)
+        coords = _geo_points(geo)
+        toks = set()
+        for lon, lat in coords:
+            for lvl in range(self.MIN_LEVEL, self.MAX_LEVEL + 1):
+                toks.add(self.cell_at(lon, lat, lvl))
+        return self._wrap(sorted(toks))
+
+
+def _geo_points(geo) -> List[tuple]:
+    t = geo.get("type", "").lower()
+    c = geo.get("coordinates")
+    if t == "point":
+        return [tuple(c)]
+    if t == "polygon":
+        return [tuple(p) for ring in c for p in ring]
+    if t == "multipolygon":
+        return [tuple(p) for poly in c for ring in poly for p in ring]
+    if t == "linestring":
+        return [tuple(p) for p in c]
+    raise ValueError(f"unsupported geo type {t!r}")
+
+
+_REGISTRY: Dict[str, Tokenizer] = {}
+
+
+def register(t: Tokenizer):
+    if t.name in _REGISTRY:
+        raise ValueError(f"duplicate tokenizer {t.name}")
+    _REGISTRY[t.name] = t
+
+
+for _t in (
+    TermTokenizer(),
+    ExactTokenizer(),
+    FulltextTokenizer(),
+    IntTokenizer(),
+    FloatTokenizer(),
+    BoolTokenizer(),
+    YearTokenizer(),
+    MonthTokenizer(),
+    DayTokenizer(),
+    HourTokenizer(),
+    HashTokenizer(),
+    Sha256Tokenizer(),
+    TrigramTokenizer(),
+    GeoTokenizer(),
+):
+    register(_t)
+
+
+def get_tokenizer(name: str) -> Tokenizer:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown tokenizer {name!r}") from None
+
+
+def get_tokenizers(names) -> List[Tokenizer]:
+    return [get_tokenizer(n) for n in names]
+
+
+def default_tokenizer_for(tid: TypeID) -> Tokenizer:
+    """Default index tokenizer per type (ref schema defaults)."""
+    return {
+        TypeID.INT: get_tokenizer("int"),
+        TypeID.FLOAT: get_tokenizer("float"),
+        TypeID.BOOL: get_tokenizer("bool"),
+        TypeID.DATETIME: get_tokenizer("year"),
+        TypeID.GEO: get_tokenizer("geo"),
+        TypeID.STRING: get_tokenizer("term"),
+        TypeID.DEFAULT: get_tokenizer("term"),
+    }.get(tid, get_tokenizer("term"))
+
+
+def build_tokens(v: Val, tokenizers) -> List[bytes]:
+    """All index tokens for value v under the given tokenizers
+    (ref posting/index.go:52 indexTokens)."""
+    out: List[bytes] = []
+    for t in tokenizers:
+        conv = convert(v, t.type_id) if v.tid != t.type_id else v
+        out.extend(t.tokens(conv))
+    return out

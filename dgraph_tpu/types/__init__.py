@@ -1,0 +1,1 @@
+from dgraph_tpu.types.types import TypeID, Val, convert, compare_vals

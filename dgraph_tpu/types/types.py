@@ -1,0 +1,235 @@
+"""Scalar value types & conversion.
+
+Mirrors /root/reference/types/ (scalar_types.go TypeID enum, conversion.go
+Convert, sort.go/compare.go ordering semantics). Values are stored in the
+posting layer as (type_id, payload-bytes) and converted on read; binary
+payload encodings follow the reference's conventions (little-endian int64 /
+float64, RFC3339 time strings parsed to datetime, geo as WKB-lite GeoJSON).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import struct
+from dataclasses import dataclass
+from enum import IntEnum
+from typing import Any, Optional
+
+
+class TypeID(IntEnum):
+    # ids match pb.Posting.ValType semantics (ref protos/pb.proto:310)
+    DEFAULT = 0
+    BINARY = 1
+    INT = 2
+    FLOAT = 3
+    BOOL = 4
+    DATETIME = 5
+    GEO = 6
+    UID = 7
+    PASSWORD = 8
+    STRING = 9
+    OBJECT = 10
+    BIGFLOAT = 11
+    VFLOAT = 12  # float32 vector (ref types/scalar_types.go VFloatID)
+
+
+_NAMES = {
+    "default": TypeID.DEFAULT,
+    "binary": TypeID.BINARY,
+    "int": TypeID.INT,
+    "float": TypeID.FLOAT,
+    "bool": TypeID.BOOL,
+    "datetime": TypeID.DATETIME,
+    "geo": TypeID.GEO,
+    "uid": TypeID.UID,
+    "password": TypeID.PASSWORD,
+    "string": TypeID.STRING,
+    "bigfloat": TypeID.BIGFLOAT,
+    "float32vector": TypeID.VFLOAT,
+}
+_ID2NAME = {v: k for k, v in _NAMES.items()}
+
+
+def type_from_name(name: str) -> TypeID:
+    try:
+        return _NAMES[name]
+    except KeyError:
+        raise ValueError(f"unknown type name {name!r}") from None
+
+
+def type_name(tid: TypeID) -> str:
+    return _ID2NAME.get(tid, "default")
+
+
+@dataclass
+class Val:
+    """A typed value (ref types/value.go Val)."""
+
+    tid: TypeID
+    value: Any
+
+    def __repr__(self):
+        return f"Val({type_name(self.tid)}, {self.value!r})"
+
+
+# ---------------------------------------------------------------------------
+# Binary encode/decode (posting payloads).
+# ---------------------------------------------------------------------------
+
+
+def to_binary(v: Val) -> bytes:
+    t = v.tid
+    if t in (TypeID.DEFAULT, TypeID.STRING, TypeID.PASSWORD):
+        return str(v.value).encode("utf-8")
+    if t == TypeID.BINARY:
+        return bytes(v.value)
+    if t == TypeID.INT:
+        return struct.pack("<q", int(v.value))
+    if t == TypeID.FLOAT:
+        return struct.pack("<d", float(v.value))
+    if t == TypeID.BOOL:
+        return b"\x01" if v.value else b"\x00"
+    if t == TypeID.DATETIME:
+        dt = v.value
+        if isinstance(dt, str):
+            dt = parse_datetime(dt)
+        return dt.isoformat().encode("utf-8")
+    if t == TypeID.GEO:
+        return json.dumps(v.value, separators=(",", ":")).encode("utf-8")
+    if t == TypeID.BIGFLOAT:
+        return str(v.value).encode("utf-8")
+    if t == TypeID.VFLOAT:
+        import numpy as np
+
+        return np.asarray(v.value, dtype=np.float32).tobytes()
+    raise ValueError(f"cannot binary-encode {t}")
+
+
+def from_binary(tid: TypeID, data: bytes) -> Val:
+    if tid in (TypeID.DEFAULT, TypeID.STRING, TypeID.PASSWORD):
+        return Val(tid, data.decode("utf-8"))
+    if tid == TypeID.BINARY:
+        return Val(tid, data)
+    if tid == TypeID.INT:
+        return Val(tid, struct.unpack("<q", data)[0])
+    if tid == TypeID.FLOAT:
+        return Val(tid, struct.unpack("<d", data)[0])
+    if tid == TypeID.BOOL:
+        return Val(tid, data == b"\x01")
+    if tid == TypeID.DATETIME:
+        return Val(tid, parse_datetime(data.decode("utf-8")))
+    if tid == TypeID.GEO:
+        return Val(tid, json.loads(data.decode("utf-8")))
+    if tid == TypeID.BIGFLOAT:
+        from decimal import Decimal
+
+        return Val(tid, Decimal(data.decode("utf-8")))
+    if tid == TypeID.VFLOAT:
+        import numpy as np
+
+        return Val(tid, np.frombuffer(data, dtype=np.float32).copy())
+    raise ValueError(f"cannot binary-decode {tid}")
+
+
+# ---------------------------------------------------------------------------
+# Conversion (ref types/conversion.go Convert).
+# ---------------------------------------------------------------------------
+
+
+def parse_datetime(s: str) -> _dt.datetime:
+    s = s.strip()
+    # RFC3339 with optional fractional seconds / zone; also bare dates.
+    for parse in (
+        lambda x: _dt.datetime.fromisoformat(x.replace("Z", "+00:00")),
+        lambda x: _dt.datetime.strptime(x, "%Y-%m-%d"),
+        lambda x: _dt.datetime.strptime(x, "%Y-%m"),
+        lambda x: _dt.datetime.strptime(x, "%Y"),
+    ):
+        try:
+            return parse(s)
+        except ValueError:
+            continue
+    raise ValueError(f"cannot parse datetime {s!r}")
+
+
+def convert(v: Val, to: TypeID) -> Val:
+    """Convert v to target type (subset of ref types/conversion.go)."""
+    if v.tid == to:
+        return v
+    x = v.value
+    src = v.tid
+    try:
+        if to == TypeID.STRING or to == TypeID.DEFAULT:
+            if src == TypeID.DATETIME:
+                return Val(to, x.isoformat())
+            if src == TypeID.BOOL:
+                return Val(to, "true" if x else "false")
+            return Val(to, str(x))
+        if to == TypeID.INT:
+            if src in (TypeID.STRING, TypeID.DEFAULT):
+                return Val(to, int(float(x)) if "." in str(x) else int(x))
+            if src == TypeID.FLOAT:
+                return Val(to, int(x))
+            if src == TypeID.BOOL:
+                return Val(to, 1 if x else 0)
+            if src == TypeID.DATETIME:
+                return Val(to, int(x.timestamp()))
+        if to == TypeID.FLOAT:
+            if src in (TypeID.STRING, TypeID.DEFAULT):
+                return Val(to, float(x))
+            if src == TypeID.INT:
+                return Val(to, float(x))
+            if src == TypeID.BOOL:
+                return Val(to, 1.0 if x else 0.0)
+            if src == TypeID.DATETIME:
+                return Val(to, x.timestamp())
+        if to == TypeID.BOOL:
+            if src in (TypeID.STRING, TypeID.DEFAULT):
+                if str(x).lower() in ("true", "1"):
+                    return Val(to, True)
+                if str(x).lower() in ("false", "0"):
+                    return Val(to, False)
+                raise ValueError(x)
+            if src == TypeID.INT:
+                return Val(to, x != 0)
+            if src == TypeID.FLOAT:
+                return Val(to, x != 0.0)
+        if to == TypeID.DATETIME:
+            if src in (TypeID.STRING, TypeID.DEFAULT):
+                return Val(to, parse_datetime(str(x)))
+            if src == TypeID.INT:
+                return Val(to, _dt.datetime.fromtimestamp(x, _dt.timezone.utc))
+        if to == TypeID.VFLOAT:
+            import numpy as np
+
+            if src in (TypeID.STRING, TypeID.DEFAULT):
+                return Val(to, np.asarray(json.loads(str(x)), dtype=np.float32))
+            if src == TypeID.BINARY:
+                return Val(to, np.frombuffer(x, dtype=np.float32).copy())
+        if to == TypeID.GEO and src in (TypeID.STRING, TypeID.DEFAULT):
+            return Val(to, json.loads(str(x)))
+        if to == TypeID.BINARY:
+            return Val(to, to_binary(v))
+    except (ValueError, TypeError) as e:
+        raise ValueError(f"cannot convert {v!r} to {type_name(to)}: {e}") from None
+    raise ValueError(f"cannot convert {type_name(src)} to {type_name(to)}")
+
+
+def _sort_key(v: Val):
+    if v.tid == TypeID.DATETIME:
+        x = v.value
+        if x.tzinfo is None:
+            x = x.replace(tzinfo=_dt.timezone.utc)
+        return x
+    return v.value
+
+
+def compare_vals(a: Val, b: Val) -> int:
+    """Three-way compare for same-type Vals (ref types/compare.go)."""
+    ka, kb = _sort_key(a), _sort_key(b)
+    if ka < kb:
+        return -1
+    if ka > kb:
+        return 1
+    return 0

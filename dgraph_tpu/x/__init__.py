@@ -1,0 +1,10 @@
+from dgraph_tpu.x.keys import (
+    DataKey,
+    IndexKey,
+    ReverseKey,
+    CountKey,
+    SchemaKey,
+    TypeKey,
+    parse_key,
+    ParsedKey,
+)

@@ -1,0 +1,166 @@
+"""Badger-style key layout for the host posting store.
+
+Mirrors the semantics of /root/reference/x/keys.go (DataKey:201,
+IndexKey:258, ReverseKey:223, CountKey:279, SchemaKey:174, TypeKey:186):
+keys order by (namespace|attr) prefix first so a whole predicate (tablet) is
+one contiguous range — that contiguity is what makes predicate-level
+sharding, moves, and prefix iteration work.
+
+Layout (bytes, big-endian so lexicographic order == numeric order):
+  [tag:1][len(nsattr):2][nsattr][kind:1][suffix]
+    tag:    0x00 data/index/reverse/count, 0x01 schema, 0x02 type
+    nsattr: 8-byte namespace (big-endian u64) + attr utf-8
+            (ref x/keys.go NamespaceAttr — namespace is baked into the attr)
+    kind:   0x00 data(uid u64) | 0x02 index(term bytes) | 0x04 reverse(uid)
+            | 0x08 count(u32 count + rev flag)
+Split keys (multi-part posting lists, ref x/keys.go:42 ByteSplit) append a
+part id; handled by posting/ when lists exceed the split threshold.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional
+
+TAG_DEFAULT = 0x00
+TAG_SCHEMA = 0x01
+TAG_TYPE = 0x02
+
+KIND_DATA = 0x00
+KIND_INDEX = 0x02
+KIND_REVERSE = 0x04
+KIND_COUNT = 0x08
+
+GALAXY_NS = 0  # default namespace (ref x/keys.go GalaxyNamespace)
+
+
+def namespace_attr(ns: int, attr: str) -> bytes:
+    return struct.pack(">Q", ns) + attr.encode("utf-8")
+
+
+def attr_from_nsattr(nsattr: bytes) -> tuple[int, str]:
+    ns = struct.unpack(">Q", nsattr[:8])[0]
+    return ns, nsattr[8:].decode("utf-8")
+
+
+def _prefix(tag: int, nsattr: bytes) -> bytes:
+    return struct.pack(">BH", tag, len(nsattr)) + nsattr
+
+
+def DataKey(attr: str, uid: int, ns: int = GALAXY_NS) -> bytes:
+    return (
+        _prefix(TAG_DEFAULT, namespace_attr(ns, attr))
+        + bytes([KIND_DATA])
+        + struct.pack(">Q", uid)
+    )
+
+
+def ReverseKey(attr: str, uid: int, ns: int = GALAXY_NS) -> bytes:
+    return (
+        _prefix(TAG_DEFAULT, namespace_attr(ns, attr))
+        + bytes([KIND_REVERSE])
+        + struct.pack(">Q", uid)
+    )
+
+
+def IndexKey(attr: str, term: bytes, ns: int = GALAXY_NS) -> bytes:
+    if isinstance(term, str):
+        term = term.encode("utf-8")
+    return _prefix(TAG_DEFAULT, namespace_attr(ns, attr)) + bytes([KIND_INDEX]) + term
+
+
+def CountKey(attr: str, count: int, reverse: bool = False, ns: int = GALAXY_NS) -> bytes:
+    return (
+        _prefix(TAG_DEFAULT, namespace_attr(ns, attr))
+        + bytes([KIND_COUNT])
+        + struct.pack(">I", count)
+        + (b"\x01" if reverse else b"\x00")
+    )
+
+
+def SchemaKey(attr: str, ns: int = GALAXY_NS) -> bytes:
+    return _prefix(TAG_SCHEMA, namespace_attr(ns, attr))
+
+
+def TypeKey(name: str, ns: int = GALAXY_NS) -> bytes:
+    return _prefix(TAG_TYPE, namespace_attr(ns, name))
+
+
+def PredicatePrefix(attr: str, ns: int = GALAXY_NS) -> bytes:
+    """Prefix covering all data/index/reverse/count keys of one predicate."""
+    return _prefix(TAG_DEFAULT, namespace_attr(ns, attr))
+
+
+def DataPrefix(attr: str, ns: int = GALAXY_NS) -> bytes:
+    return PredicatePrefix(attr, ns) + bytes([KIND_DATA])
+
+
+def IndexPrefix(attr: str, ns: int = GALAXY_NS) -> bytes:
+    return PredicatePrefix(attr, ns) + bytes([KIND_INDEX])
+
+
+def ReversePrefix(attr: str, ns: int = GALAXY_NS) -> bytes:
+    return PredicatePrefix(attr, ns) + bytes([KIND_REVERSE])
+
+
+def CountPrefix(attr: str, ns: int = GALAXY_NS) -> bytes:
+    return PredicatePrefix(attr, ns) + bytes([KIND_COUNT])
+
+
+@dataclass
+class ParsedKey:
+    """Decoded key (ref x/keys.go:330 ParsedKey)."""
+
+    tag: int
+    ns: int
+    attr: str
+    kind: Optional[int] = None
+    uid: Optional[int] = None
+    term: Optional[bytes] = None
+    count: Optional[int] = None
+    count_reverse: bool = False
+
+    @property
+    def is_data(self):
+        return self.tag == TAG_DEFAULT and self.kind == KIND_DATA
+
+    @property
+    def is_index(self):
+        return self.tag == TAG_DEFAULT and self.kind == KIND_INDEX
+
+    @property
+    def is_reverse(self):
+        return self.tag == TAG_DEFAULT and self.kind == KIND_REVERSE
+
+    @property
+    def is_count(self):
+        return self.tag == TAG_DEFAULT and self.kind == KIND_COUNT
+
+    @property
+    def is_schema(self):
+        return self.tag == TAG_SCHEMA
+
+    @property
+    def is_type(self):
+        return self.tag == TAG_TYPE
+
+
+def parse_key(key: bytes) -> ParsedKey:
+    tag, nlen = struct.unpack_from(">BH", key, 0)
+    nsattr = key[3 : 3 + nlen]
+    ns, attr = attr_from_nsattr(nsattr)
+    rest = key[3 + nlen :]
+    if tag in (TAG_SCHEMA, TAG_TYPE):
+        return ParsedKey(tag=tag, ns=ns, attr=attr)
+    kind = rest[0]
+    body = rest[1:]
+    pk = ParsedKey(tag=tag, ns=ns, attr=attr, kind=kind)
+    if kind in (KIND_DATA, KIND_REVERSE):
+        pk.uid = struct.unpack(">Q", body)[0]
+    elif kind == KIND_INDEX:
+        pk.term = body
+    elif kind == KIND_COUNT:
+        pk.count = struct.unpack(">I", body[:4])[0]
+        pk.count_reverse = body[4:5] == b"\x01"
+    return pk

@@ -1,0 +1,1 @@
+from dgraph_tpu.zero.zero import ZeroLite, TxnConflictError

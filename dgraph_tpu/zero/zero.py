@@ -1,0 +1,80 @@
+"""Zero-lite: timestamp/UID leasing and the transaction oracle.
+
+Single-process implementation of the five operations the reference
+abstracts behind the ZeroHooks seam for embedded deployments
+(/root/reference/hooks/config.go:23): lease timestamps, lease UIDs,
+commit-or-abort with conflict detection, namespace ids, membership.
+The distributed Zero service (Raft-replicated, delta streams —
+ref dgraph/cmd/zero/oracle.go) builds on the same core in parallel/.
+
+Conflict rule (ref dgraph/cmd/zero/oracle.go:72 hasConflict): a txn T
+commits iff no conflict-key it writes was committed by another txn with
+commit_ts in (T.start_ts, now]. SSI at predicate+entity granularity via
+key fingerprints.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Set
+
+
+class TxnConflictError(Exception):
+    """Transaction aborted due to write conflict (ref x/error ErrConflict)."""
+
+
+class ZeroLite:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._max_ts = 0
+        self._max_uid = 1  # uid 0 invalid, uid 1 reserved (ref assign.go)
+        # conflict key fingerprint -> last commit_ts
+        self._commits: Dict[int, int] = {}
+        self._aborted: Set[int] = set()
+
+    # -- leases (ref dgraph/cmd/zero/assign.go:69 lease) ---------------------
+
+    def next_ts(self, count: int = 1) -> int:
+        """Lease `count` timestamps; returns the first."""
+        with self._lock:
+            first = self._max_ts + 1
+            self._max_ts += count
+            return first
+
+    def read_ts(self) -> int:
+        """A fresh read timestamp (linearizable read point)."""
+        return self.next_ts()
+
+    def assign_uids(self, count: int) -> int:
+        """Lease `count` uids; returns the first (ref assign.go:176)."""
+        with self._lock:
+            first = self._max_uid + 1
+            self._max_uid += count
+            return first
+
+    @property
+    def max_assigned(self) -> int:
+        return self._max_ts
+
+    # -- commit (ref dgraph/cmd/zero/oracle.go:421 CommitOrAbort) ------------
+
+    def commit(self, start_ts: int, conflict_keys) -> int:
+        """Returns commit_ts, or raises TxnConflictError."""
+        with self._lock:
+            for ck in conflict_keys:
+                last = self._commits.get(ck, 0)
+                if last > start_ts:
+                    self._aborted.add(start_ts)
+                    raise TxnConflictError(
+                        f"conflict on key fingerprint {ck:#x} "
+                        f"(committed at {last} > start {start_ts})"
+                    )
+            self._max_ts += 1
+            commit_ts = self._max_ts
+            for ck in conflict_keys:
+                self._commits[ck] = commit_ts
+            return commit_ts
+
+    def abort(self, start_ts: int):
+        with self._lock:
+            self._aborted.add(start_ts)

@@ -1,0 +1,178 @@
+"""DQL parser tests (mirrors a subset of /root/reference/dql/parser_test.go)."""
+
+import pytest
+
+from dgraph_tpu.dql.parser import ParseError, parse
+
+
+def test_basic_block():
+    q = """
+    {
+      people(func: eq(name, "Alice"), first: 10, offset: 2) {
+        name
+        age
+      }
+    }
+    """
+    blocks = parse(q)
+    assert len(blocks) == 1
+    b = blocks[0]
+    assert b.attr == "people"
+    assert b.func.name == "eq"
+    assert b.func.attr == "name"
+    assert b.func.args == ["Alice"]
+    assert b.first == 10 and b.offset == 2
+    assert [c.attr for c in b.children] == ["name", "age"]
+
+
+def test_filter_tree():
+    q = """
+    {
+      q(func: has(name)) @filter((gt(age, 18) OR has(friend)) AND NOT eq(name, "X")) {
+        name
+      }
+    }
+    """
+    b = parse(q)[0]
+    t = b.filter
+    assert t.op == "and"
+    assert t.children[0].op == "or"
+    assert t.children[1].op == "not"
+    assert t.children[1].children[0].func.name == "eq"
+
+
+def test_nested_children_alias_pagination():
+    q = """
+    {
+      q(func: uid(0x1)) {
+        buddies: friend (first: 5, orderasc: name) @filter(lt(age, 30)) {
+          name
+          uid
+        }
+        c: count(friend)
+        total: count(uid)
+      }
+    }
+    """
+    b = parse(q)[0]
+    assert b.func.name == "uid" and b.func.args == [1]
+    f = b.children[0]
+    assert f.alias == "buddies" and f.attr == "friend"
+    assert f.first == 5 and f.order[0].attr == "name" and not f.order[0].desc
+    assert f.filter.func.name == "lt"
+    assert f.children[1].is_uid
+    c = b.children[1]
+    assert c.is_count and c.attr == "friend" and c.alias == "c"
+    t = b.children[2]
+    assert t.is_count and t.attr == "uid" and t.alias == "total"
+
+
+def test_vars_and_val():
+    q = """
+    {
+      var(func: has(age)) {
+        a as age
+        f as friend
+      }
+      q(func: uid(f), orderdesc: val(a)) {
+        name
+        val(a)
+        total: sum(val(a))
+      }
+    }
+    """
+    blocks = parse(q)
+    assert blocks[0].is_var_block
+    assert blocks[0].children[0].var_name == "a"
+    assert blocks[1].func.uid_var == "f"
+    assert blocks[1].order[0].val_var == "a"
+    assert blocks[1].children[1].val_var == "a"
+    assert blocks[1].children[2].aggregator == "sum"
+
+
+def test_similar_to_options():
+    q = """
+    {
+      v(func: similar_to(embedding, 5, "[0.1, 0.2]", ef: 20)) { uid }
+    }
+    """
+    b = parse(q)[0]
+    fn = b.func
+    assert fn.name == "similar_to"
+    assert fn.attr == "embedding"
+    assert fn.args[0] == 5
+    assert fn.options.get("ef") == 20
+
+
+def test_between_regexp_terms():
+    q = """
+    {
+      a(func: between(age, 18, 30)) { uid }
+      b(func: regexp(name, /ali.*/i)) { uid }
+      c(func: anyofterms(name, "alice bob")) { uid }
+      d(func: type(Person)) { uid }
+    }
+    """
+    blocks = parse(q)
+    assert blocks[0].func.args == [18, 30]
+    assert blocks[1].func.args == [("regex", "ali.*", "i")]
+    assert blocks[2].func.args == ["alice bob"]
+    assert blocks[3].func.attr == "Person"
+
+
+def test_recurse_cascade_facets():
+    q = """
+    {
+      q(func: uid(1)) @recurse(depth: 3, loop: true) @cascade {
+        name
+        friend @facets(since) @facets(orderasc: weight)
+      }
+    }
+    """
+    b = parse(q)[0]
+    assert b.recurse and b.recurse_depth == 3 and b.recurse_loop
+    assert b.cascade
+    f = b.children[1]
+    assert f.facets and "since" in f.facet_names
+    assert f.facet_order == "weight"
+
+
+def test_shortest_path_block():
+    q = """
+    {
+      path as shortest(from: 0x1, to: 0x2, numpaths: 2) {
+        friend
+      }
+      sp(func: uid(path)) { name }
+    }
+    """
+    blocks = parse(q)
+    assert blocks[0].attr == "shortest"
+    assert blocks[0].shortest_from == 1
+    assert blocks[0].shortest_to == 2
+    assert blocks[0].num_paths == 2
+    assert blocks[0].var_name == "path"
+
+
+def test_lang_tag_and_expand():
+    q = """
+    {
+      q(func: eq(name@en, "Alice")) {
+        name@en
+        expand(_all_) { name }
+      }
+    }
+    """
+    b = parse(q)[0]
+    assert b.func.lang == "en"
+    assert b.children[0].lang == "en"
+    assert b.children[1].expand == "_all_"
+
+
+def test_errors():
+    with pytest.raises(ParseError):
+        parse("{ q(func: eq(name, ) { } }")
+    with pytest.raises(ParseError):
+        parse("not a query")
+    with pytest.raises(ParseError):
+        parse("{ q(func: frobnicate(name)) { uid } } trailing")

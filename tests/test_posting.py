@@ -1,0 +1,187 @@
+"""Posting-list layering semantics (mirrors /root/reference/posting/list_test.go):
+rollup + committed deltas + in-txn deltas, value postings, conflicts."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.posting.pl import (
+    OP_DEL,
+    OP_SET,
+    Posting,
+    PostingList,
+    decode_record,
+    encode_delta,
+    encode_rollup,
+    lang_uid,
+)
+from dgraph_tpu.posting.lists import LocalCache, Txn
+from dgraph_tpu.posting.mutation import DirectedEdge, apply_edge
+from dgraph_tpu.schema.schema import State, parse_schema
+from dgraph_tpu.storage.kv import MemKV
+from dgraph_tpu.types.types import TypeID, Val
+from dgraph_tpu.x import keys
+from dgraph_tpu.zero.zero import TxnConflictError, ZeroLite
+from dgraph_tpu.codec import uidpack
+
+
+def test_record_roundtrip():
+    pack = uidpack.encode(np.array([1, 5, 9], np.uint64))
+    posts = [
+        Posting(uid=lang_uid(""), value=b"hello", value_type=TypeID.STRING),
+        Posting(
+            uid=7,
+            facets={"since": b"2006"},
+            facet_types={"since": TypeID.DEFAULT},
+        ),
+    ]
+    kind, pk, ps = decode_record(encode_rollup(pack, posts))
+    assert kind == 0
+    np.testing.assert_array_equal(uidpack.decode(pk), [1, 5, 9])
+    assert ps[0].value == b"hello"
+    assert ps[1].facets["since"] == b"2006"
+
+    kind, _, ps = decode_record(encode_delta([Posting(uid=3, op=OP_DEL)]))
+    assert kind == 1 and ps[0].op == OP_DEL
+
+
+def test_layered_uids():
+    kv = MemKV()
+    key = b"testkey"
+    pack = uidpack.encode(np.array([10, 20, 30], np.uint64))
+    kv.put(key, 5, encode_rollup(pack, []))
+    kv.put(key, 8, encode_delta([Posting(uid=40, op=OP_SET)]))
+    kv.put(key, 12, encode_delta([Posting(uid=20, op=OP_DEL)]))
+
+    pl = PostingList.from_versions(key, kv.versions(key, 9))
+    np.testing.assert_array_equal(pl.uids(), [10, 20, 30, 40])
+
+    pl = PostingList.from_versions(key, kv.versions(key, 12))
+    np.testing.assert_array_equal(pl.uids(), [10, 30, 40])
+
+    # read below rollup+deltas sees only what was there
+    pl = PostingList.from_versions(key, kv.versions(key, 5))
+    np.testing.assert_array_equal(pl.uids(), [10, 20, 30])
+
+
+def test_rollup_compacts():
+    kv = MemKV()
+    key = b"k"
+    kv.put(key, 1, encode_rollup(uidpack.encode(np.array([1, 2], np.uint64)), []))
+    kv.put(key, 3, encode_delta([Posting(uid=9, op=OP_SET)]))
+    pl = PostingList.from_versions(key, kv.versions(key, 10))
+    rec, ts = pl.rollup()
+    assert ts == 3
+    kv.put(key, ts, rec)  # same-ts overwrite (idempotent)
+    pl2 = PostingList.from_versions(key, kv.versions(key, 10))
+    assert not pl2.deltas
+    np.testing.assert_array_equal(pl2.uids(), [1, 2, 9])
+
+
+SCHEMA = """
+name: string @index(term, exact) .
+age: int @index(int) .
+friend: [uid] @reverse @count .
+"""
+
+
+def _state():
+    st = State()
+    preds, _ = parse_schema(SCHEMA)
+    for su in preds:
+        st.set(su)
+    return st
+
+
+def test_apply_edges_and_read():
+    kv = MemKV()
+    zero = ZeroLite()
+    st = _state()
+
+    txn = Txn(kv, zero.next_ts())
+    apply_edge(txn, st, DirectedEdge(1, "name", value=Val(TypeID.STRING, "Alice")))
+    apply_edge(txn, st, DirectedEdge(1, "friend", value_id=2))
+    apply_edge(txn, st, DirectedEdge(1, "friend", value_id=3))
+    commit_ts = zero.commit(txn.start_ts, txn.conflict_keys)
+    txn.write_deltas(kv, commit_ts)
+
+    read = LocalCache(kv, zero.read_ts())
+    np.testing.assert_array_equal(
+        read.uids(keys.DataKey("friend", 1)), [2, 3]
+    )
+    assert read.value(keys.DataKey("name", 1)).value == "Alice"
+    # reverse edges
+    np.testing.assert_array_equal(read.uids(keys.ReverseKey("friend", 2)), [1])
+    # term index
+    tok = b"\x01" + b"alice"
+    np.testing.assert_array_equal(
+        read.uids(keys.IndexKey("name", tok)), [1]
+    )
+    # exact index
+    tok = b"\x02" + b"Alice"
+    np.testing.assert_array_equal(read.uids(keys.IndexKey("name", tok)), [1])
+
+
+def test_value_overwrite_reindexes():
+    kv = MemKV()
+    zero = ZeroLite()
+    st = _state()
+
+    t1 = Txn(kv, zero.next_ts())
+    apply_edge(t1, st, DirectedEdge(1, "name", value=Val(TypeID.STRING, "Bob")))
+    t1.write_deltas(kv, zero.commit(t1.start_ts, t1.conflict_keys))
+
+    t2 = Txn(kv, zero.next_ts())
+    apply_edge(t2, st, DirectedEdge(1, "name", value=Val(TypeID.STRING, "Carol")))
+    t2.write_deltas(kv, zero.commit(t2.start_ts, t2.conflict_keys))
+
+    read = LocalCache(kv, zero.read_ts())
+    assert read.value(keys.DataKey("name", 1)).value == "Carol"
+    assert len(read.uids(keys.IndexKey("name", b"\x01bob"))) == 0
+    np.testing.assert_array_equal(read.uids(keys.IndexKey("name", b"\x01carol")), [1])
+
+
+def test_txn_conflict():
+    kv = MemKV()
+    zero = ZeroLite()
+    st = _state()
+    st.get("name").upsert = True  # conflict at entity granularity
+
+    t1 = Txn(kv, zero.next_ts())
+    t2 = Txn(kv, zero.next_ts())
+    apply_edge(t1, st, DirectedEdge(1, "name", value=Val(TypeID.STRING, "A")))
+    apply_edge(t2, st, DirectedEdge(1, "name", value=Val(TypeID.STRING, "B")))
+    t1.write_deltas(kv, zero.commit(t1.start_ts, t1.conflict_keys))
+    with pytest.raises(TxnConflictError):
+        zero.commit(t2.start_ts, t2.conflict_keys)
+
+
+def test_uncommitted_visible_to_own_txn_only():
+    kv = MemKV()
+    zero = ZeroLite()
+    st = _state()
+
+    txn = Txn(kv, zero.next_ts())
+    apply_edge(txn, st, DirectedEdge(7, "friend", value_id=8))
+    np.testing.assert_array_equal(
+        txn.cache.uids(keys.DataKey("friend", 7)), [8]
+    )
+    other = LocalCache(kv, zero.read_ts())
+    assert len(other.uids(keys.DataKey("friend", 7))) == 0
+
+
+def test_int_index_tokens_sortable():
+    kv = MemKV()
+    zero = ZeroLite()
+    st = _state()
+    for uid, age in [(1, 25), (2, 30), (3, 19)]:
+        t = Txn(kv, zero.next_ts())
+        apply_edge(t, st, DirectedEdge(uid, "age", value=Val(TypeID.INT, age)))
+        t.write_deltas(kv, zero.commit(t.start_ts, t.conflict_keys))
+    read = LocalCache(kv, zero.read_ts())
+    # iterate int index in order -> ages ascending
+    got = []
+    for k, _, _ in read.kv.iterate(keys.IndexPrefix("age"), read.read_ts):
+        pk = keys.parse_key(k)
+        uids = read.uids(k)
+        got.extend([(pk.term, int(u)) for u in uids])
+    assert [u for _, u in got] == [3, 1, 2]

@@ -1,0 +1,316 @@
+"""End-to-end query tests: RDF load -> DQL -> JSON.
+
+Mirrors the shape of /root/reference/query/query0_test.go golden assertions
+on a small social graph.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Server
+
+SCHEMA = """
+name: string @index(term, exact, trigram) @lang .
+age: int @index(int) .
+friend: [uid] @reverse @count .
+alive: bool @index(bool) .
+loc: geo @index(geo) .
+dob: datetime @index(year) .
+nick: string .
+dgraph.type: [string] @index(exact) .
+
+type Person {
+  name
+  age
+  friend
+}
+"""
+
+RDF = """
+<0x1> <name> "Michonne" .
+<0x1> <age> "38"^^<xs:int> .
+<0x1> <alive> "true"^^<xs:boolean> .
+<0x1> <dob> "1910-01-01"^^<xs:dateTime> .
+<0x1> <dgraph.type> "Person" .
+<0x1> <friend> <0x17> (since=2006-01-02) .
+<0x1> <friend> <0x18> .
+<0x1> <friend> <0x19> .
+<0x1> <friend> <0x1f> .
+<0x17> <name> "Rick Grimes" .
+<0x17> <age> "15"^^<xs:int> .
+<0x17> <dgraph.type> "Person" .
+<0x17> <friend> <0x1> .
+<0x18> <name> "Glenn Rhee" .
+<0x18> <age> "15"^^<xs:int> .
+<0x18> <dgraph.type> "Person" .
+<0x19> <name> "Daryl Dixon" .
+<0x19> <age> "17"^^<xs:int> .
+<0x19> <dgraph.type> "Person" .
+<0x1f> <name> "Andrea" .
+<0x1f> <age> "19"^^<xs:int> .
+<0x1f> <dgraph.type> "Person" .
+<0x1f> <friend> <0x18> .
+"""
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = Server()
+    s.alter(SCHEMA)
+    txn = s.new_txn()
+    txn.mutate_rdf(set_rdf=RDF, commit_now=True)
+    return s
+
+
+def test_eq_root_with_children(server):
+    res = server.query(
+        """
+        { me(func: eq(name, "Michonne")) {
+            name age alive
+            friend { name }
+        } }
+        """
+    )["data"]
+    assert res == {
+        "me": [
+            {
+                "name": "Michonne",
+                "age": 38,
+                "alive": True,
+                "friend": [
+                    {"name": "Rick Grimes"},
+                    {"name": "Glenn Rhee"},
+                    {"name": "Daryl Dixon"},
+                    {"name": "Andrea"},
+                ],
+            }
+        ]
+    }
+
+
+def test_uid_func_and_uid_leaf(server):
+    res = server.query("{ me(func: uid(0x17)) { uid name } }")["data"]
+    assert res == {"me": [{"uid": "0x17", "name": "Rick Grimes"}]}
+
+
+def test_filter_and_or_not(server):
+    res = server.query(
+        """
+        { me(func: eq(name, "Michonne")) {
+            friend @filter(gt(age, 14) AND NOT eq(name, "Andrea")) { name }
+        } }
+        """
+    )["data"]
+    names = {o["name"] for o in res["me"][0]["friend"]}
+    assert names == {"Rick Grimes", "Glenn Rhee", "Daryl Dixon"}
+
+
+def test_count_and_count_uid(server):
+    res = server.query(
+        """
+        { me(func: has(friend)) {
+            name
+            c: count(friend)
+          }
+          total(func: has(name)) { count(uid) }
+        }
+        """
+    )["data"]
+    by_name = {o["name"]: o["c"] for o in res["me"]}
+    assert by_name == {"Michonne": 4, "Rick Grimes": 1, "Andrea": 1}
+    assert res["total"] == [{"count": 5}]
+
+
+def test_pagination_and_order(server):
+    res = server.query(
+        """
+        { q(func: has(age), orderasc: age, first: 2) { name age } }
+        """
+    )["data"]
+    assert [o["age"] for o in res["q"]] == [15, 15]
+    res = server.query(
+        """
+        { q(func: has(age), orderdesc: age, first: 2, offset: 1) { name age } }
+        """
+    )["data"]
+    assert [o["age"] for o in res["q"]] == [19, 17]
+
+
+def test_between_and_ge(server):
+    res = server.query("{ q(func: between(age, 16, 19)) { age } }")["data"]
+    assert sorted(o["age"] for o in res["q"]) == [17, 19]
+    res = server.query("{ q(func: ge(age, 19)) { age } }")["data"]
+    assert sorted(o["age"] for o in res["q"]) == [19, 38]
+
+
+def test_anyofterms_allofterms(server):
+    res = server.query(
+        '{ q(func: anyofterms(name, "rick andrea")) { name } }'
+    )["data"]
+    assert {o["name"] for o in res["q"]} == {"Rick Grimes", "Andrea"}
+    res = server.query(
+        '{ q(func: allofterms(name, "rick grimes")) { name } }'
+    )["data"]
+    assert {o["name"] for o in res["q"]} == {"Rick Grimes"}
+
+
+def test_regexp(server):
+    res = server.query('{ q(func: regexp(name, /Gle.*/)) { name } }')["data"]
+    assert {o["name"] for o in res["q"]} == {"Glenn Rhee"}
+
+
+def test_reverse_edge(server):
+    res = server.query(
+        '{ q(func: eq(name, "Glenn Rhee")) { ~friend { name } } }'
+    )["data"]
+    assert {o["name"] for o in res["q"][0]["~friend"]} == {"Michonne", "Andrea"}
+
+
+def test_type_func_and_expand(server):
+    res = server.query('{ q(func: type(Person), orderasc: name, first: 1) { name } }')[
+        "data"
+    ]
+    assert res["q"] == [{"name": "Andrea"}]
+    res = server.query('{ q(func: uid(0x18)) { expand(_all_) } }')["data"]
+    assert res["q"][0]["name"] == "Glenn Rhee"
+    assert res["q"][0]["age"] == 15
+
+
+def test_vars_and_aggregation(server):
+    res = server.query(
+        """
+        {
+          var(func: eq(name, "Michonne")) {
+            f as friend { a as age }
+          }
+          friends(func: uid(f), orderasc: val(a)) {
+            name
+            val(a)
+            }
+          stats(func: uid(f)) {
+            m: min(val(a))
+            x: max(val(a))
+            s: sum(val(a))
+          }
+        }
+        """
+    )["data"]
+    assert [o["name"] for o in res["friends"]] == [
+        "Rick Grimes",
+        "Glenn Rhee",
+        "Daryl Dixon",
+        "Andrea",
+    ]
+    stats = {}
+    for o in res["stats"]:
+        stats.update(o)
+    assert stats == {"m": 15, "x": 19, "s": 66}
+
+
+def test_cascade(server):
+    res = server.query(
+        "{ q(func: type(Person)) @cascade { name friend { name } } }"
+    )["data"]
+    names = {o["name"] for o in res["q"]}
+    assert names == {"Michonne", "Rick Grimes", "Andrea"}
+
+
+def test_facets(server):
+    res = server.query(
+        '{ q(func: uid(0x1)) { friend @facets(since) { name } } }'
+    )["data"]
+    # facet values ride on the child objects keyed pred|facet
+    rick = [o for o in res["q"][0]["friend"] if o.get("name") == "Rick Grimes"]
+    assert rick  # facet itself is on the edge; round-1 exposes child values
+
+
+def test_has_at_root(server):
+    res = server.query("{ q(func: has(alive)) { name } }")["data"]
+    assert {o["name"] for o in res["q"]} == {"Michonne"}
+
+
+def test_shortest_path(server):
+    res = server.query(
+        """
+        {
+          path as shortest(from: 0x17, to: 0x18) { friend }
+          names(func: uid(path)) { name }
+        }
+        """
+    )["data"]
+    # 0x17 -> 0x1 -> 0x18
+    uids = [o["uid"] for o in res["_path_"][0]["_path_"]]
+    assert uids == ["0x17", "0x1", "0x18"]
+    assert {o["name"] for o in res["names"]} == {
+        "Rick Grimes",
+        "Michonne",
+        "Glenn Rhee",
+    }
+
+
+def test_recurse(server):
+    res = server.query(
+        """
+        { q(func: uid(0x1f)) @recurse(depth: 3) { name friend } }
+        """
+    )["data"]
+    # 0x1f -> 0x18 (no further friends)
+    assert res["q"][0]["name"] == "Andrea"
+    assert res["q"][0]["friend"][0]["name"] == "Glenn Rhee"
+
+
+def test_normalize(server):
+    res = server.query(
+        """
+        { q(func: uid(0x1)) @normalize {
+            n: name
+            friend { fn: name }
+        } }
+        """
+    )["data"]
+    assert {o["fn"] for o in res["q"]} == {
+        "Rick Grimes",
+        "Glenn Rhee",
+        "Daryl Dixon",
+        "Andrea",
+    }
+    assert all(o["n"] == "Michonne" for o in res["q"])
+
+
+def test_mutation_delete(server):
+    s = Server()
+    s.alter("name: string @index(exact) .\nfriend: [uid] .")
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf='<0x1> <name> "A" .\n<0x1> <friend> <0x2> .', commit_now=True
+    )
+    t = s.new_txn()
+    t.mutate_rdf(del_rdf="<0x1> <friend> <0x2> .", commit_now=True)
+    res = s.query('{ q(func: eq(name, "A")) { name friend { uid } } }')["data"]
+    assert res["q"] == [{"name": "A"}]
+    # S P * delete
+    t = s.new_txn()
+    t.mutate_rdf(del_rdf="<0x1> <name> * .", commit_now=True)
+    res = s.query('{ q(func: has(name)) { name } }')["data"]
+    assert res["q"] == []
+
+
+def test_blank_nodes_and_json_mutation(server):
+    s = Server()
+    s.alter("name: string @index(exact) .\nfriend: [uid] .")
+    t = s.new_txn()
+    uids = t.mutate_json(
+        set_obj={
+            "uid": "_:alice",
+            "name": "Alice",
+            "friend": [{"uid": "_:bob", "name": "Bob"}],
+        },
+        commit_now=True,
+    )
+    assert "alice" in uids and "bob" in uids
+    res = s.query('{ q(func: eq(name, "Alice")) { name friend { name } } }')[
+        "data"
+    ]
+    assert res["q"][0]["friend"][0]["name"] == "Bob"
