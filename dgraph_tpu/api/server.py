@@ -290,7 +290,7 @@ class Server:
             cache, self.schema, vector_indexes=self.vector_indexes
         )
         nodes = ex.process(blocks)
-        enc = JsonEncoder(val_vars=ex.val_vars)
+        enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
         return {"data": enc.encode_blocks(nodes)}
 
 

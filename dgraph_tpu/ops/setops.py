@@ -47,13 +47,24 @@ def _iota_mask(n: int, length) -> jnp.ndarray:
     return jnp.arange(n, dtype=jnp.int32) < length
 
 
+def _searchsorted(b, a):
+    """Shape-adaptive search: unrolled binary search when the query side is
+    much smaller than the target (log2(n) vectorized steps), sort-based
+    search when both sides are large (one fused sort amortizes better on
+    the TPU) — the static-shape analog of the reference's linear/jump/binary
+    strategy pick (algo/uidlist.go:142-168)."""
+    if a.shape[0] * 32 <= b.shape[0]:
+        return jnp.searchsorted(b, a, method="scan_unrolled")
+    return jnp.searchsorted(b, a, method="sort")
+
+
 def membership(a, la, b, lb):
     """mask[i] = (i < la) and (a[i] in b[:lb]).
 
     Vectorized binary search replaces the scalar jump/binary loops of
     algo/uidlist.go:195,226.
     """
-    idx = jnp.searchsorted(b, a, method="sort")
+    idx = _searchsorted(b, a)
     idx_c = jnp.minimum(idx, b.shape[0] - 1)
     hit = (idx < lb) & (jnp.take(b, idx_c) == a)
     return hit & _iota_mask(a.shape[0], la)
@@ -149,7 +160,7 @@ def intersect_many(lists, lengths):
 
 def index_of(a, la, u):
     """Position of u in a[:la], or -1. Ref algo/uidlist.go:546."""
-    idx = jnp.searchsorted(a, u, method="sort")
+    idx = jnp.searchsorted(a, u, method="scan_unrolled")
     idx_c = jnp.minimum(idx, a.shape[0] - 1)
     hit = (idx < la) & (jnp.take(a, idx_c) == u)
     return jnp.where(hit, idx, -1)

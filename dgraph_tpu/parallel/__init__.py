@@ -1,0 +1,7 @@
+from dgraph_tpu.parallel.mesh import (
+    make_mesh,
+    sharded_kmeans_step,
+    sharded_topk,
+    sharded_membership,
+    sharded_ivf_train,
+)

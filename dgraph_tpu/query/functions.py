@@ -310,7 +310,7 @@ class FuncRunner:
         # trigram prefilter (ref worker/task.go:1240 + tok trigram)
         cands = None
         if "trigram" in su.tokenizers:
-            plain = _required_trigrams(pattern)
+            plain = _required_trigrams(pattern, flags)
             if plain:
                 tok = get_tokenizer("trigram")
                 lists = []
@@ -401,10 +401,21 @@ class FuncRunner:
             # degree radius approximation; verify with haversine after
             deg = dist_m / 111_000.0
             cand_cells = set()
+            # pick the cell level so the disk spans ~8 cells per axis (the
+            # S2-covering analog: coarse cells for big disks); tokens exist
+            # at every level MIN..MAX so any level in range works
+            import math as _math
+
             lvl = GeoTokenizer.MAX_LEVEL
-            step = deg / 2 if deg > 0 else 0.001
-            g = np.arange(lon - deg, lon + deg + 1e-9, max(step, 1e-4))
-            gy = np.arange(lat - deg, lat + deg + 1e-9, max(step, 1e-4))
+            if deg > 0:
+                want = int(_math.floor(_math.log2(max(2880.0 / deg, 2.0))))
+                lvl = min(
+                    GeoTokenizer.MAX_LEVEL, max(GeoTokenizer.MIN_LEVEL, want)
+                )
+            # sample at half the cell pitch so no covered cell is skipped
+            step = min(360.0 / (1 << lvl), 180.0 / (1 << lvl)) / 2.0
+            g = np.arange(lon - deg, lon + deg + 1e-9, step)
+            gy = np.arange(lat - deg, lat + deg + 1e-9, step)
             for x in g:
                 for y in gy:
                     cand_cells.add(GeoTokenizer.cell_at(float(x), float(y), lvl))
@@ -455,10 +466,15 @@ def _val_eq(got: Optional[Val], want: Val) -> bool:
         return False
 
 
-def _required_trigrams(pattern: str) -> List[str]:
+def _required_trigrams(pattern: str, flags: str = "") -> List[str]:
     """Longest literal run in the regex -> trigrams (ref uses a full regexp
-    automaton analysis, vendor cockroach regexp lib; literal-run subset)."""
-    lit = max(re.split(r"[\.\*\+\?\[\]\(\)\|\\\^\$\{\}]", pattern), key=len, default="")
+    automaton analysis; literal-run subset). Returns [] (no prefilter, full
+    verify) whenever the literal-run argument is unsound: alternation makes
+    no single run required, and case-insensitive patterns don't match the
+    case-sensitive index tokens."""
+    if "|" in pattern or "i" in flags:
+        return []
+    lit = max(re.split(r"[\.\*\+\?\[\]\(\)\\\^\$\{\}]", pattern), key=len, default="")
     if len(lit) < 3:
         return []
     return [lit[i : i + 3] for i in range(len(lit) - 2)]

@@ -60,8 +60,9 @@ def encode_uid(u: int) -> str:
 
 
 class JsonEncoder:
-    def __init__(self, val_vars=None):
+    def __init__(self, val_vars=None, schema=None):
         self.val_vars = val_vars or {}
+        self.schema = schema
 
     def encode_blocks(self, nodes: List[ExecNode]) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
@@ -146,9 +147,14 @@ class JsonEncoder:
             else:
                 posts = c.values.get(uid)
                 if posts:
-                    su_is_list = len(posts) > 1
+                    # list-vs-scalar shape follows the schema, not the
+                    # value count (ref outputnode list handling)
+                    su = self.schema.get(c.attr) if self.schema else None
+                    as_list = (
+                        su.is_list if su is not None else len(posts) > 1
+                    )
                     vals = [_json_val(p.val()) for p in posts]
-                    obj[name] = vals if su_is_list else vals[0]
+                    obj[name] = vals if as_list else vals[0]
                     if gq.facets:
                         for p in posts:
                             for fk, fv in p.get_facets().items():

@@ -153,14 +153,10 @@ class Executor:
         if gq.func is None:
             raise QueryError(f"block {gq.attr!r} missing func")
         if gq.func.name == "eq" and gq.func.val_var:
-            src = _as_uids(self.val_vars.get(gq.func.val_var, {}).keys())
             # eq(val(x), v): keep uids whose var value == arg
             want = gq.func.args[0]
             vals = self.val_vars.get(gq.func.val_var, {})
-            src = _as_uids(
-                u for u in vals if _vals_equal(vals[u], want)
-            )
-            root = src
+            root = _as_uids(u for u in vals if _vals_equal(vals[u], want))
         else:
             root = runner.run_root(gq.func)
 
@@ -217,12 +213,7 @@ class Executor:
             if cnode is None:
                 continue
             node.children.append(cnode)
-            if (
-                cnode.is_uid_pred
-                and (cgq.children or cgq.recurse or True)
-                and len(cnode.dest_uids)
-                and cgq.children
-            ):
+            if cnode.is_uid_pred and len(cnode.dest_uids) and cgq.children:
                 self._expand_children(cnode, depth + 1)
 
     def _make_child(self, parent: ExecNode, cgq: GraphQuery) -> Optional[ExecNode]:

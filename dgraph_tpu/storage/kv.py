@@ -63,7 +63,10 @@ class KV:
         pass
 
 
-_WAL_REC = struct.Struct("<IQI")  # key_len, ts, val_len
+_WAL_REC = struct.Struct("<BIQI")  # op, key_len, ts, val_len
+_OP_PUT = 0
+_OP_DROP_PREFIX = 1
+_OP_DELETE_BELOW = 2
 
 
 class MemKV(KV):
@@ -78,15 +81,23 @@ class MemKV(KV):
         self._wal_path = wal_path
         if wal_path:
             if os.path.exists(wal_path):
-                self._replay_wal(wal_path)
+                valid_len = self._replay_wal(wal_path)
+                # truncate a torn tail so later appends don't land behind
+                # a half-written record and desync the next replay
+                if valid_len < os.path.getsize(wal_path):
+                    with open(wal_path, "r+b") as f:
+                        f.truncate(valid_len)
             self._wal = open(wal_path, "ab")
 
     # -- writes -------------------------------------------------------------
 
     def put(self, key: bytes, ts: int, value: bytes) -> None:
         self._put_mem(key, ts, value)
+        self._wal_append(_OP_PUT, key, ts, value)
+
+    def _wal_append(self, op: int, key: bytes, ts: int, value: bytes = b""):
         if self._wal is not None:
-            self._wal.write(_WAL_REC.pack(len(key), ts, len(value)))
+            self._wal.write(_WAL_REC.pack(op, len(key), ts, len(value)))
             self._wal.write(key)
             self._wal.write(value)
 
@@ -166,40 +177,55 @@ class MemKV(KV):
     # -- maintenance --------------------------------------------------------
 
     def delete_below(self, key: bytes, ts: int) -> None:
+        self._delete_below_mem(key, ts)
+        self._wal_append(_OP_DELETE_BELOW, key, ts)
+
+    def _delete_below_mem(self, key: bytes, ts: int) -> None:
         vers = self._data.get(key)
         if not vers:
             return
         self._data[key] = [(t, v) for t, v in vers if t >= ts]
 
     def drop_prefix(self, prefix: bytes) -> None:
+        self._drop_prefix_mem(prefix)
+        self._wal_append(_OP_DROP_PREFIX, prefix, 0)
+
+    def _drop_prefix_mem(self, prefix: bytes) -> None:
         for k in [k for k in self._data if k.startswith(prefix)]:
             del self._data[k]
         self._keys_dirty = True
 
     # -- durability ---------------------------------------------------------
 
-    def _replay_wal(self, path: str):
+    def _replay_wal(self, path: str) -> int:
+        """Replay; returns the byte length of the valid prefix."""
         with open(path, "rb") as f:
             data = f.read()
         pos = 0
         n = len(data)
         while pos + _WAL_REC.size <= n:
-            klen, ts, vlen = _WAL_REC.unpack_from(data, pos)
-            pos += _WAL_REC.size
-            if pos + klen + vlen > n:
+            op, klen, ts, vlen = _WAL_REC.unpack_from(data, pos)
+            if pos + _WAL_REC.size + klen + vlen > n or op > _OP_DELETE_BELOW:
                 break  # torn tail write — stop replay (crash-consistent)
+            pos += _WAL_REC.size
             key = data[pos : pos + klen]
             pos += klen
             val = data[pos : pos + vlen]
             pos += vlen
-            self._put_mem(key, ts, val)
+            if op == _OP_PUT:
+                self._put_mem(key, ts, val)
+            elif op == _OP_DROP_PREFIX:
+                self._drop_prefix_mem(key)
+            elif op == _OP_DELETE_BELOW:
+                self._delete_below_mem(key, ts)
+        return pos
 
     def snapshot_to(self, path: str):
         """Write a compact snapshot (all live versions)."""
         with open(path + ".tmp", "wb") as f:
             for k in self._sorted_keys():
                 for ts, v in self._data.get(k, []):
-                    f.write(_WAL_REC.pack(len(k), ts, len(v)))
+                    f.write(_WAL_REC.pack(_OP_PUT, len(k), ts, len(v)))
                     f.write(k)
                     f.write(v)
             f.flush()
