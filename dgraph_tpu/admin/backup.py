@@ -1,0 +1,99 @@
+"""Backup & restore: full + incremental with a manifest chain.
+
+Mirrors /root/reference/worker/backup*.go + backup/: a backup captures all
+KV versions in (since_ts, read_ts]; the manifest chain records the ts
+ranges so incrementals restore in order (ref backup_manifest.go).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import struct
+from typing import List, Optional
+
+_REC = struct.Struct("<IQI")  # key_len, ts, val_len
+MANIFEST = "manifest.json"
+
+
+def _load_manifest(backup_dir: str) -> dict:
+    path = os.path.join(backup_dir, MANIFEST)
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {"backups": []}
+
+
+def backup(server, backup_dir: str, incremental: bool = True) -> dict:
+    """Write a backup file; returns its manifest entry."""
+    os.makedirs(backup_dir, exist_ok=True)
+    manifest = _load_manifest(backup_dir)
+    since = (
+        manifest["backups"][-1]["read_ts"]
+        if incremental and manifest["backups"]
+        else 0
+    )
+    read_ts = server.zero.read_ts()
+    idx = len(manifest["backups"]) + 1
+    fname = f"backup-{idx:04d}-{since}-{read_ts}.gz"
+    path = os.path.join(backup_dir, fname)
+
+    n = 0
+    with gzip.open(path, "wb") as f:
+        for key, vers in server.kv.iterate_versions(b"", read_ts):
+            for ts, val in vers:  # newest first
+                if ts <= since:
+                    break
+                f.write(_REC.pack(len(key), ts, len(val)))
+                f.write(key)
+                f.write(val)
+                n += 1
+
+    entry = {
+        "path": fname,
+        "since": since,
+        "read_ts": read_ts,
+        "records": n,
+        "type": "incremental" if since else "full",
+    }
+    manifest["backups"].append(entry)
+    with open(os.path.join(backup_dir, MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2)
+    return entry
+
+
+def restore(server, backup_dir: str, until: Optional[int] = None) -> int:
+    """Replay the manifest chain into the server's KV (ref online_restore).
+    Returns number of records restored."""
+    manifest = _load_manifest(backup_dir)
+    if not manifest["backups"]:
+        raise FileNotFoundError(f"no backups in {backup_dir}")
+    total = 0
+    max_ts = 0
+    for entry in manifest["backups"]:
+        if until is not None and entry["since"] >= until:
+            break
+        path = os.path.join(backup_dir, entry["path"])
+        with gzip.open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        writes = []
+        while pos + _REC.size <= len(data):
+            klen, ts, vlen = _REC.unpack_from(data, pos)
+            pos += _REC.size
+            key = data[pos : pos + klen]
+            pos += klen
+            val = data[pos : pos + vlen]
+            pos += vlen
+            if until is not None and ts > until:
+                continue
+            writes.append((key, ts, val))
+            max_ts = max(max_ts, ts)
+            total += 1
+        server.kv.put_batch(writes)
+    # advance the ts lease past restored data
+    while server.zero.max_assigned < max_ts:
+        server.zero.next_ts(max_ts - server.zero.max_assigned)
+    server.rebuild_vector_indexes()
+    return total

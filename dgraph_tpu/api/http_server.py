@@ -1,0 +1,269 @@
+"""HTTP front-end: the Alpha endpoint surface.
+
+Mirrors /root/reference/dgraph/cmd/alpha (setupServer run.go:458, http.go,
+admin.go): /query, /mutate, /commit, /alter, /health, /state,
+/admin/schema, /admin/export, /admin/backup, /debug/prometheus_metrics.
+JSON bodies and response envelope follow the reference's
+{"data": ..., "extensions": {"server_latency": ...}} shape.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from dgraph_tpu.api.server import Server, TxnHandle
+from dgraph_tpu.zero.zero import TxnConflictError
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dgraph-tpu/0.1"
+    engine: Server = None  # type: ignore[assignment]
+    txns: Dict[int, TxnHandle] = {}
+    metrics: Dict[str, float] = {}
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    # -- helpers -------------------------------------------------------------
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    def _reply(self, obj, code=200):
+        data = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, msg, code=400):
+        self._reply(
+            {"errors": [{"message": str(msg), "extensions": {"code": "Error"}}]},
+            code,
+        )
+
+    def _count(self, name):
+        self.metrics[name] = self.metrics.get(name, 0) + 1
+
+    # -- routes ---------------------------------------------------------------
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path == "/health":
+            self._reply(
+                [
+                    {
+                        "instance": "alpha",
+                        "status": "healthy",
+                        "version": "0.1.0",
+                        "uptime": int(time.time() - _START),
+                    }
+                ]
+            )
+        elif path == "/state":
+            self._reply(
+                {
+                    "counter": self.engine.zero.max_assigned,
+                    "maxUID": self.engine.zero._max_uid,
+                    "groups": {"1": {"tablets": {
+                        p: {"predicate": p}
+                        for p in self.engine.schema.predicates()
+                    }}},
+                }
+            )
+        elif path == "/admin/schema":
+            from dgraph_tpu.admin.export import _schema_line
+
+            lines = [
+                _schema_line(self.engine.schema.get(p))
+                for p in self.engine.schema.predicates()
+            ]
+            self._reply({"data": {"schema": "\n".join(lines)}})
+        elif path == "/debug/prometheus_metrics":
+            out = []
+            for k, v in sorted(self.metrics.items()):
+                out.append(f"# TYPE dgraph_tpu_{k} counter")
+                out.append(f"dgraph_tpu_{k} {v}")
+            data = ("\n".join(out) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        else:
+            self._error(f"no route {path}", 404)
+
+    def do_POST(self):
+        t0 = time.time()
+        parsed = urlparse(self.path)
+        path = parsed.path
+        qs = parse_qs(parsed.query)
+        try:
+            if path == "/query":
+                self._count("num_queries")
+                res = self.engine.query(self._body().decode("utf-8"))
+                res["extensions"] = {
+                    "server_latency": {
+                        "total_ns": int((time.time() - t0) * 1e9)
+                    }
+                }
+                self._reply(res)
+            elif path == "/mutate":
+                self._count("num_mutations")
+                self._handle_mutate(qs)
+            elif path == "/commit":
+                ts = int(qs.get("startTs", ["0"])[0])
+                txn = self.txns.pop(ts, None)
+                if txn is None:
+                    return self._error(f"no pending txn with startTs {ts}")
+                if qs.get("abort", ["false"])[0] == "true":
+                    txn.discard()
+                    return self._reply({"data": {"code": "Success", "message": "Done"}})
+                commit_ts = txn.commit()
+                self._reply({"data": {"code": "Success", "commitTs": commit_ts}})
+            elif path == "/alter":
+                body = self._body().decode("utf-8")
+                try:
+                    op = json.loads(body)
+                except json.JSONDecodeError:
+                    op = {"schema": body}
+                if op.get("drop_all"):
+                    self.engine.alter(drop_all=True)
+                elif op.get("drop_attr"):
+                    self.engine.alter(drop_attr=op["drop_attr"])
+                else:
+                    self.engine.alter(op.get("schema", ""))
+                self._reply({"data": {"code": "Success", "message": "Done"}})
+            elif path == "/admin/export":
+                import tempfile
+
+                from dgraph_tpu.admin.export import export
+
+                out = export(self.engine, tempfile.mkdtemp(prefix="dgraph_export_"))
+                self._reply({"data": {"code": "Success", **out}})
+            elif path == "/admin/backup":
+                from dgraph_tpu.admin.backup import backup
+
+                dest = qs.get("destination", ["/tmp/dgraph_tpu_backup"])[0]
+                entry = backup(self.engine, dest)
+                self._reply({"data": {"code": "Success", **entry}})
+            else:
+                self._error(f"no route {path}", 404)
+        except TxnConflictError as e:
+            self._error(f"Transaction has been aborted. Please retry. {e}", 409)
+        except (json.JSONDecodeError, ValueError) as e:
+            self._error(e, 400)  # malformed client input
+        except Exception as e:
+            traceback.print_exc()
+            self._error(e, 500)
+
+    def _handle_mutate(self, qs):
+        body = self._body().decode("utf-8")
+        commit_now = qs.get("commitNow", ["false"])[0] == "true"
+        start_ts = int(qs.get("startTs", ["0"])[0])
+        ctype = self.headers.get("Content-Type", "application/rdf")
+
+        if start_ts and start_ts in self.txns:
+            txn = self.txns[start_ts]
+        else:
+            txn = self.engine.new_txn()
+
+        if "json" in ctype:
+            obj = json.loads(body) if body.strip() else {}
+            uids = txn.mutate_json(
+                set_obj=obj.get("set"), del_obj=obj.get("delete")
+            )
+        else:
+            # RDF body: {set { ... } delete { ... }} or bare nquads
+            set_rdf, del_rdf = _split_rdf_blocks(body)
+            uids = txn.mutate_rdf(set_rdf=set_rdf, del_rdf=del_rdf)
+
+        if commit_now:
+            commit_ts = txn.commit()
+            self._reply(
+                {
+                    "data": {
+                        "code": "Success",
+                        "uids": uids,
+                        "commitTs": commit_ts,
+                    }
+                }
+            )
+        else:
+            self.txns[txn.start_ts] = txn
+            self._reply(
+                {"data": {"code": "Success", "uids": uids, "startTs": txn.start_ts}}
+            )
+
+
+_START = time.time()
+
+
+def _scan_block(body: str, keyword: str) -> str:
+    """Extract the `keyword { ... }` block with quote-aware brace scanning
+    ('}' inside RDF string literals, e.g. GeoJSON values, must not
+    terminate the block; ref chunker mutation lexing)."""
+    import re
+
+    m = re.search(rf"\b{keyword}\s*\{{", body)
+    if not m:
+        return ""
+    i = m.end()
+    in_quote = False
+    n = len(body)
+    start = i
+    while i < n:
+        c = body[i]
+        if in_quote:
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                in_quote = False
+        elif c == '"':
+            in_quote = True
+        elif c == "}":
+            return body[start:i]
+        i += 1
+    return body[start:]
+
+
+def _split_rdf_blocks(body: str):
+    """Parse `{ set { ... } delete { ... } }` mutation envelopes
+    (ref chunker mutation parsing); bare N-Quads treated as set."""
+    set_block = _scan_block(body, "set")
+    del_block = _scan_block(body, "delete")
+    if set_block or del_block:
+        return set_block, del_block
+    return body, ""
+
+
+class HTTPServer:
+    """Embeddable HTTP server (the Alpha's 8080 surface)."""
+
+    def __init__(self, engine: Server, host: str = "127.0.0.1", port: int = 8080):
+        handler = type(
+            "BoundHandler", (_Handler,), {"engine": engine, "txns": {}, "metrics": {}}
+        )
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -59,6 +59,41 @@ class TxnHandle:
             self.commit()
         return uids
 
+    def upsert(
+        self,
+        query: str,
+        set_rdf: str = "",
+        del_rdf: str = "",
+        cond: Optional[str] = None,
+        commit_now: bool = True,
+    ) -> Dict[str, str]:
+        """Upsert block: run query, substitute uid(v)/val(v) refs in the
+        mutation, apply (ref edgraph/server.go:874 buildUpsertQuery +
+        dql upsert blocks). `cond` is '@if(eq(len(v), 0))'-style gate."""
+        from dgraph_tpu.query.subgraph import Executor
+
+        blocks = dql.parse(query)
+        ex = Executor(
+            self.txn.cache,
+            self.server.schema,
+            vector_indexes=self.server.vector_indexes,
+        )
+        ex.process(blocks)
+        uid_vars = {k: [int(u) for u in v] for k, v in ex.uid_vars.items()}
+        val_vars = ex.val_vars
+
+        if cond is not None and not _eval_cond(cond, uid_vars):
+            if commit_now:
+                self.commit()
+            return {}
+
+        out = self.server._apply_rdf_with_vars(
+            self.txn, set_rdf, del_rdf, uid_vars, val_vars
+        )
+        if commit_now:
+            self.commit()
+        return out
+
     def commit(self) -> int:
         if self.finished:
             raise RuntimeError("transaction already finished")
@@ -80,6 +115,8 @@ class Server:
         self.vector_indexes: Dict[str, object] = {}
         self._lock = threading.Lock()
         self._bootstrap_schema()
+        if data_dir is not None:
+            self._load_persisted_state()
 
     def _bootstrap_schema(self):
         # system predicates (ref schema/schema.go initialSchema)
@@ -89,27 +126,81 @@ class Server:
         )[0]:
             self.schema.set(su)
 
+    def _load_persisted_state(self):
+        """Recover schema + max ts/uid from the KV after restart (ref
+        schema load in schema/schema.go LoadFromDb; Zero state from raft)."""
+        max_ts = 0
+        max_uid = 0
+        for key, vers in self.kv.iterate_versions(b"", (1 << 62)):
+            if vers:
+                max_ts = max(max_ts, vers[0][0])
+            pk = keys.parse_key(key)
+            if pk.uid is not None:
+                max_uid = max(max_uid, pk.uid)
+            if pk.is_schema:
+                preds, _ = parse_schema(vers[0][1].decode("utf-8"))
+                for su in preds:
+                    self.schema.set(su)
+                    if su.vector_specs:
+                        self._ensure_vector_index(su)
+            elif pk.is_type:
+                _, types = parse_schema(vers[0][1].decode("utf-8"))
+                for tu in types:
+                    self.schema.set_type(tu)
+        while self.zero.max_assigned < max_ts:
+            self.zero.next_ts(max_ts - self.zero.max_assigned)
+        # re-lease uids past everything on disk, or fresh blank nodes would
+        # reuse (and overwrite) existing entities' uids
+        if max_uid and max_uid < (1 << 62) and self.zero._max_uid <= max_uid:
+            self.zero.assign_uids(max_uid - self.zero._max_uid)
+        self.rebuild_vector_indexes()
+
+    def rebuild_vector_indexes(self):
+        """Re-ingest stored vectors into the in-memory vector indexes
+        (ref posting/index.go:1354 vector-index rebuild prefixes)."""
+        ts = self.zero.read_ts()
+        read = LocalCache(self.kv, ts)
+        for pred in self.schema.predicates():
+            su = self.schema.get(pred)
+            if not su or not su.vector_specs:
+                continue
+            self._ensure_vector_index(su)
+            vidx = self.vector_indexes[pred]
+            for k, _, _ in self.kv.iterate(keys.DataPrefix(pred), ts):
+                pk = keys.parse_key(k)
+                for p in read.values(k):
+                    vidx.insert(pk.uid, p.val().value)
+
     # -- alter (ref edgraph/server.go:355) -----------------------------------
 
     def alter(self, schema_text: str = "", drop_attr: str = "", drop_all: bool = False):
         with self._lock:
             if drop_all:
-                ts = self.zero.next_ts()
-                for pred in self.schema.predicates():
-                    self.kv.drop_prefix(keys.PredicatePrefix(pred))
+                # wipe every key (data + persisted schema/types) so a
+                # restart cannot resurrect dropped state
+                self.kv.drop_prefix(b"")
                 self.schema = State()
                 self._bootstrap_schema()
                 self.vector_indexes.clear()
                 return
             if drop_attr:
                 self.kv.drop_prefix(keys.PredicatePrefix(drop_attr))
+                self.kv.drop_prefix(keys.SchemaKey(drop_attr))
                 self.schema.delete(drop_attr)
                 self.vector_indexes.pop(drop_attr, None)
                 return
             preds, types = parse_schema(schema_text)
+            ts = self.zero.next_ts()
+            from dgraph_tpu.admin.export import _schema_line
+
             for su in preds:
                 old = self.schema.get(su.predicate)
                 self.schema.set(su)
+                self.kv.put(
+                    keys.SchemaKey(su.predicate),
+                    ts,
+                    _schema_line(su).encode("utf-8"),
+                )
                 if su.vector_specs:
                     self._ensure_vector_index(su)
                 if old is not None and (
@@ -118,6 +209,12 @@ class Server:
                     self._reindex(su)
             for tu in types:
                 self.schema.set_type(tu)
+                fields = "\n  ".join(tu.fields)
+                self.kv.put(
+                    keys.TypeKey(tu.name),
+                    ts,
+                    f"type {tu.name} {{\n  {fields}\n}}\n".encode("utf-8"),
+                )
 
     def _ensure_vector_index(self, su):
         from dgraph_tpu.models.vector import VectorIndex
@@ -190,6 +287,56 @@ class Server:
             self._apply_nquad(txn, nq, resolve, OP_SET)
         for nq in parse_rdf(del_rdf):
             self._apply_nquad(txn, nq, resolve, OP_DEL)
+        return {k[2:]: hex(v) for k, v in blank.items()}
+
+    def _apply_rdf_with_vars(
+        self, txn: Txn, set_rdf: str, del_rdf: str, uid_vars, val_vars
+    ) -> Dict[str, str]:
+        """RDF application where subjects/objects may be uid(v) refs and
+        values val(v) refs; the mutation fans out over the var's uids
+        (ref dql upsert semantics)."""
+        blank: Dict[str, int] = {}
+
+        def resolve_many(ref: str) -> List[int]:
+            if ref.startswith("uid("):
+                var = ref[4:-1]
+                return uid_vars.get(var, [])
+            if ref.startswith("_:"):
+                if ref not in blank:
+                    blank[ref] = self.zero.assign_uids(1)
+                return [blank[ref]]
+            return [int(ref, 16) if ref.startswith("0x") else int(ref)]
+
+        def apply_all(rdf: str, op: int):
+            for nq in parse_rdf(rdf):
+                for subj in resolve_many(nq.subject):
+                    if nq.object_id and nq.object_id.startswith("val("):
+                        # val(v): per-subject value substitution
+                        var = nq.object_id[4:-1]
+                        v = val_vars.get(var, {}).get(subj)
+                        if v is None:
+                            continue
+                        apply_edge(
+                            txn,
+                            self.schema,
+                            DirectedEdge(
+                                subj, nq.predicate, value=v,
+                                facets=nq.facets, op=op,
+                            ),
+                        )
+                        continue
+                    objs = (
+                        resolve_many(nq.object_id) if nq.object_id else [None]
+                    )
+                    for obj in objs:
+                        # reuse the single-nquad path with pinned refs
+                        def resolve_pinned(ref, _s=subj, _o=obj):
+                            return _o if ref == nq.object_id else _s
+
+                        self._apply_nquad(txn, nq, resolve_pinned, op)
+
+        apply_all(set_rdf, OP_SET)
+        apply_all(del_rdf, OP_DEL)
         return {k[2:]: hex(v) for k, v in blank.items()}
 
     def _apply_nquad(self, txn: Txn, nq: NQuad, resolve, op: int):
@@ -292,6 +439,28 @@ class Server:
         nodes = ex.process(blocks)
         enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
         return {"data": enc.encode_blocks(nodes)}
+
+
+def _eval_cond(cond: str, uid_vars) -> bool:
+    """Evaluate '@if(eq(len(v), N))'-style upsert conditions
+    (ref dql/upsert parsing of conditional mutations)."""
+    import re as _re
+
+    m = _re.match(
+        r"\s*@if\s*\(\s*(eq|lt|le|gt|ge)\s*\(\s*len\s*\(\s*(\w+)\s*\)\s*,\s*(\d+)\s*\)\s*\)\s*",
+        cond,
+    )
+    if not m:
+        raise ValueError(f"unsupported upsert condition {cond!r}")
+    op, var, n = m.group(1), m.group(2), int(m.group(3))
+    ln = len(uid_vars.get(var, []))
+    return {
+        "eq": ln == n,
+        "lt": ln < n,
+        "le": ln <= n,
+        "gt": ln > n,
+        "ge": ln >= n,
+    }[op]
 
 
 def _as_list(x):

@@ -49,9 +49,9 @@ _TOKEN_RE = re.compile(
     (?P<ws>\s+|\#[^\n]*)
   | (?P<string>"(?:\\.|[^"\\])*")
   | (?P<regex>/(?:\\.|[^/\\])+/[i]?)
-  | (?P<num>0x[0-9a-fA-F]+|-?\d+\.\d+|-?\d+)
+  | (?P<num>0x[0-9a-fA-F]+|\d+\.\d+|\d+)
   | (?P<name>~?[a-zA-Z_][\w.\-~]*|<[^>]+>|\$[a-zA-Z_]\w*)
-  | (?P<punct>@|\(|\)|\{|\}|\[|\]|:|,|=|\*)
+  | (?P<punct>@|\(|\)|\{|\}|\[|\]|:|,|==|=|\*|\+|-|/|%|<=|>=|<|>)
 """,
     re.VERBOSE,
 )
@@ -73,6 +73,14 @@ def tokenize(s: str) -> List[Tok]:
         if not m:
             raise ParseError(f"unexpected character {s[pos]!r} at {pos}")
         kind = m.lastgroup
+        if kind == "regex":
+            # '/' is also the division operator; a regex literal is only
+            # legal in value position (after '(' or ','), e.g. regexp(x, /../)
+            prev = out[-1].text if out else ""
+            if prev not in ("(", ","):
+                out.append(Tok("punct", "/", pos))
+                pos += 1
+                continue
         if kind != "ws":
             out.append(Tok(kind, m.group(), pos))
         pos = m.end()
@@ -145,6 +153,9 @@ class GraphQuery:
     recurse_depth: int = 0
     recurse_loop: bool = False
     normalize: bool = False
+    # math & groupby
+    math_expr: Optional["MathNode"] = None
+    groupby_attrs: List[str] = field(default_factory=list)
     # facets
     facets: bool = False
     facet_names: List[str] = field(default_factory=list)
@@ -203,6 +214,18 @@ def _unquote(s: str) -> str:
 
 def _strip_angle(s: str) -> str:
     return s[1:-1] if s.startswith("<") else s
+
+
+def _parse_scalar(p: "_P"):
+    """Value with optional unary minus (num regex is unsigned so that
+    `a - 3` in math context tokenizes as three tokens)."""
+    if p.peek().text == "-":
+        p.next()
+        v = _parse_value(p.next())
+        if not isinstance(v, (int, float)):
+            raise ParseError("unary minus on non-number")
+        return -v
+    return _parse_value(p.next())
 
 
 def _parse_value(t: Tok):
@@ -290,14 +313,14 @@ def parse_func(p: _P) -> FuncSpec:
         ):
             key = p.next().text
             p.expect(":")
-            fn.options[key] = _parse_value(p.next())
+            fn.options[key] = _parse_scalar(p)
             continue
         if t.text == "[":
             fn.args.append(_parse_list(p))
             continue
         if t.text == "$":
             raise ParseError("GraphQL variables not yet supported")
-        fn.args.append(_parse_value(p.next()))
+        fn.args.append(_parse_scalar(p))
     p.expect(")")
     return fn
 
@@ -315,10 +338,90 @@ def _parse_list(p: _P) -> list:
     p.expect("[")
     out = []
     while p.peek().text != "]":
-        out.append(_parse_value(p.next()))
+        out.append(_parse_scalar(p))
         p.accept(",")
     p.expect("]")
     return out
+
+
+# ---------------------------------------------------------------------------
+# Math expressions (ref dql/math.go): math(a + b*2 - min(c, 3))
+# ---------------------------------------------------------------------------
+
+_MATH_FUNCS = (
+    "min", "max", "sqrt", "ln", "exp", "floor", "ceil", "pow", "logbase",
+    "since", "cond",
+)
+
+
+@dataclass
+class MathNode:
+    op: str = ""  # "+", "-", "*", "/", "%", func name, "const", "var"
+    children: List["MathNode"] = field(default_factory=list)
+    const: Any = None
+    var: str = ""
+
+
+def parse_math(p: _P) -> MathNode:
+    p.expect("(")
+    node = _math_expr(p)
+    p.expect(")")
+    return node
+
+
+def _math_expr(p: _P) -> MathNode:
+    left = _math_term(p)
+    while p.peek().text in ("+", "-"):
+        op = p.next().text
+        right = _math_term(p)
+        left = MathNode(op=op, children=[left, right])
+    return left
+
+
+def _math_term(p: _P) -> MathNode:
+    left = _math_unary(p)
+    while p.peek().text in ("*", "/", "%"):
+        op = p.next().text
+        right = _math_unary(p)
+        left = MathNode(op=op, children=[left, right])
+    return left
+
+
+def _math_unary(p: _P) -> MathNode:
+    if p.accept("-"):
+        return MathNode(op="neg", children=[_math_unary(p)])
+    return _math_atom(p)
+
+
+def _math_atom(p: _P) -> MathNode:
+    t = p.peek()
+    if t.text == "(":
+        p.next()
+        node = _math_expr(p)
+        p.expect(")")
+        return node
+    if t.kind == "num":
+        p.next()
+        v = int(t.text, 16) if t.text.startswith("0x") else (
+            float(t.text) if "." in t.text else int(t.text)
+        )
+        return MathNode(op="const", const=v)
+    if t.kind == "name":
+        p.next()
+        if t.text in _MATH_FUNCS and p.peek().text == "(":
+            p.next()
+            args = [_math_expr(p)]
+            while p.accept(","):
+                args.append(_math_expr(p))
+            p.expect(")")
+            return MathNode(op=t.text, children=args)
+        if t.text == "val" and p.peek().text == "(":
+            p.next()
+            var = p.next().text
+            p.expect(")")
+            return MathNode(op="var", var=var)
+        return MathNode(op="var", var=t.text)
+    raise ParseError(f"bad math token {t.text!r} at {t.pos}")
 
 
 def parse_filter(p: _P) -> FilterTree:
@@ -374,10 +477,9 @@ def _parse_args_into(p: _P, gq: GraphQuery, stop: str = ")"):
         key = p.next().text
         p.expect(":")
         if key in ("first", "offset"):
-            setattr(gq, key, int(p.next().text))
+            setattr(gq, key, int(_parse_scalar(p)))
         elif key == "after":
-            t = p.next().text
-            gq.after = int(t, 16) if t.startswith("0x") else int(t)
+            gq.after = int(_parse_scalar(p))
         elif key in ("orderasc", "orderdesc"):
             if p.peek().text == "val":
                 p.next()
@@ -436,6 +538,12 @@ def _parse_directives(p: _P, gq: GraphQuery):
             gq.recurse = True
             if p.accept("("):
                 _parse_args_into(p, gq, stop=")")
+        elif d == "groupby":
+            p.expect("(")
+            while p.peek().text != ")":
+                gq.groupby_attrs.append(_strip_angle(p.next().text))
+                p.accept(",")
+            p.expect(")")
         elif d == "facets":
             gq.facets = True
             if p.accept("("):
@@ -508,6 +616,11 @@ def parse_child(p: _P) -> GraphQuery:
         gq.attr = "val"
         return gq
 
+    if name == "math":
+        gq.math_expr = parse_math(p)
+        gq.attr = "math"
+        return gq
+
     if name == "uid":
         gq.is_uid = True
         gq.attr = "uid"
@@ -524,7 +637,7 @@ def parse_child(p: _P) -> GraphQuery:
 
     gq.attr = name
     # lang tag
-    if p.peek().text == "@" and p.toks[p.i + 1].kind == "name" and p.toks[p.i + 1].text not in ("filter", "facets", "cascade", "normalize", "recurse"):
+    if p.peek().text == "@" and p.toks[p.i + 1].kind == "name" and p.toks[p.i + 1].text not in ("filter", "facets", "cascade", "normalize", "recurse", "groupby"):
         p.next()
         gq.lang = p.next().text
 
@@ -568,7 +681,9 @@ def parse_query_block(p: _P) -> GraphQuery:
     p.expect("(")
     _parse_args_into(p, gq, stop=")")
     _parse_directives(p, gq)
-    parse_selection_set(p, gq)
+    # var blocks may omit the selection set (common in upsert queries)
+    if p.peek().text == "{" or not gq.is_var_block:
+        parse_selection_set(p, gq)
     return gq
 
 

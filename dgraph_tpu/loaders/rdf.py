@@ -151,10 +151,63 @@ def parse_nquad(line: str) -> Optional[NQuad]:
     return nq
 
 
+def split_statements(text: str) -> List[str]:
+    """Split RDF text into statements on ` . ` terminators (quote-aware).
+    N-Quads are usually one per line, but dgraph mutation blocks allow
+    several on a line (ref chunker lexing is token- not line-based)."""
+    out = []
+    buf: List[str] = []
+    in_quote = False
+    in_angle = False
+    i = 0
+    n = len(text)
+    while i < n:
+        c = text[i]
+        if in_quote:
+            if c == "\\" and i + 1 < n:
+                buf.append(c)
+                buf.append(text[i + 1])
+                i += 2
+                continue
+            if c == '"':
+                in_quote = False
+        elif in_angle:
+            if c == ">":
+                in_angle = False
+        elif c == '"':
+            in_quote = True
+        elif c == "<":
+            in_angle = True
+        elif c == "#":
+            # comment to end of line ('#' inside <IRI#frag> handled above)
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+            continue
+        elif (
+            c == "."
+            and buf
+            and buf[-1] in " \t\n"
+            and (i + 1 >= n or text[i + 1] in " \t\n\r")
+        ):
+            buf.append(c)
+            stmt = "".join(buf).strip()
+            if stmt:
+                out.append(stmt)
+            buf = []
+            i += 1
+            continue
+        buf.append(c)
+        i += 1
+    tail = "".join(buf).strip()
+    if tail:
+        out.append(tail)
+    return out
+
+
 def parse_rdf(text: str) -> List[NQuad]:
     out = []
-    for line in text.split("\n"):
-        nq = parse_nquad(line)
+    for stmt in split_statements(text):
+        nq = parse_nquad(stmt.strip())
         if nq is not None:
             out.append(nq)
     return out

@@ -97,7 +97,11 @@ class FuncRunner:
         if name == "uid":
             uids = list(fn.args)
             if fn.uid_var:
-                uids.extend(int(u) for u in self.uid_vars.get(fn.uid_var, []))
+                if fn.uid_var in self.uid_vars:
+                    uids.extend(int(u) for u in self.uid_vars[fn.uid_var])
+                elif fn.uid_var in self.val_vars:
+                    # uid(value-var): the var's uid key set (ref query.go)
+                    uids.extend(self.val_vars[fn.uid_var].keys())
             out = _as_uids(uids)
             if src is not None:
                 out = np.intersect1d(out, src, assume_unique=True)

@@ -1,0 +1,84 @@
+"""Math-tree evaluation over value variables (ref query/math.go)."""
+
+from __future__ import annotations
+
+import datetime as _dt
+import math
+from typing import Any, Dict
+
+from dgraph_tpu.dql.parser import MathNode
+from dgraph_tpu.types.types import TypeID, Val
+
+
+class MathError(Exception):
+    pass
+
+
+def eval_math(node: MathNode, env: Dict[str, Any]):
+    op = node.op
+    if op == "const":
+        return node.const
+    if op == "var":
+        if node.var not in env:
+            raise KeyError(node.var)
+        v = env[node.var]
+        return v.value if isinstance(v, Val) else v
+    args = [eval_math(c, env) for c in node.children]
+    if op == "+":
+        return args[0] + args[1]
+    if op == "-":
+        return args[0] - args[1]
+    if op == "*":
+        return args[0] * args[1]
+    if op == "/":
+        if args[1] == 0:
+            raise MathError("division by zero")
+        return args[0] / args[1]
+    if op == "%":
+        return args[0] % args[1]
+    if op == "neg":
+        return -args[0]
+    if op == "min":
+        return min(args)
+    if op == "max":
+        return max(args)
+    if op == "sqrt":
+        return math.sqrt(args[0])
+    if op == "ln":
+        return math.log(args[0])
+    if op == "exp":
+        return math.exp(args[0])
+    if op == "floor":
+        return math.floor(args[0])
+    if op == "ceil":
+        return math.ceil(args[0])
+    if op == "pow":
+        return args[0] ** args[1]
+    if op == "logbase":
+        return math.log(args[0], args[1])
+    if op == "since":
+        x = args[0]
+        if isinstance(x, _dt.datetime):
+            now = _dt.datetime.now(_dt.timezone.utc)
+            if x.tzinfo is None:
+                x = x.replace(tzinfo=_dt.timezone.utc)
+            return (now - x).total_seconds()
+        raise MathError("since() expects a datetime")
+    raise MathError(f"math op {op!r} not supported")
+
+
+def math_vars(node: MathNode) -> set:
+    if node.op == "var":
+        return {node.var}
+    out = set()
+    for c in node.children:
+        out |= math_vars(c)
+    return out
+
+
+def to_val(x) -> Val:
+    if isinstance(x, bool):
+        return Val(TypeID.BOOL, x)
+    if isinstance(x, int):
+        return Val(TypeID.INT, x)
+    return Val(TypeID.FLOAT, float(x))

@@ -43,6 +43,8 @@ def _display_name(c: ExecNode) -> str:
     gq = c.gq
     if gq.alias:
         return gq.alias
+    if gq.math_expr is not None:
+        return gq.var_name or "math"
     if gq.aggregator:
         return f"{gq.aggregator}(val({gq.val_var}))"
     if gq.val_var and not gq.aggregator:
@@ -118,6 +120,14 @@ class JsonEncoder:
             gq = c.gq
             if gq.is_uid:
                 obj["uid"] = encode_uid(uid)
+            elif gq.math_expr is not None:
+                v = c.math_vals.get(uid)
+                if v is not None:
+                    obj[name] = _json_val(v)
+            elif c.groups:
+                g = c.groups.get(uid)
+                if g:
+                    obj[name] = [{"@groupby": g}]
             elif gq.aggregator:
                 continue  # emitted at list level
             elif gq.val_var and not gq.aggregator:

@@ -44,6 +44,8 @@ class ExecNode:
     counts: Dict[int, int] = field(default_factory=dict)
     children: List["ExecNode"] = field(default_factory=list)
     is_uid_pred: bool = False
+    math_vals: Dict[int, Val] = field(default_factory=dict)
+    groups: Dict[int, List[dict]] = field(default_factory=dict)
 
 
 class Executor:
@@ -101,6 +103,7 @@ class Executor:
 
     def _block_deps(self, gq: GraphQuery) -> set:
         deps = set()
+        defined = set()
 
         def from_func(fn):
             if fn is None:
@@ -125,15 +128,21 @@ class Executor:
                     deps.add(o.val_var)
             if g.val_var:
                 deps.add(g.val_var)
+            if g.math_expr is not None:
+                from dgraph_tpu.query.matheval import math_vars
+
+                deps.update(math_vars(g.math_expr))
             if isinstance(g.shortest_from, tuple):
                 deps.add(g.shortest_from[1])
             if isinstance(g.shortest_to, tuple):
                 deps.add(g.shortest_to[1])
+            if g.var_name:
+                defined.add(g.var_name)
             for c in g.children:
                 walk(c)
 
         walk(gq)
-        return deps
+        return deps - defined  # intra-block vars resolve during execution
 
     def _deps_ready(self, gq: GraphQuery) -> bool:
         return all(
@@ -218,6 +227,8 @@ class Executor:
 
     def _make_child(self, parent: ExecNode, cgq: GraphQuery) -> Optional[ExecNode]:
         attr = cgq.attr
+        if cgq.math_expr is not None:
+            return self._make_math_child(parent, cgq)
         if cgq.is_uid or cgq.aggregator or cgq.val_var or (cgq.is_count and attr == "uid"):
             return ExecNode(gq=cgq, attr=attr, src_uids=parent.dest_uids)
 
@@ -254,6 +265,8 @@ class Executor:
                     for r in cnode.uid_matrix
                 ]
             cnode.dest_uids = _merge_rows(cnode.uid_matrix)
+            if cgq.groupby_attrs:
+                self._group_children(cgq, cnode, parent)
             if cgq.is_count:
                 cnode.counts = {
                     int(u): len(r)
@@ -281,6 +294,70 @@ class Executor:
                     u: ps[0].val() for u, ps in cnode.values.items()
                 }
         return cnode
+
+    def _make_math_child(self, parent: ExecNode, cgq: GraphQuery) -> ExecNode:
+        """math(...) over value vars, per parent uid (ref query/math.go)."""
+        from dgraph_tpu.query.matheval import (
+            MathError,
+            eval_math,
+            math_vars,
+            to_val,
+        )
+
+        cnode = ExecNode(gq=cgq, attr="math", src_uids=parent.dest_uids)
+        needed = math_vars(cgq.math_expr)
+        out: Dict[int, Val] = {}
+        for u in parent.dest_uids:
+            env = {}
+            ok = True
+            for v in needed:
+                val = self.val_vars.get(v, {}).get(int(u))
+                if val is None:
+                    ok = False
+                    break
+                env[v] = val
+            if not ok:
+                continue
+            try:
+                out[int(u)] = to_val(eval_math(cgq.math_expr, env))
+            except (MathError, KeyError):
+                continue
+        cnode.math_vals = out
+        if cgq.var_name:
+            self.val_vars[cgq.var_name] = out
+        return cnode
+
+    def _group_children(self, cgq: GraphQuery, cnode: ExecNode, parent: ExecNode):
+        """@groupby: bucket each parent's child uids by the groupby attrs'
+        values; aggregate count per bucket (ref query/groupby.go)."""
+        for i, pu in enumerate(parent.dest_uids):
+            row = cnode.uid_matrix[i] if i < len(cnode.uid_matrix) else []
+            buckets: Dict[tuple, dict] = {}
+            for cu in row:
+                key_parts = []
+                disp = {}
+                for ga in cgq.groupby_attrs:
+                    su = self.st.get(ga)
+                    if su is not None and su.value_type == TypeID.UID:
+                        tgt = self.cache.uids(
+                            keys.DataKey(ga, int(cu), self.ns)
+                        )
+                        kv = int(tgt[0]) if len(tgt) else None
+                        key_parts.append(kv)
+                        disp[ga] = hex(kv) if kv is not None else None
+                    else:
+                        v = self.cache.value(keys.DataKey(ga, int(cu), self.ns))
+                        kv = None if v is None else v.value
+                        key_parts.append(kv)
+                        disp[ga] = kv
+                k = tuple(key_parts)
+                b = buckets.get(k)
+                if b is None:
+                    buckets[k] = b = {**disp, "count": 0}
+                b["count"] += 1
+            cnode.groups[int(pu)] = [
+                buckets[k] for k in sorted(buckets, key=lambda t: str(t))
+            ]
 
     def _resolve_expand(
         self, gqs: List[GraphQuery], uids: np.ndarray

@@ -1,0 +1,115 @@
+"""HTTP endpoint tests (mirrors /root/reference/dgraph/cmd/alpha http tests)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from dgraph_tpu.api.http_server import HTTPServer
+from dgraph_tpu.api.server import Server
+
+
+@pytest.fixture()
+def http():
+    engine = Server()
+    engine.alter("name: string @index(exact) .\nfriend: [uid] .")
+    srv = HTTPServer(engine, port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _post(srv, path, body, ctype="application/rdf"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=body.encode("utf-8"),
+        headers={"Content-Type": ctype},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}") as r:
+        return r.read()
+
+
+def test_mutate_query_roundtrip(http):
+    out = _post(
+        http,
+        "/mutate?commitNow=true",
+        '{ set { _:x <name> "Neo" . } }',
+    )
+    assert out["data"]["code"] == "Success"
+    assert "x" in out["data"]["uids"]
+
+    res = _post(http, "/query", '{ q(func: eq(name, "Neo")) { name } }')
+    assert res["data"]["q"] == [{"name": "Neo"}]
+    assert "server_latency" in res["extensions"]
+
+
+def test_json_mutation(http):
+    out = _post(
+        http,
+        "/mutate?commitNow=true",
+        json.dumps({"set": {"uid": "_:a", "name": "Trin"}}),
+        ctype="application/json",
+    )
+    assert out["data"]["code"] == "Success"
+    res = _post(http, "/query", '{ q(func: eq(name, "Trin")) { name } }')
+    assert res["data"]["q"] == [{"name": "Trin"}]
+
+
+def test_txn_begin_then_commit(http):
+    out = _post(http, "/mutate", '{ set { <0x9> <name> "Tank" . } }')
+    ts = out["data"]["startTs"]
+    # not yet visible
+    res = _post(http, "/query", '{ q(func: eq(name, "Tank")) { uid } }')
+    assert res["data"]["q"] == []
+    out = _post(http, f"/commit?startTs={ts}", "")
+    assert out["data"]["code"] == "Success"
+    res = _post(http, "/query", '{ q(func: eq(name, "Tank")) { uid } }')
+    assert res["data"]["q"] == [{"uid": "0x9"}]
+
+
+def test_alter_and_admin_schema(http):
+    out = _post(http, "/alter", "city: string @index(term) .")
+    assert out["data"]["code"] == "Success"
+    body = json.loads(_get(http, "/admin/schema"))
+    assert "city: string @index(term) ." in body["data"]["schema"]
+
+
+def test_health_state_metrics(http):
+    h = json.loads(_get(http, "/health"))
+    assert h[0]["status"] == "healthy"
+    st = json.loads(_get(http, "/state"))
+    assert "groups" in st
+    _post(http, "/query", "{ q(func: has(name)) { uid } }")
+    m = _get(http, "/debug/prometheus_metrics").decode()
+    assert "dgraph_tpu_num_queries" in m
+
+
+def test_error_shapes(http):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{http.port}/query",
+        data=b"{ bad query",
+        method="POST",
+    )
+    try:
+        urllib.request.urlopen(req)
+        assert False, "expected HTTPError"
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read())
+        assert body["errors"][0]["message"]
+
+
+def test_geojson_value_with_braces(http):
+    _post(http, "/alter", "loc: geo @index(geo) .")
+    out = _post(
+        http,
+        "/mutate?commitNow=true",
+        '{ set { <0x1> <loc> "{\\"type\\":\\"Point\\",\\"coordinates\\":[1.0,2.0]}"^^<geo:geojson> . } }',
+    )
+    assert out["data"]["code"] == "Success"
+    res = _post(http, "/query", "{ q(func: uid(0x1)) { loc } }")
+    assert res["data"]["q"][0]["loc"]["type"] == "Point"

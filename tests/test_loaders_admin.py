@@ -1,0 +1,200 @@
+"""Bulk/live loaders, export, backup/restore, restart persistence."""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Server
+from dgraph_tpu.loaders.bulk import bulk_load_rdf
+from dgraph_tpu.loaders.live import LiveLoader
+from dgraph_tpu.admin.export import export
+from dgraph_tpu.admin.backup import backup, restore
+
+SCHEMA = """
+name: string @index(term, exact) .
+age: int @index(int) .
+friend: [uid] @reverse @count .
+embedding: float32vector @index(hnsw(metric:"euclidean")) .
+"""
+
+RDF = """
+_:a <name> "Ann" .
+_:a <age> "30"^^<xs:int> .
+_:a <friend> _:b .
+_:a <embedding> "[1.0, 2.0]"^^<float32vector> .
+_:b <name> "Ben" .
+_:b <age> "40"^^<xs:int> .
+_:b <friend> _:a .
+"""
+
+
+def test_bulk_load_and_query():
+    s = Server()
+    s.alter(SCHEMA)
+    bulk_load_rdf(s, RDF)
+    res = s.query('{ q(func: eq(name, "Ann")) { name age friend { name } } }')[
+        "data"
+    ]
+    assert res["q"][0]["name"] == "Ann"
+    assert res["q"][0]["friend"][0]["name"] == "Ben"
+    # reverse + count from bulk path
+    res = s.query('{ q(func: eq(name, "Ben")) { c: count(~friend) } }')["data"]
+    assert res["q"][0]["c"] == 1
+    # vector present
+    res = s.query('{ v(func: similar_to(embedding, 1, "[1.0,2.0]")) { name } }')[
+        "data"
+    ]
+    assert res["v"][0]["name"] == "Ann"
+
+
+def test_bulk_equals_live():
+    sb, sl = Server(), Server()
+    sb.alter(SCHEMA)
+    sl.alter(SCHEMA)
+    bulk_load_rdf(sb, RDF)
+    LiveLoader(sl, batch_size=2).load_rdf(RDF)
+    q = '{ q(func: has(name), orderasc: name) { name age c: count(friend) } }'
+    assert sb.query(q)["data"] == sl.query(q)["data"]
+
+
+def test_live_loader_stats():
+    s = Server()
+    s.alter(SCHEMA)
+    ll = LiveLoader(s, batch_size=3)
+    ll.load_rdf(RDF)
+    assert ll.nquads_loaded == 7
+    assert ll.txns_committed >= 2
+
+
+def test_export_rdf_roundtrip(tmp_path):
+    s = Server()
+    s.alter(SCHEMA)
+    bulk_load_rdf(s, RDF)
+    out = export(s, str(tmp_path), fmt="rdf")
+    assert out["nquads"] >= 7
+
+    # re-import the export into a fresh server: same query results
+    with gzip.open(out["data"], "rt") as f:
+        rdf = f.read()
+    with gzip.open(out["schema"], "rt") as f:
+        schema_text = f.read()
+    s2 = Server()
+    s2.alter(schema_text)
+    bulk_load_rdf(s2, rdf)
+    q = '{ q(func: has(name), orderasc: name) { name age friend { name } } }'
+    assert s.query(q)["data"] == s2.query(q)["data"]
+
+
+def test_export_json(tmp_path):
+    s = Server()
+    s.alter(SCHEMA)
+    bulk_load_rdf(s, RDF)
+    out = export(s, str(tmp_path), fmt="json", compress=False)
+    with open(out["data"]) as f:
+        rows = json.load(f)
+    names = {r.get("name") for r in rows if "name" in r}
+    assert names == {"Ann", "Ben"}
+
+
+def test_backup_restore_full_and_incremental(tmp_path):
+    bdir = str(tmp_path / "backups")
+    s = Server()
+    s.alter(SCHEMA)
+    bulk_load_rdf(s, RDF)
+    e1 = backup(s, bdir)
+    assert e1["type"] == "full"
+
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='<0x100> <name> "Cid" .', commit_now=True)
+    e2 = backup(s, bdir)
+    assert e2["type"] == "incremental"
+    assert e2["since"] == e1["read_ts"]
+
+    s2 = Server()
+    s2.alter(SCHEMA)
+    n = restore(s2, bdir)
+    assert n > 0
+    q = '{ q(func: has(name), orderasc: name) { name } }'
+    assert s2.query(q)["data"] == s.query(q)["data"]
+
+
+def test_restart_persistence(tmp_path):
+    d = str(tmp_path / "data")
+    s = Server(data_dir=d)
+    s.alter(SCHEMA)
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf='<0x1> <name> "Zed" .\n'
+        '<0x1> <embedding> "[0.5, 0.5]"^^<float32vector> .',
+        commit_now=True,
+    )
+    s.kv.close()
+
+    s2 = Server(data_dir=d)
+    # schema recovered
+    assert s2.schema.get("name").tokenizers == ["term", "exact"]
+    res = s2.query('{ q(func: eq(name, "Zed")) { name } }')["data"]
+    assert res["q"] == [{"name": "Zed"}]
+    # vector index rebuilt
+    res = s2.query('{ v(func: similar_to(embedding, 1, "[0.5,0.5]")) { uid } }')[
+        "data"
+    ]
+    assert res["v"] == [{"uid": "0x1"}]
+    # new writes still work at advanced ts
+    t = s2.new_txn()
+    t.mutate_rdf(set_rdf='<0x2> <name> "Yao" .', commit_now=True)
+    res = s2.query('{ q(func: has(name), orderasc: name) { name } }')["data"]
+    assert [o["name"] for o in res["q"]] == ["Yao", "Zed"]
+    s2.kv.close()
+
+
+def test_restart_uid_lease_no_reuse(tmp_path):
+    # review regression: blank nodes after restart must not reuse uids
+    d = str(tmp_path / "lease")
+    s = Server(data_dir=d)
+    s.alter("name: string @index(exact) .")
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='_:a <name> "Alice" .', commit_now=True)
+    s.kv.close()
+    s2 = Server(data_dir=d)
+    t = s2.new_txn()
+    t.mutate_rdf(set_rdf='_:b <name> "Bob" .', commit_now=True)
+    res = s2.query('{ q(func: has(name), orderasc: name) { name } }')["data"]
+    assert [o["name"] for o in res["q"]] == ["Alice", "Bob"]
+    s2.kv.close()
+
+
+def test_drop_attr_survives_restart(tmp_path):
+    d = str(tmp_path / "drop")
+    s = Server(data_dir=d)
+    s.alter("name: string @index(exact) .\ncity: string .")
+    s.alter(drop_attr="name")
+    s.kv.close()
+    s2 = Server(data_dir=d)
+    assert s2.schema.get("name") is None
+    assert s2.schema.get("city") is not None
+    s2.kv.close()
+
+
+def test_rdf_iri_fragments_and_multistatement():
+    from dgraph_tpu.loaders.rdf import parse_rdf
+
+    nqs = parse_rdf(
+        '<0x1> <http://schema.org#name> "Alice" . <0x2> <age> "3"^^<xs:int> .'
+    )
+    assert len(nqs) == 2
+    assert nqs[0].predicate == "http://schema.org#name"
+    # comments still stripped
+    nqs = parse_rdf('# a comment\n<0x1> <name> "A" .')
+    assert len(nqs) == 1
+
+
+def test_loaders_accept_multistatement_lines():
+    s = Server()
+    s.alter("name: string @index(exact) .")
+    bulk_load_rdf(s, '_:a <name> "X" . _:b <name> "Y" .')
+    res = s.query('{ q(func: has(name)) { name } }')["data"]
+    assert {o["name"] for o in res["q"]} == {"X", "Y"}

@@ -1,0 +1,156 @@
+"""Upserts, math expressions, @groupby (ref query/math.go, groupby.go,
+edgraph upsert path)."""
+
+import pytest
+
+from dgraph_tpu.api.server import Server
+
+SCHEMA = """
+name: string @index(exact) @upsert .
+email: string @index(exact) @upsert .
+age: int @index(int) .
+bonus: float .
+friend: [uid] @reverse .
+"""
+
+
+def _server():
+    s = Server()
+    s.alter(SCHEMA)
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf="""
+        <0x1> <name> "Alice" .
+        <0x1> <age> "30"^^<xs:int> .
+        <0x1> <bonus> "2.5"^^<xs:float> .
+        <0x2> <name> "Bob" .
+        <0x2> <age> "25"^^<xs:int> .
+        <0x2> <bonus> "1.5"^^<xs:float> .
+        <0x3> <name> "Carol" .
+        <0x3> <age> "25"^^<xs:int> .
+        <0x1> <friend> <0x2> .
+        <0x1> <friend> <0x3> .
+        """,
+        commit_now=True,
+    )
+    return s
+
+
+def test_math_expr():
+    s = _server()
+    res = s.query(
+        """
+        {
+          q(func: has(bonus)) {
+            name
+            a as age
+            b as bonus
+            total: math(a + b * 2)
+          }
+        }
+        """
+    )["data"]
+    by = {o["name"]: o["total"] for o in res["q"]}
+    assert by == {"Alice": 35.0, "Bob": 28.0}
+
+
+def test_math_var_reuse_and_order():
+    s = _server()
+    res = s.query(
+        """
+        {
+          var(func: has(age)) {
+            a as age
+            double as math(a * 2)
+          }
+          q(func: uid(double), orderdesc: val(double)) {
+            name
+            val(double)
+          }
+        }
+        """
+    )["data"]
+    assert [o["name"] for o in res["q"]][0] == "Alice"
+    assert res["q"][0]["val(double)"] == 60
+
+
+def test_groupby_value_pred():
+    s = _server()
+    res = s.query(
+        """
+        {
+          q(func: uid(0x1)) {
+            friend @groupby(age) {
+              count(uid)
+            }
+          }
+        }
+        """
+    )["data"]
+    groups = res["q"][0]["friend"][0]["@groupby"]
+    assert groups == [{"age": 25, "count": 2}]
+
+
+def test_upsert_insert_then_update():
+    s = _server()
+    # first run: no match -> create via blank node
+    t = s.new_txn()
+    uids = t.upsert(
+        query='{ v as var(func: eq(email, "x@y.z")) }',
+        set_rdf='_:new <email> "x@y.z" .\n_:new <name> "Xavier" .',
+        cond="@if(eq(len(v), 0))",
+    )
+    assert "new" in uids
+    # second run: match -> cond fails, no new node
+    t = s.new_txn()
+    uids = t.upsert(
+        query='{ v as var(func: eq(email, "x@y.z")) }',
+        set_rdf='_:new <email> "x@y.z" .\n_:new <name> "DUPE" .',
+        cond="@if(eq(len(v), 0))",
+    )
+    assert uids == {}
+    res = s.query('{ q(func: eq(email, "x@y.z")) { name } }')["data"]
+    assert res["q"] == [{"name": "Xavier"}]
+
+
+def test_upsert_update_via_uid_var():
+    s = _server()
+    t = s.new_txn()
+    t.upsert(
+        query='{ v as var(func: eq(name, "Bob")) }',
+        set_rdf='uid(v) <age> "26"^^<xs:int> .',
+    )
+    res = s.query('{ q(func: eq(name, "Bob")) { age } }')["data"]
+    assert res["q"] == [{"age": 26}]
+
+
+def test_upsert_val_var_copy():
+    s = _server()
+    t = s.new_txn()
+    # copy each person's age into bonus via val(var)
+    t.upsert(
+        query="{ v as var(func: has(age)) { a as age } }",
+        set_rdf="uid(v) <bonus> val(a) .",
+    )
+    res = s.query('{ q(func: eq(name, "Carol")) { bonus } }')["data"]
+    assert res["q"] == [{"bonus": 25.0}]
+
+
+def test_negative_numbers_in_args():
+    s = _server()
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='<0x9> <age> "-5"^^<xs:int> .', commit_now=True)
+    res = s.query("{ q(func: lt(age, -1)) { uid age } }")["data"]
+    assert res["q"] == [{"uid": "0x9", "age": -5}]
+
+
+def test_double_division_and_negative_first():
+    s = _server()
+    res = s.query(
+        "{ q(func: has(age)) { a as age half: math(a / 2 / 1) } }"
+    )["data"]
+    assert any(o.get("half") == 15.0 for o in res["q"])
+    res = s.query("{ q(func: has(age), first: -2, orderasc: age) { age } }")[
+        "data"
+    ]
+    assert len(res["q"]) == 2
