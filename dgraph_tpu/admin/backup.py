@@ -92,8 +92,8 @@ def restore(server, backup_dir: str, until: Optional[int] = None) -> int:
             max_ts = max(max_ts, ts)
             total += 1
         server.kv.put_batch(writes)
-    # advance the ts lease past restored data
-    while server.zero.max_assigned < max_ts:
-        server.zero.next_ts(max_ts - server.zero.max_assigned)
-    server.rebuild_vector_indexes()
+    # recover schema/type definitions, ts + uid leases, and vector indexes
+    # from the restored keys — a fresh Server must be fully usable without
+    # a prior alter() (ref online_restore schema handling)
+    server._load_persisted_state()
     return total

@@ -30,7 +30,10 @@ def _rdf_literal(val, tid: TypeID) -> str:
     if tid == TypeID.DATETIME:
         return f'"{v.isoformat()}"^^<xs:dateTime>'
     if tid == TypeID.GEO:
-        return f'"{json.dumps(v, separators=(",", ":"))}"^^<geo:geojson>'
+        j = json.dumps(v, separators=(",", ":")).replace("\\", "\\\\").replace(
+            '"', '\\"'
+        )
+        return f'"{j}"^^<geo:geojson>'
     if tid == TypeID.VFLOAT:
         arr = json.dumps([float(x) for x in v])
         return f'"{arr}"^^<float32vector>'

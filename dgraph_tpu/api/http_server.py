@@ -142,6 +142,23 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self.engine.alter(op.get("schema", ""))
                 self._reply({"data": {"code": "Success", "message": "Done"}})
+            elif path == "/graphql":
+                body = json.loads(self._body().decode("utf-8"))
+                gql = getattr(self.engine, "graphql", None)
+                if gql is None:
+                    return self._error("no GraphQL schema configured", 400)
+                self._reply(
+                    gql.execute(
+                        body.get("query", ""), body.get("variables")
+                    )
+                )
+            elif path == "/admin/schema/graphql":
+                # upload an SDL schema (ref graphql/admin updateGQLSchema)
+                from dgraph_tpu.graphql import GraphQLServer
+
+                sdl = self._body().decode("utf-8")
+                self.engine.graphql = GraphQLServer(self.engine, sdl)
+                self._reply({"data": {"code": "Success", "message": "Done"}})
             elif path == "/admin/export":
                 import tempfile
 
@@ -187,6 +204,7 @@ class _Handler(BaseHTTPRequestHandler):
             uids = txn.mutate_rdf(set_rdf=set_rdf, del_rdf=del_rdf)
 
         if commit_now:
+            self.txns.pop(txn.start_ts, None)  # finished txns don't linger
             commit_ts = txn.commit()
             self._reply(
                 {

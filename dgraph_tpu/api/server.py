@@ -329,18 +329,27 @@ class Server:
                         resolve_many(nq.object_id) if nq.object_id else [None]
                     )
                     for obj in objs:
-                        # reuse the single-nquad path with pinned refs
-                        def resolve_pinned(ref, _s=subj, _o=obj):
-                            return _o if ref == nq.object_id else _s
-
-                        self._apply_nquad(txn, nq, resolve_pinned, op)
+                        self._apply_nquad(
+                            txn, nq, None, op, subj_uid=subj, obj_uid=obj
+                        )
 
         apply_all(set_rdf, OP_SET)
         apply_all(del_rdf, OP_DEL)
         return {k[2:]: hex(v) for k, v in blank.items()}
 
-    def _apply_nquad(self, txn: Txn, nq: NQuad, resolve, op: int):
-        subj = resolve(nq.subject)
+    def _apply_nquad(
+        self,
+        txn: Txn,
+        nq: NQuad,
+        resolve,
+        op: int,
+        subj_uid: Optional[int] = None,
+        obj_uid: Optional[int] = None,
+    ):
+        """Apply one N-Quad. Callers either pass a `resolve` function or
+        pre-resolved subject/object uids (the upsert fan-out path — pinned
+        by role, so `uid(v) <p> uid(v)` self-pairs resolve correctly)."""
+        subj = subj_uid if subj_uid is not None else resolve(nq.subject)
         if nq.star:
             if op != OP_DEL:
                 raise ValueError("S P * only valid in delete")
@@ -350,7 +359,7 @@ class Server:
             edge = DirectedEdge(
                 subj,
                 nq.predicate,
-                value_id=resolve(nq.object_id),
+                value_id=obj_uid if obj_uid is not None else resolve(nq.object_id),
                 facets=nq.facets,
                 op=op,
             )

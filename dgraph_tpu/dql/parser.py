@@ -50,7 +50,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>"(?:\\.|[^"\\])*")
   | (?P<regex>/(?:\\.|[^/\\])+/[i]?)
   | (?P<num>0x[0-9a-fA-F]+|\d+\.\d+|\d+)
-  | (?P<name>~?[a-zA-Z_][\w.\-~]*|<[^>]+>|\$[a-zA-Z_]\w*)
+  | (?P<name>~?[a-zA-Z_][\w.~]*|<[^>]+>|\$[a-zA-Z_]\w*)
   | (?P<punct>@|\(|\)|\{|\}|\[|\]|:|,|==|=|\*|\+|-|/|%|<=|>=|<|>)
 """,
     re.VERBOSE,

@@ -1,0 +1,1 @@
+from dgraph_tpu.graphql.resolve import GraphQLServer

@@ -1,0 +1,198 @@
+"""Minimal GraphQL operation parser (queries + mutations with variables).
+
+Stand-in for the reference's vendored gqlparser
+(/root/reference/graphql/schema uses github.com/dgraph-io/gqlparser):
+parses operations, selection sets, arguments (int/float/string/bool/enum/
+list/object/variable), aliases, and variable definitions.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class GqlParseError(Exception):
+    pass
+
+
+_TOKEN = re.compile(
+    r"""
+    (?P<ws>[\s,]+|\#[^\n]*)
+  | (?P<string>"(?:\\.|[^"\\])*")
+  | (?P<num>-?\d+\.\d+(?:[eE][+-]?\d+)?|-?\d+)
+  | (?P<name>[_A-Za-z]\w*)
+  | (?P<punct>\$|\(|\)|\{|\}|\[|\]|:|=|!|@|\.\.\.)
+""",
+    re.VERBOSE,
+)
+
+
+def _tokenize(s: str):
+    out, pos = [], 0
+    while pos < len(s):
+        m = _TOKEN.match(s, pos)
+        if not m:
+            raise GqlParseError(f"unexpected char {s[pos]!r} at {pos}")
+        if m.lastgroup != "ws":
+            out.append((m.lastgroup, m.group(), pos))
+        pos = m.end()
+    out.append(("eof", "", len(s)))
+    return out
+
+
+@dataclass
+class Selection:
+    name: str
+    alias: str = ""
+    args: Dict[str, Any] = field(default_factory=dict)
+    selections: List["Selection"] = field(default_factory=list)
+
+    @property
+    def key(self) -> str:
+        return self.alias or self.name
+
+
+@dataclass
+class Operation:
+    kind: str  # query | mutation
+    name: str = ""
+    var_defs: Dict[str, Any] = field(default_factory=dict)  # name -> default
+    selections: List[Selection] = field(default_factory=list)
+
+
+class _P:
+    def __init__(self, toks):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, text):
+        t = self.next()
+        if t[1] != text:
+            raise GqlParseError(f"expected {text!r}, got {t[1]!r} at {t[2]}")
+        return t
+
+    def accept(self, text):
+        if self.peek()[1] == text:
+            self.i += 1
+            return True
+        return False
+
+
+def _unquote(s: str) -> str:
+    return re.sub(
+        r"\\(.)",
+        lambda m: {"n": "\n", "t": "\t", '"': '"', "\\": "\\"}.get(
+            m.group(1), m.group(1)
+        ),
+        s[1:-1],
+    )
+
+
+def _parse_value(p: _P, variables: Dict[str, Any]):
+    kind, text, pos = p.next()
+    if text == "$":
+        vname = p.next()[1]
+        if vname not in variables:
+            raise GqlParseError(f"undefined variable ${vname}")
+        return variables[vname]
+    if kind == "string":
+        return _unquote(text)
+    if kind == "num":
+        return float(text) if ("." in text or "e" in text.lower()) else int(text)
+    if text == "[":
+        out = []
+        while p.peek()[1] != "]":
+            out.append(_parse_value(p, variables))
+        p.expect("]")
+        return out
+    if text == "{":
+        obj = {}
+        while p.peek()[1] != "}":
+            k = p.next()[1]
+            p.expect(":")
+            obj[k] = _parse_value(p, variables)
+        p.expect("}")
+        return obj
+    if text == "true":
+        return True
+    if text == "false":
+        return False
+    if text == "null":
+        return None
+    if kind == "name":
+        return text  # enum
+    raise GqlParseError(f"bad value {text!r} at {pos}")
+
+
+def _parse_args(p: _P, variables):
+    args = {}
+    if p.accept("("):
+        while p.peek()[1] != ")":
+            name = p.next()[1]
+            p.expect(":")
+            args[name] = _parse_value(p, variables)
+        p.expect(")")
+    return args
+
+
+def _parse_selection_set(p: _P, variables) -> List[Selection]:
+    p.expect("{")
+    out = []
+    while not p.accept("}"):
+        name = p.next()[1]
+        sel = Selection(name=name)
+        if p.accept(":"):
+            sel.alias = name
+            sel.name = p.next()[1]
+        sel.args = _parse_args(p, variables)
+        while p.accept("@"):  # skip field directives
+            p.next()
+            _parse_args(p, variables)
+        if p.peek()[1] == "{":
+            sel.selections = _parse_selection_set(p, variables)
+        out.append(sel)
+    return out
+
+
+def parse_operation(
+    text: str, variables: Optional[Dict[str, Any]] = None
+) -> Operation:
+    variables = dict(variables or {})
+    p = _P(_tokenize(text))
+    kind = "query"
+    name = ""
+    t = p.peek()
+    if t[1] in ("query", "mutation"):
+        kind = p.next()[1]
+        if p.peek()[0] == "name":
+            name = p.next()[1]
+        if p.accept("("):
+            # variable definitions: ($x: Type! = default)
+            while p.peek()[1] != ")":
+                p.expect("$")
+                vname = p.next()[1]
+                p.expect(":")
+                p.next()  # type name
+                while p.peek()[1] in ("!", "[", "]"):
+                    p.next()
+                if p.accept("="):
+                    default = _parse_value(p, variables)
+                    variables.setdefault(vname, default)
+                if vname not in variables:
+                    variables[vname] = None
+            p.expect(")")
+    op = Operation(kind=kind, name=name)
+    op.selections = _parse_selection_set(p, variables)
+    if p.peek()[0] != "eof":
+        raise GqlParseError(f"trailing input at {p.peek()[2]}")
+    return op

@@ -1,0 +1,441 @@
+"""GraphQL execution: generated API resolved onto the DQL executor.
+
+Mirrors /root/reference/graphql/resolve (query_rewriter.go,
+mutation_rewriter.go, resolver.go): for each SDL type T the API exposes
+  getT(id/xid), queryT(filter, order, first, offset), aggregateT(filter),
+  addT(input, upsert), updateT(input: {filter, set, remove}),
+  deleteT(filter), querySimilarTByEmbedding(by, topK, vector)
+and resolves them by building internal GraphQuery ASTs (not text) executed
+by query.subgraph.Executor, with mutations applied through the
+transactional path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dgraph_tpu.dql.parser import FilterTree, FuncSpec, GraphQuery, Order
+from dgraph_tpu.graphql.parser import Operation, Selection, parse_operation
+from dgraph_tpu.graphql.sdl import GqlField, GqlType, parse_sdl, to_dql_schema
+from dgraph_tpu.posting.lists import LocalCache
+from dgraph_tpu.posting.mutation import DirectedEdge, apply_edge
+from dgraph_tpu.posting.pl import OP_DEL, OP_SET
+from dgraph_tpu.query.outputjson import JsonEncoder
+from dgraph_tpu.query.subgraph import Executor
+from dgraph_tpu.types.types import TypeID, Val
+from dgraph_tpu.x import keys
+
+_FILTER_OPS = {
+    "eq": "eq",
+    "in": "eq",
+    "le": "le",
+    "lt": "lt",
+    "ge": "ge",
+    "gt": "gt",
+    "between": "between",
+    "anyofterms": "anyofterms",
+    "allofterms": "allofterms",
+    "anyoftext": "anyoftext",
+    "alloftext": "alloftext",
+    "regexp": "regexp",
+    "near": "near",
+}
+
+
+class GraphQLError(Exception):
+    pass
+
+
+class GraphQLServer:
+    def __init__(self, engine, sdl: str):
+        self.engine = engine
+        self.types: Dict[str, GqlType] = parse_sdl(sdl)
+        self.sdl = sdl
+        engine.alter(to_dql_schema(self.types))
+
+    # ------------------------------------------------------------------
+    # Entry
+    # ------------------------------------------------------------------
+
+    def execute(
+        self, query: str, variables: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        try:
+            op = parse_operation(query, variables)
+            data = {}
+            for sel in op.selections:
+                if op.kind == "mutation":
+                    data[sel.key] = self._resolve_mutation(sel)
+                else:
+                    data[sel.key] = self._resolve_query(sel)
+            return {"data": data}
+        except Exception as e:  # noqa: BLE001 — GraphQL error envelope
+            return {"data": None, "errors": [{"message": str(e)}]}
+
+    # ------------------------------------------------------------------
+    # Query resolution
+    # ------------------------------------------------------------------
+
+    def _type_for(self, sel_name: str, prefixes) -> GqlType:
+        for pre in prefixes:
+            if sel_name.startswith(pre):
+                tname = sel_name[len(pre) :]
+                t = self.types.get(tname)
+                if t:
+                    return t
+        raise GraphQLError(f"unknown operation {sel_name!r}")
+
+    def _resolve_query(self, sel: Selection):
+        name = sel.name
+        if name.startswith("get"):
+            t = self._type_for(name, ["get"])
+            return self._get(t, sel)
+        if name.startswith("querySimilar") and name.endswith("ByEmbedding"):
+            tname = name[len("querySimilar") : -len("ByEmbedding")]
+            t = self.types.get(tname)
+            if not t:
+                raise GraphQLError(f"unknown type {tname}")
+            return self._similar(t, sel)
+        if name.startswith("query"):
+            t = self._type_for(name, ["query"])
+            return self._query_list(t, sel)
+        if name.startswith("aggregate"):
+            t = self._type_for(name, ["aggregate"])
+            return self._aggregate(t, sel)
+        raise GraphQLError(f"unknown query {name!r}")
+
+    def _run_block(self, gq: GraphQuery) -> List[dict]:
+        cache = LocalCache(self.engine.kv, self.engine.zero.read_ts())
+        ex = Executor(
+            cache, self.engine.schema, vector_indexes=self.engine.vector_indexes
+        )
+        nodes = ex.process([gq])
+        enc = JsonEncoder(val_vars=ex.val_vars, schema=self.engine.schema)
+        return enc.encode_blocks(nodes).get(gq.attr, [])
+
+    def _selection_children(
+        self, t: GqlType, sels: List[Selection]
+    ) -> List[GraphQuery]:
+        out = []
+        for s in sels:
+            f = t.fields.get(s.name)
+            if s.name == "id" or (f and f.type_name == "ID"):
+                out.append(GraphQuery(attr="uid", is_uid=True, alias=s.key))
+                continue
+            if f is None:
+                raise GraphQLError(f"no field {s.name!r} on type {t.name}")
+            child = GraphQuery(attr=f"{t.name}.{f.name}", alias=s.key)
+            if not f.is_scalar:
+                ct = self.types.get(f.type_name)
+                if ct is None:
+                    raise GraphQLError(f"unknown type {f.type_name}")
+                child.children = self._selection_children(ct, s.selections)
+            out.append(child)
+        return out
+
+    def _filter_tree(self, t: GqlType, fobj: dict) -> Optional[FilterTree]:
+        parts: List[FilterTree] = []
+        for k, v in (fobj or {}).items():
+            if k == "and":
+                subs = [self._filter_tree(t, x) for x in _as_list(v)]
+                parts.append(FilterTree(op="and", children=[s for s in subs if s]))
+            elif k == "or":
+                subs = [self._filter_tree(t, x) for x in _as_list(v)]
+                parts.append(FilterTree(op="or", children=[s for s in subs if s]))
+            elif k == "not":
+                sub = self._filter_tree(t, v)
+                if sub:
+                    parts.append(FilterTree(op="not", children=[sub]))
+            elif k == "id":
+                uids = [int(x, 16) for x in _as_list(v)]
+                parts.append(
+                    FilterTree(func=FuncSpec(name="uid", args=uids))
+                )
+            elif k == "has":
+                for fname in _as_list(v):
+                    f = t.fields.get(fname)
+                    if f is None:
+                        raise GraphQLError(f"no field {fname!r}")
+                    parts.append(
+                        FilterTree(
+                            func=FuncSpec(name="has", attr=f"{t.name}.{fname}")
+                        )
+                    )
+            else:
+                f = t.fields.get(k)
+                if f is None:
+                    raise GraphQLError(f"no field {k!r} on {t.name}")
+                attr = f"{t.name}.{k}"
+                if not isinstance(v, dict):
+                    v = {"eq": v}
+                for opname, arg in v.items():
+                    fn = _FILTER_OPS.get(opname)
+                    if fn is None:
+                        raise GraphQLError(f"bad filter op {opname!r}")
+                    if opname == "in":
+                        args = _as_list(arg)
+                    elif opname == "between":
+                        args = [arg.get("min"), arg.get("max")]
+                    elif opname == "near":
+                        c = arg.get("coordinate", {})
+                        args = [
+                            [c.get("longitude"), c.get("latitude")],
+                            arg.get("distance"),
+                        ]
+                    elif opname == "regexp":
+                        pat = str(arg)
+                        if pat.startswith("/"):
+                            end = pat.rindex("/")
+                            args = [("regex", pat[1:end], pat[end + 1 :])]
+                        else:
+                            args = [("regex", pat, "")]
+                    else:
+                        args = [arg]
+                    parts.append(
+                        FilterTree(func=FuncSpec(name=fn, attr=attr, args=args))
+                    )
+        if not parts:
+            return None
+        if len(parts) == 1:
+            return parts[0]
+        return FilterTree(op="and", children=parts)
+
+    def _query_list(self, t: GqlType, sel: Selection) -> List[dict]:
+        gq = GraphQuery(attr="q")
+        gq.func = FuncSpec(name="type", attr=t.name)
+        gq.filter = self._filter_tree(t, sel.args.get("filter"))
+        order = sel.args.get("order") or {}
+        if "asc" in order:
+            gq.order.append(Order(attr=f"{t.name}.{order['asc']}"))
+        if "desc" in order:
+            gq.order.append(Order(attr=f"{t.name}.{order['desc']}", desc=True))
+        gq.first = sel.args.get("first")
+        gq.offset = sel.args.get("offset")
+        gq.children = self._selection_children(t, sel.selections)
+        return self._run_block(gq)
+
+    def _get(self, t: GqlType, sel: Selection) -> Optional[dict]:
+        gq = GraphQuery(attr="q")
+        if "id" in sel.args:
+            gq.func = FuncSpec(name="uid", args=[int(sel.args["id"], 16)])
+            gq.filter = FilterTree(func=FuncSpec(name="type", attr=t.name))
+        else:
+            xf = t.xid_field()
+            if xf is None or xf.name not in sel.args:
+                raise GraphQLError(f"get{t.name} requires id or @id field")
+            gq.func = FuncSpec(
+                name="eq",
+                attr=f"{t.name}.{xf.name}",
+                args=[sel.args[xf.name]],
+            )
+        gq.children = self._selection_children(t, sel.selections)
+        res = self._run_block(gq)
+        return res[0] if res else None
+
+    def _aggregate(self, t: GqlType, sel: Selection) -> dict:
+        gq = GraphQuery(attr="q")
+        gq.func = FuncSpec(name="type", attr=t.name)
+        gq.filter = self._filter_tree(t, sel.args.get("filter"))
+        gq.children = [GraphQuery(attr="uid", is_count=True, alias="count")]
+        res = self._run_block(gq)
+        count = res[0]["count"] if res else 0
+        return {"count": count}
+
+    def _similar(self, t: GqlType, sel: Selection) -> List[dict]:
+        by = sel.args.get("by")
+        topk = int(sel.args.get("topK", 10))
+        vec = sel.args.get("vector")
+        gq = GraphQuery(attr="q")
+        import json as _json
+
+        gq.func = FuncSpec(
+            name="similar_to",
+            attr=f"{t.name}.{by}",
+            args=[topk, _json.dumps(vec)],
+        )
+        gq.children = self._selection_children(t, sel.selections)
+        return self._run_block(gq)
+
+    # ------------------------------------------------------------------
+    # Mutations (ref resolve/mutation_rewriter.go)
+    # ------------------------------------------------------------------
+
+    def _resolve_mutation(self, sel: Selection):
+        name = sel.name
+        if name.startswith("add"):
+            return self._add(self._type_for(name, ["add"]), sel)
+        if name.startswith("update"):
+            return self._update(self._type_for(name, ["update"]), sel)
+        if name.startswith("delete"):
+            return self._delete(self._type_for(name, ["delete"]), sel)
+        raise GraphQLError(f"unknown mutation {name!r}")
+
+    def _payload(self, t: GqlType, sel: Selection, uids: List[int], num: int):
+        out: Dict[str, Any] = {}
+        for s in sel.selections:
+            if s.name == "numUids":
+                out[s.key] = num
+            elif s.name == "msg":
+                out[s.key] = "Deleted" if sel.name.startswith("delete") else "Ok"
+            elif s.name.lower() == t.name.lower():
+                gq = GraphQuery(attr="q")
+                gq.func = FuncSpec(name="uid", args=uids)
+                gq.children = self._selection_children(t, s.selections)
+                out[s.key] = self._run_block(gq)
+        return out
+
+    def _set_field(self, txn, t: GqlType, uid: int, f: GqlField, value, op=OP_SET):
+        attr = f"{t.name}.{f.name}"
+        if f.is_embedding:
+            edge = DirectedEdge(
+                uid, attr, value=Val(TypeID.VFLOAT, np.asarray(value, np.float32)),
+                op=op,
+            )
+            apply_edge(txn, self.engine.schema, edge)
+            return
+        if not f.is_scalar:
+            ct = self.types[f.type_name]
+            for obj in _as_list(value):
+                child_uid = self._upsert_object(txn, ct, obj, getattr(txn, '_created', None))
+                apply_edge(
+                    txn,
+                    self.engine.schema,
+                    DirectedEdge(uid, attr, value_id=child_uid, op=op),
+                )
+                if f.has_inverse:
+                    apply_edge(
+                        txn,
+                        self.engine.schema,
+                        DirectedEdge(
+                            child_uid,
+                            f"{ct.name}.{f.has_inverse}",
+                            value_id=uid,
+                            op=op,
+                        ),
+                    )
+            return
+        vals = value if (f.is_list and isinstance(value, list)) else [value]
+        for v in vals:
+            apply_edge(
+                txn,
+                self.engine.schema,
+                DirectedEdge(uid, attr, value=_to_val(v, f), op=op),
+            )
+
+    def _upsert_object(self, txn, t: GqlType, obj: dict, created=None) -> int:
+        """Create or reference an object: {id: "0x1"} references, otherwise
+        create a new node (with @id dedup)."""
+        if set(obj.keys()) == {"id"}:
+            return int(obj["id"], 16)
+        xf = t.xid_field()
+        if xf and xf.name in obj:
+            # look up existing by xid
+            ex = Executor(txn.cache, self.engine.schema)
+            found = ex._runner().run_root(
+                FuncSpec(
+                    name="eq", attr=f"{t.name}.{xf.name}", args=[obj[xf.name]]
+                )
+            )
+            if len(found):
+                uid = int(found[0])
+                for k, v in obj.items():
+                    if k in ("id", xf.name):
+                        continue
+                    self._set_field(txn, t, uid, t.fields[k], v)
+                return uid
+        uid = self.engine.zero.assign_uids(1)
+        if created is not None:
+            created.append(uid)
+        apply_edge(
+            txn,
+            self.engine.schema,
+            DirectedEdge(uid, "dgraph.type", value=Val(TypeID.STRING, t.name)),
+        )
+        for k, v in obj.items():
+            if k == "id":
+                continue
+            f = t.fields.get(k)
+            if f is None:
+                raise GraphQLError(f"no field {k!r} on {t.name}")
+            self._set_field(txn, t, uid, f, v)
+        return uid
+
+    def _add(self, t: GqlType, sel: Selection):
+        inputs = _as_list(sel.args.get("input", []))
+        txn = self.engine.new_txn()
+        created: List[int] = []
+        txn.txn._created = created  # nested creates counted in numUids
+        uids = [self._upsert_object(txn.txn, t, obj, created) for obj in inputs]
+        txn.commit()
+        return self._payload(t, sel, uids, len(created))
+
+    def _match_filter_uids(self, t: GqlType, fobj) -> List[int]:
+        gq = GraphQuery(attr="q")
+        gq.func = FuncSpec(name="type", attr=t.name)
+        gq.filter = self._filter_tree(t, fobj)
+        gq.children = [GraphQuery(attr="uid", is_uid=True)]
+        return [int(o["uid"], 16) for o in self._run_block(gq)]
+
+    def _update(self, t: GqlType, sel: Selection):
+        inp = sel.args.get("input", {})
+        uids = self._match_filter_uids(t, inp.get("filter"))
+        txn = self.engine.new_txn()
+        for uid in uids:
+            for k, v in (inp.get("set") or {}).items():
+                f = t.fields.get(k)
+                if f is None:
+                    raise GraphQLError(f"no field {k!r}")
+                self._set_field(txn.txn, t, uid, f, v)
+            for k, v in (inp.get("remove") or {}).items():
+                f = t.fields.get(k)
+                if f is None:
+                    raise GraphQLError(f"no field {k!r}")
+                self._set_field(txn.txn, t, uid, f, v, op=OP_DEL)
+        txn.commit()
+        return self._payload(t, sel, uids, len(uids))
+
+    def _delete(self, t: GqlType, sel: Selection):
+        from dgraph_tpu.posting.mutation import delete_entity_attr
+
+        uids = self._match_filter_uids(t, sel.args.get("filter"))
+        txn = self.engine.new_txn()
+        for uid in uids:
+            for f in t.fields.values():
+                if f.type_name == "ID":
+                    continue
+                delete_entity_attr(
+                    txn.txn, self.engine.schema, uid, f"{t.name}.{f.name}"
+                )
+            delete_entity_attr(txn.txn, self.engine.schema, uid, "dgraph.type")
+        txn.commit()
+        return self._payload(t, sel, uids, len(uids))
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return x if isinstance(x, list) else [x]
+
+
+def _to_val(v, f: GqlField) -> Val:
+    dtype = f.dql_type
+    if dtype == "int":
+        return Val(TypeID.INT, int(v))
+    if dtype == "float":
+        return Val(TypeID.FLOAT, float(v))
+    if dtype == "bool":
+        return Val(TypeID.BOOL, bool(v))
+    if dtype == "datetime":
+        from dgraph_tpu.types.types import parse_datetime
+
+        return Val(TypeID.DATETIME, parse_datetime(str(v)))
+    if dtype == "geo":
+        if isinstance(v, dict) and "longitude" in v:
+            v = {
+                "type": "Point",
+                "coordinates": [v["longitude"], v["latitude"]],
+            }
+        return Val(TypeID.GEO, v)
+    return Val(TypeID.STRING, str(v))

@@ -1,0 +1,178 @@
+"""GraphQL SDL schema: parse type definitions, generate the DQL mapping.
+
+Mirrors /root/reference/graphql/schema/gqlschema.go (API synthesis from
+SDL) + schemagen.go (SDL -> dgraph schema): each GraphQL type T with field
+f becomes predicate `T.f`; @search(by:[...]) maps to @index tokenizers;
+@id fields get @index(hash) @upsert; @hasInverse becomes @reverse pairs;
+vector fields (`[Float!] @embedding @search(by:["hnsw"])`) map to
+float32vector hnsw indexes.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_SCALARS = {
+    "String": "string",
+    "Int": "int",
+    "Int64": "int",
+    "Float": "float",
+    "Boolean": "bool",
+    "DateTime": "datetime",
+    "ID": "uid",
+    "Point": "geo",
+}
+
+_SEARCH_DEFAULT = {
+    "string": ["term"],
+    "int": ["int"],
+    "float": ["float"],
+    "bool": ["bool"],
+    "datetime": ["year"],
+    "geo": ["geo"],
+}
+
+
+@dataclass
+class GqlField:
+    name: str
+    type_name: str  # GraphQL type, e.g. String, Person
+    is_list: bool = False
+    non_null: bool = False
+    is_id: bool = False  # @id (external id) or ID type
+    search: List[str] = field(default_factory=list)
+    has_inverse: str = ""  # field name on target type
+    is_embedding: bool = False
+    is_scalar: bool = True
+
+    @property
+    def dql_type(self) -> str:
+        if self.is_embedding:
+            return "float32vector"
+        return _SCALARS.get(self.type_name, "uid")
+
+
+@dataclass
+class GqlType:
+    name: str
+    fields: Dict[str, GqlField] = field(default_factory=dict)
+
+    def id_field(self) -> Optional[GqlField]:
+        for f in self.fields.values():
+            if f.type_name == "ID":
+                return f
+        return None
+
+    def xid_field(self) -> Optional[GqlField]:
+        for f in self.fields.values():
+            if f.is_id:
+                return f
+        return None
+
+
+_TYPE_RE = re.compile(
+    r"type\s+(?P<name>\w+)\s*(?:implements\s+[\w&\s]+)?\{(?P<body>[^}]*)\}",
+    re.DOTALL,
+)
+_FIELD_RE = re.compile(
+    r"""(?P<name>\w+)\s*:\s*
+    (?P<list>\[)?\s*(?P<type>\w+)\s*(?P<inner_nn>!)?\s*\]?\s*(?P<nn>!)?\s*
+    (?P<directives>(?:@\w+(?:\((?:[^()]|\([^()]*\))*\))?\s*)*)""",
+    re.VERBOSE,
+)
+_DIR_RE = re.compile(r"@(\w+)(?:\(((?:[^()]|\([^()]*\))*)\))?")
+
+
+class SDLError(Exception):
+    pass
+
+
+def parse_sdl(sdl: str) -> Dict[str, GqlType]:
+    sdl = re.sub(r'"""[\s\S]*?"""', "", sdl)  # strip descriptions
+    sdl = re.sub(r"#[^\n]*", "", sdl)
+    types: Dict[str, GqlType] = {}
+    for m in _TYPE_RE.finditer(sdl):
+        t = GqlType(name=m.group("name"))
+        body = m.group("body")
+        matches = list(_FIELD_RE.finditer(body))
+        if not matches and body.strip():
+            raise SDLError(f"cannot parse fields of type {t.name}: {body!r}")
+        # ensure nothing between fields went unparsed (newline- or
+        # whitespace-separated declarations both allowed in SDL)
+        leftover = body
+        for fm in matches:
+            leftover = leftover.replace(fm.group(0), "", 1)
+        if leftover.strip():
+            raise SDLError(
+                f"cannot parse field(s) {leftover.strip()!r} in type {t.name}"
+            )
+        for fm in matches:
+            f = GqlField(
+                name=fm.group("name"),
+                type_name=fm.group("type"),
+                is_list=bool(fm.group("list")),
+                non_null=bool(fm.group("nn") or fm.group("inner_nn")),
+            )
+            f.is_scalar = fm.group("type") in _SCALARS
+            for dm in _DIR_RE.finditer(fm.group("directives") or ""):
+                dname, dargs = dm.group(1), dm.group(2) or ""
+                if dname == "id":
+                    f.is_id = True
+                elif dname == "search":
+                    by = re.findall(r"\w+", dargs.split(":", 1)[1]) if ":" in dargs else []
+                    f.search = [b.lower() for b in by] or ["__default__"]
+                elif dname == "hasInverse":
+                    iv = re.search(r"field\s*:\s*\"?(\w+)\"?", dargs)
+                    if iv:
+                        f.has_inverse = iv.group(1)
+                elif dname == "embedding":
+                    f.is_embedding = True
+                    f.is_scalar = True
+            t.fields[f.name] = f
+        types[t.name] = t
+    return types
+
+
+def to_dql_schema(types: Dict[str, GqlType]) -> str:
+    """Generate the internal schema text (ref schemagen.go)."""
+    lines: List[str] = []
+    for t in types.values():
+        tfields = []
+        for f in t.fields.values():
+            if f.type_name == "ID":
+                continue  # internal uid, no predicate
+            pred = f"{t.name}.{f.name}"
+            tfields.append(pred)
+            dtype = f.dql_type
+            type_str = f"[{dtype}]" if (f.is_list and not f.is_embedding) else dtype
+            directives = []
+            if f.is_embedding:
+                search = [s for s in f.search if s != "__default__"]
+                metric = "euclidean"
+                for s in search:
+                    if s in ("euclidean", "cosine", "dotproduct"):
+                        metric = s
+                directives.append(f'@index(hnsw(metric:"{metric}"))')
+            elif f.is_id:
+                directives.append("@index(hash)")
+                directives.append("@upsert")
+            elif f.search:
+                toks = []
+                for s in f.search:
+                    if s == "__default__":
+                        toks.extend(_SEARCH_DEFAULT.get(dtype, ["term"]))
+                    elif s == "regexp":
+                        toks.append("trigram")
+                    else:
+                        toks.append(s)
+                directives.append(f"@index({', '.join(dict.fromkeys(toks))})")
+            if not f.is_scalar:
+                if f.has_inverse:
+                    directives.append("@reverse")
+            d = (" " + " ".join(directives)) if directives else ""
+            lines.append(f"<{pred}>: {type_str}{d} .")
+        fl = "\n  ".join(tfields)
+        lines.append(f"type {t.name} {{\n  {fl}\n}}")
+    return "\n".join(lines)

@@ -186,7 +186,12 @@ def split_statements(text: str) -> List[str]:
         elif (
             c == "."
             and buf
-            and buf[-1] in " \t\n"
+            # terminator dot: after whitespace, or abutting a closing
+            # quote/angle/blank-node ('"Alice".' / '<0x2>.' / '_:b.')
+            and (
+                buf[-1] in " \t\n\r\">"
+                or (i + 1 >= n or text[i + 1] in "\n\r")
+            )
             and (i + 1 >= n or text[i + 1] in " \t\n\r")
         ):
             buf.append(c)

@@ -119,7 +119,7 @@ class JsonEncoder:
             name = _display_name(c)
             gq = c.gq
             if gq.is_uid:
-                obj["uid"] = encode_uid(uid)
+                obj[name] = encode_uid(uid)
             elif gq.math_expr is not None:
                 v = c.math_vals.get(uid)
                 if v is not None:

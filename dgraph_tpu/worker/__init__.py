@@ -1,0 +1,1 @@
+from dgraph_tpu.worker.groups import DistributedCluster, ZeroService

@@ -146,6 +146,18 @@ class ParsedKey:
         return self.tag == TAG_TYPE
 
 
+def attr_of(key_or_prefix: bytes) -> Optional[str]:
+    """Extract the attr from a key OR a bare prefix (which lacks the
+    kind/uid suffix a full parse_key needs)."""
+    if len(key_or_prefix) < 3:
+        return None
+    tag, nlen = struct.unpack_from(">BH", key_or_prefix, 0)
+    if len(key_or_prefix) < 3 + nlen:
+        return None
+    _, attr = attr_from_nsattr(key_or_prefix[3 : 3 + nlen])
+    return attr
+
+
 def parse_key(key: bytes) -> ParsedKey:
     tag, nlen = struct.unpack_from(">BH", key, 0)
     nsattr = key[3 : 3 + nlen]

@@ -113,3 +113,32 @@ def test_geojson_value_with_braces(http):
     assert out["data"]["code"] == "Success"
     res = _post(http, "/query", "{ q(func: uid(0x1)) { loc } }")
     assert res["data"]["q"][0]["loc"]["type"] == "Point"
+
+
+def test_graphql_endpoint(http):
+    import urllib.request as ur
+
+    sdl = "type City { id: ID! name: String! @search(by: [exact]) }"
+    req = ur.Request(
+        f"http://127.0.0.1:{http.port}/admin/schema/graphql",
+        data=sdl.encode(),
+        method="POST",
+    )
+    with ur.urlopen(req) as r:
+        assert json.loads(r.read())["data"]["code"] == "Success"
+    out = _post(
+        http,
+        "/graphql",
+        json.dumps(
+            {"query": 'mutation { addCity(input: [{name: "Oslo"}]) { numUids } }'}
+        ),
+        ctype="application/json",
+    )
+    assert out["data"]["addCity"]["numUids"] == 1
+    out = _post(
+        http,
+        "/graphql",
+        json.dumps({"query": "query { queryCity { name } }"}),
+        ctype="application/json",
+    )
+    assert out["data"]["queryCity"] == [{"name": "Oslo"}]

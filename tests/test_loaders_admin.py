@@ -198,3 +198,43 @@ def test_loaders_accept_multistatement_lines():
     bulk_load_rdf(s, '_:a <name> "X" . _:b <name> "Y" .')
     res = s.query('{ q(func: has(name)) { name } }')["data"]
     assert {o["name"] for o in res["q"]} == {"X", "Y"}
+
+
+def test_rdf_dot_abutting_and_export_geo_roundtrip(tmp_path):
+    from dgraph_tpu.loaders.rdf import parse_rdf
+
+    nqs = parse_rdf('<0x1> <name> "Alice".\n<0x2> <name> "Bob".')
+    assert len(nqs) == 2
+    # geo export lines re-parse (escaped inner quotes)
+    s = Server()
+    s.alter("loc: geo @index(geo) .")
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf='<0x1> <loc> "{\\"type\\":\\"Point\\",\\"coordinates\\":[1.0,2.0]}"^^<geo:geojson> .',
+        commit_now=True,
+    )
+    out = export(s, str(tmp_path), fmt="rdf", compress=False)
+    with open(out["data"]) as f:
+        rdf = f.read()
+    s2 = Server()
+    s2.alter("loc: geo @index(geo) .")
+    bulk_load_rdf(s2, rdf)
+    res = s2.query("{ q(func: uid(0x1)) { loc } }")["data"]
+    assert res["q"][0]["loc"]["type"] == "Point"
+
+
+def test_restore_into_fresh_server_recovers_schema(tmp_path):
+    bdir = str(tmp_path / "b2")
+    s = Server()
+    s.alter(SCHEMA)
+    bulk_load_rdf(s, RDF)
+    backup(s, bdir)
+    s2 = Server()  # NO alter — schema must come from the backup
+    restore(s2, bdir)
+    assert s2.schema.get("name").tokenizers == ["term", "exact"]
+    res = s2.query('{ q(func: eq(name, "Ann")) { name } }')["data"]
+    assert res["q"] == [{"name": "Ann"}]
+    res = s2.query('{ v(func: similar_to(embedding, 1, "[1.0,2.0]")) { name } }')[
+        "data"
+    ]
+    assert res["v"][0]["name"] == "Ann"

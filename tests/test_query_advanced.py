@@ -154,3 +154,17 @@ def test_double_division_and_negative_first():
         "data"
     ]
     assert len(res["q"]) == 2
+
+
+def test_upsert_self_pair_edges():
+    s = _server()
+    t = s.new_txn()
+    t.upsert(
+        query='{ v as var(func: eq(age, 25)) }',
+        set_rdf="uid(v) <friend> uid(v) .",
+    )
+    # v = {Bob(0x2), Carol(0x3)}: cross product incl. self-pairs written
+    # with correct subject->object orientation
+    res = s.query("{ q(func: uid(0x2)) { friend { uid } } }")["data"]
+    uids = {o["uid"] for o in res["q"][0]["friend"]}
+    assert uids == {"0x2", "0x3"}
