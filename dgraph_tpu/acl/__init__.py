@@ -1,0 +1,1 @@
+from dgraph_tpu.acl.acl import AclManager, AclError, Permission

@@ -1,0 +1,319 @@
+"""Access control: users, groups, predicate-level rules, JWT sessions.
+
+Mirrors /root/reference/edgraph/access.go (+ worker/acl_cache.go): users
+and groups are stored *as graph data* in the cluster itself (predicates
+dgraph.xid, dgraph.password, dgraph.user.group, dgraph.acl.rule /
+dgraph.rule.predicate / dgraph.rule.permission); login issues an
+access+refresh JWT pair; per-request authorization checks the union of the
+user's groups' rules at predicate granularity (READ=4, WRITE=2, MODIFY=1);
+members of the `guardians` group bypass checks; the bootstrap superuser is
+`groot` (access.go:417-531).
+
+Multi-tenancy: each namespace has its own user/group universe (keys are
+namespaced); guardians of the galaxy (ns 0) administer namespaces.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Dict, List, Optional, Set
+
+from dgraph_tpu.acl import jwt
+from dgraph_tpu.posting.lists import LocalCache, Txn
+from dgraph_tpu.posting.mutation import DirectedEdge, apply_edge
+from dgraph_tpu.posting.pl import OP_DEL
+from dgraph_tpu.types.types import TypeID, Val
+from dgraph_tpu.x import keys
+
+READ = 4
+WRITE = 2
+MODIFY = 1
+
+
+class Permission:
+    READ = READ
+    WRITE = WRITE
+    MODIFY = MODIFY
+
+
+class AclError(Exception):
+    pass
+
+
+_ACL_SCHEMA = """
+dgraph.xid: string @index(exact) @upsert .
+dgraph.password: password .
+dgraph.user.group: [uid] @reverse .
+dgraph.acl.rule: [uid] .
+dgraph.rule.predicate: string @index(exact) .
+dgraph.rule.permission: int .
+"""
+
+GROOT = "groot"
+GUARDIANS = "guardians"
+_ACCESS_TTL = 6 * 3600
+_REFRESH_TTL = 30 * 24 * 3600
+
+
+def _hash_password(pw: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", pw.encode(), salt, 10_000)
+
+
+class AclManager:
+    def __init__(self, server, secret: Optional[bytes] = None):
+        self.server = server
+        self.secret = secret or os.urandom(32)
+        self._ensure_schema()
+
+    # -- bootstrap (ref access.go:417 initializeAcl) -------------------------
+
+    def _ensure_schema(self):
+        self.server.alter(_ACL_SCHEMA)
+
+    def bootstrap(self, ns: int = keys.GALAXY_NS, groot_password: str = "password"):
+        """Create groot + guardians if missing."""
+        if self._uid_of_xid(GUARDIANS, ns) is None:
+            g_uid = self._create_node(ns, GUARDIANS, kind="group")
+        else:
+            g_uid = self._uid_of_xid(GUARDIANS, ns)
+        if self._uid_of_xid(GROOT, ns) is None:
+            u_uid = self._create_node(
+                ns, GROOT, kind="user", password=groot_password
+            )
+            txn = self.server.new_txn()
+            apply_edge(
+                txn.txn,
+                self.server.schema,
+                DirectedEdge(u_uid, "dgraph.user.group", value_id=g_uid, ns=ns),
+            )
+            txn.commit()
+
+    def _create_node(self, ns, xid, kind, password: Optional[str] = None) -> int:
+        uid = self.server.zero.assign_uids(1)
+        txn = self.server.new_txn()
+        apply_edge(
+            txn.txn,
+            self.server.schema,
+            DirectedEdge(uid, "dgraph.xid", value=Val(TypeID.STRING, xid), ns=ns),
+        )
+        if password is not None:
+            salt = hashlib.sha256(xid.encode()).digest()[:16]
+            ph = salt + _hash_password(password, salt)
+            apply_edge(
+                txn.txn,
+                self.server.schema,
+                DirectedEdge(
+                    uid,
+                    "dgraph.password",
+                    value=Val(TypeID.PASSWORD, ph.hex()),
+                    ns=ns,
+                ),
+            )
+        txn.commit()
+        return uid
+
+    # -- lookups ---------------------------------------------------------------
+
+    def _cache(self) -> LocalCache:
+        return LocalCache(self.server.kv, self.server.zero.read_ts())
+
+    def _uid_of_xid(self, xid: str, ns: int) -> Optional[int]:
+        cache = self._cache()
+        tok = b"\x02" + xid.encode()
+        uids = cache.uids(keys.IndexKey("dgraph.xid", tok, ns))
+        return int(uids[0]) if len(uids) else None
+
+    def _groups_of(self, uid: int, ns: int) -> List[int]:
+        cache = self._cache()
+        return [
+            int(g)
+            for g in cache.uids(keys.DataKey("dgraph.user.group", uid, ns))
+        ]
+
+    def _xid_of(self, uid: int, ns: int) -> str:
+        v = self._cache().value(keys.DataKey("dgraph.xid", uid, ns))
+        return str(v.value) if v else ""
+
+    # -- user/group admin (ref graphql/admin ACL resolvers) ----------------------
+
+    def add_user(self, xid: str, password: str, ns: int = keys.GALAXY_NS) -> int:
+        if self._uid_of_xid(xid, ns) is not None:
+            raise AclError(f"user {xid!r} exists")
+        return self._create_node(ns, xid, "user", password)
+
+    def add_group(self, xid: str, ns: int = keys.GALAXY_NS) -> int:
+        if self._uid_of_xid(xid, ns) is not None:
+            raise AclError(f"group {xid!r} exists")
+        return self._create_node(ns, xid, "group")
+
+    def add_user_to_group(self, user: str, group: str, ns: int = keys.GALAXY_NS):
+        u, g = self._uid_of_xid(user, ns), self._uid_of_xid(group, ns)
+        if u is None or g is None:
+            raise AclError("unknown user or group")
+        txn = self.server.new_txn()
+        apply_edge(
+            txn.txn,
+            self.server.schema,
+            DirectedEdge(u, "dgraph.user.group", value_id=g, ns=ns),
+        )
+        txn.commit()
+
+    def set_rule(
+        self, group: str, predicate: str, perm: int, ns: int = keys.GALAXY_NS
+    ):
+        g = self._uid_of_xid(group, ns)
+        if g is None:
+            raise AclError(f"unknown group {group!r}")
+        rule_uid = self.server.zero.assign_uids(1)
+        txn = self.server.new_txn()
+        apply_edge(
+            txn.txn,
+            self.server.schema,
+            DirectedEdge(g, "dgraph.acl.rule", value_id=rule_uid, ns=ns),
+        )
+        apply_edge(
+            txn.txn,
+            self.server.schema,
+            DirectedEdge(
+                rule_uid,
+                "dgraph.rule.predicate",
+                value=Val(TypeID.STRING, predicate),
+                ns=ns,
+            ),
+        )
+        apply_edge(
+            txn.txn,
+            self.server.schema,
+            DirectedEdge(
+                rule_uid,
+                "dgraph.rule.permission",
+                value=Val(TypeID.INT, perm),
+                ns=ns,
+            ),
+        )
+        txn.commit()
+
+    # -- login (ref access.go:42 Login) ------------------------------------------
+
+    def login(
+        self, user: str, password: str, ns: int = keys.GALAXY_NS
+    ) -> Dict[str, str]:
+        uid = self._uid_of_xid(user, ns)
+        if uid is None:
+            raise AclError("invalid username or password")
+        stored = self._cache().value(keys.DataKey("dgraph.password", uid, ns))
+        if stored is None:
+            raise AclError("invalid username or password")
+        raw = bytes.fromhex(str(stored.value))
+        salt, want = raw[:16], raw[16:]
+        import hmac as _hmac
+
+        if not _hmac.compare_digest(_hash_password(password, salt), want):
+            raise AclError("invalid username or password")
+        now = int(time.time())
+        groups = [self._xid_of(g, ns) for g in self._groups_of(uid, ns)]
+        access = jwt.encode(
+            {
+                "userid": user,
+                "namespace": ns,
+                "groups": groups,
+                "exp": now + _ACCESS_TTL,
+                "typ": "access",
+            },
+            self.secret,
+        )
+        refresh = jwt.encode(
+            {"userid": user, "namespace": ns, "exp": now + _REFRESH_TTL,
+             "typ": "refresh"},
+            self.secret,
+        )
+        return {"accessJwt": access, "refreshJwt": refresh}
+
+    def refresh(self, refresh_jwt: str) -> Dict[str, str]:
+        claims = jwt.decode(refresh_jwt, self.secret)
+        if claims.get("typ") != "refresh":
+            raise AclError("not a refresh token")
+        user, ns = claims["userid"], claims.get("namespace", 0)
+        uid = self._uid_of_xid(user, ns)
+        if uid is None:
+            raise AclError("user deleted")
+        now = int(time.time())
+        groups = [self._xid_of(g, ns) for g in self._groups_of(uid, ns)]
+        access = jwt.encode(
+            {"userid": user, "namespace": ns, "groups": groups,
+             "exp": now + _ACCESS_TTL, "typ": "access"},
+            self.secret,
+        )
+        return {"accessJwt": access, "refreshJwt": refresh_jwt}
+
+    # -- authorization (ref access.go:620 authorizePreds) -------------------------
+
+    def claims(self, access_jwt: str) -> dict:
+        c = jwt.decode(access_jwt, self.secret)
+        if c.get("typ") != "access":
+            raise AclError("not an access token")
+        return c
+
+    def _perms_for(self, claims: dict) -> Optional[Dict[str, int]]:
+        """None => guardian (all access). Else predicate -> permission bits."""
+        ns = claims.get("namespace", 0)
+        if GUARDIANS in claims.get("groups", []):
+            return None
+        cache = self._cache()
+        perms: Dict[str, int] = {}
+        for gname in claims.get("groups", []):
+            g = self._uid_of_xid(gname, ns)
+            if g is None:
+                continue
+            for rule in cache.uids(keys.DataKey("dgraph.acl.rule", g, ns)):
+                p = cache.value(
+                    keys.DataKey("dgraph.rule.predicate", int(rule), ns)
+                )
+                m = cache.value(
+                    keys.DataKey("dgraph.rule.permission", int(rule), ns)
+                )
+                if p is not None and m is not None:
+                    pred = str(p.value)
+                    perms[pred] = perms.get(pred, 0) | int(m.value)
+        return perms
+
+    def readable_preds(self, claims: dict) -> Optional[Set[str]]:
+        """Set of predicates the caller may READ, or None for guardians
+        (used to filter expand(_all_), ref graphql auth filtering)."""
+        perms = self._perms_for(claims)
+        if perms is None:
+            return None
+        return {p for p, m in perms.items() if m & READ}
+
+    def is_guardian(self, access_jwt: Optional[str]) -> bool:
+        if access_jwt is None:
+            return False
+        try:
+            claims = self.claims(access_jwt)
+        except Exception:
+            return False
+        return GUARDIANS in claims.get("groups", [])
+
+    def authorize_preds(
+        self, access_jwt: str, preds: List[str], need: int, claims=None
+    ) -> None:
+        """Raise AclError if any predicate lacks `need` permission."""
+        if claims is None:
+            claims = self.claims(access_jwt)
+        perms = self._perms_for(claims)
+        if perms is None:
+            return  # guardian
+        for pred in preds:
+            if pred.startswith("dgraph."):
+                if need != READ:
+                    raise AclError(
+                        f"only guardians may modify {pred!r}"
+                    )
+                continue
+            if not (perms.get(pred, 0) & need):
+                raise AclError(
+                    f"unauthorized to {'read' if need == READ else 'write'} "
+                    f"predicate {pred!r}"
+                )

@@ -1,0 +1,50 @@
+"""Minimal JWT (HS256) — stdlib only.
+
+Stand-in for the golang-jwt dependency used by
+/root/reference/edgraph/access.go (access+refresh token pair with
+namespace + groups claims)."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import Optional
+
+
+class JwtError(Exception):
+    pass
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(s: str) -> bytes:
+    pad = "=" * (-len(s) % 4)
+    return base64.urlsafe_b64decode(s + pad)
+
+
+def encode(claims: dict, secret: bytes) -> str:
+    header = _b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64(json.dumps(claims, separators=(",", ":")).encode())
+    msg = f"{header}.{payload}".encode()
+    sig = _b64(hmac.new(secret, msg, hashlib.sha256).digest())
+    return f"{header}.{payload}.{sig}"
+
+
+def decode(token: str, secret: bytes, verify_exp: bool = True) -> dict:
+    try:
+        header, payload, sig = token.split(".")
+    except ValueError:
+        raise JwtError("malformed token") from None
+    msg = f"{header}.{payload}".encode()
+    want = _b64(hmac.new(secret, msg, hashlib.sha256).digest())
+    if not hmac.compare_digest(want, sig):
+        raise JwtError("bad signature")
+    claims = json.loads(_unb64(payload))
+    if verify_exp and claims.get("exp", 0) < time.time():
+        raise JwtError("token expired")
+    return claims

@@ -17,6 +17,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from dgraph_tpu.acl.acl import AclError
+from dgraph_tpu.acl.jwt import JwtError
 from dgraph_tpu.api.server import Server, TxnHandle
 from dgraph_tpu.zero.zero import TxnConflictError
 
@@ -106,10 +108,38 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         path = parsed.path
         qs = parse_qs(parsed.query)
+        token = self.headers.get("X-Dgraph-AccessToken")
+        # admin/DDL routes are guardian-only once ACL is enabled
+        # (ref edgraph alter/admin guardian checks)
+        _GUARDED = (
+            "/alter", "/admin/export", "/admin/backup",
+            "/admin/schema/graphql",
+        )
         try:
-            if path == "/query":
+            if self.engine.acl is not None and path in _GUARDED:
+                if not self.engine.acl.is_guardian(token):
+                    return self._error(
+                        "only guardians can access this endpoint", 403
+                    )
+            if path == "/login":
+                if self.engine.acl is None:
+                    return self._error("ACL not enabled", 400)
+                body = json.loads(self._body().decode("utf-8"))
+                if body.get("refreshToken"):
+                    toks = self.engine.acl.refresh(body["refreshToken"])
+                    self.engine._audit("login-refresh")
+                else:
+                    toks = self.engine.login(
+                        body.get("userid", ""),
+                        body.get("password", ""),
+                        int(body.get("namespace", 0)),
+                    )
+                self._reply({"data": toks})
+            elif path == "/query":
                 self._count("num_queries")
-                res = self.engine.query(self._body().decode("utf-8"))
+                res = self.engine.query(
+                    self._body().decode("utf-8"), access_jwt=token
+                )
                 res["extensions"] = {
                     "server_latency": {
                         "total_ns": int((time.time() - t0) * 1e9)
@@ -118,7 +148,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(res)
             elif path == "/mutate":
                 self._count("num_mutations")
-                self._handle_mutate(qs)
+                self._handle_mutate(qs, token)
             elif path == "/commit":
                 ts = int(qs.get("startTs", ["0"])[0])
                 txn = self.txns.pop(ts, None)
@@ -176,13 +206,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(f"no route {path}", 404)
         except TxnConflictError as e:
             self._error(f"Transaction has been aborted. Please retry. {e}", 409)
+        except (AclError, JwtError) as e:
+            self._error(e, 401)
         except (json.JSONDecodeError, ValueError) as e:
             self._error(e, 400)  # malformed client input
         except Exception as e:
             traceback.print_exc()
             self._error(e, 500)
 
-    def _handle_mutate(self, qs):
+    def _handle_mutate(self, qs, token=None):
         body = self._body().decode("utf-8")
         commit_now = qs.get("commitNow", ["false"])[0] == "true"
         start_ts = int(qs.get("startTs", ["0"])[0])
@@ -196,12 +228,16 @@ class _Handler(BaseHTTPRequestHandler):
         if "json" in ctype:
             obj = json.loads(body) if body.strip() else {}
             uids = txn.mutate_json(
-                set_obj=obj.get("set"), del_obj=obj.get("delete")
+                set_obj=obj.get("set"),
+                del_obj=obj.get("delete"),
+                access_jwt=token,
             )
         else:
             # RDF body: {set { ... } delete { ... }} or bare nquads
             set_rdf, del_rdf = _split_rdf_blocks(body)
-            uids = txn.mutate_rdf(set_rdf=set_rdf, del_rdf=del_rdf)
+            uids = txn.mutate_rdf(
+                set_rdf=set_rdf, del_rdf=del_rdf, access_jwt=token
+            )
 
         if commit_now:
             self.txns.pop(txn.start_ts, None)  # finished txns don't linger

@@ -46,15 +46,38 @@ class TxnHandle:
         return self.server._query(q, self.txn.cache)
 
     def mutate_rdf(
-        self, set_rdf: str = "", del_rdf: str = "", commit_now: bool = False
+        self,
+        set_rdf: str = "",
+        del_rdf: str = "",
+        commit_now: bool = False,
+        access_jwt: Optional[str] = None,
     ) -> Dict[str, str]:
-        uids = self.server._apply_rdf(self.txn, set_rdf, del_rdf)
+        from dgraph_tpu.loaders.rdf import parse_rdf as _prdf
+
+        set_nqs, del_nqs = _prdf(set_rdf), _prdf(del_rdf)
+        body = f"set:{set_rdf!r} del:{del_rdf!r}"
+        ns, user = self.server._authorize_mutation(
+            access_jwt,
+            sorted({nq.predicate for nq in set_nqs + del_nqs}),
+            body,
+        )
+        uids = self.server._apply_nquads(self.txn, set_nqs, del_nqs, ns)
         if commit_now:
             self.commit()
         return uids
 
-    def mutate_json(self, set_obj=None, del_obj=None, commit_now: bool = False):
-        uids = self.server._apply_json(self.txn, set_obj, del_obj)
+    def mutate_json(
+        self,
+        set_obj=None,
+        del_obj=None,
+        commit_now: bool = False,
+        access_jwt: Optional[str] = None,
+    ):
+        body = json.dumps({"set": set_obj, "delete": del_obj}, default=str)
+        ns, _ = self.server._authorize_mutation(
+            access_jwt, sorted(_json_preds(set_obj) | _json_preds(del_obj)), body
+        )
+        uids = self.server._apply_json(self.txn, set_obj, del_obj, ns)
         if commit_now:
             self.commit()
         return uids
@@ -114,9 +137,95 @@ class Server:
         self.schema = State()
         self.vector_indexes: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self.acl = None  # enabled via enable_acl() (ref --acl superflag)
+        self.audit = None  # enabled via enable_audit()
         self._bootstrap_schema()
         if data_dir is not None:
             self._load_persisted_state()
+
+    # -- security (ref edgraph/access.go; audit/) -----------------------------
+
+    def enable_acl(self, secret: Optional[bytes] = None, groot_password="password"):
+        from dgraph_tpu.acl.acl import AclManager
+
+        self.acl = AclManager(self, secret)
+        self.acl.bootstrap(groot_password=groot_password)
+        return self.acl
+
+    def enable_audit(self, out_dir: str, key: Optional[bytes] = None):
+        from dgraph_tpu.audit.audit import AuditLog
+
+        self.audit = AuditLog(out_dir, key=key)
+        return self.audit
+
+    def login(self, user: str, password: str, ns: int = keys.GALAXY_NS):
+        if self.acl is None:
+            raise RuntimeError("ACL not enabled")
+        try:
+            out = self.acl.login(user, password, ns)
+            self._audit("login", user=user, ns=ns)
+            return out
+        except Exception:
+            self._audit("login", user=user, ns=ns, status="DENIED")
+            raise
+
+    def _audit(self, endpoint, user="", ns=0, body="", status="OK"):
+        if self.audit is not None:
+            self.audit.record(endpoint, user=user, ns=ns, body=body, status=status)
+
+    def _authorize(self, access_jwt, preds, need) -> int:
+        """Returns the caller's namespace (0 when ACL off)."""
+        if self.acl is None:
+            return keys.GALAXY_NS
+        from dgraph_tpu.acl.acl import AclError
+
+        if access_jwt is None:
+            raise AclError("no access token (ACL enabled)")
+        claims = self.acl.claims(access_jwt)
+        self.acl.authorize_preds(access_jwt, preds, need)
+        return int(claims.get("namespace", 0))
+
+    def _authorize_mutation(self, access_jwt, preds, audit_body):
+        """WRITE authorization + audit for any mutation entry point.
+        Returns (namespace, user)."""
+        ns, user = keys.GALAXY_NS, ""
+        if self.acl is not None:
+            from dgraph_tpu.acl.acl import WRITE, AclError
+
+            try:
+                if access_jwt is None:
+                    raise AclError("no access token (ACL enabled)")
+                claims = self.acl.claims(access_jwt)
+                user = claims.get("userid", "")
+                ns = int(claims.get("namespace", 0))
+                self.acl.authorize_preds(
+                    access_jwt, preds, WRITE, claims=claims
+                )
+            except Exception:
+                self._audit(
+                    "mutate", user=user, body=audit_body, status="DENIED"
+                )
+                raise
+        self._audit("mutate", user=user, ns=ns, body=audit_body)
+        return ns, user
+
+    def _apply_nquads(self, txn, set_nqs, del_nqs, ns) -> Dict[str, str]:
+        blank: Dict[str, int] = {}
+
+        def resolve(ref: str) -> int:
+            if ref.startswith("_:"):
+                if ref not in blank:
+                    blank[ref] = self.zero.assign_uids(1)
+                return blank[ref]
+            if ref.startswith("0x"):
+                return int(ref, 16)
+            return int(ref)
+
+        for nq in set_nqs:
+            self._apply_nquad(txn, nq, resolve, OP_SET, ns=ns)
+        for nq in del_nqs:
+            self._apply_nquad(txn, nq, resolve, OP_DEL, ns=ns)
+        return {k[2:]: hex(v) for k, v in blank.items()}
 
     def _bootstrap_schema(self):
         # system predicates (ref schema/schema.go initialSchema)
@@ -134,7 +243,10 @@ class Server:
         for key, vers in self.kv.iterate_versions(b"", (1 << 62)):
             if vers:
                 max_ts = max(max_ts, vers[0][0])
-            pk = keys.parse_key(key)
+            try:
+                pk = keys.parse_key(key)
+            except Exception:
+                continue  # non-graph meta keys (e.g. namespace counter)
             if pk.uid is not None:
                 max_uid = max(max_uid, pk.uid)
             if pk.is_schema:
@@ -257,6 +369,9 @@ class Server:
     def _commit(self, txn: Txn) -> int:
         commit_ts = self.zero.commit(txn.start_ts, txn.conflict_keys)
         txn.write_deltas(self.kv, commit_ts)
+        cdc = getattr(self, "_cdc", None)
+        if cdc is not None:
+            cdc.emit_commit(commit_ts, txn.cache.deltas)
         # vector index ingestion at commit (factory seam)
         for key, posts in txn.cache.deltas.items():
             pk = keys.parse_key(key)
@@ -271,23 +386,12 @@ class Server:
 
     # -- mutations -------------------------------------------------------------
 
-    def _apply_rdf(self, txn: Txn, set_rdf: str, del_rdf: str) -> Dict[str, str]:
-        blank: Dict[str, int] = {}
-
-        def resolve(ref: str) -> int:
-            if ref.startswith("_:"):
-                if ref not in blank:
-                    blank[ref] = self.zero.assign_uids(1)
-                return blank[ref]
-            if ref.startswith("0x"):
-                return int(ref, 16)
-            return int(ref)
-
-        for nq in parse_rdf(set_rdf):
-            self._apply_nquad(txn, nq, resolve, OP_SET)
-        for nq in parse_rdf(del_rdf):
-            self._apply_nquad(txn, nq, resolve, OP_DEL)
-        return {k[2:]: hex(v) for k, v in blank.items()}
+    def _apply_rdf(
+        self, txn: Txn, set_rdf: str, del_rdf: str, ns: int = keys.GALAXY_NS
+    ) -> Dict[str, str]:
+        return self._apply_nquads(
+            txn, parse_rdf(set_rdf), parse_rdf(del_rdf), ns
+        )
 
     def _apply_rdf_with_vars(
         self, txn: Txn, set_rdf: str, del_rdf: str, uid_vars, val_vars
@@ -345,6 +449,7 @@ class Server:
         op: int,
         subj_uid: Optional[int] = None,
         obj_uid: Optional[int] = None,
+        ns: int = keys.GALAXY_NS,
     ):
         """Apply one N-Quad. Callers either pass a `resolve` function or
         pre-resolved subject/object uids (the upsert fan-out path — pinned
@@ -353,7 +458,7 @@ class Server:
         if nq.star:
             if op != OP_DEL:
                 raise ValueError("S P * only valid in delete")
-            delete_entity_attr(txn, self.schema, subj, nq.predicate)
+            delete_entity_attr(txn, self.schema, subj, nq.predicate, ns)
             return
         if nq.object_id:
             edge = DirectedEdge(
@@ -362,6 +467,7 @@ class Server:
                 value_id=obj_uid if obj_uid is not None else resolve(nq.object_id),
                 facets=nq.facets,
                 op=op,
+                ns=ns,
             )
         else:
             edge = DirectedEdge(
@@ -371,10 +477,13 @@ class Server:
                 lang=nq.lang,
                 facets=nq.facets,
                 op=op,
+                ns=ns,
             )
         apply_edge(txn, self.schema, edge)
 
-    def _apply_json(self, txn: Txn, set_obj, del_obj) -> Dict[str, str]:
+    def _apply_json(
+        self, txn: Txn, set_obj, del_obj, ns: int = keys.GALAXY_NS
+    ) -> Dict[str, str]:
         """JSON mutation format (ref chunker/json_parser.go): nested objects
         with "uid" refs; blank nodes via "_:name"."""
         blank: Dict[str, int] = {}
@@ -401,7 +510,7 @@ class Server:
                             self.schema,
                             DirectedEdge(
                                 uid, "dgraph.type",
-                                value=Val(TypeID.STRING, t), op=op,
+                                value=Val(TypeID.STRING, t), op=op, ns=ns,
                             ),
                         )
                     continue
@@ -416,14 +525,16 @@ class Server:
                         apply_edge(
                             txn,
                             self.schema,
-                            DirectedEdge(uid, pred, value_id=child, op=op),
+                            DirectedEdge(uid, pred, value_id=child, op=op, ns=ns),
                         )
                     else:
                         val = _json_to_val(item)
                         apply_edge(
                             txn,
                             self.schema,
-                            DirectedEdge(uid, pred, value=val, lang=lang, op=op),
+                            DirectedEdge(
+                                uid, pred, value=val, lang=lang, op=op, ns=ns
+                            ),
                         )
             return uid
 
@@ -435,19 +546,129 @@ class Server:
 
     # -- queries ----------------------------------------------------------------
 
-    def query(self, q: str, read_ts: Optional[int] = None) -> dict:
+    def query(
+        self,
+        q: str,
+        read_ts: Optional[int] = None,
+        access_jwt: Optional[str] = None,
+    ) -> dict:
         """Run a read-only query at a fresh (or given) read ts."""
         ts = read_ts if read_ts is not None else self.zero.read_ts()
-        return self._query(q, LocalCache(self.kv, ts))
+        blocks = dql.parse(q)
+        ns = keys.GALAXY_NS
+        allowed = None
+        user = ""
+        if self.acl is not None:
+            from dgraph_tpu.acl.acl import READ, AclError
+
+            try:
+                if access_jwt is None:
+                    raise AclError("no access token (ACL enabled)")
+                claims = self.acl.claims(access_jwt)
+                user = claims.get("userid", "")
+                ns = int(claims.get("namespace", 0))
+                self.acl.authorize_preds(
+                    access_jwt, _query_preds(blocks), READ, claims=claims
+                )
+                allowed = self.acl.readable_preds(claims)
+            except Exception:
+                self._audit("query", user=user, body=q, status="DENIED")
+                raise
+        self._audit("query", user=user, ns=ns, body=q)
+        return self._query_parsed(
+            blocks, LocalCache(self.kv, ts), ns, allowed
+        )
 
     def _query(self, q: str, cache: LocalCache) -> dict:
-        blocks = dql.parse(q)
+        return self._query_parsed(dql.parse(q), cache, keys.GALAXY_NS)
+
+    def _query_parsed(
+        self, blocks, cache: LocalCache, ns: int, allowed_preds=None
+    ) -> dict:
         ex = Executor(
-            cache, self.schema, vector_indexes=self.vector_indexes
+            cache,
+            self.schema,
+            ns=ns,
+            vector_indexes=self.vector_indexes,
+            allowed_preds=allowed_preds,
         )
         nodes = ex.process(blocks)
         enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
         return {"data": enc.encode_blocks(nodes)}
+
+
+def _query_preds(blocks) -> list:
+    """All predicates a query touches (for ACL checks,
+    ref edgraph/server.go authorizeRequest)."""
+    preds = set()
+
+    def from_func(fn):
+        if fn is not None and fn.attr:
+            preds.add(fn.attr.lstrip("~"))
+
+    def from_filter(ft):
+        if ft is None:
+            return
+        from_func(ft.func)
+        for c in ft.children:
+            from_filter(c)
+
+    def walk(g):
+        from_func(g.func)
+        from_filter(g.filter)
+        # classify by node kind (flags), not by attr-name heuristics — a
+        # data predicate literally named "q"/"var" must still be checked
+        is_virtual = (
+            g.is_uid
+            or g.val_var
+            or g.aggregator
+            or g.math_expr is not None
+            or g.expand  # expanded preds are ACL-filtered at execution
+            or (g.is_count and g.attr == "uid")
+        )
+        if g.attr and not is_virtual:
+            preds.add(g.attr.lstrip("~"))
+        for ga in g.groupby_attrs:
+            preds.add(ga.lstrip("~"))
+        for o in g.order:
+            if o.attr:
+                preds.add(o.attr)
+        for c in g.children:
+            walk(c)
+
+    for b in blocks:
+        for c in b.children:
+            walk(c)
+        from_func(b.func)
+        from_filter(b.filter)
+        for ga in b.groupby_attrs:
+            preds.add(ga.lstrip("~"))
+        for o in b.order:
+            if o.attr:
+                preds.add(o.attr)
+    return sorted(preds)
+
+
+def _json_preds(obj) -> set:
+    """Predicates referenced by a JSON mutation object tree."""
+    preds = set()
+
+    def walk(o):
+        if isinstance(o, list):
+            for it in o:
+                walk(it)
+            return
+        if not isinstance(o, dict):
+            return
+        for k, v in o.items():
+            if k == "uid":
+                continue
+            preds.add(k.split("@", 1)[0])
+            if isinstance(v, (dict, list)):
+                walk(v)
+
+    walk(obj)
+    return preds
 
 
 def _eval_cond(cond: str, uid_vars) -> bool:

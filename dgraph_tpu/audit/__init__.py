@@ -1,0 +1,1 @@
+from dgraph_tpu.audit.audit import AuditLog

@@ -1,0 +1,266 @@
+"""CLI: the `dgraph` binary equivalent (ref /root/reference/dgraph/cmd).
+
+Subcommands mirror the reference's cobra tree (root.go:80):
+  alpha    — serve the HTTP API (ref cmd/alpha)
+  bulk     — offline bulk load RDF into a data dir (ref cmd/bulk)
+  live     — transactional load into a running data dir (ref cmd/live)
+  export   — dump RDF/JSON + schema (ref worker/export.go)
+  backup / restore — manifest-chain backups (ref worker/backup*.go)
+  acl      — user/group/rule administration (ref cmd/acl)
+  increment — smoke-test counter (ref cmd/increment)
+  debug    — p-dir inspector (ref cmd/debug)
+  mcp      — MCP server on stdio (ref cmd/mcp)
+  version
+
+Usage: python -m dgraph_tpu <subcommand> [...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _server(args):
+    from dgraph_tpu.api.server import Server
+
+    return Server(data_dir=args.p)
+
+
+def cmd_alpha(args):
+    from dgraph_tpu.api.http_server import HTTPServer
+
+    engine = _server(args)
+    if args.schema:
+        with open(args.schema) as f:
+            engine.alter(f.read())
+    if args.acl_secret_file:
+        with open(args.acl_secret_file, "rb") as f:
+            engine.enable_acl(secret=f.read().strip())
+    if args.audit_dir:
+        engine.enable_audit(args.audit_dir)
+    if args.cdc_file:
+        from dgraph_tpu.admin.cdc import CDC
+
+        CDC(engine, sink_path=args.cdc_file)
+    if args.rollup_interval > 0:
+        from dgraph_tpu.posting.rollup import RollupDaemon
+
+        RollupDaemon(engine, interval_s=args.rollup_interval).start()
+    srv = HTTPServer(engine, host=args.bind, port=args.port).start()
+    print(f"alpha listening on http://{args.bind}:{srv.port}")
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+def cmd_bulk(args):
+    import time
+
+    from dgraph_tpu.loaders.bulk import BulkLoader
+
+    engine = _server(args)
+    if args.schema:
+        with open(args.schema) as f:
+            engine.alter(f.read())
+    t0 = time.time()
+    loader = BulkLoader(engine)
+    for path in args.files:
+        loader.add_rdf_file(path)
+    n = loader._nquads
+    loader.finish()
+    engine.kv.sync() if hasattr(engine.kv, "sync") else None
+    print(f"bulk loaded {n} nquads in {time.time()-t0:.1f}s")
+
+
+def cmd_live(args):
+    from dgraph_tpu.loaders.live import LiveLoader
+
+    engine = _server(args)
+    if args.schema:
+        with open(args.schema) as f:
+            engine.alter(f.read())
+    ll = LiveLoader(engine, batch_size=args.batch)
+    for path in args.files:
+        ll.load_rdf_file(path)
+    print(
+        f"live loaded {ll.nquads_loaded} nquads in {ll.txns_committed} txns "
+        f"({ll.aborts} aborts)"
+    )
+
+
+def cmd_export(args):
+    from dgraph_tpu.admin.export import export
+
+    out = export(_server(args), args.out, fmt=args.format)
+    print(json.dumps(out))
+
+
+def cmd_backup(args):
+    from dgraph_tpu.admin.backup import backup
+
+    entry = backup(_server(args), args.dest, incremental=not args.full)
+    print(json.dumps(entry))
+
+
+def cmd_restore(args):
+    from dgraph_tpu.admin.backup import restore
+
+    n = restore(_server(args), args.src)
+    print(f"restored {n} records")
+
+
+def cmd_acl(args):
+    engine = _server(args)
+    acl = engine.enable_acl()
+    if args.acl_cmd == "add-user":
+        acl.add_user(args.user, args.password)
+        print(f"user {args.user} created")
+    elif args.acl_cmd == "add-group":
+        acl.add_group(args.group)
+        print(f"group {args.group} created")
+    elif args.acl_cmd == "add-to-group":
+        acl.add_user_to_group(args.user, args.group)
+        print("ok")
+    elif args.acl_cmd == "set-rule":
+        acl.set_rule(args.group, args.predicate, args.perm)
+        print("ok")
+
+
+def cmd_increment(args):
+    """Smoke test: read-modify-write a counter N times
+    (ref dgraph/cmd/increment)."""
+    engine = _server(args)
+    engine.alter("counter.val: int .")
+    for _ in range(args.num):
+        txn = engine.new_txn()
+        res = txn.query("{ q(func: uid(0x1)) { counter.val } }")
+        cur = res["data"]["q"][0]["counter.val"] if res["data"]["q"] else 0
+        txn.mutate_rdf(
+            set_rdf=f'<0x1> <counter.val> "{cur + 1}"^^<xs:int> .'
+        )
+        txn.commit()
+    res = engine.query("{ q(func: uid(0x1)) { counter.val } }")
+    print(f"counter: {res['data']['q'][0]['counter.val']}")
+
+
+def cmd_debug(args):
+    """Inspect a p-dir: key histogram per predicate (ref cmd/debug)."""
+    from dgraph_tpu.x import keys as xkeys
+
+    engine = _server(args)
+    hist = {}
+    for key, _, _ in engine.kv.iterate(b"", 1 << 62):
+        try:
+            pk = xkeys.parse_key(key)
+        except Exception:
+            continue
+        kind = (
+            "schema" if pk.is_schema else
+            "type" if pk.is_type else
+            "data" if pk.is_data else
+            "index" if pk.is_index else
+            "reverse" if pk.is_reverse else
+            "count"
+        )
+        hist.setdefault(pk.attr, {}).setdefault(kind, 0)
+        hist[pk.attr][kind] += 1
+    print(json.dumps(hist, indent=2, sort_keys=True))
+
+
+def cmd_mcp(args):
+    from dgraph_tpu.api.mcp_server import McpServer
+
+    McpServer(_server(args)).serve_stdio()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="dgraph-tpu")
+    ap.add_argument("--version", action="version", version="dgraph-tpu 0.1.0")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def add_p(p):
+        p.add_argument("-p", default=None, help="data directory (default: in-memory)")
+
+    p = sub.add_parser("alpha", help="serve the HTTP API")
+    add_p(p)
+    p.add_argument("--bind", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--schema", default=None)
+    p.add_argument("--acl-secret-file", default=None)
+    p.add_argument("--audit-dir", default=None)
+    p.add_argument("--cdc-file", default=None)
+    p.add_argument("--rollup-interval", type=float, default=30.0)
+    p.set_defaults(fn=cmd_alpha)
+
+    p = sub.add_parser("bulk", help="offline bulk load")
+    add_p(p)
+    p.add_argument("--schema", default=None)
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_bulk)
+
+    p = sub.add_parser("live", help="transactional load")
+    add_p(p)
+    p.add_argument("--schema", default=None)
+    p.add_argument("--batch", type=int, default=1000)
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_live)
+
+    p = sub.add_parser("export")
+    add_p(p)
+    p.add_argument("--out", required=True)
+    p.add_argument("--format", choices=["rdf", "json"], default="rdf")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("backup")
+    add_p(p)
+    p.add_argument("--dest", required=True)
+    p.add_argument("--full", action="store_true")
+    p.set_defaults(fn=cmd_backup)
+
+    p = sub.add_parser("restore")
+    add_p(p)
+    p.add_argument("--src", required=True)
+    p.set_defaults(fn=cmd_restore)
+
+    p = sub.add_parser("acl")
+    add_p(p)
+    asub = p.add_subparsers(dest="acl_cmd", required=True)
+    a = asub.add_parser("add-user")
+    a.add_argument("--user", required=True)
+    a.add_argument("--password", required=True)
+    a = asub.add_parser("add-group")
+    a.add_argument("--group", required=True)
+    a = asub.add_parser("add-to-group")
+    a.add_argument("--user", required=True)
+    a.add_argument("--group", required=True)
+    a = asub.add_parser("set-rule")
+    a.add_argument("--group", required=True)
+    a.add_argument("--predicate", required=True)
+    a.add_argument("--perm", type=int, required=True)
+    p.set_defaults(fn=cmd_acl)
+
+    p = sub.add_parser("increment", help="counter smoke test")
+    add_p(p)
+    p.add_argument("--num", type=int, default=1)
+    p.set_defaults(fn=cmd_increment)
+
+    p = sub.add_parser("debug", help="inspect a data dir")
+    add_p(p)
+    p.set_defaults(fn=cmd_debug)
+
+    p = sub.add_parser("mcp", help="MCP server on stdio")
+    add_p(p)
+    p.set_defaults(fn=cmd_mcp)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from dgraph_tpu.enc.enc import encrypt_stream, decrypt_stream, read_key_file

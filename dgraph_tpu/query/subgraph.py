@@ -55,11 +55,15 @@ class Executor:
         st: State,
         ns: int = keys.GALAXY_NS,
         vector_indexes=None,
+        allowed_preds=None,
     ):
         self.cache = cache
         self.st = st
         self.ns = ns
         self.vector_indexes = vector_indexes or {}
+        # None = unrestricted; a set filters expand(_all_) expansion to
+        # ACL-readable predicates (ref expand filtering in edgraph auth)
+        self.allowed_preds = allowed_preds
         self.uid_vars: Dict[str, np.ndarray] = {}
         self.val_vars: Dict[str, Dict[int, Val]] = {}
 
@@ -385,6 +389,11 @@ class Executor:
             for pname in preds:
                 if pname in seen:
                     continue
+                if (
+                    self.allowed_preds is not None
+                    and pname not in self.allowed_preds
+                ):
+                    continue  # silently drop unreadable preds (ref behavior)
                 seen.add(pname)
                 child = GraphQuery(attr=pname)
                 child.children = list(g.children)

@@ -1,0 +1,224 @@
+"""ACL, JWT, namespaces, audit, encryption tests
+(mirrors /root/reference/acl tests + audit/ + enc/)."""
+
+import time
+
+import pytest
+
+from dgraph_tpu.acl import jwt
+from dgraph_tpu.acl.acl import READ, WRITE, AclError
+from dgraph_tpu.api.server import Server
+
+SCHEMA = "name: string @index(exact) .\nsalary: float @index(float) ."
+
+
+def _server():
+    s = Server()
+    s.alter(SCHEMA)
+    s.enable_acl(secret=b"test-secret-0123456789abcdef0000")
+    return s
+
+
+def test_jwt_roundtrip_and_tamper():
+    secret = b"s" * 32
+    tok = jwt.encode({"userid": "u", "exp": time.time() + 100}, secret)
+    assert jwt.decode(tok, secret)["userid"] == "u"
+    with pytest.raises(jwt.JwtError):
+        jwt.decode(tok + "x", secret)
+    with pytest.raises(jwt.JwtError):
+        jwt.decode(tok, b"wrong" * 8)
+    expired = jwt.encode({"exp": time.time() - 1}, secret)
+    with pytest.raises(jwt.JwtError):
+        jwt.decode(expired, secret)
+
+
+def test_groot_login_and_guardian_bypass():
+    s = _server()
+    toks = s.login("groot", "password")
+    assert "accessJwt" in toks
+    with pytest.raises(AclError):
+        s.login("groot", "wrongpass")
+    # guardian can query anything
+    res = s.query("{ q(func: has(name)) { name } }", access_jwt=toks["accessJwt"])
+    assert res["data"]["q"] == []
+
+
+def test_non_user_denied_and_rules():
+    s = _server()
+    acl = s.acl
+    acl.add_user("alice", "alicepw")
+    acl.add_group("engineering")
+    acl.add_user_to_group("alice", "engineering")
+    toks = s.login("alice", "alicepw")
+    a = toks["accessJwt"]
+
+    # no rules yet: read denied
+    with pytest.raises(AclError):
+        s.query("{ q(func: has(name)) { name } }", access_jwt=a)
+
+    acl.set_rule("engineering", "name", READ)
+    res = s.query("{ q(func: has(name)) { name } }", access_jwt=a)
+    assert res["data"]["q"] == []
+
+    # write still denied
+    t = s.new_txn()
+    with pytest.raises(AclError):
+        t.mutate_rdf(set_rdf='<0x1> <name> "X" .', access_jwt=a)
+
+    acl.set_rule("engineering", "name", WRITE)
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='<0x1> <name> "X" .', access_jwt=a, commit_now=True)
+
+    # but salary is still invisible
+    with pytest.raises(AclError):
+        s.query("{ q(func: has(salary)) { salary } }", access_jwt=a)
+
+
+def test_missing_token_when_acl_on():
+    s = _server()
+    with pytest.raises(AclError):
+        s.query("{ q(func: has(name)) { name } }")
+
+
+def test_refresh_token():
+    s = _server()
+    toks = s.login("groot", "password")
+    toks2 = s.acl.refresh(toks["refreshJwt"])
+    assert toks2["accessJwt"]
+    claims = s.acl.claims(toks2["accessJwt"])
+    assert claims["userid"] == "groot"
+
+
+def test_namespaces_isolated():
+    from dgraph_tpu.admin.namespace import NamespaceManager
+
+    s = _server()
+    nm = NamespaceManager(s)
+    ns1 = nm.create_namespace()
+    assert ns1 >= 1
+    # same user name in two namespaces, different passwords
+    s.acl.add_user("bob", "pw0")
+    s.acl.add_user("bob", "pw1", ns=ns1)
+    t0 = s.login("bob", "pw0")
+    t1 = s.login("bob", "pw1", ns=ns1)
+    assert s.acl.claims(t0["accessJwt"])["namespace"] == 0
+    assert s.acl.claims(t1["accessJwt"])["namespace"] == ns1
+    with pytest.raises(AclError):
+        s.login("bob", "pw0", ns=ns1)
+
+    # groot of ns1 writes data invisible to galaxy queries
+    g1 = s.login("groot", "password", ns=ns1)["accessJwt"]
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='<0x900> <name> "ns1-only" .', access_jwt=g1, commit_now=True)
+    g0 = s.login("groot", "password")["accessJwt"]
+    res = s.query('{ q(func: eq(name, "ns1-only")) { name } }', access_jwt=g0)
+    assert res["data"]["q"] == []
+    res = s.query('{ q(func: eq(name, "ns1-only")) { name } }', access_jwt=g1)
+    assert res["data"]["q"] == [{"name": "ns1-only"}]
+
+
+def test_audit_log(tmp_path):
+    s = Server()
+    s.alter(SCHEMA)
+    s.enable_audit(str(tmp_path), key=b"0123456789abcdef")
+    s.enable_acl(secret=b"x" * 32)
+    toks = s.login("groot", "password")
+    s.query("{ q(func: has(name)) { name } }", access_jwt=toks["accessJwt"])
+    try:
+        s.login("groot", "nope")
+    except AclError:
+        pass
+    entries = s.audit.read_all()
+    endpoints = [(e["endpoint"], e["status"]) for e in entries]
+    assert ("login", "OK") in endpoints
+    assert ("query", "OK") in endpoints
+    assert ("login", "DENIED") in endpoints
+    # raw file is encrypted (no plaintext 'login')
+    import os
+
+    raw = open(os.path.join(str(tmp_path), os.listdir(str(tmp_path))[0]), "rb").read()
+    assert b'"endpoint"' not in raw
+
+
+def test_encryption_roundtrip(tmp_path):
+    from dgraph_tpu.enc.enc import decrypt_stream, encrypt_stream, read_key_file
+
+    key_path = str(tmp_path / "key")
+    with open(key_path, "wb") as f:
+        f.write(b"0123456789abcdef")
+    key = read_key_file(key_path)
+    data = b"secret posting list" * 100
+    enc = encrypt_stream(data, key)
+    assert enc[16:] != data
+    assert decrypt_stream(enc, key) == data
+    # unique IVs
+    assert encrypt_stream(data, key) != enc
+
+
+def test_json_mutation_requires_token_and_ns():
+    s = _server()
+    t = s.new_txn()
+    with pytest.raises(AclError):
+        t.mutate_json(set_obj={"uid": "0x1", "name": "evil"})
+    # guardian token works and nested preds are checked
+    tok = s.login("groot", "password")["accessJwt"]
+    t = s.new_txn()
+    t.mutate_json(
+        set_obj={"uid": "0x1", "name": "ok"}, access_jwt=tok, commit_now=True
+    )
+
+
+def test_expand_all_respects_acl():
+    s = _server()
+    g = s.login("groot", "password")["accessJwt"]
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf='<0x2> <name> "secret" .\n<0x2> <dgraph.type> "Person" .',
+        access_jwt=g,
+        commit_now=True,
+    )
+    from dgraph_tpu.schema.schema import TypeUpdate
+
+    s.schema.set_type(TypeUpdate(name="Person", fields=["name", "salary"]))
+    s.acl.add_user("eve", "evepw")
+    s.acl.add_group("nothing")
+    s.acl.add_user_to_group("eve", "nothing")
+    s.acl.set_rule("nothing", "salary", READ)  # can read salary, NOT name
+    a = s.login("eve", "evepw")["accessJwt"]
+    res = s.query("{ q(func: uid(0x2)) { expand(_all_) } }", access_jwt=a)
+    assert "name" not in res["data"]["q"][0] if res["data"]["q"] else True
+    # groupby on a denied pred also blocked
+    with pytest.raises(AclError):
+        s.query(
+            "{ q(func: uid(0x2)) @groupby(name) { count(uid) } }", access_jwt=a
+        )
+
+
+def test_admin_routes_guardian_only():
+    import json as _json
+    import urllib.request as ur
+    import urllib.error
+
+    from dgraph_tpu.api.http_server import HTTPServer
+
+    s = _server()
+    srv = HTTPServer(s, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def post(path, body, headers=None):
+        req = ur.Request(
+            base + path, data=body.encode(), headers=headers or {}, method="POST"
+        )
+        try:
+            with ur.urlopen(req) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    assert post("/alter", '{"drop_all": true}') == 403
+    assert post("/admin/export", "") == 403
+    tok = s.login("groot", "password")["accessJwt"]
+    assert (
+        post("/alter", "city2: string .", {"X-Dgraph-AccessToken": tok}) == 200
+    )
+    srv.stop()
