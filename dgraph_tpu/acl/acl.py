@@ -99,7 +99,7 @@ class AclManager:
             DirectedEdge(uid, "dgraph.xid", value=Val(TypeID.STRING, xid), ns=ns),
         )
         if password is not None:
-            salt = hashlib.sha256(xid.encode()).digest()[:16]
+            salt = os.urandom(16)  # stored alongside the hash
             ph = salt + _hash_password(password, salt)
             apply_edge(
                 txn.txn,
@@ -285,7 +285,7 @@ class AclManager:
         perms = self._perms_for(claims)
         if perms is None:
             return None
-        return {p for p, m in perms.items() if m & READ}
+        return {p for p, m in perms.items() if m & READ} | {"dgraph.type"}
 
     def is_guardian(self, access_jwt: Optional[str]) -> bool:
         if access_jwt is None:
@@ -307,11 +307,14 @@ class AclManager:
             return  # guardian
         for pred in preds:
             if pred.startswith("dgraph."):
-                if need != READ:
-                    raise AclError(
-                        f"only guardians may modify {pred!r}"
-                    )
-                continue
+                # non-guardians may only READ dgraph.type (needed by
+                # type()/expand); ACL internals (dgraph.password,
+                # dgraph.acl.rule, ...) are guardian-only like the reference
+                if need == READ and pred == "dgraph.type":
+                    continue
+                raise AclError(
+                    f"only guardians may access {pred!r}"
+                )
             if not (perms.get(pred, 0) & need):
                 raise AclError(
                     f"unauthorized to {'read' if need == READ else 'write'} "

@@ -27,6 +27,7 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "dgraph-tpu/0.1"
     engine: Server = None  # type: ignore[assignment]
     txns: Dict[int, TxnHandle] = {}
+    txn_owner: Dict[int, str] = {}
     metrics: Dict[str, float] = {}
 
     def log_message(self, *a):  # quiet
@@ -114,12 +115,28 @@ class _Handler(BaseHTTPRequestHandler):
         _GUARDED = (
             "/alter", "/admin/export", "/admin/backup",
             "/admin/schema/graphql",
+            # GraphQL resolvers run inside the engine without per-predicate
+            # enforcement this round; guardian-only when ACL is on (the
+            # reference gates GraphQL with its own @auth system instead)
+            "/graphql",
         )
         try:
             if self.engine.acl is not None and path in _GUARDED:
                 if not self.engine.acl.is_guardian(token):
                     return self._error(
                         "only guardians can access this endpoint", 403
+                    )
+            if self.engine.acl is not None and path == "/commit":
+                # commits/aborts are bound to the txn owner's identity
+                ts_q = int(qs.get("startTs", ["0"])[0])
+                owner = self.txn_owner.get(ts_q)
+                try:
+                    caller = self.engine.acl.claims(token)["userid"] if token else None
+                except Exception:
+                    caller = None
+                if caller is None or (owner is not None and owner != caller):
+                    return self._error(
+                        "access token required to commit this transaction", 401
                     )
             if path == "/login":
                 if self.engine.acl is None:
@@ -152,6 +169,7 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/commit":
                 ts = int(qs.get("startTs", ["0"])[0])
                 txn = self.txns.pop(ts, None)
+                self.txn_owner.pop(ts, None)
                 if txn is None:
                     return self._error(f"no pending txn with startTs {ts}")
                 if qs.get("abort", ["false"])[0] == "true":
@@ -253,6 +271,13 @@ class _Handler(BaseHTTPRequestHandler):
             )
         else:
             self.txns[txn.start_ts] = txn
+            if self.engine.acl is not None and token:
+                try:
+                    self.txn_owner[txn.start_ts] = self.engine.acl.claims(
+                        token
+                    )["userid"]
+                except Exception:
+                    pass
             self._reply(
                 {"data": {"code": "Success", "uids": uids, "startTs": txn.start_ts}}
             )
@@ -305,7 +330,9 @@ class HTTPServer:
 
     def __init__(self, engine: Server, host: str = "127.0.0.1", port: int = 8080):
         handler = type(
-            "BoundHandler", (_Handler,), {"engine": engine, "txns": {}, "metrics": {}}
+            "BoundHandler",
+            (_Handler,),
+            {"engine": engine, "txns": {}, "txn_owner": {}, "metrics": {}},
         )
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]

@@ -42,8 +42,23 @@ class TxnHandle:
         self.read_only = read_only
         self.finished = False
 
-    def query(self, q: str) -> dict:
-        return self.server._query(q, self.txn.cache)
+    def query(self, q: str, access_jwt: Optional[str] = None) -> dict:
+        """Query within this txn's snapshot (sees own uncommitted writes)."""
+        blocks = dql.parse(q)
+        ns = keys.GALAXY_NS
+        allowed = None
+        if self.server.acl is not None:
+            from dgraph_tpu.acl.acl import READ, AclError
+
+            if access_jwt is None:
+                raise AclError("no access token (ACL enabled)")
+            claims = self.server.acl.claims(access_jwt)
+            ns = int(claims.get("namespace", 0))
+            self.server.acl.authorize_preds(
+                access_jwt, _query_preds(blocks), READ, claims=claims
+            )
+            allowed = self.server.acl.readable_preds(claims)
+        return self.server._query_parsed(blocks, self.txn.cache, ns, allowed)
 
     def mutate_rdf(
         self,
@@ -89,6 +104,7 @@ class TxnHandle:
         del_rdf: str = "",
         cond: Optional[str] = None,
         commit_now: bool = True,
+        access_jwt: Optional[str] = None,
     ) -> Dict[str, str]:
         """Upsert block: run query, substitute uid(v)/val(v) refs in the
         mutation, apply (ref edgraph/server.go:874 buildUpsertQuery +
@@ -96,9 +112,30 @@ class TxnHandle:
         from dgraph_tpu.query.subgraph import Executor
 
         blocks = dql.parse(query)
+        ns = keys.GALAXY_NS
+        if self.server.acl is not None:
+            from dgraph_tpu.acl.acl import READ, AclError
+            from dgraph_tpu.loaders.rdf import parse_rdf as _prdf
+
+            if access_jwt is None:
+                raise AclError("no access token (ACL enabled)")
+            claims = self.server.acl.claims(access_jwt)
+            ns = int(claims.get("namespace", 0))
+            self.server.acl.authorize_preds(
+                access_jwt, _query_preds(blocks), READ, claims=claims
+            )
+            mpreds = sorted(
+                {nq.predicate for nq in _prdf(set_rdf) + _prdf(del_rdf)}
+            )
+            from dgraph_tpu.acl.acl import WRITE
+
+            self.server.acl.authorize_preds(
+                access_jwt, mpreds, WRITE, claims=claims
+            )
         ex = Executor(
             self.txn.cache,
             self.server.schema,
+            ns=ns,
             vector_indexes=self.server.vector_indexes,
         )
         ex.process(blocks)
@@ -603,7 +640,11 @@ def _query_preds(blocks) -> list:
     preds = set()
 
     def from_func(fn):
-        if fn is not None and fn.attr:
+        if fn is None or not fn.attr:
+            return
+        if fn.name == "type":
+            preds.add("dgraph.type")  # attr holds the type NAME, not a pred
+        else:
             preds.add(fn.attr.lstrip("~"))
 
     def from_filter(ft):

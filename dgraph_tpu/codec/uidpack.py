@@ -159,6 +159,18 @@ def join_segments(segments: Dict[int, np.ndarray]) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _bitpack(vals, width):
+    from dgraph_tpu import native
+
+    return native.bitpack(vals, width)
+
+
+def _bitunpack(data, count, width):
+    from dgraph_tpu import native
+
+    return native.bitunpack(data, count, width)
+
+
 def serialize(pack: UidPack) -> bytes:
     """Bit-pack each block's offsets to its max width. Ref codec.go:393 Encode
     (group-varint there; fixed-width lanes here — see module docstring)."""
@@ -206,7 +218,7 @@ def deserialize(data: bytes) -> UidPack:
     return UidPack(bases=bases, counts=counts, offsets=offsets, num_uids=num_uids)
 
 
-def _bitpack(vals: np.ndarray, width: int) -> bytes:
+def _bitpack_py(vals: np.ndarray, width: int) -> bytes:
     """Pack uint32 values into `width`-bit little-endian lanes."""
     if width == 0 or vals.size == 0:
         return b""
@@ -227,7 +239,7 @@ def _bitpack(vals: np.ndarray, width: int) -> bytes:
     return buf.tobytes()
 
 
-def _bitunpack(data: bytes, count: int, width: int) -> np.ndarray:
+def _bitunpack_py(data: bytes, count: int, width: int) -> np.ndarray:
     if width == 0 or count == 0:
         return np.zeros((count,), np.uint32)
     buf = np.frombuffer(data, dtype=np.uint8)

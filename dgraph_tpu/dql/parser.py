@@ -51,7 +51,7 @@ _TOKEN_RE = re.compile(
   | (?P<regex>/(?:\\.|[^/\\])+/[i]?)
   | (?P<num>0x[0-9a-fA-F]+|\d+\.\d+|\d+)
   | (?P<name>~?[a-zA-Z_][\w.~]*|<[^>]+>|\$[a-zA-Z_]\w*)
-  | (?P<punct>@|\(|\)|\{|\}|\[|\]|:|,|==|=|\*|\+|-|/|%|<=|>=|<|>)
+  | (?P<punct>@|\(|\)|\{|\}|\[|\]|:|,|==|=|\*|\+|-|/|%|<=|>=|<|>|\.)
 """,
     re.VERBOSE,
 )
@@ -159,6 +159,7 @@ class GraphQuery:
     # facets
     facets: bool = False
     facet_names: List[str] = field(default_factory=list)
+    facet_filter: Optional["FuncSpec"] = None
     facet_order: str = ""
     facet_order_desc: bool = False
     # lang tag on predicate: name@en
@@ -250,13 +251,26 @@ def _parse_value(t: Tok):
     raise ParseError(f"unexpected value token {t.text!r} at {t.pos}")
 
 
+def _parse_lang_chain(p: _P) -> str:
+    """en | en:fr:de | . — language preference list (ref dql lang lists)."""
+    parts = [p.next().text]
+    while p.peek().text == ":" and p.toks[p.i + 1].kind in ("name",) or (
+        p.peek().text == ":" and p.toks[p.i + 1].text == "."
+    ):
+        p.next()
+        parts.append(p.next().text)
+    return ":".join(parts)
+
+
 def _parse_name_with_lang(p: _P) -> tuple[str, str]:
     name = _strip_angle(p.next().text)
     lang = ""
-    if p.peek().text == "@" and p.toks[p.i + 1].kind == "name":
-        # name@en  (no whitespace semantics enforced; lexer-level in ref)
+    if p.peek().text == "@" and (
+        p.toks[p.i + 1].kind == "name" or p.toks[p.i + 1].text == "."
+    ):
+        # name@en / name@en:fr:. (no whitespace enforced; lexer-level in ref)
         p.next()
-        lang = p.next().text
+        lang = _parse_lang_chain(p)
     return name, lang
 
 
@@ -545,8 +559,19 @@ def _parse_directives(p: _P, gq: GraphQuery):
                 p.accept(",")
             p.expect(")")
         elif d == "facets":
-            gq.facets = True
             if p.accept("("):
+                is_filter = (
+                    p.peek().kind == "name"
+                    and p.toks[p.i + 1].text == "("
+                    and p.peek().text.lower()
+                    in ("eq", "le", "lt", "ge", "gt", "allofterms", "anyofterms")
+                )
+                if is_filter:
+                    # @facets(eq(since, "2006")) — edge filter, not output
+                    gq.facet_filter = parse_func(p)
+                    p.expect(")")
+                    return _parse_directives(p, gq)
+                gq.facets = True
                 while p.peek().text != ")":
                     t = p.next()
                     if t.text in ("orderasc", "orderdesc"):
@@ -557,6 +582,8 @@ def _parse_directives(p: _P, gq: GraphQuery):
                         gq.facet_names.append(t.text)
                     p.accept(",")
                 p.expect(")")
+            else:
+                gq.facets = True
         else:
             raise ParseError(f"unknown directive @{d}")
 
@@ -636,10 +663,15 @@ def parse_child(p: _P) -> GraphQuery:
         return gq
 
     gq.attr = name
-    # lang tag
-    if p.peek().text == "@" and p.toks[p.i + 1].kind == "name" and p.toks[p.i + 1].text not in ("filter", "facets", "cascade", "normalize", "recurse", "groupby"):
+    # lang tag / preference chain (name@en, name@fr:pt:.)
+    if (
+        p.peek().text == "@"
+        and (p.toks[p.i + 1].kind == "name" or p.toks[p.i + 1].text == ".")
+        and p.toks[p.i + 1].text
+        not in ("filter", "facets", "cascade", "normalize", "recurse", "groupby")
+    ):
         p.next()
-        gq.lang = p.next().text
+        gq.lang = _parse_lang_chain(p)
 
     # (first: N, ...) argument list
     if p.accept("("):

@@ -40,7 +40,7 @@ class VectorIndex:
         metric: str = "euclidean",
         ivf_threshold: int = 200_000,
         nlist: Optional[int] = None,
-        nprobe: int = 16,
+        nprobe: Optional[int] = None,
     ):
         if metric not in ("euclidean", "cosine", "dotproduct"):
             raise ValueError(f"unknown metric {metric!r}")
@@ -256,6 +256,10 @@ class VectorIndex:
         for ci in range(nlist):
             rws = rows_rep[order[starts[ci] : ends[ci]]]
             cells[ci, : len(rws)] = rws
+        if self.nprobe is None:
+            # probe ~12% of cells by default: keeps recall@10 >= ~0.9 even
+            # on unclustered data while still skipping most of the corpus
+            self.nprobe = max(16, nlist // 8)
         self._ivf = {
             "centroids": c_np,
             "cells": cells,

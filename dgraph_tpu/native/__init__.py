@@ -1,0 +1,159 @@
+"""Native host kernel loader: compiles codec.cpp once, binds via ctypes.
+
+The C++ layer covers the host-side hot paths (SURVEY.md §2.7): the
+bit-pack codec used by UID pack (de)serialization and the scalar sorted-set
+ops used by the dispatcher's small-op fallback. Python/numpy fallbacks keep
+everything working where no compiler exists (`NATIVE_AVAILABLE` tells you
+which you got).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+NATIVE_AVAILABLE = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.join(os.path.dirname(__file__), "codec.cpp")
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "DGRAPH_TPU_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), "dgraph_tpu_native"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"codec-{tag}.so")
+    if not os.path.exists(so_path):
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-o", tmp, src,
+        ]
+        # -march=native unlocks SIMD; retry without it if unsupported
+        try:
+            subprocess.run(
+                cmd[:2] + ["-march=native"] + cmd[2:],
+                check=True, capture_output=True, timeout=120,
+            )
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                subprocess.TimeoutExpired):
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        os.replace(tmp, so_path)
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64 = ctypes.c_int64
+
+    lib.bitpack.argtypes = [u32p, i64, ctypes.c_int, u8p]
+    lib.bitunpack.argtypes = [u8p, i64, i64, ctypes.c_int, u32p]
+    for name in ("intersect_u64", "union_u64", "difference_u64"):
+        fn = getattr(lib, name)
+        fn.argtypes = [u64p, i64, u64p, i64, u64p]
+        fn.restype = i64
+    lib.merge_sorted_u64.argtypes = [
+        u64p, ctypes.POINTER(i64), i64, u64p, u64p
+    ]
+    lib.merge_sorted_u64.restype = i64
+    return lib
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+try:
+    _LIB = _build_and_load()
+    NATIVE_AVAILABLE = _LIB is not None
+except Exception:
+    _LIB = None
+    NATIVE_AVAILABLE = False
+
+
+# ---------------------------------------------------------------------------
+# numpy-facing wrappers (with pure-Python fallbacks)
+# ---------------------------------------------------------------------------
+
+
+def bitpack(vals: np.ndarray, width: int) -> bytes:
+    vals = np.ascontiguousarray(vals, dtype=np.uint32)
+    n = vals.size
+    if width == 0 or n == 0:
+        return b""
+    nbytes = (n * width + 7) // 8
+    if _LIB is not None:
+        out = np.zeros((nbytes + 8,), np.uint8)  # slack for the 5-byte write
+        _LIB.bitpack(
+            _ptr(vals, ctypes.c_uint32), n, width, _ptr(out, ctypes.c_uint8)
+        )
+        return out[:nbytes].tobytes()
+    from dgraph_tpu.codec.uidpack import _bitpack_py
+
+    return _bitpack_py(vals, width)
+
+
+def bitunpack(data: bytes, count: int, width: int) -> np.ndarray:
+    if width == 0 or count == 0:
+        return np.zeros((count,), np.uint32)
+    if _LIB is not None:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        out = np.empty((count,), np.uint32)
+        _LIB.bitunpack(
+            _ptr(buf, ctypes.c_uint8),
+            buf.size,
+            count,
+            width,
+            _ptr(out, ctypes.c_uint32),
+        )
+        return out
+    from dgraph_tpu.codec.uidpack import _bitunpack_py
+
+    return _bitunpack_py(data, count, width)
+
+
+def _setop(name: str, a: np.ndarray, b: np.ndarray, out_size: int) -> np.ndarray:
+    a = np.ascontiguousarray(a, np.uint64)
+    b = np.ascontiguousarray(b, np.uint64)
+    out = np.empty((out_size,), np.uint64)
+    n = getattr(_LIB, name)(
+        _ptr(a, ctypes.c_uint64),
+        a.size,
+        _ptr(b, ctypes.c_uint64),
+        b.size,
+        _ptr(out, ctypes.c_uint64),
+    )
+    return out[:n]
+
+
+def intersect(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    if _LIB is None:
+        return np.intersect1d(a, b, assume_unique=True)
+    return _setop("intersect_u64", a, b, min(a.size, b.size))
+
+
+def union(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    if _LIB is None:
+        return np.union1d(a, b)
+    return _setop("union_u64", a, b, a.size + b.size)
+
+
+def difference(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    if _LIB is None:
+        return np.setdiff1d(a, b, assume_unique=True)
+    return _setop("difference_u64", a, b, a.size)

@@ -1,0 +1,141 @@
+// Native host kernels: bit-pack codec + sorted-set algebra.
+//
+// The reference's performance-critical "near-native" pieces (SURVEY.md §2.7)
+// are go-groupvarint's SSE decode (codec/codec.go:15) and the adaptive
+// intersect loops (algo/uidlist.go). On the TPU build these live in two
+// places: the device kernels (ops/setops.py) for batched query execution,
+// and THIS file for the host-side paths — disk (de)serialization of UID
+// packs and small singleton set ops where device dispatch isn't worth it.
+//
+// Built with -O3 -march=native when available; the auto-vectorizer turns
+// the pack/unpack loops into SIMD shifts/masks (the groupvarint-equivalent).
+// Exposed via ctypes (dgraph_tpu/native/__init__.py) — no pybind11 needed.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Bit-packing: fixed-width lanes (ref codec.go packBlock; fixed-width instead
+// of group-varint so decode is branch-free — see codec/uidpack.py docstring).
+// ---------------------------------------------------------------------------
+
+void bitpack(const uint32_t* vals, int64_t n, int width, uint8_t* out) {
+    // out must be zeroed, size (n*width+7)/8
+    uint64_t bitpos = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t v = vals[i];
+        uint64_t byte = bitpos >> 3;
+        uint64_t shift = bitpos & 7;
+        // write up to 5 bytes (width <= 32, shift <= 7)
+        uint64_t cur = 0;
+        memcpy(&cur, out + byte, 5);
+        cur |= (v << shift);
+        memcpy(out + byte, &cur, 5);
+        bitpos += width;
+    }
+}
+
+void bitunpack(const uint8_t* data, int64_t nbytes, int64_t n, int width,
+               uint32_t* out) {
+    uint64_t mask = (width >= 32) ? 0xFFFFFFFFull : ((1ull << width) - 1);
+    uint64_t bitpos = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t byte = bitpos >> 3;
+        uint64_t shift = bitpos & 7;
+        uint64_t window = 0;
+        int64_t take = nbytes - (int64_t)byte;
+        if (take > 8) take = 8;
+        if (take > 0) memcpy(&window, data + byte, take);
+        out[i] = (uint32_t)((window >> shift) & mask);
+        bitpos += width;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Sorted u64 set algebra (ref algo/uidlist.go IntersectWith:142 adaptive
+// strategies; same linear/gallop split here).
+// ---------------------------------------------------------------------------
+
+static int64_t gallop(const uint64_t* arr, int64_t n, int64_t lo, uint64_t x) {
+    // first index >= x, starting the search at lo
+    int64_t step = 1, hi = lo + 1;
+    while (hi < n && arr[hi] < x) {
+        lo = hi;
+        hi += step;
+        step <<= 1;
+    }
+    if (hi > n) hi = n;
+    // binary search in (lo, hi]
+    while (lo < hi) {
+        int64_t mid = lo + ((hi - lo) >> 1);
+        if (arr[mid] < x) lo = mid + 1; else hi = mid;
+    }
+    return lo;
+}
+
+int64_t intersect_u64(const uint64_t* a, int64_t na, const uint64_t* b,
+                      int64_t nb, uint64_t* out) {
+    if (na > nb) { const uint64_t* t = a; a = b; b = t;
+                   int64_t tn = na; na = nb; nb = tn; }
+    int64_t k = 0;
+    if (nb <= na * 32) {  // similar sizes: linear merge
+        int64_t i = 0, j = 0;
+        while (i < na && j < nb) {
+            if (a[i] < b[j]) i++;
+            else if (a[i] > b[j]) j++;
+            else { out[k++] = a[i]; i++; j++; }
+        }
+    } else {  // ratio large: gallop the big side (IntersectWithJump/Bin)
+        int64_t j = 0;
+        for (int64_t i = 0; i < na; i++) {
+            j = gallop(b, nb, j, a[i]);
+            if (j < nb && b[j] == a[i]) out[k++] = a[i];
+            if (j >= nb) break;
+        }
+    }
+    return k;
+}
+
+int64_t union_u64(const uint64_t* a, int64_t na, const uint64_t* b,
+                  int64_t nb, uint64_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) out[k++] = a[i++];
+        else if (a[i] > b[j]) out[k++] = b[j++];
+        else { out[k++] = a[i]; i++; j++; }
+    }
+    while (i < na) out[k++] = a[i++];
+    while (j < nb) out[k++] = b[j++];
+    return k;
+}
+
+int64_t difference_u64(const uint64_t* a, int64_t na, const uint64_t* b,
+                       int64_t nb, uint64_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) out[k++] = a[i++];
+        else if (a[i] > b[j]) j++;
+        else { i++; j++; }
+    }
+    while (i < na) out[k++] = a[i++];
+    return k;
+}
+
+// k-way merge via repeated 2-way (callers pass scratch; ref MergeSorted)
+int64_t merge_sorted_u64(const uint64_t* flat, const int64_t* lens,
+                         int64_t nlists, uint64_t* out, uint64_t* scratch) {
+    int64_t acc = 0;  // current size in out
+    int64_t off = 0;
+    for (int64_t l = 0; l < nlists; l++) {
+        int64_t n = lens[l];
+        int64_t merged = union_u64(out, acc, flat + off, n, scratch);
+        memcpy(out, scratch, merged * sizeof(uint64_t));
+        acc = merged;
+        off += n;
+    }
+    return acc;
+}
+
+}  // extern "C"

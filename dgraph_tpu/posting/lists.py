@@ -52,6 +52,16 @@ class LocalCache:
     def has(self, key: bytes) -> bool:
         return not self.get(key).is_empty(self.deltas.get(key))
 
+    def edge_facets(self, key: bytes):
+        """Facets per target uid for a uid-edge list (ref facets on
+        pb.Posting; used by @facets projection/filtering)."""
+        merged = self.get(key)._merged_postings(self.deltas.get(key))
+        out = {}
+        for uid, p in merged.items():
+            if not p.is_value and p.facets and p.op == 1:  # OP_SET
+                out[uid] = p.get_facets()
+        return out
+
     # -- writes --------------------------------------------------------------
 
     def add_delta(self, key: bytes, p: Posting):

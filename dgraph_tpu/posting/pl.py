@@ -313,11 +313,19 @@ class PostingList:
         """Compact all layers into a fresh rollup record.
 
         Returns (record_bytes, ts). Ref posting/list.go:1416 Rollup.
+        Uid-edge postings that carry facets are kept alongside the pack
+        (the pack stores only the uid set; facets live on the posting).
         """
         uids = self.uids()
         pack = uidpack.encode(uids)
-        values = self.get_all_values()
+        posts = self.get_all_values()
+        live = set(int(u) for u in uids)
+        merged = self._merged_postings()
+        for uid in sorted(merged):
+            p = merged[uid]
+            if not p.is_value and p.op != OP_DEL and p.facets and uid in live:
+                posts.append(p)
         ts = max(
             [self.min_ts] + [t for t, _ in self.deltas]
         )
-        return encode_rollup(pack, values), ts
+        return encode_rollup(pack, posts), ts

@@ -43,12 +43,20 @@ def _pow2(n: int) -> int:
 
 
 def _np_op(op: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    # host fallback: native C++ galloping/merge loops when compiled
+    # (dgraph_tpu/native), numpy otherwise
+    from dgraph_tpu import native
+
     if op == "intersect":
-        return np.intersect1d(a, b, assume_unique=True)
+        return native.intersect(
+            np.asarray(a, np.uint64), np.asarray(b, np.uint64)
+        )
     if op == "difference":
-        return np.setdiff1d(a, b, assume_unique=True)
+        return native.difference(
+            np.asarray(a, np.uint64), np.asarray(b, np.uint64)
+        )
     if op == "union":
-        return np.union1d(a, b)
+        return native.union(np.asarray(a, np.uint64), np.asarray(b, np.uint64))
     raise ValueError(op)
 
 

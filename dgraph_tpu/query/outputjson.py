@@ -142,6 +142,7 @@ class JsonEncoder:
                 kids = []
                 r = c.uid_matrix[row] if row < len(c.uid_matrix) else []
                 dest_idx = {int(x): j for j, x in enumerate(c.dest_uids)}
+                fmaps = getattr(c, "edge_facet_maps", None)
                 for v in r:
                     kid = (
                         self.encode_entity(c, int(v), dest_idx.get(int(v), 0))
@@ -150,6 +151,11 @@ class JsonEncoder:
                     )
                     if not c.children:
                         kid = {"uid": encode_uid(int(v))}
+                    if fmaps is not None and row < len(fmaps):
+                        for fk, fv in fmaps[row].get(int(v), {}).items():
+                            if gq.facet_names and fk not in gq.facet_names:
+                                continue
+                            kid[f"{name}|{fk}"] = _json_val(fv)
                     if kid:
                         kids.append(kid)
                 if kids:

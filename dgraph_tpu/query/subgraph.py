@@ -258,6 +258,8 @@ class Executor:
                 cnode.uid_matrix = DISPATCHER.run_pairs(
                     "intersect", [(r, dest) for r in rows]
                 )
+            if cgq.facet_filter is not None or cgq.facet_order or cgq.facets:
+                self._apply_edge_facets(cnode, cgq, parent, reverse)
             # per-row order & pagination (ref query.go:2493,2511)
             if cgq.order:
                 cnode.uid_matrix = [
@@ -285,7 +287,11 @@ class Executor:
             for u in parent.dest_uids:
                 posts = self.cache.values(keys.DataKey(attr, int(u), self.ns))
                 if cgq.lang:
-                    posts = [p for p in posts if p.lang == cgq.lang]
+                    posts = _pick_lang(posts, cgq.lang)
+                elif su is not None and su.lang:
+                    # untagged read on an @lang predicate returns only the
+                    # untagged value (ref lang semantics)
+                    posts = [p for p in posts if p.lang == ""]
                 if posts:
                     cnode.values[int(u)] = posts
             if cgq.is_count:
@@ -362,6 +368,73 @@ class Executor:
             cnode.groups[int(pu)] = [
                 buckets[k] for k in sorted(buckets, key=lambda t: str(t))
             ]
+
+    def _apply_edge_facets(self, cnode: ExecNode, cgq, parent, reverse: bool):
+        """Edge-facet filtering / ordering / projection for uid predicates
+        (ref worker/task.go:2291-2498 facets filtering)."""
+        from dgraph_tpu.query.functions import _coerce
+
+        fmaps = []
+        for i, pu in enumerate(parent.dest_uids):
+            key = (
+                keys.ReverseKey(cnode.attr[1:], int(pu), self.ns)
+                if reverse
+                else keys.DataKey(cnode.attr, int(pu), self.ns)
+            )
+            fmap = self.cache.edge_facets(key)
+            fmaps.append(fmap)
+            row = cnode.uid_matrix[i] if i < len(cnode.uid_matrix) else EMPTY
+            if cgq.facet_filter is not None:
+                ff = cgq.facet_filter
+                keep = []
+                for u in row:
+                    fv = fmap.get(int(u), {}).get(ff.attr)
+                    if fv is None:
+                        continue
+                    if ff.name in ("allofterms", "anyofterms"):
+                        from dgraph_tpu.tok.tok import _normalize, _word_re
+
+                        have = set(_word_re.findall(_normalize(str(fv.value))))
+                        want_terms = set(
+                            _word_re.findall(_normalize(str(ff.args[0])))
+                        )
+                        ok = (
+                            want_terms <= have
+                            if ff.name == "allofterms"
+                            else bool(want_terms & have)
+                        )
+                        if ok:
+                            keep.append(int(u))
+                        continue
+                    try:
+                        want = _coerce(ff.args[0], fv.tid)
+                        c = compare_vals(convert(fv, want.tid), want)
+                    except (ValueError, TypeError):
+                        continue
+                    ok = {
+                        "eq": c == 0, "le": c <= 0, "lt": c < 0,
+                        "ge": c >= 0, "gt": c > 0,
+                    }.get(ff.name, False)
+                    if ok:
+                        keep.append(int(u))
+                row = np.array(keep, dtype=np.uint64)
+            if cgq.facet_order:
+                with_v = [
+                    (fmap.get(int(u), {}).get(cgq.facet_order), int(u))
+                    for u in row
+                ]
+                present = sorted(
+                    [(v.value, u) for v, u in with_v if v is not None],
+                    reverse=cgq.facet_order_desc,
+                )
+                missing = [u for v, u in with_v if v is None]
+                row = np.array(
+                    [u for _, u in present] + missing, dtype=np.uint64
+                )
+            cnode.uid_matrix[i] = row
+        # (dest_uids is recomputed by the caller after order/pagination)
+        if cgq.facets:
+            cnode.edge_facet_maps = fmaps  # type: ignore[attr-defined]
 
     def _resolve_expand(
         self, gqs: List[GraphQuery], uids: np.ndarray
@@ -561,6 +634,20 @@ def _paginate(uids: np.ndarray, first, offset, after) -> np.ndarray:
         else:
             uids = uids[first:]
     return uids
+
+
+def _pick_lang(posts: List[Posting], chain: str) -> List[Posting]:
+    """Language preference list: name@en:fr:. — first language in the chain
+    with values wins; '.' accepts any (ref dql lang list semantics)."""
+    for lang in chain.split(":"):
+        if lang == ".":
+            if posts:
+                return posts[:1]
+            continue
+        got = [p for p in posts if p.lang == lang]
+        if got:
+            return got
+    return []
 
 
 def _sort_key_of(v: Val):

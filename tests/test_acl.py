@@ -222,3 +222,54 @@ def test_admin_routes_guardian_only():
         post("/alter", "city2: string .", {"X-Dgraph-AccessToken": tok}) == 200
     )
     srv.stop()
+
+
+def test_dgraph_internal_preds_guarded():
+    s = _server()
+    s.acl.add_user("mal", "malpw")
+    a = s.login("mal", "malpw")["accessJwt"]
+    with pytest.raises(AclError):
+        s.query(
+            "{ q(func: has(dgraph.password)) { dgraph.password } }",
+            access_jwt=a,
+        )
+    # dgraph.type READ still allowed (type()/expand need it)
+    s.acl.add_group("g1")
+    s.acl.add_user_to_group("mal", "g1")
+    s.acl.set_rule("g1", "name", READ)
+    a = s.login("mal", "malpw")["accessJwt"]  # re-login: groups in claims
+    res = s.query("{ q(func: type(Person)) { name } }", access_jwt=a)
+    assert res["data"]["q"] == []
+
+
+def test_txn_query_and_upsert_require_token():
+    s = _server()
+    t = s.new_txn()
+    with pytest.raises(AclError):
+        t.query("{ q(func: has(name)) { name } }")
+    t = s.new_txn()
+    with pytest.raises(AclError):
+        t.upsert(
+            query="{ v as var(func: has(name)) }",
+            set_rdf='uid(v) <name> "x" .',
+        )
+    g = s.login("groot", "password")["accessJwt"]
+    t = s.new_txn()
+    assert t.query("{ q(func: has(name)) { name } }", access_jwt=g)
+
+
+def test_random_salt():
+    s = _server()
+    s.acl.add_user("s1", "same")
+    s.acl.add_user("s2", "same")
+    from dgraph_tpu.posting.lists import LocalCache
+    from dgraph_tpu.x import keys as xkeys
+
+    cache = LocalCache(s.kv, s.zero.read_ts())
+    hashes = []
+    for xid in ("s1", "s2"):
+        uid = s.acl._uid_of_xid(xid, 0)
+        hashes.append(
+            cache.value(xkeys.DataKey("dgraph.password", uid)).value
+        )
+    assert hashes[0] != hashes[1]  # same password, different salt/hash

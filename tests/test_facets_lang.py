@@ -1,0 +1,92 @@
+"""Edge facets (projection/filter/order) and language preference chains
+(mirrors /root/reference/query facets tests + lang list semantics)."""
+
+import pytest
+
+from dgraph_tpu.api.server import Server
+
+SCHEMA = """
+name: string @index(exact) @lang .
+friend: [uid] @reverse .
+"""
+
+RDF = """
+<0x1> <name> "Center" .
+<0x1> <friend> <0x2> (since=2004, close=true) .
+<0x1> <friend> <0x3> (since=2010, close=false) .
+<0x1> <friend> <0x4> (since=2001) .
+<0x2> <name> "Two" .
+<0x3> <name> "Three" .
+<0x4> <name> "Four" .
+<0x5> <name> "Olá"@pt .
+<0x5> <name> "Hello"@en .
+<0x5> <name> "plain" .
+<0x6> <name> "nur deutsch"@de .
+"""
+
+
+@pytest.fixture()
+def server():
+    s = Server()
+    s.alter(SCHEMA)
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf=RDF, commit_now=True)
+    return s
+
+
+def test_facet_projection(server):
+    res = server.query(
+        '{ q(func: uid(0x1)) { friend @facets(since) { name } } }'
+    )["data"]
+    by_name = {o["name"]: o.get("friend|since") for o in res["q"][0]["friend"]}
+    assert by_name == {"Two": 2004, "Three": 2010, "Four": 2001}
+
+
+def test_facet_filter(server):
+    res = server.query(
+        '{ q(func: uid(0x1)) { friend @facets(gt(since, 2003)) { name } } }'
+    )["data"]
+    names = {o["name"] for o in res["q"][0]["friend"]}
+    assert names == {"Two", "Three"}
+    res = server.query(
+        '{ q(func: uid(0x1)) { friend @facets(eq(close, true)) { name } } }'
+    )["data"]
+    assert {o["name"] for o in res["q"][0]["friend"]} == {"Two"}
+
+
+def test_facet_order(server):
+    res = server.query(
+        '{ q(func: uid(0x1)) { friend @facets(orderasc: since) { name } } }'
+    )["data"]
+    assert [o["name"] for o in res["q"][0]["friend"]] == [
+        "Four",
+        "Two",
+        "Three",
+    ]
+
+
+def test_facets_survive_rollup(server):
+    from dgraph_tpu.posting.rollup import rollup_all
+
+    assert rollup_all(server, min_deltas=1) > 0
+    res = server.query(
+        '{ q(func: uid(0x1)) { friend @facets(since) { name } } }'
+    )["data"]
+    by_name = {o["name"]: o.get("friend|since") for o in res["q"][0]["friend"]}
+    assert by_name["Two"] == 2004
+
+
+def test_lang_chain(server):
+    res = server.query('{ q(func: uid(0x5)) { name@en } }')["data"]
+    assert res["q"] == [{"name@en": "Hello"}]
+    res = server.query('{ q(func: uid(0x5)) { name@fr:pt } }')["data"]
+    assert res["q"] == [{"name@fr:pt": "Olá"}]
+    # '.' = any language
+    res = server.query('{ q(func: uid(0x6)) { name@fr:. } }')["data"]
+    assert res["q"] == [{"name@fr:.": "nur deutsch"}]
+    # untagged read gets untagged value
+    res = server.query('{ q(func: uid(0x5)) { name } }')["data"]
+    assert res["q"] == [{"name": "plain"}]
+    # missing language entirely -> absent field
+    res = server.query('{ q(func: uid(0x6)) { name@fr } }')["data"]
+    assert res["q"] == []

@@ -104,3 +104,28 @@ def test_dispatcher_forced_device(monkeypatch):
         np.testing.assert_array_equal(
             np.asarray(g, np.uint64), np.intersect1d(a, b, assume_unique=True)
         )
+
+
+def test_native_layer():
+    from dgraph_tpu import native
+
+    rng = np.random.default_rng(5)
+    a = _rand_uids(rng, 5000, hi=1 << 40)
+    b = _rand_uids(rng, 300, hi=1 << 40)
+    np.testing.assert_array_equal(
+        native.intersect(a, b), np.intersect1d(a, b, assume_unique=True)
+    )
+    np.testing.assert_array_equal(native.union(a, b), np.union1d(a, b))
+    np.testing.assert_array_equal(
+        native.difference(a, b), np.setdiff1d(a, b, assume_unique=True)
+    )
+    vals = np.asarray(rng.integers(0, 1 << 17, 777), np.uint32)
+    for w in (1, 7, 17, 32):
+        vv = vals & ((1 << w) - 1) if w < 32 else vals
+        packed = native.bitpack(vv, w)
+        np.testing.assert_array_equal(native.bitunpack(packed, len(vv), w), vv)
+    # native and python paths produce identical bytes
+    if native.NATIVE_AVAILABLE:
+        from dgraph_tpu.codec.uidpack import _bitpack_py
+
+        assert native.bitpack(vv, 17) == _bitpack_py(vals & 0x1FFFF, 17)
