@@ -176,9 +176,15 @@ class Server:
         self._lock = threading.Lock()
         self.acl = None  # enabled via enable_acl() (ref --acl superflag)
         self.audit = None  # enabled via enable_audit()
+        self.slow_query_ms = 1000.0  # slow-query log threshold
         self._bootstrap_schema()
         if data_dir is not None:
             self._load_persisted_state()
+        # warm the native C++ layer off the request path (first import
+        # compiles codec.cpp; without this the first query/rollup pays it)
+        threading.Thread(
+            target=lambda: __import__("dgraph_tpu.native"), daemon=True
+        ).start()
 
     # -- security (ref edgraph/access.go; audit/) -----------------------------
 
@@ -409,6 +415,9 @@ class Server:
         cdc = getattr(self, "_cdc", None)
         if cdc is not None:
             cdc.emit_commit(commit_ts, txn.cache.deltas)
+        subs = getattr(self, "_subscriptions", None)
+        if subs is not None:
+            subs.on_commit(txn.cache.deltas)
         # vector index ingestion at commit (factory seam)
         for key, posts in txn.cache.deltas.items():
             pk = keys.parse_key(key)
@@ -612,9 +621,23 @@ class Server:
                 self._audit("query", user=user, body=q, status="DENIED")
                 raise
         self._audit("query", user=user, ns=ns, body=q)
-        return self._query_parsed(
-            blocks, LocalCache(self.kv, ts), ns, allowed
-        )
+        import time as _time
+
+        t0 = _time.monotonic()
+        out = self._query_parsed(blocks, LocalCache(self.kv, ts), ns, allowed)
+        took_ms = (_time.monotonic() - t0) * 1e3
+        if took_ms > self.slow_query_ms:
+            # structured slow-query log (ref x/log.go LogSlowOperation,
+            # edgraph/server.go:1448)
+            import logging
+
+            logging.getLogger("dgraph_tpu.slow").warning(
+                "slow query: %.1fms ns=%d query=%s",
+                took_ms,
+                ns,
+                q[:500].replace("\n", " "),
+            )
+        return out
 
     def _query(self, q: str, cache: LocalCache) -> dict:
         return self._query_parsed(dql.parse(q), cache, keys.GALAXY_NS)

@@ -1,0 +1,84 @@
+"""Query subscriptions: re-run a query when its predicates change.
+
+Mirrors /root/reference/graphql/subscription/ + worker/worker.go:75
+Subscribe (badger-prefix subscription -> poller re-running the query):
+a subscription registers the predicates its query touches; every commit
+that writes one of them re-evaluates the query, and the callback fires
+when the result actually changed.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional
+
+from dgraph_tpu.x import keys
+
+
+class Subscriptions:
+    def __init__(self, server):
+        self.server = server
+        self._subs: Dict[int, dict] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        server._subscriptions = self
+
+    def subscribe(
+        self,
+        query: str,
+        callback: Callable[[dict], None],
+        access_jwt: Optional[str] = None,
+    ) -> int:
+        """Register; fires callback immediately with the current result and
+        then on every change. With ACL enabled the subscriber's token is
+        captured and used for every re-evaluation. Returns a sub id."""
+        from dgraph_tpu import dql
+        from dgraph_tpu.api.server import _query_preds
+
+        blocks = dql.parse(query)
+        preds = set(_query_preds(blocks))
+        result = self.server.query(query, access_jwt=access_jwt)
+        with self._lock:
+            self._next_id += 1
+            sid = self._next_id
+            self._subs[sid] = {
+                "query": query,
+                "preds": preds,
+                "callback": callback,
+                "jwt": access_jwt,
+                "last": json.dumps(result, sort_keys=True, default=str),
+            }
+        callback(result)
+        return sid
+
+    def unsubscribe(self, sid: int):
+        with self._lock:
+            self._subs.pop(sid, None)
+
+    def on_commit(self, deltas):
+        """Called by the engine post-commit with the touched keys."""
+        touched = set()
+        for key in deltas:
+            try:
+                touched.add(keys.parse_key(key).attr)
+            except Exception:
+                continue
+        with self._lock:
+            subs = list(self._subs.items())
+        for sid, sub in subs:
+            if not (sub["preds"] & touched):
+                continue
+            # never let a subscriber error fail the commit that triggered it
+            try:
+                result = self.server.query(sub["query"], access_jwt=sub["jwt"])
+                blob = json.dumps(result, sort_keys=True, default=str)
+                if blob != sub["last"]:
+                    sub["last"] = blob
+                    sub["callback"](result)
+            except Exception:
+                import logging
+
+                logging.getLogger("dgraph_tpu.subs").exception(
+                    "subscription %d re-evaluation failed", sid
+                )

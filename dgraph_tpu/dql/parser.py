@@ -105,6 +105,7 @@ class FuncSpec:
     options: Dict[str, Any] = field(default_factory=dict)
     uid_var: str = ""  # for uid(x)
     val_var: str = ""  # for eq(val(x), ...)
+    is_count: bool = False  # for eq(count(pred), N)
 
 
 @dataclass
@@ -254,8 +255,8 @@ def _parse_value(t: Tok):
 def _parse_lang_chain(p: _P) -> str:
     """en | en:fr:de | . — language preference list (ref dql lang lists)."""
     parts = [p.next().text]
-    while p.peek().text == ":" and p.toks[p.i + 1].kind in ("name",) or (
-        p.peek().text == ":" and p.toks[p.i + 1].text == "."
+    while p.peek().text == ":" and (
+        p.toks[p.i + 1].kind == "name" or p.toks[p.i + 1].text == "."
     ):
         p.next()
         parts.append(p.next().text)
@@ -309,11 +310,17 @@ def parse_func(p: _P) -> FuncSpec:
         p.expect(")")
         return fn
 
-    # first arg: attr, val(x), or type name
-    if p.peek().text == "val":
+    # first arg: attr, val(x), count(pred), or type name
+    if p.peek().text == "val" and p.toks[p.i + 1].text == "(":
         p.next()
         p.expect("(")
         fn.val_var = p.next().text
+        p.expect(")")
+    elif p.peek().text == "count" and p.toks[p.i + 1].text == "(":
+        p.next()
+        p.expect("(")
+        fn.attr = _strip_angle(p.next().text)
+        fn.is_count = True
         p.expect(")")
     else:
         fn.attr, fn.lang = _parse_name_with_lang(p)

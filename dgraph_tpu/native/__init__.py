@@ -157,3 +157,25 @@ def difference(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     if _LIB is None:
         return np.setdiff1d(a, b, assume_unique=True)
     return _setop("difference_u64", a, b, a.size)
+
+
+def merge_sorted(lists) -> np.ndarray:
+    """K-way sorted union (ref algo/uidlist.go:448 MergeSorted)."""
+    lists = [np.ascontiguousarray(x, np.uint64) for x in lists if len(x)]
+    if not lists:
+        return np.zeros((0,), np.uint64)
+    if _LIB is None:
+        return np.unique(np.concatenate(lists))
+    flat = np.concatenate(lists)
+    lens = np.asarray([x.size for x in lists], np.int64)
+    total = int(flat.size)
+    out = np.empty((total,), np.uint64)
+    scratch = np.empty((total,), np.uint64)
+    n = _LIB.merge_sorted_u64(
+        _ptr(flat, ctypes.c_uint64),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lens.size,
+        _ptr(out, ctypes.c_uint64),
+        _ptr(scratch, ctypes.c_uint64),
+    )
+    return out[:n]

@@ -16,8 +16,10 @@ base comparison is left to the caller's block structure (codec blocks are
 range-partitioned, so the engine only feeds tiles overlapping [a_min,
 a_max]).
 
-Correctness is validated in interpret mode on CPU (tests); enable on real
-TPU with DGRAPH_TPU_PALLAS=1 (bench.py compares both paths).
+Correctness is validated in interpret mode on CPU (tests). The dispatcher
+uses this path for intersect buckets with <=128-element small sides when
+DGRAPH_TPU_PALLAS=1 (query/dispatch.py); default remains the XLA
+searchsorted path until the sweep is profiled on real hardware.
 """
 
 from __future__ import annotations
@@ -95,6 +97,9 @@ def membership(a, la, b, lb, interpret: bool = _INTERPRET):
     n = a.shape[0]
     if n > LANE:
         raise ValueError(f"pallas membership path is for <=128 queries, got {n}")
+    if b.shape[0] == 0:
+        # zero grid steps would leave the output uninitialized
+        return jnp.zeros((n,), jnp.bool_)
     a_l = jnp.pad(a, (0, LANE - n))
     m = b.shape[0]
     b_p = jnp.pad(b, (0, (-m) % TILE))

@@ -35,6 +35,8 @@ from dgraph_tpu.ops import setops
 # Below this much total work, numpy wins (dispatch overhead dominates).
 _DEVICE_MIN_TOTAL = int(os.environ.get("DGRAPH_TPU_DEVICE_MIN_TOTAL", 1 << 15))
 _FORCE_DEVICE = os.environ.get("DGRAPH_TPU_FORCE_DEVICE", "") == "1"
+# opt-in Pallas compare-all sweep for small-side intersect buckets
+_USE_PALLAS = os.environ.get("DGRAPH_TPU_PALLAS", "") == "1"
 _MIN_PAD = 8
 
 
@@ -136,6 +138,10 @@ class SetOpDispatcher:
                 "difference": setops.difference,
                 "union": setops.union,
             }[op]
+            if _USE_PALLAS and op == "intersect" and pa <= 128:
+                from dgraph_tpu.ops import pallas_setops
+
+                base = pallas_setops.intersect
             fn = jax.jit(jax.vmap(base))
             self._jit_cache[key] = fn
         return fn

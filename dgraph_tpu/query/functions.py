@@ -94,6 +94,8 @@ class FuncRunner:
 
     def _run(self, fn: FuncSpec, src: Optional[np.ndarray]) -> np.ndarray:
         name = fn.name
+        if fn.is_count:
+            return self._count_func(fn, name, src)
         if name == "uid":
             uids = list(fn.args)
             if fn.uid_var:
@@ -133,6 +135,60 @@ class FuncRunner:
         raise QueryError(f"function {name!r} not supported")
 
     # -- implementations -----------------------------------------------------
+
+    def _count_func(self, fn: FuncSpec, op: str, src) -> np.ndarray:
+        """eq/lt/le/gt/ge(count(pred), N) — via the @count index when
+        present (ref worker/task.go:1222 handleCompareCountFunction),
+        else by counting lists. count(~pred) counts reverse edges."""
+        reverse = fn.attr.startswith("~")
+        attr = fn.attr[1:] if reverse else fn.attr
+        su = self._schema(attr)
+        if reverse and not su.directive_reverse:
+            raise QueryError(f"predicate {attr!r} has no @reverse index")
+        want = int(fn.args[0])
+
+        def ok(c: int) -> bool:
+            return (
+                (op == "eq" and c == want)
+                or (op == "le" and c <= want)
+                or (op == "lt" and c < want)
+                or (op == "ge" and c >= want)
+                or (op == "gt" and c > want)
+            )
+
+        # count index holds forward counts only (mutation.py); reverse
+        # counts always use the fallback scan
+        if su.count and src is None and not reverse:
+            out = EMPTY
+            prefix = keys.CountPrefix(attr, self.ns)
+            for k, _, _ in self.cache.kv.iterate(prefix, self.cache.read_ts):
+                pk = keys.parse_key(k)
+                if ok(pk.count):
+                    out = np.union1d(out, self.cache.uids(k))
+            return out.astype(np.uint64)
+
+        def key_of(u):
+            return (
+                keys.ReverseKey(attr, int(u), self.ns)
+                if reverse
+                else keys.DataKey(attr, int(u), self.ns)
+            )
+
+        if src is not None:
+            cands = src
+        elif reverse:
+            # reverse candidates = every uid with a reverse list
+            cands = _as_uids(
+                keys.parse_key(k).uid
+                for k, _, _ in self.cache.kv.iterate(
+                    keys.ReversePrefix(attr, self.ns), self.cache.read_ts
+                )
+            )
+        else:
+            cands = self._scan_data_uids(attr)
+        return _as_uids(
+            int(u) for u in cands if ok(len(self.cache.uids(key_of(u))))
+        )
 
     def _has(self, fn: FuncSpec, src) -> np.ndarray:
         attr = fn.attr

@@ -620,7 +620,9 @@ def _merge_rows(rows: List[np.ndarray]) -> np.ndarray:
     nonempty = [r for r in rows if len(r)]
     if not nonempty:
         return EMPTY
-    return np.unique(np.concatenate(nonempty)).astype(np.uint64)
+    from dgraph_tpu import native
+
+    return native.merge_sorted(nonempty).astype(np.uint64)
 
 
 def _paginate(uids: np.ndarray, first, offset, after) -> np.ndarray:

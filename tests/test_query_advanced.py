@@ -168,3 +168,87 @@ def test_upsert_self_pair_edges():
     res = s.query("{ q(func: uid(0x2)) { friend { uid } } }")["data"]
     uids = {o["uid"] for o in res["q"][0]["friend"]}
     assert uids == {"0x2", "0x3"}
+
+
+def test_count_index_root_funcs():
+    s = Server()
+    s.alter("name: string @index(exact) .\nfriend: [uid] @count .")
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf="""
+        <0x1> <friend> <0x10> .
+        <0x1> <friend> <0x11> .
+        <0x1> <friend> <0x12> .
+        <0x2> <friend> <0x10> .
+        <0x3> <name> "loner" .
+        """,
+        commit_now=True,
+    )
+    res = s.query("{ q(func: eq(count(friend), 3)) { uid } }")["data"]
+    assert res["q"] == [{"uid": "0x1"}]
+    res = s.query("{ q(func: ge(count(friend), 1)) { uid } }")["data"]
+    assert {o["uid"] for o in res["q"]} == {"0x1", "0x2"}
+    # as a filter over candidates
+    res = s.query(
+        "{ q(func: has(friend)) @filter(lt(count(friend), 2)) { uid } }"
+    )["data"]
+    assert res["q"] == [{"uid": "0x2"}]
+
+
+def test_subscriptions():
+    from dgraph_tpu.api.subscriptions import Subscriptions
+
+    s = Server()
+    s.alter("name: string @index(exact) .\ncity: string .")
+    events = []
+    subs = Subscriptions(s)
+    sid = subs.subscribe(
+        "{ q(func: has(name)) { name } }", lambda r: events.append(r)
+    )
+    assert len(events) == 1  # initial snapshot
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='<0x1> <name> "N" .', commit_now=True)
+    assert len(events) == 2
+    assert events[1]["data"]["q"] == [{"name": "N"}]
+    # commit touching an unrelated pred does not refire
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='<0x2> <city> "Pune" .', commit_now=True)
+    assert len(events) == 2
+    subs.unsubscribe(sid)
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='<0x3> <name> "M" .', commit_now=True)
+    assert len(events) == 2
+
+
+def test_count_reverse_edges():
+    s = Server()
+    s.alter("friend: [uid] @reverse @count .")
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf="<0x1> <friend> <0x9> .\n<0x2> <friend> <0x9> .\n"
+        "<0x3> <friend> <0x9> .\n<0x1> <friend> <0x8> .",
+        commit_now=True,
+    )
+    res = s.query("{ q(func: eq(count(~friend), 3)) { uid } }")["data"]
+    assert res["q"] == [{"uid": "0x9"}]
+    res = s.query("{ q(func: eq(count(~friend), 1)) { uid } }")["data"]
+    assert res["q"] == [{"uid": "0x8"}]
+
+
+def test_subscription_acl_safe():
+    from dgraph_tpu.api.subscriptions import Subscriptions
+
+    s = _server()
+    s.enable_acl(secret=b"z" * 32)
+    g = s.login("groot", "password")["accessJwt"]
+    events = []
+    subs = Subscriptions(s)
+    subs.subscribe(
+        "{ q(func: has(name)) { name } }",
+        lambda r: events.append(r),
+        access_jwt=g,
+    )
+    t = s.new_txn()
+    # commit succeeds even though subscription re-evaluation runs under ACL
+    t.mutate_rdf(set_rdf='<0x1> <name> "S" .', access_jwt=g, commit_now=True)
+    assert len(events) == 2
