@@ -117,7 +117,11 @@ class AclManager:
     # -- lookups ---------------------------------------------------------------
 
     def _cache(self) -> LocalCache:
-        return LocalCache(self.server.kv, self.server.zero.read_ts())
+        return LocalCache(
+            self.server.kv,
+            self.server.zero.read_ts(),
+            mem=getattr(self.server, "mem", None),
+        )
 
     def _uid_of_xid(self, xid: str, ns: int) -> Optional[int]:
         cache = self._cache()

@@ -86,7 +86,7 @@ def export(
     """Dump data + schema; returns {'data': path, 'schema': path, 'nquads': n}."""
     os.makedirs(out_dir, exist_ok=True)
     ts = read_ts if read_ts is not None else server.zero.read_ts()
-    cache = LocalCache(server.kv, ts)
+    cache = LocalCache(server.kv, ts, mem=getattr(server, "mem", None))
 
     ext = "rdf" if fmt == "rdf" else "json"
     data_path = os.path.join(out_dir, f"export.{ext}" + (".gz" if compress else ""))

@@ -38,7 +38,7 @@ class TxnHandle:
     def __init__(self, server: "Server", read_only: bool = False):
         self.server = server
         self.start_ts = server.zero.next_ts()
-        self.txn = Txn(server.kv, self.start_ts)
+        self.txn = Txn(server.kv, self.start_ts, mem=server.mem)
         self.read_only = read_only
         self.finished = False
 
@@ -174,9 +174,12 @@ class Server:
         self.schema = State()
         self.vector_indexes: Dict[str, object] = {}
         self._lock = threading.Lock()
+        from dgraph_tpu.posting.memlayer import MemoryLayer
+
         self.acl = None  # enabled via enable_acl() (ref --acl superflag)
         self.audit = None  # enabled via enable_audit()
         self.slow_query_ms = 1000.0  # slow-query log threshold
+        self.mem = MemoryLayer()  # shared decoded-list read cache
         self._bootstrap_schema()
         if data_dir is not None:
             self._load_persisted_state()
@@ -412,6 +415,7 @@ class Server:
     def _commit(self, txn: Txn) -> int:
         commit_ts = self.zero.commit(txn.start_ts, txn.conflict_keys)
         txn.write_deltas(self.kv, commit_ts)
+        self.mem.invalidate(txn.cache.deltas.keys())
         cdc = getattr(self, "_cdc", None)
         if cdc is not None:
             cdc.emit_commit(commit_ts, txn.cache.deltas)
@@ -624,7 +628,9 @@ class Server:
         import time as _time
 
         t0 = _time.monotonic()
-        out = self._query_parsed(blocks, LocalCache(self.kv, ts), ns, allowed)
+        out = self._query_parsed(
+            blocks, LocalCache(self.kv, ts, mem=self.mem), ns, allowed
+        )
         took_ms = (_time.monotonic() - t0) * 1e3
         if took_ms > self.slow_query_ms:
             # structured slow-query log (ref x/log.go LogSlowOperation,

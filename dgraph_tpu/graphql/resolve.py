@@ -107,7 +107,11 @@ class GraphQLServer:
         raise GraphQLError(f"unknown query {name!r}")
 
     def _run_block(self, gq: GraphQuery) -> List[dict]:
-        cache = LocalCache(self.engine.kv, self.engine.zero.read_ts())
+        cache = LocalCache(
+            self.engine.kv,
+            self.engine.zero.read_ts(),
+            mem=getattr(self.engine, "mem", None),
+        )
         ex = Executor(
             cache, self.engine.schema, vector_indexes=self.engine.vector_indexes
         )

@@ -22,19 +22,27 @@ from dgraph_tpu.storage.kv import KV
 
 
 class LocalCache:
-    """Per-txn read-through cache with uncommitted delta overlay."""
+    """Per-txn read-through cache with uncommitted delta overlay.
 
-    def __init__(self, kv: KV, read_ts: int):
+    When a shared MemoryLayer is provided, decoded lists are reused across
+    transactions/queries (ref posting/mvcc.go MemoryLayer)."""
+
+    def __init__(self, kv: KV, read_ts: int, mem=None):
         self.kv = kv
         self.read_ts = read_ts
+        self.mem = mem
         self._plists: Dict[bytes, PostingList] = {}
         self.deltas: Dict[bytes, List[Posting]] = {}
 
     def get(self, key: bytes) -> PostingList:
         pl = self._plists.get(key)
         if pl is None:
-            versions = self.kv.versions(key, self.read_ts)
-            pl = PostingList.from_versions(key, versions)
+            if self.mem is not None:
+                pl = self.mem.read(self.kv, key, self.read_ts)
+            else:
+                pl = PostingList.from_versions(
+                    key, self.kv.versions(key, self.read_ts)
+                )
             self._plists[key] = pl
         return pl
 
@@ -71,9 +79,9 @@ class LocalCache:
 class Txn:
     """A read-write transaction (ref posting/oracle.go:40 Txn)."""
 
-    def __init__(self, kv: KV, start_ts: int):
+    def __init__(self, kv: KV, start_ts: int, mem=None):
         self.start_ts = start_ts
-        self.cache = LocalCache(kv, start_ts)
+        self.cache = LocalCache(kv, start_ts, mem=mem)
         self.conflict_keys: set[int] = set()
         self.committed = False
         self.aborted = False

@@ -1,0 +1,75 @@
+"""MemoryLayer: shared read cache for decoded posting lists.
+
+Mirrors /root/reference/posting/mvcc.go:387 MemoryLayer (ristretto-backed
+cache keyed by key bytes): decoding a posting list (KV versions -> record
+parse -> UidPack decode) is the host-side hot cost of every traversal
+level. This cache keeps *decoded* PostingLists keyed by (key, newest
+version ts) so repeated reads — including the same predicate reached from
+different query roots — skip straight to the materialized form.
+
+Invalidation mirrors the reference (mvcc.go:510 updates on commit): the
+engine calls `invalidate(keys)` with every committed key. Entries also
+self-validate by comparing the KV's newest version ts, so even a missed
+invalidation only costs a re-decode, never staleness.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Iterable, Optional, Tuple
+
+from dgraph_tpu.posting.pl import PostingList
+
+
+class MemoryLayer:
+    def __init__(self, max_entries: int = 100_000):
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        # key -> (newest_version_ts, PostingList); LRU by insertion order
+        self._cache: "OrderedDict[bytes, Tuple[int, PostingList]]" = (
+            OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+
+    def read(self, kv, key: bytes, read_ts: int) -> PostingList:
+        """Read-through: returns a PostingList valid at read_ts.
+
+        Cached entries are keyed by the newest version <= read_ts, so a
+        reader at an older ts never sees future versions. The version list
+        is fetched ONCE and the cache key derives from it — deriving it
+        from a separate earlier kv.get would race concurrent commits and
+        cache future versions under an old ts."""
+        versions = kv.versions(key, read_ts)
+        newest_ts = versions[0][0] if versions else 0
+        with self._lock:
+            got = self._cache.get(key)
+            if got is not None and got[0] == newest_ts:
+                self._cache.move_to_end(key)
+                self.hits += 1
+                return got[1]
+        self.misses += 1
+        pl = PostingList.from_versions(key, versions)
+        with self._lock:
+            self._cache[key] = (newest_ts, pl)
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.max_entries:
+                self._cache.popitem(last=False)
+        return pl
+
+    def invalidate(self, keys: Iterable[bytes]):
+        with self._lock:
+            for k in keys:
+                self._cache.pop(k, None)
+
+    def clear(self):
+        with self._lock:
+            self._cache.clear()
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._cache),
+            "hits": self.hits,
+            "misses": self.misses,
+        }

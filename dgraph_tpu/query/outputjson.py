@@ -116,7 +116,12 @@ class JsonEncoder:
     ) -> Dict[str, Any]:
         obj: Dict[str, Any] = {}
         for c in node.children:
-            name = _display_name(c)
+            # per-node caches: display name and dest-uid index are loop
+            # invariants; rebuilding them per parent entity made encoding
+            # quadratic in fan-out
+            name = getattr(c, "_disp_name", None)
+            if name is None:
+                name = c._disp_name = _display_name(c)  # type: ignore[attr-defined]
             gq = c.gq
             if gq.is_uid:
                 obj[name] = encode_uid(uid)
@@ -141,7 +146,11 @@ class JsonEncoder:
             elif c.is_uid_pred:
                 kids = []
                 r = c.uid_matrix[row] if row < len(c.uid_matrix) else []
-                dest_idx = {int(x): j for j, x in enumerate(c.dest_uids)}
+                dest_idx = getattr(c, "_dest_idx", None)
+                if dest_idx is None:
+                    dest_idx = c._dest_idx = {  # type: ignore[attr-defined]
+                        int(x): j for j, x in enumerate(c.dest_uids)
+                    }
                 fmaps = getattr(c, "edge_facet_maps", None)
                 for v in r:
                     kid = (
