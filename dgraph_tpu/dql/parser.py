@@ -680,11 +680,15 @@ def parse_child(p: _P) -> GraphQuery:
         p.next()
         gq.lang = _parse_lang_chain(p)
 
-    # (first: N, ...) argument list
-    if p.accept("("):
-        _parse_args_into(p, gq, stop=")")
-
-    _parse_directives(p, gq)
+    # argument lists and directives may interleave in any order:
+    # pred (first: N) @filter(...)  |  pred @filter(...) (orderasc: x)
+    while True:
+        if p.accept("("):
+            _parse_args_into(p, gq, stop=")")
+        elif p.peek().text == "@":
+            _parse_directives(p, gq)
+        else:
+            break
 
     if p.peek().text == "{":
         parse_selection_set(p, gq)

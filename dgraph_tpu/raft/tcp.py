@@ -1,0 +1,130 @@
+"""TCP transport for Raft: the cross-host network seam.
+
+The reference's conn/node.go batches raft messages onto long-lived gRPC
+streams between peers (BatchAndSendMessages:338, streamMessages:398). This
+is the socket equivalent for dgraph-tpu: one listener per node, persistent
+outbound connections per peer with automatic reconnect, newline-delimited
+JSON frames (snappy/proto framing is a drop-in upgrade later). Implements
+the same send/drain interface as InProcNetwork, so RaftNode is unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from dgraph_tpu.raft.raft import Message
+
+
+class TcpNetwork:
+    """Per-process endpoint: local inboxes + outbound peer connections."""
+
+    def __init__(self, peers: Dict[int, Tuple[str, int]]):
+        """peers: node_id -> (host, port) for every cluster member."""
+        self.peers = peers
+        self.inboxes: Dict[int, List[Message]] = {}
+        self.lock = threading.Lock()
+        self._conns: Dict[int, socket.socket] = {}
+        # serializes connect + sendall per peer: frames must not interleave
+        # when several locally-hosted nodes write to the same remote socket
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._servers: List[socketserver.ThreadingTCPServer] = []
+        self.down: set = set()  # local fault injection parity
+
+    # -- server side ---------------------------------------------------------
+
+    def register(self, node_id: int):
+        """Start listening for this (locally hosted) node."""
+        self.inboxes[node_id] = []
+        host, port = self.peers[node_id]
+        net = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    try:
+                        d = json.loads(line)
+                        msg = Message(
+                            kind=d["k"], frm=d["f"], to=d["t"],
+                            term=d["m"], payload=d["p"],
+                        )
+                    except (json.JSONDecodeError, KeyError):
+                        continue
+                    with net.lock:
+                        if msg.to in net.inboxes:
+                            net.inboxes[msg.to].append(msg)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            # must be set on the class: the constructor binds immediately,
+            # and a restarting node must rebind through TIME_WAIT
+            allow_reuse_address = True
+            daemon_threads = True
+
+        srv = _Server((host, port), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        self._servers.append(srv)
+        # update port if OS-assigned (port=0)
+        self.peers[node_id] = srv.server_address[:2]
+
+    # -- client side ---------------------------------------------------------
+
+    def _conn_to(self, node_id: int) -> Optional[socket.socket]:
+        s = self._conns.get(node_id)
+        if s is not None:
+            return s
+        try:
+            s = socket.create_connection(self.peers[node_id], timeout=1.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns[node_id] = s
+            return s
+        except OSError:
+            return None
+
+    def send(self, msg: Message):
+        if msg.frm in self.down or msg.to in self.down:
+            return
+        if msg.to in self.inboxes:  # local fast path
+            with self.lock:
+                self.inboxes[msg.to].append(msg)
+            return
+        frame = (
+            json.dumps(
+                {"k": msg.kind, "f": msg.frm, "t": msg.to,
+                 "m": msg.term, "p": msg.payload}
+            )
+            + "\n"
+        ).encode()
+        with self.lock:
+            plock = self._send_locks.setdefault(msg.to, threading.Lock())
+        with plock:
+            s = self._conn_to(msg.to)
+            if s is None:
+                return  # peer unreachable: raft retries via timeouts
+            try:
+                s.sendall(frame)
+            except OSError:
+                self._conns.pop(msg.to, None)
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def drain(self, node_id: int) -> List[Message]:
+        with self.lock:
+            msgs = self.inboxes.get(node_id, [])
+            self.inboxes[node_id] = []
+            return msgs
+
+    def close(self):
+        for srv in self._servers:
+            srv.shutdown()
+            srv.server_close()
+        for s in self._conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._conns.clear()

@@ -187,8 +187,11 @@ class DistributedCluster:
             self.groups[g] = AlphaGroup(g, ids, self.net)
             for node in self.groups[g].nodes:
                 self.zero.connect(node.id, g)
+        from dgraph_tpu.posting.memlayer import MemoryLayer
+
         self.schema = State()
         self.vector_indexes: Dict[str, object] = {}
+        self.mem = MemoryLayer()  # shared decoded-list cache (ref MemoryLayer)
         # serializes commits against tablet moves (write fencing: a commit
         # racing phase-2 of a move would land on the source group and be
         # destroyed by the drop; ref predicate_move.go's blocking phase)
@@ -288,6 +291,8 @@ class DistributedCluster:
                 f"FATAL partial commit at ts {commit_ts}: groups {done} "
                 f"applied, remaining failed: {e}"
             ) from e
+        finally:
+            self.mem.invalidate(txn.cache.deltas.keys())
         # vector ingestion
         from dgraph_tpu.posting.pl import OP_DEL, OP_SET
 
@@ -326,7 +331,7 @@ class DistributedCluster:
         from dgraph_tpu.query.subgraph import Executor
 
         ts = read_ts if read_ts is not None else self.zero.zero.read_ts()
-        cache = LocalCache(RoutingKV(self), ts)
+        cache = LocalCache(RoutingKV(self), ts, mem=self.mem)
         ex = Executor(cache, self.schema, vector_indexes=self.vector_indexes)
         nodes = ex.process(dql.parse(q))
         enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
@@ -354,6 +359,7 @@ class DistributedCluster:
         # phase 2: flip tablet ownership, then drop from source
         self.zero.move_tablet(pred, dst_group)
         self._propose_and_wait(src_group, ("drop", prefix))
+        self.mem.clear()  # routing changed for the whole tablet
 
     def rebalance(self):
         """Move tablets from the most- to the least-loaded group
@@ -379,7 +385,7 @@ class ClusterTxn:
     def __init__(self, cluster: DistributedCluster):
         self.cluster = cluster
         self.start_ts = cluster.zero.zero.next_ts()
-        self.txn = Txn(RoutingKV(cluster), self.start_ts)
+        self.txn = Txn(RoutingKV(cluster), self.start_ts, mem=cluster.mem)
 
     def mutate_rdf(self, set_rdf: str = "", del_rdf: str = "", commit_now=False):
         from dgraph_tpu.loaders.rdf import parse_rdf

@@ -105,3 +105,79 @@ def test_five_node_majority():
     leader.propose("x")
     alive = [i for i in c.nodes if i not in downs]
     assert c.run_until(lambda: all(len(c.applied[i]) == 1 for i in alive))
+
+
+def test_raft_over_tcp_sockets():
+    """3 nodes on real localhost sockets (each with its own endpoint, as
+    separate processes would be) elect a leader and replicate."""
+    import threading
+    import time as _time
+
+    from dgraph_tpu.raft.raft import RaftNode
+    from dgraph_tpu.raft.tcp import TcpNetwork
+
+    # reserve three ports
+    import socket as _socket
+
+    ports = []
+    socks = []
+    for _ in range(3):
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    peers = {i + 1: ("127.0.0.1", ports[i]) for i in range(3)}
+
+    nets, nodes, applied = [], {}, {1: [], 2: [], 3: []}
+    for nid in (1, 2, 3):
+        net = TcpNetwork(dict(peers))
+        net.register(nid)
+        nets.append(net)
+        nodes[nid] = RaftNode(
+            nid, [1, 2, 3], net,
+            lambda idx, d, _n=nid: applied[_n].append(d), seed=nid,
+        )
+
+    stop = threading.Event()
+
+    def tick_loop(node):
+        now = 0
+        while not stop.is_set():
+            now += 50
+            node.tick(now)
+            _time.sleep(0.005)
+
+    threads = [
+        threading.Thread(target=tick_loop, args=(n,), daemon=True)
+        for n in nodes.values()
+    ]
+    for t in threads:
+        t.start()
+    try:
+        deadline = _time.time() + 15
+        leader = None
+        while _time.time() < deadline:
+            leaders = [n for n in nodes.values() if n.is_leader()]
+            if leaders:
+                leader = max(leaders, key=lambda n: n.term)
+                break
+            _time.sleep(0.02)
+        assert leader is not None, "no leader elected over TCP"
+        for i in range(3):
+            assert leader.propose({"n": i})
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            if all(len(applied[i]) == 3 for i in applied):
+                break
+            _time.sleep(0.02)
+        assert all(
+            [d["n"] for d in applied[i]] == [0, 1, 2] for i in applied
+        ), applied
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=1)
+        for net in nets:
+            net.close()
