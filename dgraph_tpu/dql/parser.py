@@ -183,10 +183,17 @@ class _P:
         self.text = text
 
     def peek(self) -> Tok:
+        if self.i >= len(self.toks):
+            return self.toks[-1]  # eof sentinel
         return self.toks[self.i]
 
     def next(self) -> Tok:
-        t = self.toks[self.i]
+        t = self.peek()
+        if t.kind == "eof":
+            # consuming past end = malformed input; raising (rather than
+            # returning eof without advancing) keeps `while` loops from
+            # spinning forever on truncated queries
+            raise ParseError(f"unexpected end of input at {t.pos}")
         self.i += 1
         return t
 
@@ -359,7 +366,10 @@ def _parse_list(p: _P) -> list:
     p.expect("[")
     out = []
     while p.peek().text != "]":
-        out.append(_parse_scalar(p))
+        if p.peek().text == "[":
+            out.append(_parse_list(p))  # nested (geo polygons)
+        else:
+            out.append(_parse_scalar(p))
         p.accept(",")
     p.expect("]")
     return out

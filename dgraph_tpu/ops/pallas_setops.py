@@ -37,7 +37,14 @@ LANE = 128
 SUBLANE = 8
 TILE = LANE * SUBLANE  # 1024 u32 per b-tile
 
-_INTERPRET = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+def _default_interpret() -> bool:
+    """Pallas TPU kernels only run compiled on real TPUs; everywhere else
+    use interpret mode. Resolved from the live backend (the env var can
+    disagree with the configured platform, e.g. under the test conftest)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
 
 
 def _member_kernel(lb_ref, a_ref, b_ref, out_ref):
@@ -67,7 +74,7 @@ def _member_kernel(lb_ref, a_ref, b_ref, out_ref):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def membership_small(a128, b_padded, lb, interpret: bool = _INTERPRET):
+def membership_small(a128, b_padded, lb, interpret: bool = False):
     """mask over a128 (shape (128,) uint32) against b_padded (shape (N,)
     uint32, N a multiple of 1024); b validity = index < lb."""
     nb = b_padded.shape[0] // TILE
@@ -88,12 +95,14 @@ def membership_small(a128, b_padded, lb, interpret: bool = _INTERPRET):
     return out[0]
 
 
-def membership(a, la, b, lb, interpret: bool = _INTERPRET):
+def membership(a, la, b, lb, interpret=None):
     """Drop-in replacement for setops.membership when len(a) <= 128.
 
     Handles the sentinel-collision case (0xFFFFFFFF is a legal uid) by
     masking on explicit lengths like the XLA path.
     """
+    if interpret is None:
+        interpret = _default_interpret()
     n = a.shape[0]
     if n > LANE:
         raise ValueError(f"pallas membership path is for <=128 queries, got {n}")
@@ -107,7 +116,7 @@ def membership(a, la, b, lb, interpret: bool = _INTERPRET):
     return hits[:n] & (jnp.arange(n) < la)
 
 
-def intersect(a, la, b, lb, interpret: bool = _INTERPRET):
+def intersect(a, la, b, lb, interpret=None):
     """Pallas-backed intersect for small a (uses sort-based compaction)."""
     from dgraph_tpu.ops import setops
 

@@ -132,7 +132,32 @@ class FuncRunner:
             return self._similar_to(fn, src)
         if name in ("near", "within"):
             return self._geo(fn, name, src)
+        if name == "checkpwd":
+            return self._checkpwd(fn, src)
         raise QueryError(f"function {name!r} not supported")
+
+    def _checkpwd(self, fn: FuncSpec, src) -> np.ndarray:
+        """checkpwd(pred, "pw") — verify a password-type value
+        (ref worker/task.go passwordFn). Salt+PBKDF2 format from acl/."""
+        import hmac as _hmac
+
+        from dgraph_tpu.acl.acl import _hash_password
+
+        cands = src if src is not None else self._scan_data_uids(fn.attr)
+        pw = str(fn.args[0])
+        out = []
+        for u in cands:
+            got = self._value_of(fn.attr, u)
+            if got is None:
+                continue
+            try:
+                raw = bytes.fromhex(str(got.value))
+                salt, want = raw[:16], raw[16:]
+                if _hmac.compare_digest(_hash_password(pw, salt), want):
+                    out.append(int(u))
+            except ValueError:
+                continue
+        return _as_uids(out)
 
     # -- implementations -----------------------------------------------------
 
@@ -498,6 +523,35 @@ class FuncRunner:
             if src is not None:
                 res = np.intersect1d(res, src, assume_unique=True)
             return res
+        if op == "within":
+            # within(loc, [[[lon,lat],...]]) — points inside a polygon
+            # (ref types/geofilter.go queryTokensGeo + filterGeo verify)
+            ring = fn.args[0]
+            if ring and isinstance(ring[0][0], list):
+                ring = ring[0]  # polygon given as [ [ [lon,lat], ... ] ]
+            lons = [float(p[0]) for p in ring]
+            lats = [float(p[1]) for p in ring]
+            # candidate cells: cover the bbox at a radius-matched level
+            lon0, lon1 = min(lons), max(lons)
+            lat0, lat1 = min(lats), max(lats)
+            deg = max(lon1 - lon0, lat1 - lat0, 1e-6) / 2
+            cx, cy = (lon0 + lon1) / 2, (lat0 + lat1) / 2
+            near_fn = FuncSpec(
+                name="near", attr=fn.attr,
+                args=[[cx, cy], deg * 111_000.0 * 1.5],
+            )
+            cands = self._geo(near_fn, "near", src)
+            out = []
+            for u in cands:
+                got = self._value_of(fn.attr, u)
+                if got is None:
+                    continue
+                pt = got.value.get("coordinates", [None, None])
+                if pt[0] is not None and _point_in_poly(
+                    float(pt[0]), float(pt[1]), ring
+                ):
+                    out.append(int(u))
+            return _as_uids(out)
         raise QueryError(f"geo function {op!r} not supported yet")
 
 
@@ -550,6 +604,20 @@ def _levenshtein(a: str, b: str) -> int:
             cur.append(min(prev[j] + 1, cur[-1] + 1, prev[j - 1] + (ca != cb)))
         prev = cur
     return prev[-1]
+
+
+def _point_in_poly(x: float, y: float, ring) -> bool:
+    """Ray casting point-in-polygon."""
+    inside = False
+    n = len(ring)
+    j = n - 1
+    for i in range(n):
+        xi, yi = float(ring[i][0]), float(ring[i][1])
+        xj, yj = float(ring[j][0]), float(ring[j][1])
+        if (yi > y) != (yj > y) and x < (xj - xi) * (y - yi) / (yj - yi) + xi:
+            inside = not inside
+        j = i
+    return inside
 
 
 def _haversine_m(lat1, lon1, lat2, lon2) -> float:

@@ -10,6 +10,7 @@ the same send/drain interface as InProcNetwork, so RaftNode is unchanged.
 
 from __future__ import annotations
 
+import base64
 import json
 import socket
 import socketserver
@@ -17,6 +18,27 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from dgraph_tpu.raft.raft import Message
+
+
+def _jsonize(obj):
+    """Payloads carry bytes (delta keys/records); JSON needs b64 tagging."""
+    if isinstance(obj, bytes):
+        return {"__b64__": base64.b64encode(obj).decode()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonize(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _jsonize(v) for k, v in obj.items()}
+    return obj
+
+
+def _unjsonize(obj):
+    if isinstance(obj, dict):
+        if set(obj.keys()) == {"__b64__"}:
+            return base64.b64decode(obj["__b64__"])
+        return {k: _unjsonize(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unjsonize(x) for x in obj]
+    return obj
 
 
 class TcpNetwork:
@@ -49,7 +71,7 @@ class TcpNetwork:
                         d = json.loads(line)
                         msg = Message(
                             kind=d["k"], frm=d["f"], to=d["t"],
-                            term=d["m"], payload=d["p"],
+                            term=d["m"], payload=_unjsonize(d["p"]),
                         )
                     except (json.JSONDecodeError, KeyError):
                         continue
@@ -90,13 +112,18 @@ class TcpNetwork:
             with self.lock:
                 self.inboxes[msg.to].append(msg)
             return
-        frame = (
-            json.dumps(
-                {"k": msg.kind, "f": msg.frm, "t": msg.to,
-                 "m": msg.term, "p": msg.payload}
-            )
-            + "\n"
-        ).encode()
+        try:
+            frame = (
+                json.dumps(
+                    {"k": msg.kind, "f": msg.frm, "t": msg.to,
+                     "m": msg.term, "p": _jsonize(msg.payload)}
+                )
+                + "\n"
+            ).encode()
+        except (TypeError, ValueError):
+            # an unserializable payload must never kill the tick thread;
+            # raft treats it as a dropped message and retries
+            return
         with self.lock:
             plock = self._send_locks.setdefault(msg.to, threading.Lock())
         with plock:
@@ -122,9 +149,11 @@ class TcpNetwork:
         for srv in self._servers:
             srv.shutdown()
             srv.server_close()
-        for s in self._conns.values():
+        with self.lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for s in conns:
             try:
                 s.close()
             except OSError:
                 pass
-        self._conns.clear()

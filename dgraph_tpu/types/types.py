@@ -209,6 +209,15 @@ def convert(v: Val, to: TypeID) -> Val:
                 return Val(to, np.frombuffer(x, dtype=np.float32).copy())
         if to == TypeID.GEO and src in (TypeID.STRING, TypeID.DEFAULT):
             return Val(to, json.loads(str(x)))
+        if to == TypeID.PASSWORD and src in (TypeID.STRING, TypeID.DEFAULT):
+            # plaintext is hashed at ingest (ref types/conversion.go:220
+            # StringID->PasswordID bcrypt): stored form = hex(salt||PBKDF2)
+            import hashlib as _hl
+            import os as _os
+
+            salt = _os.urandom(16)
+            digest = _hl.pbkdf2_hmac("sha256", str(x).encode(), salt, 10_000)
+            return Val(to, (salt + digest).hex())
         if to == TypeID.BINARY:
             return Val(to, to_binary(v))
     except (ValueError, TypeError) as e:

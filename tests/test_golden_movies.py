@@ -99,9 +99,14 @@ GOLDEN = [
             {"name": "Alien"}, {"name": "Mad Max: Fury Road"}]}]},
     ),
     (
-        "filter tree over ratings and years",
-        """{ q(func: type_unused_placeholder(x)) { uid } }""",
-        None,  # placeholder replaced below
+        "filter tree AND/OR/NOT over ratings and years",
+        """{ q(func: has(rating), orderasc: name)
+             @filter(
+               (ge(rating, 8.1) OR ge(initial_release_date, "2020-01-01"))
+               AND NOT eq(name, "Alien")
+             ) { name } }""",
+        {"q": [{"name": "Blade Runner"}, {"name": "Dune"},
+               {"name": "Mad Max: Fury Road"}]},
     ),
     (
         "terms + inequality filter",

@@ -252,3 +252,43 @@ def test_subscription_acl_safe():
     # commit succeeds even though subscription re-evaluation runs under ACL
     t.mutate_rdf(set_rdf='<0x1> <name> "S" .', access_jwt=g, commit_now=True)
     assert len(events) == 2
+
+
+def test_checkpwd_and_geo_within():
+    s = Server()
+    s.alter("pw: password .\nloc: geo @index(geo) .\nname: string @index(exact) .")
+    t = s.new_txn()
+    t.mutate_rdf(
+        # clients write PLAINTEXT; the type conversion hashes at ingest
+        set_rdf='<0x1> <pw> "s3cret"^^<xs:password> .\n'
+        '<0x1> <name> "u1" .\n'
+        '<0x2> <loc> "{\\"type\\":\\"Point\\",\\"coordinates\\":[10.0,10.0]}"^^<geo:geojson> .\n'
+        '<0x3> <loc> "{\\"type\\":\\"Point\\",\\"coordinates\\":[50.0,50.0]}"^^<geo:geojson> .',
+        commit_now=True,
+    )
+    res = s.query('{ q(func: uid(0x1)) @filter(checkpwd(pw, "s3cret")) { name } }')["data"]
+    assert res["q"] == [{"name": "u1"}]
+    res = s.query('{ q(func: uid(0x1)) @filter(checkpwd(pw, "wrong")) { name } }')["data"]
+    assert res["q"] == []
+    res = s.query(
+        "{ q(func: within(loc, [[[5.0,5.0],[15.0,5.0],[15.0,15.0],[5.0,15.0]]])) { uid } }"
+    )["data"]
+    assert res["q"] == [{"uid": "0x2"}]
+
+
+def test_parser_fuzz_no_crashes():
+    import random
+
+    from dgraph_tpu.dql.parser import ParseError, parse
+
+    rng = random.Random(0)
+    corpus = '{}()@:,"abcfunc eq uid name <x> 0x1 12 /re/ * - . ~f $v as val'
+    pieces = corpus.split(" ") + list('{}()@:,"*-.')
+    for _ in range(800):
+        q = " ".join(rng.choice(pieces) for _ in range(rng.randint(1, 30)))
+        try:
+            parse(q)
+        except ParseError:
+            pass  # the only acceptable failure mode
+        except RecursionError:
+            pass  # deeply nested parens; acceptable guard
