@@ -68,6 +68,74 @@ class SetOpDispatcher:
     def __init__(self):
         self._jit_cache: Dict[Tuple[str, int, int], object] = {}
 
+    # -- shared-big-operand fan-out -----------------------------------------
+
+    def run_rows_vs_one(
+        self,
+        op: str,
+        rows: Sequence[np.ndarray],
+        b: np.ndarray,
+    ) -> List[np.ndarray]:
+        """Apply `op` to each (row, b) with ONE shared b operand — the
+        dominant query shape (uid_matrix rows vs a filter result, recurse
+        frontier vs seen-set). b uploads once per call instead of being
+        replicated per pair. (A cross-call device-resident pack cache
+        needs versioned posting-list identities plumbed through the
+        executor — NOTES_NEXT_ROUND.md §1.)
+
+        Falls back to host ops below the device threshold. u64 inputs with
+        multiple hi-32 segments fall back to the generic pair path."""
+        rows = list(rows)
+        if not rows:
+            return []
+        total = sum(len(r) for r in rows) + len(b)
+        if not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL:
+            return [_np_op(op, r, b) for r in rows]
+        bseg = split_segments(np.asarray(b, np.uint64))
+        row_segs = [split_segments(np.asarray(r, np.uint64)) for r in rows]
+        his = set(bseg)
+        for rs in row_segs:
+            his |= set(rs)
+        if len(his) > 1 or any(len(rs) > 1 for rs in row_segs):
+            return self.run_pairs(op, [(r, b) for r in rows])
+
+        hi = next(iter(his)) if his else 0
+        b32 = bseg.get(hi, np.zeros((0,), np.uint32))
+        pb = _pow2(len(b32))
+        Bd = jnp.asarray(setops.pad_sorted(b32, pb))
+        LB = np.int32(len(b32))
+
+        pa = _pow2(max((len(rs.get(hi, ())) for rs in row_segs), default=1))
+        n = len(rows)
+        nb = _pow2(n)
+        A = np.full((nb, pa), setops.UINT32_MAX, np.uint32)
+        LA = np.zeros((nb,), np.int32)
+        for i, rs in enumerate(row_segs):
+            r32 = rs.get(hi, np.zeros((0,), np.uint32))
+            A[i, : len(r32)] = r32
+            LA[i] = len(r32)
+        fn = self._get_jitted_shared(op, pa, pb)
+        out, cnt = fn(jnp.asarray(A), jnp.asarray(LA), Bd, LB)
+        out = np.asarray(out)
+        cnt = np.asarray(cnt)
+        res = []
+        for i in range(n):
+            res.append(join_segments({hi: out[i, : cnt[i]]}))
+        return res
+
+    def _get_jitted_shared(self, op: str, pa: int, pb: int):
+        key = (op + "#shared", pa, pb)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            base = {
+                "intersect": setops.intersect,
+                "difference": setops.difference,
+                "union": setops.union,
+            }[op]
+            fn = jax.jit(jax.vmap(base, in_axes=(0, 0, None, None)))
+            self._jit_cache[key] = fn
+        return fn
+
     # -- public API ---------------------------------------------------------
 
     def run_pairs(

@@ -143,6 +143,8 @@ class FuncRunner:
 
         from dgraph_tpu.acl.acl import _hash_password
 
+        if not fn.args:
+            raise QueryError("checkpwd(pred, password) requires a password")
         cands = src if src is not None else self._scan_data_uids(fn.attr)
         pw = str(fn.args[0])
         out = []
@@ -526,9 +528,15 @@ class FuncRunner:
         if op == "within":
             # within(loc, [[[lon,lat],...]]) — points inside a polygon
             # (ref types/geofilter.go queryTokensGeo + filterGeo verify)
-            ring = fn.args[0]
-            if ring and isinstance(ring[0][0], list):
+            ring = fn.args[0] if fn.args else None
+            if not isinstance(ring, list) or not ring:
+                raise QueryError("within() requires a non-empty polygon")
+            if isinstance(ring[0], list) and ring[0] and isinstance(ring[0][0], list):
                 ring = ring[0]  # polygon given as [ [ [lon,lat], ... ] ]
+            if len(ring) < 3 or not all(
+                isinstance(pt, list) and len(pt) >= 2 for pt in ring
+            ):
+                raise QueryError("within() polygon needs >=3 [lon,lat] points")
             lons = [float(p[0]) for p in ring]
             lats = [float(p[1]) for p in ring]
             # candidate cells: cover the bbox at a radius-matched level

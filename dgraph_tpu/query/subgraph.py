@@ -255,8 +255,8 @@ class Executor:
             dest = _merge_rows(rows)
             if cgq.filter is not None:
                 dest = self.eval_filter(cgq.filter, dest)
-                cnode.uid_matrix = DISPATCHER.run_pairs(
-                    "intersect", [(r, dest) for r in rows]
+                cnode.uid_matrix = DISPATCHER.run_rows_vs_one(
+                    "intersect", rows, dest
                 )
             if cgq.facet_filter is not None or cgq.facet_order or cgq.facets:
                 self._apply_edge_facets(cnode, cgq, parent, reverse)
@@ -504,8 +504,8 @@ class Executor:
                         new = DISPATCHER.run_pairs(
                             "difference", [(cnode.dest_uids, seen)]
                         )[0]
-                        cnode.uid_matrix = DISPATCHER.run_pairs(
-                            "intersect", [(r, new) for r in cnode.uid_matrix]
+                        cnode.uid_matrix = DISPATCHER.run_rows_vs_one(
+                            "intersect", cnode.uid_matrix, new
                         )
                         cnode.dest_uids = new
                         seen = np.union1d(seen, new)

@@ -55,6 +55,7 @@ class TcpNetwork:
         self._send_locks: Dict[int, threading.Lock] = {}
         self._servers: List[socketserver.ThreadingTCPServer] = []
         self.down: set = set()  # local fault injection parity
+        self._drop_logged: set = set()
 
     # -- server side ---------------------------------------------------------
 
@@ -121,8 +122,17 @@ class TcpNetwork:
                 + "\n"
             ).encode()
         except (TypeError, ValueError):
-            # an unserializable payload must never kill the tick thread;
-            # raft treats it as a dropped message and retries
+            # an unserializable payload must never kill the tick thread —
+            # but a silent drop would retry forever, so log once per type
+            tname = type(msg.payload).__name__
+            if tname not in self._drop_logged:
+                self._drop_logged.add(tname)
+                import logging
+
+                logging.getLogger("dgraph_tpu.raft.tcp").error(
+                    "dropping unserializable raft payload (%s) — "
+                    "these messages can never succeed", tname,
+                )
             return
         with self.lock:
             plock = self._send_locks.setdefault(msg.to, threading.Lock())

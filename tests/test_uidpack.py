@@ -129,3 +129,29 @@ def test_native_layer():
         from dgraph_tpu.codec.uidpack import _bitpack_py
 
         assert native.bitpack(vv, 17) == _bitpack_py(vals & 0x1FFFF, 17)
+
+
+def test_rows_vs_one_shared_operand(monkeypatch):
+    import dgraph_tpu.query.dispatch as dispatch
+
+    monkeypatch.setattr(dispatch, "_DEVICE_MIN_TOTAL", 0)
+    rng = np.random.default_rng(31)
+    d = dispatch.SetOpDispatcher()
+    b = _rand_uids(rng, 2000, hi=1 << 31)
+    rows = [_rand_uids(rng, int(n), hi=1 << 31) for n in (5, 120, 0, 700)]
+    for op, ref in [
+        ("intersect", lambda a: np.intersect1d(a, b, assume_unique=True)),
+        ("difference", lambda a: np.setdiff1d(a, b, assume_unique=True)),
+        ("union", lambda a: np.union1d(a, b)),
+    ]:
+        got = d.run_rows_vs_one(op, rows, b)
+        for r, g in zip(rows, got):
+            np.testing.assert_array_equal(np.asarray(g, np.uint64), ref(r), err_msg=op)
+
+    # multi-segment operands fall back to the generic pair path correctly
+    b2 = np.concatenate([b, (np.uint64(5) << np.uint64(32)) + np.arange(3, dtype=np.uint64)])
+    got = d.run_rows_vs_one("intersect", rows, np.sort(b2))
+    for r, g in zip(rows, got):
+        np.testing.assert_array_equal(
+            np.asarray(g, np.uint64), np.intersect1d(r, b2, assume_unique=True)
+        )
