@@ -114,7 +114,8 @@ class _Handler(BaseHTTPRequestHandler):
         # (ref edgraph alter/admin guardian checks)
         _GUARDED = (
             "/alter", "/admin/export", "/admin/backup",
-            "/admin/schema/graphql",
+            "/admin/schema/graphql", "/admin/draining", "/admin/shutdown",
+            "/admin/task",
             # GraphQL resolvers run inside the engine without per-predicate
             # enforcement this round; guardian-only when ACL is on (the
             # reference gates GraphQL with its own @auth system instead)
@@ -164,6 +165,10 @@ class _Handler(BaseHTTPRequestHandler):
                 }
                 self._reply(res)
             elif path == "/mutate":
+                if getattr(self.engine, "draining", False):
+                    return self._error(
+                        "the server is in draining mode", 503
+                    )
                 self._count("num_mutations")
                 self._handle_mutate(qs, token)
             elif path == "/commit":
@@ -178,6 +183,8 @@ class _Handler(BaseHTTPRequestHandler):
                 commit_ts = txn.commit()
                 self._reply({"data": {"code": "Success", "commitTs": commit_ts}})
             elif path == "/alter":
+                if getattr(self.engine, "draining", False):
+                    return self._error("the server is in draining mode", 503)
                 body = self._body().decode("utf-8")
                 try:
                     op = json.loads(body)
@@ -210,16 +217,54 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/admin/export":
                 import tempfile
 
-                from dgraph_tpu.admin.export import export
+                from dgraph_tpu.admin import tasks
 
-                out = export(self.engine, tempfile.mkdtemp(prefix="dgraph_export_"))
-                self._reply({"data": {"code": "Success", **out}})
+                out_dir = qs.get(
+                    "destination", [tempfile.mkdtemp(prefix="dgraph_export_")]
+                )[0]
+                tid = tasks.enqueue_export(self.engine, out_dir)
+                st = tasks._queue_of(self.engine).wait(tid)
+                ok = st.get("status") == "Success"
+                self._reply(
+                    {"data": {"code": st.get("status", "Unknown"), **st}},
+                    200 if ok else 500,
+                )
             elif path == "/admin/backup":
-                from dgraph_tpu.admin.backup import backup
+                from dgraph_tpu.admin import tasks
 
                 dest = qs.get("destination", ["/tmp/dgraph_tpu_backup"])[0]
-                entry = backup(self.engine, dest)
-                self._reply({"data": {"code": "Success", **entry}})
+                tid = tasks.enqueue_backup(self.engine, dest)
+                if qs.get("wait", ["true"])[0] == "true":
+                    st = tasks._queue_of(self.engine).wait(tid)
+                    ok = st.get("status") == "Success"
+                    self._reply(
+                        {"data": {"code": st.get("status", "Unknown"), **st}},
+                        200 if ok else 500,
+                    )
+                else:
+                    self._reply(
+                        {"data": {"code": "Success", "taskId": f"{tid:#x}"}}
+                    )
+            elif path == "/admin/task":
+                tid = int(qs.get("id", ["0"])[0], 16)
+                from dgraph_tpu.admin import tasks
+
+                st = tasks._queue_of(self.engine).status(tid)
+                if st is None:
+                    return self._error(f"no task {tid:#x}", 404)
+                self._reply({"data": st})
+            elif path == "/admin/draining":
+                enable = qs.get("enable", ["true"])[0] == "true"
+                self.engine.draining = enable
+                self._reply(
+                    {"data": {"code": "Success",
+                              "message": f"draining mode set to {enable}"}}
+                )
+            elif path == "/admin/shutdown":
+                self._reply({"data": {"code": "Success", "message": "Done"}})
+                threading.Thread(
+                    target=self.server.shutdown, daemon=True
+                ).start()
             else:
                 self._error(f"no route {path}", 404)
         except TxnConflictError as e:

@@ -267,6 +267,8 @@ class GraphQLServer:
     # ------------------------------------------------------------------
 
     def _resolve_mutation(self, sel: Selection):
+        if getattr(self.engine, "draining", False):
+            raise GraphQLError("the server is in draining mode")
         name = sel.name
         if name.startswith("add"):
             return self._add(self._type_for(name, ["add"]), sel)

@@ -143,3 +143,86 @@ def test_cli_bulk_export_debug_increment(tmp_path):
     with contextlib.redirect_stdout(buf):
         main(["increment", "-p", pdir, "--num", "3"])
     assert "counter: 3" in buf.getvalue()
+
+
+def test_task_queue_serializes_ops(tmp_path):
+    import time
+
+    from dgraph_tpu.admin import tasks
+
+    s = Server()
+    s.alter(SCHEMA)
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='<0x1> <name> "T" .', commit_now=True)
+
+    order = []
+    tq = tasks._queue_of(s)
+
+    def slow(tag):
+        def run():
+            order.append(("start", tag))
+            time.sleep(0.05)
+            order.append(("end", tag))
+            return tag
+        return run
+
+    t1 = tq.enqueue(tasks.KIND_EXPORT, slow("a"))
+    t2 = tq.enqueue(tasks.KIND_BACKUP, slow("b"))
+    assert tq.wait(t1)["status"] == "Success"
+    assert tq.wait(t2)["status"] == "Success"
+    # strictly serialized: no interleaving
+    assert order == [("start", "a"), ("end", "a"), ("start", "b"), ("end", "b")]
+
+    # real ops through the queue
+    tid = tasks.enqueue_backup(s, str(tmp_path / "b"))
+    st = tq.wait(tid)
+    assert st["status"] == "Success" and st["result"]["records"] > 0
+    tid = tasks.enqueue_rollup(s, min_deltas=1)
+    assert tq.wait(tid)["status"] == "Success"
+    # failures recorded, queue survives
+    tid = tq.enqueue(tasks.KIND_EXPORT, lambda: 1 / 0)
+    st = tq.wait(tid)
+    assert st["status"] == "Failed" and "division" in st["error"]
+    assert len(tq.list()) == 5
+
+
+def test_http_draining_and_task_status(tmp_path):
+    import json as _json
+    import urllib.request as ur
+    import urllib.error
+
+    from dgraph_tpu.api.http_server import HTTPServer
+
+    s = Server()
+    s.alter(SCHEMA)
+    srv = HTTPServer(s, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def post(path, body=""):
+        req = ur.Request(base + path, data=body.encode(), method="POST")
+        try:
+            with ur.urlopen(req) as r:
+                return r.status, _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read())
+
+    code, _ = post("/admin/draining?enable=true")
+    assert code == 200
+    code, body = post("/mutate?commitNow=true", '{ set { <0x1> <name> "X" . } }')
+    assert code == 503
+    post("/admin/draining?enable=false")
+    code, _ = post("/mutate?commitNow=true", '{ set { <0x1> <name> "X" . } }')
+    assert code == 200
+    # async backup + task status poll
+    code, body = post(f"/admin/backup?destination={tmp_path}/bk&wait=false")
+    tid = body["data"]["taskId"]
+    import time as _t
+
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        code, st = post(f"/admin/task?id={tid}")
+        if st["data"]["status"] in ("Success", "Failed"):
+            break
+        _t.sleep(0.05)
+    assert st["data"]["status"] == "Success"
+    srv.stop()
