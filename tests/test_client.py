@@ -1,0 +1,77 @@
+"""pydgraph-style client tests against a live HTTP server."""
+
+import pytest
+
+from dgraph_tpu.api.http_server import HTTPServer
+from dgraph_tpu.api.server import Server
+from dgraph_tpu.client import DgraphClient, DgraphClientError, RetriableError
+
+
+@pytest.fixture()
+def live():
+    engine = Server()
+    srv = HTTPServer(engine, port=0).start()
+    yield engine, DgraphClient(f"http://127.0.0.1:{srv.port}")
+    srv.stop()
+
+
+def test_full_client_flow(live):
+    engine, c = live
+    c.alter(schema="name: string @index(exact) @upsert .\nfriend: [uid] .")
+    txn = c.txn()
+    out = txn.mutate(set_rdf='_:a <name> "Ada" . _:a <friend> _:b . _:b <name> "Bo" .')
+    assert "a" in out["uids"]
+    txn.commit()
+    res = c.query('{ q(func: eq(name, "Ada")) { name friend { name } } }')
+    assert res["data"]["q"][0]["friend"][0]["name"] == "Bo"
+    # json mutation + discard leaves no trace
+    txn = c.txn()
+    txn.mutate(set_obj={"uid": "_:x", "name": "Ghost"})
+    txn.discard()
+    res = c.query('{ q(func: eq(name, "Ghost")) { uid } }')
+    assert res["data"]["q"] == []
+    # conflict maps to RetriableError
+    t1, t2 = c.txn(), c.txn()
+    t1.mutate(set_rdf='<0x1> <name> "A" .')
+    t2.mutate(set_rdf='<0x1> <name> "B" .')
+    t1.commit()
+    with pytest.raises(RetriableError):
+        t2.commit()
+    assert c.health()[0]["status"] == "healthy"
+
+
+def test_client_acl_login_and_refresh(live):
+    engine, c = live
+    engine.alter("name: string @index(exact) .")
+    engine.enable_acl(secret=b"c" * 32)
+    with pytest.raises(DgraphClientError):
+        c.query("{ q(func: has(name)) { uid } }")
+    c.login("groot", "password")
+    assert c.query("{ q(func: has(name)) { uid } }")["data"]["q"] == []
+    # expired access token: client refreshes transparently
+    c._access = c._access[:-2] + "xx"  # corrupt -> 401 -> refresh path
+    assert c.query("{ q(func: has(name)) { uid } }")["data"]["q"] == []
+
+
+def test_client_graphql(live):
+    engine, c = live
+    c.set_graphql_schema("type Item { id: ID! sku: String! @search(by: [exact]) }")
+    c.graphql('mutation { addItem(input: [{sku: "X1"}]) { numUids } }')
+    out = c.graphql(
+        "query q($s: String!) { queryItem(filter: {sku: {eq: $s}}) { sku } }",
+        variables={"s": "X1"},
+    )
+    assert out["data"]["queryItem"] == [{"sku": "X1"}]
+
+
+def test_discard_after_failed_commit_is_noop(live):
+    engine, c = live
+    c.alter(schema="v: string @index(exact) @upsert .")
+    t1, t2 = c.txn(), c.txn()
+    t1.mutate(set_rdf='<0x5> <v> "a" .')
+    t2.mutate(set_rdf='<0x5> <v> "b" .')
+    t1.commit()
+    with pytest.raises(RetriableError):
+        t2.commit()
+    t2.discard()  # must not raise (canonical retry pattern)
+    assert t2.finished
