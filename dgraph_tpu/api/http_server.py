@@ -19,6 +19,8 @@ from urllib.parse import parse_qs, urlparse
 
 from dgraph_tpu.acl.acl import AclError
 from dgraph_tpu.acl.jwt import JwtError
+from dgraph_tpu.dql.parser import ParseError
+from dgraph_tpu.query.functions import QueryError
 from dgraph_tpu.api.server import Server, TxnHandle
 from dgraph_tpu.zero.zero import TxnConflictError
 
@@ -155,8 +157,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply({"data": toks})
             elif path == "/query":
                 self._count("num_queries")
+                raw = self._body().decode("utf-8")
+                variables = None
+                if "json" in self.headers.get("Content-Type", ""):
+                    body = json.loads(raw)
+                    if not isinstance(body, dict):
+                        raise ValueError("JSON query body must be an object")
+                    raw = body.get("query", "")
+                    variables = body.get("variables")
+                    if variables is not None and not isinstance(variables, dict):
+                        raise ValueError('"variables" must be an object')
                 res = self.engine.query(
-                    self._body().decode("utf-8"), access_jwt=token
+                    raw, access_jwt=token, variables=variables
                 )
                 res["extensions"] = {
                     "server_latency": {
@@ -271,8 +283,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(f"Transaction has been aborted. Please retry. {e}", 409)
         except (AclError, JwtError) as e:
             self._error(e, 401)
-        except (json.JSONDecodeError, ValueError) as e:
-            self._error(e, 400)  # malformed client input
+        except (json.JSONDecodeError, ValueError, ParseError, QueryError) as e:
+            self._error(e, 400)  # malformed client input/query
         except Exception as e:
             traceback.print_exc()
             self._error(e, 500)

@@ -601,10 +601,11 @@ class Server:
         q: str,
         read_ts: Optional[int] = None,
         access_jwt: Optional[str] = None,
+        variables: Optional[Dict[str, str]] = None,
     ) -> dict:
         """Run a read-only query at a fresh (or given) read ts."""
         ts = read_ts if read_ts is not None else self.zero.read_ts()
-        blocks = dql.parse(q)
+        blocks = dql.parse(q, variables)
         ns = keys.GALAXY_NS
         allowed = None
         user = ""

@@ -132,7 +132,13 @@ class DgraphClient:
 
     # -- queries ----------------------------------------------------------------
 
-    def query(self, q: str) -> dict:
+    def query(self, q: str, variables: Optional[Dict[str, str]] = None) -> dict:
+        if variables:
+            return self._do(
+                "/query",
+                json.dumps({"query": q, "variables": variables}),
+                ctype="application/json",
+            )
         return self._do("/query", q)
 
     def graphql(

@@ -177,10 +177,11 @@ class GraphQuery:
 
 
 class _P:
-    def __init__(self, toks: List[Tok], text: str):
+    def __init__(self, toks: List[Tok], text: str, variables=None):
         self.toks = toks
         self.i = 0
         self.text = text
+        self.vars: Dict[str, Any] = variables or {}
 
     def peek(self) -> Tok:
         if self.i >= len(self.toks):
@@ -230,14 +231,18 @@ def _parse_scalar(p: "_P"):
     `a - 3` in math context tokenizes as three tokens)."""
     if p.peek().text == "-":
         p.next()
-        v = _parse_value(p.next())
+        v = _parse_value(p.next(), p)
         if not isinstance(v, (int, float)):
             raise ParseError("unary minus on non-number")
         return -v
-    return _parse_value(p.next())
+    return _parse_value(p.next(), p)
 
 
-def _parse_value(t: Tok):
+def _parse_value(t: Tok, p: Optional["_P"] = None):
+    if t.kind == "name" and t.text.startswith("$"):
+        if p is None or t.text not in p.vars:
+            raise ParseError(f"undefined query variable {t.text} at {t.pos}")
+        return p.vars[t.text]
     if t.kind == "regex":
         # /pattern/flags -> ("regex", pattern, flags)
         end = t.text.rindex("/")
@@ -270,6 +275,18 @@ def _parse_lang_chain(p: _P) -> str:
     return ":".join(parts)
 
 
+def _uid_value(v, t: Tok) -> int:
+    """Coerce a query-variable value into a uid."""
+    try:
+        if isinstance(v, str):
+            return int(v, 16) if v.startswith("0x") else int(v)
+        return int(v)
+    except (TypeError, ValueError):
+        raise ParseError(
+            f"variable {t.text} is not a valid uid: {v!r}"
+        ) from None
+
+
 def _parse_name_with_lang(p: _P) -> tuple[str, str]:
     name = _strip_angle(p.next().text)
     lang = ""
@@ -287,12 +304,14 @@ def parse_func(p: _P) -> FuncSpec:
     p.expect("(")
     fn = FuncSpec(name=name)
     if name == "uid":
-        # uid(0x1, 0x2) or uid(varname)
+        # uid(0x1, 0x2) or uid(varname) or uid($queryvar)
         args = []
         while p.peek().text != ")":
             t = p.next()
             if t.kind == "num":
                 args.append(int(t.text, 16) if t.text.startswith("0x") else int(t.text))
+            elif t.kind == "name" and t.text.startswith("$"):
+                args.append(_uid_value(_parse_value(t, p), t))
             elif t.kind == "name":
                 fn.uid_var = t.text
             p.accept(",")
@@ -309,6 +328,8 @@ def parse_func(p: _P) -> FuncSpec:
                 fn.args.append(
                     int(t.text, 16) if t.text.startswith("0x") else int(t.text)
                 )
+            elif t.kind == "name" and t.text.startswith("$"):
+                fn.args.append(_uid_value(_parse_value(t, p), t))
             elif t.text == "uid":
                 p.expect("(")
                 fn.uid_var = p.next().text
@@ -346,8 +367,6 @@ def parse_func(p: _P) -> FuncSpec:
         if t.text == "[":
             fn.args.append(_parse_list(p))
             continue
-        if t.text == "$":
-            raise ParseError("GraphQL variables not yet supported")
         fn.args.append(_parse_scalar(p))
     p.expect(")")
     return fn
@@ -532,11 +551,12 @@ def _parse_args_into(p: _P, gq: GraphQuery, stop: str = ")"):
         elif key == "to":
             gq.shortest_to = _parse_uid_or_var(p)
         elif key == "numpaths":
-            gq.num_paths = int(p.next().text)
+            gq.num_paths = int(_parse_scalar(p))
         elif key == "depth":
-            gq.recurse_depth = int(p.next().text)
+            gq.recurse_depth = int(_parse_scalar(p))
         elif key == "loop":
-            gq.recurse_loop = p.next().text == "true"
+            v = _parse_scalar(p)
+            gq.recurse_loop = v if isinstance(v, bool) else str(v) == "true"
         else:
             raise ParseError(f"unknown query arg {key!r}")
         p.accept(",")
@@ -740,9 +760,55 @@ def parse_query_block(p: _P) -> GraphQuery:
     return gq
 
 
-def parse(text: str) -> List[GraphQuery]:
-    """Parse a DQL read query -> list of root blocks."""
-    p = _P(tokenize(text), text)
+_VAR_TYPES = ("string", "int", "float", "bool", "uid", "default")
+
+
+def _coerce_var(value, type_name: str):
+    if type_name not in _VAR_TYPES:
+        raise ParseError(
+            f"unknown query variable type {type_name!r} "
+            f"(expected one of {_VAR_TYPES})"
+        )
+    try:
+        if type_name in ("int",):
+            return int(value)
+        if type_name in ("float",):
+            return float(value)
+        if type_name in ("bool",):
+            return value if isinstance(value, bool) else str(value).lower() == "true"
+    except (TypeError, ValueError):
+        raise ParseError(
+            f"query variable value {value!r} does not match type {type_name}"
+        ) from None
+    return value
+
+
+def parse(text: str, variables=None) -> List[GraphQuery]:
+    """Parse a DQL read query -> list of root blocks.
+
+    Supports the `query name($a: string = "dflt") { ... }` prologue
+    (ref dql/parser.go parseQueryWithVars); `variables` maps "$a" -> value.
+    """
+    p = _P(tokenize(text), text, variables=dict(variables or {}))
+    if p.peek().text == "query":
+        p.next()
+        if p.peek().kind == "name" and not p.peek().text.startswith("$"):
+            p.next()  # operation name
+        if p.accept("("):
+            while p.peek().text != ")":
+                vname = p.next().text
+                if not vname.startswith("$"):
+                    raise ParseError(f"expected $var, got {vname!r}")
+                p.expect(":")
+                tname = p.next().text.lower()
+                if p.accept("="):
+                    default = _parse_scalar(p)
+                    p.vars.setdefault(vname, default)
+                if vname not in p.vars:
+                    raise ParseError(f"missing value for variable {vname}")
+                p.vars[vname] = _coerce_var(p.vars[vname], tname)
+                p.accept(",")
+            p.expect(")")
     p.expect("{")
     blocks: List[GraphQuery] = []
     while not p.accept("}"):

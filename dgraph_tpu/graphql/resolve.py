@@ -239,13 +239,52 @@ class GraphQLServer:
         return res[0] if res else None
 
     def _aggregate(self, t: GqlType, sel: Selection) -> dict:
+        """aggregateT(filter) { count fieldMin fieldMax fieldSum fieldAvg }
+        (ref gqlschema.go aggregate type synthesis)."""
         gq = GraphQuery(attr="q")
         gq.func = FuncSpec(name="type", attr=t.name)
         gq.filter = self._filter_tree(t, sel.args.get("filter"))
-        gq.children = [GraphQuery(attr="uid", is_count=True, alias="count")]
+        count_key = next(
+            (s.key for s in sel.selections if s.name == "count"), "count"
+        )
+        gq.children = [GraphQuery(attr="uid", is_count=True, alias=count_key)]
+
+        # map selections like ageMin/ageMax/ageSum/ageAvg to aggregators
+        aggs = []  # (sel_key, field, op)
+        for s in sel.selections:
+            if s.name == "count":
+                continue
+            for suffix, op in (
+                ("Min", "min"), ("Max", "max"), ("Sum", "sum"), ("Avg", "avg"),
+            ):
+                if s.name.endswith(suffix):
+                    fname = s.name[: -len(suffix)]
+                    f = t.fields.get(fname)
+                    if f is not None and f.is_scalar:
+                        aggs.append((s.key, fname, op))
+                    break
+        var_of = {}
+        for i, (_, fname, _) in enumerate(aggs):
+            if fname not in var_of:
+                var_of[fname] = f"v{i}"
+                gq.children.append(
+                    GraphQuery(
+                        attr=f"{t.name}.{fname}", var_name=var_of[fname]
+                    )
+                )
+        for key, fname, op in aggs:
+            gq.children.append(
+                GraphQuery(aggregator=op, val_var=var_of[fname], alias=key)
+            )
         res = self._run_block(gq)
-        count = res[0]["count"] if res else 0
-        return {"count": count}
+        out = {count_key: 0}
+        for obj in res:
+            out.update(obj)
+        wanted = {s.key for s in sel.selections}
+        out = {k: v for k, v in out.items() if k in wanted}
+        for s in sel.selections:  # absent aggregates -> null
+            out.setdefault(s.key, None)
+        return out
 
     def _similar(self, t: GqlType, sel: Selection) -> List[dict]:
         by = sel.args.get("by")

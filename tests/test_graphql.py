@@ -209,3 +209,24 @@ def test_vector_embedding_sdl():
         """
     )
     assert res["data"]["querySimilarProductByEmbedding"][0]["name"] == "p1"
+
+
+def test_aggregate_fields(gql):
+    gql.execute(
+        'mutation { addAuthor(input: [{name: "G1", age: 10}, '
+        '{name: "G2", age: 30}]) { numUids } }'
+    )
+    res = gql.execute(
+        "query { aggregateAuthor(filter: {name: {anyofterms: \"g1 g2\"}}) "
+        "{ count ageMin ageMax ageSum ageAvg } }"
+    )
+    agg = res["data"]["aggregateAuthor"]
+    assert agg["count"] == 2
+    assert agg["ageMin"] == 10 and agg["ageMax"] == 30
+    assert agg["ageSum"] == 40 and agg["ageAvg"] == 20.0
+
+
+def test_aggregate_aliased_count(gql):
+    gql.execute('mutation { addAuthor(input: [{name: "AC"}]) { numUids } }')
+    res = gql.execute("query { aggregateAuthor { c: count } }")
+    assert res["data"]["aggregateAuthor"]["c"] >= 1

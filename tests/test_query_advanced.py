@@ -292,3 +292,47 @@ def test_parser_fuzz_no_crashes():
             pass  # the only acceptable failure mode
         except RecursionError:
             pass  # deeply nested parens; acceptable guard
+
+
+def test_dql_query_variables():
+    s = _server()
+    res = s.query(
+        'query people($n: string, $min: int = 20) '
+        "{ q(func: eq(name, $n)) @filter(ge(age, $min)) { name age } }",
+        variables={"$n": "Alice"},
+    )["data"]
+    assert res["q"] == [{"name": "Alice", "age": 30}]
+    # default value used
+    res = s.query(
+        'query v($lim: int = 1) { q(func: has(age), first: $lim) { uid } }'
+    )["data"]
+    assert len(res["q"]) == 1
+    # missing required variable
+    from dgraph_tpu.dql.parser import ParseError
+
+    with pytest.raises(ParseError):
+        s.query('query q($x: string) { q(func: eq(name, $x)) { uid } }')
+    # type mismatch
+    with pytest.raises(ParseError):
+        s.query(
+            'query q($x: int) { q(func: ge(age, $x)) { uid } }',
+            variables={"$x": "notanint"},
+        )
+
+
+def test_query_vars_in_uid_depth_and_negative_default():
+    s = _server()
+    res = s.query(
+        "query q($u: uid) { q(func: uid($u)) { name } }",
+        variables={"$u": "0x1"},
+    )["data"]
+    assert res["q"] == [{"name": "Alice"}]
+    res = s.query(
+        "query q($d: int = -1) { q(func: has(age), first: $d) { uid } }"
+    )["data"]
+    assert len(res["q"]) == 1  # first: -1 = last one
+    from dgraph_tpu.dql.parser import ParseError
+
+    with pytest.raises(ParseError):
+        s.query("query q($x: in) { q(func: ge(age, $x)) { uid } }",
+                variables={"$x": "5"})
