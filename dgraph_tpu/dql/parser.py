@@ -154,6 +154,7 @@ class GraphQuery:
     recurse_depth: int = 0
     recurse_loop: bool = False
     normalize: bool = False
+    ignore_reflex: bool = False
     # math & groupby
     math_expr: Optional["MathNode"] = None
     groupby_attrs: List[str] = field(default_factory=list)
@@ -585,6 +586,8 @@ def _parse_directives(p: _P, gq: GraphQuery):
             gq.cascade = True
         elif d == "normalize":
             gq.normalize = True
+        elif d == "ignorereflex":
+            gq.ignore_reflex = True
         elif d == "recurse":
             gq.recurse = True
             if p.accept("("):
@@ -775,7 +778,14 @@ def _coerce_var(value, type_name: str):
         if type_name in ("float",):
             return float(value)
         if type_name in ("bool",):
-            return value if isinstance(value, bool) else str(value).lower() == "true"
+            if isinstance(value, bool):
+                return value
+            sv = str(value).lower()
+            if sv in ("true", "1"):
+                return True
+            if sv in ("false", "0"):
+                return False
+            raise ValueError(value)
     except (TypeError, ValueError):
         raise ParseError(
             f"query variable value {value!r} does not match type {type_name}"

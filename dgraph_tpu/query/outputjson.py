@@ -101,8 +101,12 @@ class JsonEncoder:
                 for p in node.paths  # type: ignore[attr-defined]
             ]
 
+        ancestors = frozenset()
         for i, u in enumerate(node.dest_uids):
-            obj = self.encode_entity(node, int(u), i)
+            obj = self.encode_entity(
+                node, int(u), i,
+                ancestors=ancestors if node.gq.ignore_reflex else None,
+            )
             if obj:
                 if node.gq.normalize:
                     for flat in _normalize_flatten(obj):
@@ -112,9 +116,16 @@ class JsonEncoder:
         return out
 
     def encode_entity(
-        self, node: ExecNode, uid: int, row: int
+        self, node: ExecNode, uid: int, row: int, ancestors=None
     ) -> Dict[str, Any]:
+        """ancestors: when not None, @ignorereflex is active — edges back
+        to any uid on the current path are dropped at encode time (the
+        only place the actual path exists; matrix rows are shared across
+        parents so executor-side pruning cannot be path-correct)."""
         obj: Dict[str, Any] = {}
+        banned = None
+        if ancestors is not None:
+            banned = ancestors | {uid}
         for c in node.children:
             # per-node caches: display name and dest-uid index are loop
             # invariants; rebuilding them per parent entity made encoding
@@ -142,7 +153,13 @@ class JsonEncoder:
             elif gq.is_count:
                 if gq.attr == "uid":
                     continue
-                obj[name] = c.counts.get(uid, 0)
+                if banned is not None and c.is_uid_pred:
+                    r = c.uid_matrix[row] if row < len(c.uid_matrix) else []
+                    obj[name] = int(
+                        sum(1 for v in r if int(v) not in banned)
+                    )
+                else:
+                    obj[name] = c.counts.get(uid, 0)
             elif c.is_uid_pred:
                 kids = []
                 r = c.uid_matrix[row] if row < len(c.uid_matrix) else []
@@ -153,8 +170,13 @@ class JsonEncoder:
                     }
                 fmaps = getattr(c, "edge_facet_maps", None)
                 for v in r:
+                    if banned is not None and int(v) in banned:
+                        continue  # @ignorereflex: path back-edge
                     kid = (
-                        self.encode_entity(c, int(v), dest_idx.get(int(v), 0))
+                        self.encode_entity(
+                            c, int(v), dest_idx.get(int(v), 0),
+                            ancestors=banned,
+                        )
                         if c.children
                         else {}
                     )

@@ -559,27 +559,29 @@ class Executor:
     def _order_uids(self, gq: GraphQuery, uids: np.ndarray) -> np.ndarray:
         if not len(uids) or not gq.order:
             return uids
-        o = gq.order[0]
 
-        def key_of(u):
+        def key_of(o: Order, u):
             if o.val_var:
-                v = self.val_vars.get(o.val_var, {}).get(int(u))
-            else:
-                v = self.cache.value(
-                    keys.DataKey(o.attr, int(u), self.ns), o.lang
-                )
-            return v
-
-        vals = [(key_of(u), int(u)) for u in uids]
-        present = [(v, u) for v, u in vals if v is not None]
-        missing = [u for v, u in vals if v is None]
-        try:
-            present.sort(
-                key=lambda t: _sort_key_of(t[0]), reverse=o.desc
+                return self.val_vars.get(o.val_var, {}).get(int(u))
+            return self.cache.value(
+                keys.DataKey(o.attr, int(u), self.ns), o.lang
             )
+
+        # multi-key ordering: stable sorts applied in reverse key order
+        # (ref query.go multiSort); missing-valued uids sink to the end
+        ordered = [int(u) for u in uids]
+        try:
+            for o in reversed(gq.order):
+                vals = {u: key_of(o, u) for u in ordered}
+                present = [u for u in ordered if vals[u] is not None]
+                missing = [u for u in ordered if vals[u] is None]
+                present.sort(
+                    key=lambda u: _sort_key_of(vals[u]), reverse=o.desc
+                )
+                ordered = present + missing
         except TypeError:
-            raise QueryError(f"unorderable values for {o.attr or o.val_var}")
-        ordered = [u for _, u in present] + missing
+            names = ", ".join(o.attr or o.val_var for o in gq.order)
+            raise QueryError(f"unorderable values for {names}") from None
         return np.array(ordered, dtype=np.uint64)
 
     # ------------------------------------------------------------------

@@ -314,3 +314,63 @@ def test_blank_nodes_and_json_mutation(server):
         "data"
     ]
     assert res["q"][0]["friend"][0]["name"] == "Bob"
+
+
+def test_multi_key_ordering(server):
+    # ages tie at 15: name breaks the tie; then desc age primary
+    res = server.query(
+        "{ q(func: has(age), orderasc: age, orderasc: name) { name age } }"
+    )["data"]
+    assert [o["name"] for o in res["q"]][:2] == ["Glenn Rhee", "Rick Grimes"]
+    res = server.query(
+        "{ q(func: has(age), orderdesc: age, orderasc: name, first: 3) { age } }"
+    )["data"]
+    assert [o["age"] for o in res["q"]] == [38, 19, 17]
+
+
+def test_ignorereflex(server):
+    # Michonne <-> Rick are mutual friends; @ignorereflex drops the
+    # back-edge to the parent
+    res = server.query(
+        "{ q(func: uid(0x1)) @ignorereflex { name friend { name friend { name } } } }"
+    )["data"]
+    rick = [f for f in res["q"][0]["friend"] if f["name"] == "Rick Grimes"][0]
+    assert "friend" not in rick or all(
+        g["name"] != "Michonne" for g in rick.get("friend", [])
+    )
+
+
+def test_ignorereflex_path_correctness():
+    # review repros: shared child reached from two parents keeps the
+    # non-ancestor edge on each path; self-loops pruned without losing
+    # sibling subtrees; counts agree with pruned lists
+    s = Server()
+    s.alter("name: string @index(exact) .\nfriend: [uid] @count .")
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='''
+    <0xa> <name> "A" . <0xb> <name> "B" . <0xc> <name> "C" .
+    <0xa> <friend> <0xc> . <0xb> <friend> <0xc> .
+    <0xc> <friend> <0xa> . <0xc> <friend> <0xb> .
+    <0xd> <name> "D" . <0xe> <name> "E" . <0xf> <name> "F" .
+    <0xd> <friend> <0xd> . <0xd> <friend> <0xe> . <0xe> <friend> <0xf> .
+    ''', commit_now=True)
+    res = s.query(
+        "{ q(func: uid(0xa, 0xb)) @ignorereflex { name friend { name friend { name } } } }"
+    )["data"]
+    by = {o["name"]: o for o in res["q"]}
+    # under A, C keeps friend B; under B, C keeps friend A
+    assert [g["name"] for g in by["A"]["friend"][0]["friend"]] == ["B"]
+    assert [g["name"] for g in by["B"]["friend"][0]["friend"]] == ["A"]
+    # self-loop pruned, sibling subtree intact
+    res = s.query(
+        "{ q(func: uid(0xd)) @ignorereflex { name friend { name friend { name } } } }"
+    )["data"]
+    d = res["q"][0]
+    assert [f["name"] for f in d["friend"]] == ["E"]
+    assert [g["name"] for g in d["friend"][0]["friend"]] == ["F"]
+    # count matches pruned list
+    res = s.query(
+        "{ q(func: uid(0xa)) @ignorereflex { friend { name c: count(friend) friend { name } } } }"
+    )["data"]
+    c_obj = res["q"][0]["friend"][0]
+    assert c_obj["c"] == len(c_obj.get("friend", []))
