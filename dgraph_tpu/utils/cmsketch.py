@@ -1,0 +1,70 @@
+"""Count-Min sketch for index-selectivity stats.
+
+Mirrors /root/reference/algo/cm-sketch.go (CountMinSketch:39, itself from
+BoomFilters): probabilistic (attr, token) -> frequency estimates used for
+eq-filter planning (ref worker/task.go:1881 planForEqFilter with
+posting/stats.go StatsHolder). numpy-vectorized update/query.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import struct
+
+import numpy as np
+
+
+class CountMinSketch:
+    def __init__(self, epsilon: float = 0.001, delta: float = 0.01):
+        """epsilon: relative accuracy; delta: error probability
+        (ref cm-sketch.go NewCountMinSketch)."""
+        self.width = int(math.ceil(math.e / epsilon))
+        self.depth = int(math.ceil(math.log(1.0 / delta)))
+        self.matrix = np.zeros((self.depth, self.width), dtype=np.uint64)
+        self.count = 0
+
+    def _indexes(self, key: bytes) -> np.ndarray:
+        # double hashing: h_i = h1 + i*h2 (Kirsch-Mitzenmacher)
+        d = hashlib.blake2b(key, digest_size=16).digest()
+        h1, h2 = struct.unpack("<QQ", d)
+        i = np.arange(self.depth, dtype=np.uint64)
+        return (np.uint64(h1) + i * np.uint64(h2 | 1)) % np.uint64(self.width)
+
+    def add(self, key: bytes, count: int = 1):
+        idx = self._indexes(key)
+        self.matrix[np.arange(self.depth), idx] += np.uint64(count)
+        self.count += count
+
+    def estimate(self, key: bytes) -> int:
+        idx = self._indexes(key)
+        return int(self.matrix[np.arange(self.depth), idx].min())
+
+    def merge(self, other: "CountMinSketch"):
+        if self.matrix.shape != other.matrix.shape:
+            raise ValueError("cannot merge sketches of different shapes")
+        self.matrix += other.matrix
+        self.count += other.count
+
+    def reset(self):
+        self.matrix[:] = 0
+        self.count = 0
+
+
+class StatsHolder:
+    """(attr, token) -> approximate posting-list length, for eq planning
+    (ref posting/stats.go StatsHolder; worker/task.go planForEqFilter picks
+    the cheapest token order for multi-value eq)."""
+
+    def __init__(self):
+        self._sketch = CountMinSketch()
+
+    def record(self, attr: str, token: bytes, n: int = 1):
+        self._sketch.add(attr.encode() + b"\x00" + token, n)
+
+    def estimate(self, attr: str, token: bytes) -> int:
+        return self._sketch.estimate(attr.encode() + b"\x00" + token)
+
+    def plan_eq_order(self, attr: str, tokens) -> list:
+        """Cheapest-first token order for multi-value eq scans."""
+        return sorted(tokens, key=lambda t: self.estimate(attr, t))

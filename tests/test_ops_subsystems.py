@@ -226,3 +226,35 @@ def test_http_draining_and_task_status(tmp_path):
         _t.sleep(0.05)
     assert st["data"]["status"] == "Success"
     srv.stop()
+
+
+def test_count_min_sketch():
+    import numpy as np
+
+    from dgraph_tpu.utils.cmsketch import CountMinSketch, StatsHolder
+
+    cms = CountMinSketch(epsilon=0.001, delta=0.01)
+    rng = np.random.default_rng(0)
+    truth = {}
+    for i in range(200):
+        key = f"tok{i}".encode()
+        n = int(rng.integers(1, 500))
+        truth[key] = n
+        cms.add(key, n)
+    # estimates never underestimate; overestimate bounded by eps * total
+    slack = int(0.001 * cms.count * 3)
+    for key, n in truth.items():
+        est = cms.estimate(key)
+        assert est >= n
+        assert est <= n + slack
+    # merging folds another sketch's counts into this one
+    cms2 = CountMinSketch(epsilon=0.001, delta=0.01)
+    cms2.add(b"tok0", 7)
+    cms.merge(cms2)
+    assert cms.estimate(b"tok0") >= truth[b"tok0"] + 7
+
+    st = StatsHolder()
+    st.record("name", b"a", 100)
+    st.record("name", b"b", 5)
+    st.record("name", b"c", 50)
+    assert st.plan_eq_order("name", [b"a", b"b", b"c"]) == [b"b", b"c", b"a"]
