@@ -37,7 +37,7 @@ class TxnHandle:
 
     def __init__(self, server: "Server", read_only: bool = False):
         self.server = server
-        self.start_ts = server.zero.next_ts()
+        self.start_ts = server.zero.begin_txn()
         self.txn = Txn(server.kv, self.start_ts, mem=server.mem)
         self.read_only = read_only
         self.finished = False
@@ -396,16 +396,25 @@ class Server:
         tokenizers = su.tokenizer_objs()
         if not tokenizers:
             return
-        writes = []
+        from dgraph_tpu.posting.pl import encode_delta
+
+        # aggregate uids per index key: entities sharing a token must land
+        # in ONE record, since MemKV overwrites same-(key, ts) versions
+        # (ref posting/index.go IndexRebuild emits complete per-key lists)
+        by_key: Dict[bytes, set] = {}
         for k, _, _ in self.kv.iterate(keys.DataPrefix(pred), ts):
             pk = keys.parse_key(k)
             for p in read.values(k):
                 for tokb in build_tokens(p.val(), tokenizers):
-                    ikey = keys.IndexKey(pred, tokb)
-                    from dgraph_tpu.posting.pl import encode_delta
-
-                    writes.append((ikey, ts, encode_delta([Posting(uid=pk.uid, op=OP_SET)])))
-        self.kv.put_batch(writes)
+                    by_key.setdefault(keys.IndexKey(pred, tokb), set()).add(pk.uid)
+        self.kv.put_batch(
+            (
+                ikey,
+                ts,
+                encode_delta([Posting(uid=u, op=OP_SET) for u in sorted(uids)]),
+            )
+            for ikey, uids in by_key.items()
+        )
 
     # -- transactions ---------------------------------------------------------
 
@@ -413,8 +422,14 @@ class Server:
         return TxnHandle(self, read_only)
 
     def _commit(self, txn: Txn) -> int:
-        commit_ts = self.zero.commit(txn.start_ts, txn.conflict_keys)
-        txn.write_deltas(self.kv, commit_ts)
+        # serialized: MemKV is single-writer, and readers must not see a
+        # commit_ts whose deltas aren't written yet (ADVICE r1 #2)
+        with self._lock:
+            commit_ts = self.zero.commit(txn.start_ts, txn.conflict_keys, track=True)
+            try:
+                txn.write_deltas(self.kv, commit_ts)
+            finally:
+                self.zero.applied(commit_ts)
         self.mem.invalidate(txn.cache.deltas.keys())
         cdc = getattr(self, "_cdc", None)
         if cdc is not None:

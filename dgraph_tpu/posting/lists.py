@@ -51,6 +51,16 @@ class LocalCache:
     def uids(self, key: bytes) -> np.ndarray:
         return self.get(key).uids(self.deltas.get(key))
 
+    def uids_tok(self, key: bytes):
+        """(uids, version token). The token is the posting list's device-
+        cache identity (key, latest_ts) — None when this txn has local
+        deltas on the key (the materialized view is txn-private then)."""
+        pl = self.get(key)
+        extra = self.deltas.get(key)
+        uids = pl.uids(extra)
+        tok = None if extra else (key, pl.latest_ts)
+        return uids, tok
+
     def value(self, key: bytes, lang: str = ""):
         return self.get(key).get_value(lang, self.deltas.get(key))
 

@@ -59,9 +59,14 @@ class MemoryLayer:
         return pl
 
     def invalidate(self, keys: Iterable[bytes]):
+        keys = list(keys)
         with self._lock:
             for k in keys:
                 self._cache.pop(k, None)
+        # the device (HBM) operand cache mirrors this invalidation
+        from dgraph_tpu.query.dispatch import DISPATCHER
+
+        DISPATCHER.device_cache.invalidate(keys)
 
     def clear(self):
         with self._lock:

@@ -38,6 +38,12 @@ OP_DEL = 2
 VALUE_UID = (1 << 64) - 1  # plain scalar value posting
 
 
+class CorruptRecordError(ValueError):
+    """A stored posting record failed structural validation (truncated or
+    corrupt bytes) — raised instead of silently decoding garbage
+    (mirrors the strict checks in codec/uidpack.deserialize)."""
+
+
 def fingerprint64(data: bytes) -> int:
     h = hashlib.blake2b(data, digest_size=8).digest()
     v = struct.unpack("<Q", h)[0]
@@ -108,24 +114,38 @@ def _enc_posting(p: Posting, out: List[bytes]):
         out.append(fv)
 
 
+def _need(data: bytes, pos: int, n: int):
+    if pos + n > len(data):
+        raise CorruptRecordError(
+            f"posting record truncated: need {n} bytes at {pos}, have {len(data)}"
+        )
+
+
 def _dec_posting(data: bytes, pos: int) -> Tuple[Posting, int]:
+    _need(data, pos, 11)
     flags, uid, tid = struct.unpack_from("<BQB", data, pos)
     pos += 10
     (llen,) = struct.unpack_from("<B", data, pos)
     pos += 1
+    _need(data, pos, llen)
     lang = data[pos : pos + llen].decode("utf-8")
     pos += llen
+    _need(data, pos, 4)
     (vlen,) = struct.unpack_from("<I", data, pos)
     pos += 4
+    _need(data, pos, vlen)
     value = data[pos : pos + vlen]
     pos += vlen
+    _need(data, pos, 2)
     (nf,) = struct.unpack_from("<H", data, pos)
     pos += 2
     facets: Dict[str, bytes] = {}
     ftypes: Dict[str, TypeID] = {}
     for _ in range(nf):
+        _need(data, pos, 4)
         klen, ftid, fvlen = struct.unpack_from("<BBH", data, pos)
         pos += 4
+        _need(data, pos, klen + fvlen)
         k = data[pos : pos + klen].decode("utf-8")
         pos += klen
         facets[k] = data[pos : pos + fvlen]
@@ -162,11 +182,16 @@ def encode_delta(postings: List[Posting]) -> bytes:
 
 def decode_record(data: bytes):
     """Returns (kind, pack_or_None, postings)."""
+    _need(data, 0, 5)
     kind, n = struct.unpack_from("<BI", data, 0)
+    if kind not in (KIND_ROLLUP, KIND_DELTA):
+        raise CorruptRecordError(f"unknown record kind {kind}")
     pos = 5
     if kind == KIND_ROLLUP:
+        _need(data, pos, n)
         pack = uidpack.deserialize(data[pos : pos + n])
         pos += n
+        _need(data, pos, 4)
         (cnt,) = struct.unpack_from("<I", data, pos)
         pos += 4
         postings = []
@@ -208,6 +233,10 @@ class PostingList:
         # committed deltas above the rollup, ascending commit_ts
         self.deltas = deltas or []
         self.min_ts = min_ts  # ts of the rollup layer
+        # newest version ts this list was built from — the identity used by
+        # the device pack cache (key, latest_ts); 0 = empty/unknown
+        self.latest_ts = max((ts for ts, _ in self.deltas), default=min_ts)
+        self._uids_cache: Optional[np.ndarray] = None
 
     # -- construction from KV versions --------------------------------------
 
@@ -241,7 +270,19 @@ class PostingList:
     # -- reads ---------------------------------------------------------------
 
     def uids(self, extra_deltas: Optional[List[Posting]] = None) -> np.ndarray:
-        """Materialized sorted u64 uid set (ref list.go:1758 Uids)."""
+        """Materialized sorted u64 uid set (ref list.go:1758 Uids).
+
+        The no-extra-deltas result is memoized: a PostingList is immutable
+        once constructed, and MemoryLayer shares it across queries — without
+        this, every traversal level re-decodes the pack."""
+        if extra_deltas is None and self._uids_cache is not None:
+            return self._uids_cache
+        out = self._compute_uids(extra_deltas)
+        if extra_deltas is None:
+            self._uids_cache = out
+        return out
+
+    def _compute_uids(self, extra_deltas: Optional[List[Posting]]) -> np.ndarray:
         base = uidpack.decode(self.pack)
         # last-writer-wins per uid across layers in commit order
         final_op: Dict[int, int] = {}

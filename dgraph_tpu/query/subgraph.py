@@ -202,12 +202,11 @@ class Executor:
         if ft.op == "not":
             inner = self.eval_filter(ft.children[0], src)
             return DISPATCHER.run_pairs("difference", [(src, inner)])[0]
+        # whole AND/OR chain in ONE device dispatch (intersect_many /
+        # k-way merge), not k-1 sequential pairwise calls
         parts = [self.eval_filter(c, src) for c in ft.children]
-        out = parts[0]
         op = "intersect" if ft.op == "and" else "union"
-        for p in parts[1:]:
-            out = DISPATCHER.run_pairs(op, [(out, p)])[0]
-        return out.astype(np.uint64)
+        return DISPATCHER.run_chain(op, parts).astype(np.uint64)
 
     # ------------------------------------------------------------------
     # Child expansion — the batched fan-out
@@ -244,19 +243,22 @@ class Executor:
                 raise QueryError(f"predicate {attr[1:]!r} has no @reverse index")
             cnode.is_uid_pred = True
             rows = []
+            row_toks = []
             for u in parent.dest_uids:
                 key = (
                     keys.ReverseKey(attr[1:], int(u), self.ns)
                     if reverse
                     else keys.DataKey(attr, int(u), self.ns)
                 )
-                rows.append(self.cache.uids(key))
+                r, tok = self.cache.uids_tok(key)
+                rows.append(r)
+                row_toks.append(tok)
             cnode.uid_matrix = rows
             dest = _merge_rows(rows)
             if cgq.filter is not None:
                 dest = self.eval_filter(cgq.filter, dest)
                 cnode.uid_matrix = DISPATCHER.run_rows_vs_one(
-                    "intersect", rows, dest
+                    "intersect", rows, dest, row_tokens=row_toks
                 )
             if cgq.facet_filter is not None or cgq.facet_order or cgq.facets:
                 self._apply_edge_facets(cnode, cgq, parent, reverse)

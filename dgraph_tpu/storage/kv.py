@@ -28,6 +28,7 @@ import bisect
 import io
 import os
 import struct
+import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
 
@@ -73,6 +74,10 @@ class MemKV(KV):
     """In-memory versioned sorted map + optional WAL durability."""
 
     def __init__(self, wal_path: Optional[str] = None):
+        # guards _data/_keys/WAL: the HTTP front-end serves concurrently and
+        # MemKV must not corrupt its sorted-key index or interleave WAL
+        # records (ADVICE r1 #2); writes are short, an RLock suffices
+        self._mu = threading.RLock()
         # key -> list[(ts, value)] ascending by ts
         self._data: Dict[bytes, List[Tuple[int, bytes]]] = {}
         self._keys: List[bytes] = []  # sorted key index
@@ -92,8 +97,15 @@ class MemKV(KV):
     # -- writes -------------------------------------------------------------
 
     def put(self, key: bytes, ts: int, value: bytes) -> None:
-        self._put_mem(key, ts, value)
-        self._wal_append(_OP_PUT, key, ts, value)
+        with self._mu:
+            self._put_mem(key, ts, value)
+            self._wal_append(_OP_PUT, key, ts, value)
+
+    def put_batch(self, items) -> None:
+        with self._mu:
+            for k, ts, v in items:
+                self._put_mem(k, ts, v)
+                self._wal_append(_OP_PUT, k, ts, v)
 
     def _wal_append(self, op: int, key: bytes, ts: int, value: bytes = b""):
         if self._wal is not None:
@@ -125,25 +137,30 @@ class MemKV(KV):
     # -- reads --------------------------------------------------------------
 
     def get(self, key: bytes, read_ts: int) -> Optional[Tuple[int, bytes]]:
-        vers = self._data.get(key)
-        if not vers:
-            return None
-        i = bisect.bisect_right(vers, read_ts, key=lambda x: x[0])
-        if i == 0:
-            return None
-        return vers[i - 1]
+        with self._mu:
+            vers = self._data.get(key)
+            if not vers:
+                return None
+            i = bisect.bisect_right(vers, read_ts, key=lambda x: x[0])
+            if i == 0:
+                return None
+            return vers[i - 1]
 
     def versions(self, key: bytes, read_ts: int) -> List[Tuple[int, bytes]]:
-        vers = self._data.get(key)
-        if not vers:
-            return []
-        return [(ts, v) for ts, v in reversed(vers) if ts <= read_ts]
+        with self._mu:
+            vers = self._data.get(key)
+            if not vers:
+                return []
+            return [(ts, v) for ts, v in reversed(vers) if ts <= read_ts]
 
     def _sorted_keys(self) -> List[bytes]:
-        if self._keys_dirty:
-            self._keys = sorted(self._data)
-            self._keys_dirty = False
-        return self._keys
+        # returns an immutable snapshot list: writers replace (not mutate)
+        # self._keys, so iterators holding an old snapshot stay valid
+        with self._mu:
+            if self._keys_dirty:
+                self._keys = sorted(self._data)
+                self._keys_dirty = False
+            return self._keys
 
     def iterate(
         self, prefix: bytes, read_ts: int
@@ -177,8 +194,9 @@ class MemKV(KV):
     # -- maintenance --------------------------------------------------------
 
     def delete_below(self, key: bytes, ts: int) -> None:
-        self._delete_below_mem(key, ts)
-        self._wal_append(_OP_DELETE_BELOW, key, ts)
+        with self._mu:
+            self._delete_below_mem(key, ts)
+            self._wal_append(_OP_DELETE_BELOW, key, ts)
 
     def _delete_below_mem(self, key: bytes, ts: int) -> None:
         vers = self._data.get(key)
@@ -187,8 +205,9 @@ class MemKV(KV):
         self._data[key] = [(t, v) for t, v in vers if t >= ts]
 
     def drop_prefix(self, prefix: bytes) -> None:
-        self._drop_prefix_mem(prefix)
-        self._wal_append(_OP_DROP_PREFIX, prefix, 0)
+        with self._mu:
+            self._drop_prefix_mem(prefix)
+            self._wal_append(_OP_DROP_PREFIX, prefix, 0)
 
     def _drop_prefix_mem(self, prefix: bytes) -> None:
         for k in [k for k in self._data if k.startswith(prefix)]:
@@ -222,7 +241,7 @@ class MemKV(KV):
 
     def snapshot_to(self, path: str):
         """Write a compact snapshot (all live versions)."""
-        with open(path + ".tmp", "wb") as f:
+        with self._mu, open(path + ".tmp", "wb") as f:
             for k in self._sorted_keys():
                 for ts, v in self._data.get(k, []):
                     f.write(_WAL_REC.pack(_OP_PUT, len(k), ts, len(v)))
@@ -233,10 +252,11 @@ class MemKV(KV):
         os.replace(path + ".tmp", path)
 
     def close(self):
-        if self._wal is not None:
-            self.sync()
-            self._wal.close()
-            self._wal = None
+        with self._mu:
+            if self._wal is not None:
+                self.sync()
+                self._wal.close()
+                self._wal = None
 
 
 def open_kv(path: Optional[str] = None) -> KV:

@@ -261,7 +261,7 @@ class DistributedCluster:
             return self._commit_locked(txn)
 
     def _commit_locked(self, txn: Txn) -> int:
-        commit_ts = self.zero.zero.commit(txn.start_ts, txn.conflict_keys)
+        commit_ts = self.zero.zero.commit(txn.start_ts, txn.conflict_keys, track=True)
         # shard deltas by owning group (populateMutationMap analog)
         per_group: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
         from dgraph_tpu.posting.pl import encode_delta
@@ -292,6 +292,7 @@ class DistributedCluster:
                 f"applied, remaining failed: {e}"
             ) from e
         finally:
+            self.zero.zero.applied(commit_ts)
             self.mem.invalidate(txn.cache.deltas.keys())
         # vector ingestion
         from dgraph_tpu.posting.pl import OP_DEL, OP_SET
@@ -384,7 +385,7 @@ class DistributedCluster:
 class ClusterTxn:
     def __init__(self, cluster: DistributedCluster):
         self.cluster = cluster
-        self.start_ts = cluster.zero.zero.next_ts()
+        self.start_ts = cluster.zero.zero.begin_txn()
         self.txn = Txn(RoutingKV(cluster), self.start_ts, mem=cluster.mem)
 
     def mutate_rdf(self, set_rdf: str = "", del_rdf: str = "", commit_now=False):

@@ -11,6 +11,15 @@ Conflict rule (ref dgraph/cmd/zero/oracle.go:72 hasConflict): a txn T
 commits iff no conflict-key it writes was committed by another txn with
 commit_ts in (T.start_ts, now]. SSI at predicate+entity granularity via
 key fingerprints.
+
+Visibility rule (ref worker/oracle MaxAssigned / WaitForTs): a commit_ts
+is handed out *before* its deltas are written; a reader leasing a fresh
+read_ts must not observe a gap where commit_ts < read_ts but the deltas
+are not yet in the KV. `commit()` therefore records the ts as pending and
+`read_ts()` blocks until every pending commit below it is `applied()`.
+
+Conflict-state GC (ref dgraph/cmd/zero/oracle.go:125 purgeBelow): the
+fingerprint->commit_ts map is purged below the minimum active start ts.
 """
 
 from __future__ import annotations
@@ -26,11 +35,16 @@ class TxnConflictError(Exception):
 class ZeroLite:
     def __init__(self):
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self._max_ts = 0
         self._max_uid = 1  # uid 0 invalid, uid 1 reserved (ref assign.go)
         # conflict key fingerprint -> last commit_ts
         self._commits: Dict[int, int] = {}
         self._aborted: Set[int] = set()
+        # start_ts of open (registered) transactions — GC watermark
+        self._active: Set[int] = set()
+        # commit_ts assigned but whose deltas are not yet applied to the KV
+        self._pending: Set[int] = set()
 
     # -- leases (ref dgraph/cmd/zero/assign.go:69 lease) ---------------------
 
@@ -41,9 +55,30 @@ class ZeroLite:
             self._max_ts += count
             return first
 
+    def begin_txn(self) -> int:
+        """Lease a start ts and register the txn as active (for conflict-map
+        GC). Pair with commit()/abort()."""
+        with self._lock:
+            self._max_ts += 1
+            self._active.add(self._max_ts)
+            return self._max_ts
+
     def read_ts(self) -> int:
-        """A fresh read timestamp (linearizable read point)."""
-        return self.next_ts()
+        """A fresh read timestamp (linearizable read point): waits until all
+        commits below it have had their deltas applied, so the snapshot at
+        this ts is complete (ref worker/oracle.go WaitForTs). The wait is
+        bounded — a crashed writer costs staleness, never a deadlock."""
+        with self._cv:
+            self._max_ts += 1
+            ts = self._max_ts
+            deadline = 30.0
+            while self._pending and min(self._pending) < ts and deadline > 0:
+                import time as _t
+
+                t0 = _t.monotonic()
+                self._cv.wait(timeout=min(1.0, deadline))
+                deadline -= _t.monotonic() - t0
+            return ts
 
     def assign_uids(self, count: int) -> int:
         """Lease `count` uids; returns the first (ref assign.go:176)."""
@@ -58,13 +93,19 @@ class ZeroLite:
 
     # -- commit (ref dgraph/cmd/zero/oracle.go:421 CommitOrAbort) ------------
 
-    def commit(self, start_ts: int, conflict_keys) -> int:
-        """Returns commit_ts, or raises TxnConflictError."""
+    def commit(self, start_ts: int, conflict_keys, track: bool = False) -> int:
+        """Returns commit_ts, or raises TxnConflictError. With track=True the
+        commit is registered as pending and the caller MUST call
+        applied(commit_ts) once deltas are written (fresh readers block on
+        it); track=False is for single-writer callers that write deltas
+        before any reader can observe the ts."""
         with self._lock:
+            self._active.discard(start_ts)
             for ck in conflict_keys:
                 last = self._commits.get(ck, 0)
                 if last > start_ts:
                     self._aborted.add(start_ts)
+                    self._gc_locked()
                     raise TxnConflictError(
                         f"conflict on key fingerprint {ck:#x} "
                         f"(committed at {last} > start {start_ts})"
@@ -73,8 +114,35 @@ class ZeroLite:
             commit_ts = self._max_ts
             for ck in conflict_keys:
                 self._commits[ck] = commit_ts
+            if track:
+                self._pending.add(commit_ts)
+            self._gc_locked()
             return commit_ts
+
+    def applied(self, commit_ts: int):
+        """Deltas for commit_ts are in the KV; unblock readers."""
+        with self._cv:
+            self._pending.discard(commit_ts)
+            self._cv.notify_all()
 
     def abort(self, start_ts: int):
         with self._lock:
             self._aborted.add(start_ts)
+            self._active.discard(start_ts)
+            self._gc_locked()
+
+    def _gc_locked(self):
+        """Purge conflict state below the oldest active txn's start ts
+        (ref zero/oracle.go purgeBelow): an entry with commit_ts <= every
+        active start_ts can never abort anyone again. Only runs when at
+        least one txn is registered — with an empty registry we cannot
+        know whether an unregistered reader/writer (low-level next_ts
+        users) still needs the entries."""
+        if not self._active:
+            return
+        floor = min(self._active)
+        if self._commits:
+            for ck in [ck for ck, cts in self._commits.items() if cts <= floor]:
+                del self._commits[ck]
+        if self._aborted:
+            self._aborted = {ts for ts in self._aborted if ts >= floor}
