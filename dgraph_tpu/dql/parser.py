@@ -170,6 +170,8 @@ class GraphQuery:
     shortest_from: Optional[Any] = None
     shortest_to: Optional[Any] = None
     num_paths: int = 1
+    min_weight: Optional[float] = None
+    max_weight: Optional[float] = None
 
 
 # ---------------------------------------------------------------------------
@@ -553,6 +555,10 @@ def _parse_args_into(p: _P, gq: GraphQuery, stop: str = ")"):
             gq.shortest_to = _parse_uid_or_var(p)
         elif key == "numpaths":
             gq.num_paths = int(_parse_scalar(p))
+        elif key == "minweight":
+            gq.min_weight = float(_parse_scalar(p))
+        elif key == "maxweight":
+            gq.max_weight = float(_parse_scalar(p))
         elif key == "depth":
             gq.recurse_depth = int(_parse_scalar(p))
         elif key == "loop":

@@ -95,10 +95,17 @@ class JsonEncoder:
                 out.append({_display_name(c): int(len(node.dest_uids))})
 
         if getattr(node, "paths", None):
-            # shortest-path block: emit the path uid chains (ref _path_)
+            # shortest-path block: emit the path uid chains + total cost
+            # (ref outputnode.go _path_ / _weight_)
+            weights = getattr(node, "path_weights", None) or [
+                float(len(p) - 1) for p in node.paths  # type: ignore
+            ]
             return [
-                {"_path_": [{"uid": encode_uid(u)} for u in p]}
-                for p in node.paths  # type: ignore[attr-defined]
+                {
+                    "_path_": [{"uid": encode_uid(u)} for u in p],
+                    "_weight_": w,
+                }
+                for p, w in zip(node.paths, weights)  # type: ignore
             ]
 
         ancestors = frozenset()

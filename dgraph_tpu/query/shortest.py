@@ -1,17 +1,23 @@
-"""K-shortest paths via batched frontier expansion.
+"""K-shortest paths: batched BFS (unweighted) + Dijkstra k-paths (weighted).
 
 The reference runs a Dijkstra-style priority queue issuing per-node tasks
-(/root/reference/query/shortest.go:457 shortestPath, expandOut:141). The
-TPU-first formulation (SURVEY.md §7.6): BFS levels where each level expands
-the whole frontier as one batched uid fan-out (frontier -> union of
-neighbor lists), which is exactly the batched set-union the device kernels
-cover. Unweighted edges round 1 (uniform cost, like the reference's default
-when no facet weights are used).
+(/root/reference/query/shortest.go:457 shortestPath, expandOut:141), with
+edge costs taken from an @facets(<name>) facet on the path predicates
+(shortest.go:141 expandOut reads the facet into cost; default cost 1).
+
+TPU-first formulation (SURVEY.md §7.6): the unweighted case expands the
+whole frontier per BFS level as one batched uid fan-out. The weighted case
+keeps the reference's priority-queue route expansion on the host — path
+enumeration is sequential by nature — but reads neighbor lists through the
+shared decoded-list cache so repeated expansions are cheap.
+
+minweight/maxweight bound accepted path costs (shortest.go route filter).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import heapq
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -19,6 +25,61 @@ from dgraph_tpu.posting.lists import LocalCache
 from dgraph_tpu.schema.schema import State
 from dgraph_tpu.types.types import TypeID
 from dgraph_tpu.x import keys
+
+
+class _Edges:
+    """Neighbor + per-edge-cost reader over the path predicates."""
+
+    def __init__(self, cache, st, preds, weight_facets, ns):
+        self.cache = cache
+        self.ns = ns
+        self.upreds: List[Tuple[str, Optional[str]]] = []
+        for i, p in enumerate(preds):
+            su = st.get(p.lstrip("~"))
+            if su is not None and su.value_type == TypeID.UID:
+                wf = weight_facets[i] if weight_facets else None
+                self.upreds.append((p, wf))
+        self.weighted = any(wf for _, wf in self.upreds)
+
+    def _key(self, pred: str, u: int):
+        return (
+            keys.ReverseKey(pred[1:], u, self.ns)
+            if pred.startswith("~")
+            else keys.DataKey(pred, u, self.ns)
+        )
+
+    def neighbors(self, u: int) -> Dict[int, float]:
+        """target uid -> edge cost (min across predicates)."""
+        out: Dict[int, float] = {}
+        for pred, wf in self.upreds:
+            key = self._key(pred, u)
+            vs = self.cache.uids(key)
+            if not len(vs):
+                continue
+            fmap = self.cache.edge_facets(key) if wf else {}
+            for v in vs:
+                v = int(v)
+                cost = 1.0
+                if wf:
+                    fv = fmap.get(v, {}).get(wf)
+                    if fv is not None:
+                        try:
+                            cost = float(fv.value)
+                        except (TypeError, ValueError):
+                            cost = 1.0
+                if v not in out or cost < out[v]:
+                    out[v] = cost
+        return out
+
+    def neighbor_uids(self, u: int) -> np.ndarray:
+        outs = []
+        for pred, _ in self.upreds:
+            o = self.cache.uids(self._key(pred, u))
+            if len(o):
+                outs.append(o)
+        if not outs:
+            return np.zeros((0,), np.uint64)
+        return np.unique(np.concatenate(outs))
 
 
 def k_shortest_paths(
@@ -30,42 +91,69 @@ def k_shortest_paths(
     num_paths: int = 1,
     ns: int = keys.GALAXY_NS,
     max_depth: int = 10,
-) -> List[List[int]]:
-    """Returns up to num_paths uid-paths from src to dst (shortest first)."""
-    if src == dst:
-        return [[src]]
+    weight_facets: Optional[List[Optional[str]]] = None,
+    min_weight: Optional[float] = None,
+    max_weight: Optional[float] = None,
+) -> List[Tuple[List[int], float]]:
+    """Returns up to num_paths (uid-path, total_cost) pairs, cheapest first.
 
-    upreds = [
-        p for p in preds if (st.get(p.lstrip("~")) or None) is not None
-        and st.get(p.lstrip("~")).value_type == TypeID.UID
-    ]
-    if not upreds:
+    weight_facets[i] names the facet carrying pred[i]'s edge cost (None =
+    unit cost, matching the reference's default)."""
+    edges = _Edges(cache, st, preds, weight_facets, ns)
+    if not edges.upreds:
         return []
+    if src == dst:
+        return [([src], 0.0)]
 
-    def neighbors(u: int) -> np.ndarray:
-        outs = []
-        for p in upreds:
-            key = (
-                keys.ReverseKey(p[1:], u, ns)
-                if p.startswith("~")
-                else keys.DataKey(p, u, ns)
-            )
-            outs.append(cache.uids(key))
-        outs = [o for o in outs if len(o)]
-        if not outs:
-            return np.zeros((0,), np.uint64)
-        return np.unique(np.concatenate(outs))
+    def in_bounds(w: float) -> bool:
+        if min_weight is not None and w < min_weight:
+            return False
+        if max_weight is not None and w > max_weight:
+            return False
+        return True
 
-    # BFS with parent sets (supports multiple shortest paths)
+    if not edges.weighted and num_paths == 1 and min_weight is None and max_weight is None:
+        got = _bfs_single(edges, src, dst, max_depth)
+        return [(p, float(len(p) - 1)) for p in got]
+
+    # weighted / k-paths: loopless route expansion with a bounded pop count
+    # per node (ref shortest.go priority-queue expansion)
+    results: List[Tuple[List[int], float]] = []
+    pops: Dict[int, int] = {}
+    heap: List[Tuple[float, List[int]]] = [(0.0, [src])]
+    while heap and len(results) < num_paths:
+        cost, path = heapq.heappop(heap)
+        u = path[-1]
+        pops[u] = pops.get(u, 0) + 1
+        if pops[u] > num_paths:
+            continue
+        if u == dst:
+            if in_bounds(cost):
+                results.append((path, cost))
+            continue
+        if len(path) > max_depth:
+            continue
+        if max_weight is not None and cost > max_weight:
+            continue  # costs are non-negative: no route can come back down
+        on_path = set(path)
+        for v, w in edges.neighbors(u).items():
+            if v in on_path:
+                continue
+            heapq.heappush(heap, (cost + w, path + [v]))
+    return results
+
+
+def _bfs_single(edges: _Edges, src: int, dst: int, max_depth: int):
+    """Unweighted single-path BFS with batched level expansion."""
     parents: Dict[int, set] = {src: set()}
     frontier = {src}
-    found_depth = None
+    found = False
     depth = 0
-    while frontier and depth < max_depth:
+    while frontier and depth < max_depth and not found:
         depth += 1
         nxt: Dict[int, set] = {}
         for u in frontier:
-            for v in neighbors(u):
+            for v in edges.neighbor_uids(u):
                 v = int(v)
                 if v in parents:
                     continue
@@ -73,24 +161,11 @@ def k_shortest_paths(
         for v, ps in nxt.items():
             parents[v] = ps
         if dst in nxt:
-            found_depth = depth
-            break
+            found = True
         frontier = set(nxt)
-
-    if found_depth is None:
+    if not found:
         return []
-
-    # reconstruct up to num_paths paths (DFS over parent sets)
-    paths: List[List[int]] = []
-
-    def walk(u: int, acc: List[int]):
-        if len(paths) >= num_paths:
-            return
-        if u == src:
-            paths.append([src] + list(reversed(acc)))
-            return
-        for p in sorted(parents.get(u, ())):
-            walk(p, acc + [u])
-
-    walk(dst, [])
-    return paths[:num_paths]
+    path = [dst]
+    while path[-1] != src:
+        path.append(sorted(parents[path[-1]])[0])
+    return [list(reversed(path))]

@@ -480,45 +480,58 @@ class Executor:
     # ------------------------------------------------------------------
 
     def _expand_recurse(self, node: ExecNode):
+        """@recurse: apply the query's predicates repeatedly, each uid-pred
+        child recursed independently (ref query/recurse.go:19 expandRecurse
+        — ALL uid predicates continue, not just the first). A shared seen
+        set (loop: false) prunes revisits across the whole traversal."""
         depth = node.gq.recurse_depth or 5
         preds = [c for c in node.gq.children if not (c.is_uid or c.val_var)]
-        seen = node.dest_uids.copy()
-        frontier_node = node
-        for _ in range(depth):
-            if not len(frontier_node.dest_uids):
-                break
-            next_children = []
-            for cgq in preds:
-                c2 = GraphQuery(
-                    attr=cgq.attr,
-                    alias=cgq.alias,
-                    filter=cgq.filter,
-                    lang=cgq.lang,
-                    first=cgq.first,
-                    offset=cgq.offset,
-                )
-                cnode = self._make_child(frontier_node, c2)
-                if cnode is None:
-                    continue
-                frontier_node.children.append(cnode)
-                if cnode.is_uid_pred:
-                    if not node.gq.recurse_loop:
-                        new = DISPATCHER.run_pairs(
-                            "difference", [(cnode.dest_uids, seen)]
-                        )[0]
-                        cnode.uid_matrix = DISPATCHER.run_rows_vs_one(
-                            "intersect", cnode.uid_matrix, new
-                        )
-                        cnode.dest_uids = new
-                        seen = np.union1d(seen, new)
-                    next_children.append(cnode)
-            if not next_children:
-                break
-            # recurse on the union of uid-pred children (single-pred typical)
-            frontier_node = next_children[0]
-            if len(next_children) > 1:
-                # multiple uid preds: recurse each (simplified: first only)
-                pass
+        seen = [node.dest_uids.copy()]  # single-element holder (shared state)
+        self._recurse_level(node, preds, seen, depth, node.gq.recurse_loop)
+
+    def _recurse_level(
+        self,
+        frontier_node: ExecNode,
+        preds: List[GraphQuery],
+        seen: List[np.ndarray],
+        remaining: int,
+        loop: bool,
+    ):
+        if remaining <= 0 or not len(frontier_node.dest_uids):
+            return
+        uid_children: List[ExecNode] = []
+        # expand every pred from this frontier first (level-synchronous:
+        # the seen snapshot is shared by all preds of one level)
+        snapshot = seen[0]
+        new_sets = []
+        for cgq in preds:
+            c2 = GraphQuery(
+                attr=cgq.attr,
+                alias=cgq.alias,
+                filter=cgq.filter,
+                lang=cgq.lang,
+                first=cgq.first,
+                offset=cgq.offset,
+            )
+            cnode = self._make_child(frontier_node, c2)
+            if cnode is None:
+                continue
+            frontier_node.children.append(cnode)
+            if cnode.is_uid_pred:
+                if not loop:
+                    new = DISPATCHER.run_pairs(
+                        "difference", [(cnode.dest_uids, snapshot)]
+                    )[0]
+                    cnode.uid_matrix = DISPATCHER.run_rows_vs_one(
+                        "intersect", cnode.uid_matrix, new
+                    )
+                    cnode.dest_uids = new
+                    new_sets.append(new)
+                uid_children.append(cnode)
+        if not loop and new_sets:
+            seen[0] = DISPATCHER.run_chain("union", [seen[0]] + new_sets)
+        for cnode in uid_children:
+            self._recurse_level(cnode, preds, seen, remaining - 1, loop)
 
     # ------------------------------------------------------------------
     # @cascade: prune uids missing any child (ref query.go cascade)
@@ -596,14 +609,28 @@ class Executor:
         src = self._resolve_endpoint(gq.shortest_from)
         dst = self._resolve_endpoint(gq.shortest_to)
         preds = [c.attr for c in gq.children]
-        paths = k_shortest_paths(
-            self.cache, self.st, src, dst, preds, gq.num_paths, self.ns
+        # @facets(<name>) on a path predicate names its edge-cost facet
+        # (ref shortest.go:141 expandOut facet costs)
+        wfacets = [
+            (c.facet_names[0] if c.facet_names else None) for c in gq.children
+        ]
+        routes = k_shortest_paths(
+            self.cache,
+            self.st,
+            src,
+            dst,
+            preds,
+            gq.num_paths,
+            self.ns,
+            max_depth=gq.recurse_depth or 10,
+            weight_facets=wfacets,
+            min_weight=gq.min_weight,
+            max_weight=gq.max_weight,
         )
         node = ExecNode(gq=gq, attr="_path_")
-        node.dest_uids = (
-            _as_uids(paths[0]) if paths else EMPTY
-        )
-        node.paths = paths  # type: ignore[attr-defined]
+        node.dest_uids = _as_uids(routes[0][0]) if routes else EMPTY
+        node.paths = [p for p, _ in routes]  # type: ignore[attr-defined]
+        node.path_weights = [w for _, w in routes]  # type: ignore[attr-defined]
         if gq.var_name:
             # path var holds the uids on the best path (ref shortest.go)
             self.uid_vars[gq.var_name] = node.dest_uids

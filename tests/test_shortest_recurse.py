@@ -1,0 +1,135 @@
+"""Golden tests for weighted shortest paths and multi-predicate @recurse.
+
+Semantics mirror /root/reference/query/shortest.go (facet edge costs,
+numpaths, minweight/maxweight) and query/recurse.go:19 (ALL uid predicates
+recurse, shared seen set).
+"""
+
+import pytest
+
+from dgraph_tpu.api.server import Server
+
+SCHEMA = """
+name: string @index(exact) .
+connects: [uid] @reverse .
+rail: [uid] .
+follow: [uid] .
+"""
+
+# weighted graph (facet w):
+#   A(0x1) -2-> B(0x2) -2-> D(0x4)
+#   A(0x1) -5-> C(0x3) -1-> D(0x4)
+#   A(0x1) -10-> D(0x4)
+RDF = """
+<0x1> <name> "A" .
+<0x2> <name> "B" .
+<0x3> <name> "C" .
+<0x4> <name> "D" .
+<0x1> <connects> <0x2> (w=2) .
+<0x1> <connects> <0x3> (w=5) .
+<0x1> <connects> <0x4> (w=10) .
+<0x2> <connects> <0x4> (w=2) .
+<0x3> <connects> <0x4> (w=1) .
+"""
+
+# two-relation graph for multi-pred recurse:
+#   1 -rail-> 2 ; 1 -follow-> 3 ; 2 -follow-> 4 ; 3 -rail-> 5
+RECURSE_RDF = """
+<0x11> <name> "n1" .
+<0x12> <name> "n2" .
+<0x13> <name> "n3" .
+<0x14> <name> "n4" .
+<0x15> <name> "n5" .
+<0x11> <rail> <0x12> .
+<0x11> <follow> <0x13> .
+<0x12> <follow> <0x14> .
+<0x13> <rail> <0x15> .
+"""
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = Server()
+    s.alter(SCHEMA)
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf=RDF + RECURSE_RDF, commit_now=True)
+    return s
+
+
+def _path_uids(entry):
+    return [p["uid"] for p in entry["_path_"]]
+
+
+def test_weighted_shortest_uses_facet_costs(server):
+    out = server.query(
+        """{
+          path as shortest(from: 0x1, to: 0x4) {
+            connects @facets(w)
+          }
+          path(func: uid(path)) { name }
+        }"""
+    )
+    # cheapest route is A->B->D at cost 4 (not the 1-hop cost-10 edge)
+    paths = out["data"]["_path_"]
+    assert _path_uids(paths[0]) == ["0x1", "0x2", "0x4"]
+    assert paths[0]["_weight_"] == 4.0
+    names = [n["name"] for n in out["data"]["path"]]
+    assert names == ["A", "B", "D"]
+
+
+def test_numpaths_orders_by_cost(server):
+    out = server.query(
+        """{
+          shortest(from: 0x1, to: 0x4, numpaths: 3) {
+            connects @facets(w)
+          }
+        }"""
+    )
+    paths = out["data"]["_path_"]
+    assert [p["_weight_"] for p in paths] == [4.0, 6.0, 10.0]
+    assert _path_uids(paths[1]) == ["0x1", "0x3", "0x4"]
+    assert _path_uids(paths[2]) == ["0x1", "0x4"]
+
+
+def test_min_max_weight_bounds(server):
+    out = server.query(
+        """{
+          shortest(from: 0x1, to: 0x4, numpaths: 3, minweight: 5, maxweight: 8) {
+            connects @facets(w)
+          }
+        }"""
+    )
+    paths = out["data"]["_path_"]
+    assert [p["_weight_"] for p in paths] == [6.0]
+
+
+def test_unweighted_shortest_hop_count(server):
+    out = server.query(
+        """{
+          shortest(from: 0x1, to: 0x4) { connects }
+        }"""
+    )
+    paths = out["data"]["_path_"]
+    assert _path_uids(paths[0]) == ["0x1", "0x4"]
+    assert paths[0]["_weight_"] == 1.0
+
+
+def test_recurse_expands_all_uid_preds(server):
+    """Both rail and follow must recurse: n4 is only reachable via
+    rail(1->2) then follow(2->4); n5 only via follow(1->3) then rail."""
+    out = server.query(
+        """{
+          q(func: uid(0x11)) @recurse(depth: 4) {
+            name
+            rail
+            follow
+          }
+        }"""
+    )
+    q = out["data"]["q"][0]
+    rail_child = q["rail"][0]
+    assert rail_child["name"] == "n2"
+    assert rail_child["follow"][0]["name"] == "n4"
+    follow_child = q["follow"][0]
+    assert follow_child["name"] == "n3"
+    assert follow_child["rail"][0]["name"] == "n5"
