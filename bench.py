@@ -95,6 +95,27 @@ def main():
         out = fn(Ad, LAd, Bd, LBd)
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
+
+    # ratio sweep (stderr only): mirrors algo/benchmarks:2-46 ratio ladder —
+    # small side fixed at 10, big side 10*ratio, batch 256
+    for ratio in (1, 10, 100, 1000, 10000):
+        big_n = SMALL * ratio
+        pad = max(16, 1 << (big_n - 1).bit_length())
+        Bs = np.full((pad,), 0xFFFFFFFF, np.uint32)
+        Bs[:big_n] = np.sort(rng.choice(big, big_n, replace=False))
+        f2 = jax.jit(jax.vmap(setops.intersect, in_axes=(0, 0, None, None)))
+        o = f2(Ad, LAd, jnp.asarray(Bs), jnp.asarray(np.int32(big_n)))
+        jax.block_until_ready(o)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            o = f2(Ad, LAd, jnp.asarray(Bs), jnp.asarray(np.int32(big_n)))
+            jax.block_until_ready(o)
+        dt = (time.perf_counter() - t0) / 5
+        print(
+            f"sweep ratio={ratio}: {dt/BATCH*1e9:.1f} ns/op "
+            f"(batch {BATCH} in {dt*1e3:.3f} ms)",
+            file=sys.stderr,
+        )
     signal.alarm(0)
 
     per_op_ns = (np.median(times) / BATCH) * 1e9
