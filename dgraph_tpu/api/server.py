@@ -343,6 +343,7 @@ class Server:
                 return
             if drop_attr:
                 self.kv.drop_prefix(keys.PredicatePrefix(drop_attr))
+                self.kv.drop_prefix(keys.SplitPredicatePrefix(drop_attr))
                 self.kv.drop_prefix(keys.SchemaKey(drop_attr))
                 self.schema.delete(drop_attr)
                 self.vector_indexes.pop(drop_attr, None)

@@ -130,16 +130,16 @@ class BulkLoader:
         kv = server.kv
         writes = []
 
+        from dgraph_tpu.posting.pl import rollup_writes
+
         for key, uids in self._uid_edges.items():
-            pack = uidpack.encode(
-                np.unique(np.asarray(uids, np.uint64))
-            )
+            u = np.unique(np.asarray(uids, np.uint64))
             # count index on the fly (ref bulk count_index.go)
             pk = keys.parse_key(key)
             su = self.schema.get(pk.attr)
             if su is not None and su.count and pk.is_data:
-                self._counts[(pk.attr, len(pack), pk.ns)].append(pk.uid)
-            writes.append((key, ts, encode_rollup(pack, [])))
+                self._counts[(pk.attr, len(u), pk.ns)].append(pk.uid)
+            writes.extend(rollup_writes(key, u, [], ts))
 
         for key, posts in self._value_posts.items():
             dedup: Dict[int, Posting] = {}
@@ -157,8 +157,8 @@ class BulkLoader:
             )
 
         for key, uids in self._index_uids.items():
-            pack = uidpack.encode(np.unique(np.asarray(uids, np.uint64)))
-            writes.append((key, ts, encode_rollup(pack, [])))
+            u = np.unique(np.asarray(uids, np.uint64))
+            writes.extend(rollup_writes(key, u, [], ts))
 
         for (attr, cnt, ns), uids in self._counts.items():
             pack = uidpack.encode(np.unique(np.asarray(uids, np.uint64)))

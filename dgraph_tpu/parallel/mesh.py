@@ -64,6 +64,38 @@ def sharded_membership(mesh: Mesh, a: jnp.ndarray, la, b: jnp.ndarray, lb):
     return _member(a, jnp.asarray(la, jnp.int32), b, jnp.asarray(lb, jnp.int32))
 
 
+def sharded_rows_membership(mesh: Mesh, A, LA, b, lb):
+    """Membership of a replicated row batch in a ROW-SHARDED big list.
+
+    A: (n, pa) replicated padded sorted u32 rows; LA: (n,) lengths;
+    b: row-sharded padded sorted u32 (multiple of mesh size); lb: total
+    valid length. Returns (n, pa) bool mask — element of A present in b.
+
+    This is the query-side face of multi-part posting lists: each device
+    holds a tile of the giant list (one or more parts), checks the whole
+    level's rows against its tile, and the masks OR-reduce over ICI
+    (psum>0). Ref worker/task.go fan-out replaced by one collective."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P("data"), P()),
+        out_specs=P(),
+    )
+    def _member(A_all, LA_all, b_tile, lb_all):
+        tile_n = b_tile.shape[0]
+        start = jax.lax.axis_index("data") * tile_n
+        local_len = jnp.clip(lb_all - start, 0, tile_n)
+        m = jax.vmap(setops.membership, in_axes=(0, 0, None, None))(
+            A_all, LA_all, b_tile, local_len
+        )
+        return jax.lax.psum(m.astype(jnp.int32), "data") > 0
+
+    return _member(
+        A, jnp.asarray(LA, jnp.int32), b, jnp.asarray(lb, jnp.int32)
+    )
+
+
 def sharded_intersect_count(mesh: Mesh, a, la, b, lb):
     """Total intersection size of a row-sharded list vs replicated list
     (psum over the mesh)."""

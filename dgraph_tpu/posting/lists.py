@@ -41,7 +41,10 @@ class LocalCache:
                 pl = self.mem.read(self.kv, key, self.read_ts)
             else:
                 pl = PostingList.from_versions(
-                    key, self.kv.versions(key, self.read_ts)
+                    key,
+                    self.kv.versions(key, self.read_ts),
+                    kv=self.kv,
+                    read_ts=self.read_ts,
                 )
             self._plists[key] = pl
         return pl

@@ -50,7 +50,7 @@ class MemoryLayer:
                 self.hits += 1
                 return got[1]
         self.misses += 1
-        pl = PostingList.from_versions(key, versions)
+        pl = PostingList.from_versions(key, versions, kv=kv, read_ts=read_ts)
         with self._lock:
             self._cache[key] = (newest_ts, pl)
             self._cache.move_to_end(key)

@@ -35,6 +35,13 @@ from dgraph_tpu.types.types import TypeID, Val, from_binary, to_binary
 OP_SET = 1
 OP_DEL = 2
 
+# multi-part list threshold: a rollup whose uid set exceeds this is split
+# into part records under keys.SplitKey (ref posting/list.go:44 maxListSize,
+# rollup re-split list.go:1590). Tunable for tests / memory budgets.
+import os as _os
+
+MAX_PART_UIDS = int(_os.environ.get("DGRAPH_TPU_MAX_PART_UIDS", 1 << 20))
+
 VALUE_UID = (1 << 64) - 1  # plain scalar value posting
 
 
@@ -164,12 +171,23 @@ def _dec_posting(data: bytes, pos: int) -> Tuple[Posting, int]:
     return p, pos
 
 
-def encode_rollup(pack: uidpack.UidPack, postings: List[Posting]) -> bytes:
+def encode_rollup(
+    pack: uidpack.UidPack,
+    postings: List[Posting],
+    split_starts: Optional[List[int]] = None,
+) -> bytes:
+    """Main rollup record. When `split_starts` is non-empty the pack holds
+    only value/facet postings' context — the uid set lives in part records
+    (one per start uid) under keys.SplitKey(main_key, start)."""
     pb = uidpack.serialize(pack)
     out = [struct.pack("<BI", KIND_ROLLUP, len(pb)), pb]
     out.append(struct.pack("<I", len(postings)))
     for p in postings:
         _enc_posting(p, out)
+    ss = split_starts or []
+    out.append(struct.pack("<I", len(ss)))
+    for st in ss:
+        out.append(struct.pack("<Q", st))
     return b"".join(out)
 
 
@@ -181,7 +199,7 @@ def encode_delta(postings: List[Posting]) -> bytes:
 
 
 def decode_record(data: bytes):
-    """Returns (kind, pack_or_None, postings)."""
+    """Returns (kind, pack_or_None, postings, split_starts)."""
     _need(data, 0, 5)
     kind, n = struct.unpack_from("<BI", data, 0)
     if kind not in (KIND_ROLLUP, KIND_DELTA):
@@ -198,12 +216,53 @@ def decode_record(data: bytes):
         for _ in range(cnt):
             p, pos = _dec_posting(data, pos)
             postings.append(p)
-        return KIND_ROLLUP, pack, postings
+        splits: List[int] = []
+        if pos < len(data):  # records from before splits lack the tail
+            _need(data, pos, 4)
+            (ns,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            _need(data, pos, 8 * ns)
+            for i in range(ns):
+                splits.append(struct.unpack_from("<Q", data, pos)[0])
+                pos += 8
+        return KIND_ROLLUP, pack, postings, splits
     postings = []
     for _ in range(n):
         p, pos = _dec_posting(data, pos)
         postings.append(p)
-    return KIND_DELTA, None, postings
+    return KIND_DELTA, None, postings, []
+
+
+def rollup_writes(
+    key: bytes, uids: np.ndarray, posts: List[Posting], ts: int
+) -> List[Tuple[bytes, int, bytes]]:
+    """KV writes for a full rollup of `key` with the given uid set —
+    split into part records when oversized (used by the bulk loader's
+    reduce phase and tablet-move streaming; same split layout as
+    PostingList.rollup)."""
+    uids = np.asarray(uids, np.uint64)
+    if len(uids) <= MAX_PART_UIDS:
+        return [(key, ts, encode_rollup(uidpack.encode(uids), list(posts)))]
+    from dgraph_tpu.x import keys as _keys
+
+    per = max(1, MAX_PART_UIDS // 2)
+    writes: List[Tuple[bytes, int, bytes]] = []
+    starts: List[int] = []
+    for i in range(0, len(uids), per):
+        chunk = uids[i : i + per]
+        starts.append(int(chunk[0]))
+        writes.append(
+            (
+                _keys.SplitKey(key, int(chunk[0])),
+                ts,
+                encode_rollup(uidpack.encode(chunk), []),
+            )
+        )
+    empty = uidpack.encode(np.zeros((0,), np.uint64))
+    writes.append(
+        (key, ts, encode_rollup(empty, list(posts), split_starts=starts))
+    )
+    return writes
 
 
 # ---------------------------------------------------------------------------
@@ -237,35 +296,69 @@ class PostingList:
         # the device pack cache (key, latest_ts); 0 = empty/unknown
         self.latest_ts = max((ts for ts, _ in self.deltas), default=min_ts)
         self._uids_cache: Optional[np.ndarray] = None
+        # multi-part list: per-part uid packs in ascending start-uid order
+        # (the main record's pack is empty then; ref posting/list.go:519
+        # pIterator walking split parts)
+        self.part_packs: List[uidpack.UidPack] = []
+        self.split_starts: List[int] = []
 
     # -- construction from KV versions --------------------------------------
 
     @classmethod
     def from_versions(
-        cls, key: bytes, versions: List[Tuple[int, bytes]]
+        cls,
+        key: bytes,
+        versions: List[Tuple[int, bytes]],
+        kv=None,
+        read_ts: Optional[int] = None,
     ) -> "PostingList":
-        """versions: (ts, record) newest first (KV.versions contract)."""
+        """versions: (ts, record) newest first (KV.versions contract).
+
+        When the rollup layer is split (multi-part list), `kv`/`read_ts`
+        are used to fetch the part records; without them a split list
+        raises (callers with KV access — LocalCache, MemoryLayer, rollups —
+        always pass them)."""
         deltas: List[Tuple[int, List[Posting]]] = []
         pack = None
         value_postings: List[Posting] = []
         min_ts = 0
+        splits: List[int] = []
         for ts, rec in versions:
-            kind, pk, posts = decode_record(rec)
+            kind, pk, posts, ss = decode_record(rec)
             if kind == KIND_DELTA:
                 deltas.append((ts, posts))
             else:
                 pack = pk
                 value_postings = posts
                 min_ts = ts
+                splits = ss
                 break
         deltas.reverse()  # ascending commit_ts
-        return cls(
+        pl = cls(
             key,
             pack=pack,
             value_postings=value_postings,
             deltas=deltas,
             min_ts=min_ts,
         )
+        if splits:
+            if kv is None:
+                raise CorruptRecordError(
+                    "split posting list needs KV access to read parts"
+                )
+            from dgraph_tpu.x import keys as _keys
+
+            rts = read_ts if read_ts is not None else min_ts
+            pl.split_starts = list(splits)
+            for st in splits:
+                got = kv.get(_keys.SplitKey(key, st), max(rts, min_ts))
+                if got is None:
+                    raise CorruptRecordError(
+                        f"missing split part start={st} for key {key!r}"
+                    )
+                _, ppack, _, _ = decode_record(got[1])
+                pl.part_packs.append(ppack)
+        return pl
 
     # -- reads ---------------------------------------------------------------
 
@@ -283,7 +376,14 @@ class PostingList:
         return out
 
     def _compute_uids(self, extra_deltas: Optional[List[Posting]]) -> np.ndarray:
-        base = uidpack.decode(self.pack)
+        if self.part_packs:
+            # parts hold disjoint ascending uid ranges: concatenation of
+            # decoded parts is already sorted
+            base = np.concatenate(
+                [uidpack.decode(pp) for pp in self.part_packs]
+            ).astype(np.uint64)
+        else:
+            base = uidpack.decode(self.pack)
         # last-writer-wins per uid across layers in commit order
         final_op: Dict[int, int] = {}
         for _, posts in self.deltas:
@@ -350,15 +450,17 @@ class PostingList:
 
     # -- rollup --------------------------------------------------------------
 
-    def rollup(self) -> Tuple[bytes, int]:
+    def rollup(self) -> Tuple[bytes, int, List[Tuple[int, bytes]]]:
         """Compact all layers into a fresh rollup record.
 
-        Returns (record_bytes, ts). Ref posting/list.go:1416 Rollup.
+        Returns (main_record_bytes, ts, parts) where parts is
+        [(start_uid, part_record_bytes)] — non-empty when the uid set
+        exceeds MAX_PART_UIDS and the list splits (ref posting/list.go:1416
+        Rollup + :1590 splitUpList re-split; part keys via keys.SplitKey).
         Uid-edge postings that carry facets are kept alongside the pack
         (the pack stores only the uid set; facets live on the posting).
         """
         uids = self.uids()
-        pack = uidpack.encode(uids)
         posts = self.get_all_values()
         live = set(int(u) for u in uids)
         merged = self._merged_postings()
@@ -369,4 +471,18 @@ class PostingList:
         ts = max(
             [self.min_ts] + [t for t, _ in self.deltas]
         )
-        return encode_rollup(pack, posts), ts
+        if len(uids) <= MAX_PART_UIDS:
+            return encode_rollup(uidpack.encode(uids), posts), ts, []
+        # split: half-threshold parts so in-place growth has headroom
+        # before the next re-split (mirrors the reference's size targets)
+        per = max(1, MAX_PART_UIDS // 2)
+        parts: List[Tuple[int, bytes]] = []
+        starts: List[int] = []
+        for i in range(0, len(uids), per):
+            chunk = uids[i : i + per]
+            starts.append(int(chunk[0]))
+            parts.append(
+                (int(chunk[0]), encode_rollup(uidpack.encode(chunk), []))
+            )
+        empty = uidpack.encode(np.zeros((0,), np.uint64))
+        return encode_rollup(empty, posts, split_starts=starts), ts, parts

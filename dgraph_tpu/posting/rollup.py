@@ -18,7 +18,11 @@ from dgraph_tpu.x import keys
 
 
 def rollup_key(kv, key: bytes, read_ts: int) -> bool:
-    """Compact one key's layers; returns True if a rollup was written."""
+    """Compact one key's layers; returns True if a rollup was written.
+
+    Oversized lists split into part records (keys.SplitKey) and re-split on
+    every rollup (ref posting/list.go:1590 splitUpList); parts dropped by a
+    re-split are deleted."""
     versions = kv.versions(key, read_ts)
     n_deltas = 0
     for _, rec in versions:
@@ -29,8 +33,17 @@ def rollup_key(kv, key: bytes, read_ts: int) -> bool:
             break
     if n_deltas == 0:
         return False
-    pl = PostingList.from_versions(key, versions)
-    rec, ts = pl.rollup()
+    pl = PostingList.from_versions(key, versions, kv=kv, read_ts=read_ts)
+    old_starts = set(pl.split_starts)
+    rec, ts, parts = pl.rollup()
+    new_starts = set()
+    for start, prec in parts:
+        pk = keys.SplitKey(key, start)
+        kv.put(pk, ts, prec)
+        kv.delete_below(pk, ts)
+        new_starts.add(start)
+    for start in old_starts - new_starts:
+        kv.delete_below(keys.SplitKey(key, start), ts + 1)
     kv.put(key, ts, rec)
     kv.delete_below(key, ts)
     return True

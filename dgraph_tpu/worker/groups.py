@@ -350,16 +350,19 @@ class DistributedCluster:
             return
         src = self.groups[src_group].any_replica().kv
         prefix = keys.PredicatePrefix(pred)
+        split_prefix = keys.SplitPredicatePrefix(pred)
         writes: List[Tuple[bytes, int, bytes]] = []
-        for key, vers in src.iterate_versions(prefix, (1 << 62)):
-            for ts, val in reversed(vers):  # oldest first
-                writes.append((key, ts, val))
+        for pfx in (prefix, split_prefix):  # parts travel with the tablet
+            for key, vers in src.iterate_versions(pfx, (1 << 62)):
+                for ts, val in reversed(vers):  # oldest first
+                    writes.append((key, ts, val))
         # phase 1: copy into destination group via its raft log
         if writes:
             self._propose_and_wait(dst_group, ("delta", writes))
         # phase 2: flip tablet ownership, then drop from source
         self.zero.move_tablet(pred, dst_group)
         self._propose_and_wait(src_group, ("drop", prefix))
+        self._propose_and_wait(src_group, ("drop", split_prefix))
         self.mem.clear()  # routing changed for the whole tablet
 
     def rebalance(self):

@@ -26,6 +26,7 @@ from typing import Optional
 TAG_DEFAULT = 0x00
 TAG_SCHEMA = 0x01
 TAG_TYPE = 0x02
+TAG_SPLIT = 0x03  # multi-part posting-list part (ref x/keys.go:512 SplitKey)
 
 KIND_DATA = 0x00
 KIND_INDEX = 0x02
@@ -108,6 +109,29 @@ def CountPrefix(attr: str, ns: int = GALAXY_NS) -> bytes:
     return PredicatePrefix(attr, ns) + bytes([KIND_COUNT])
 
 
+def SplitKey(base_key: bytes, start_uid: int) -> bytes:
+    """Part key of a multi-part posting list: the base (data/index/reverse)
+    key re-tagged into the split region + the part's first uid
+    (ref x/keys.go:512 SplitKey — same idea, separate key region so data
+    prefix iteration never sees parts)."""
+    if base_key[0] != TAG_DEFAULT:
+        raise ValueError("only default-region keys can be split")
+    return bytes([TAG_SPLIT]) + base_key[1:] + struct.pack(">Q", start_uid)
+
+
+def base_of_split(split_key: bytes) -> tuple[bytes, int]:
+    """Inverse of SplitKey: (base_key, start_uid)."""
+    if split_key[0] != TAG_SPLIT:
+        raise ValueError("not a split key")
+    start = struct.unpack(">Q", split_key[-8:])[0]
+    return bytes([TAG_DEFAULT]) + split_key[1:-8], start
+
+
+def SplitPredicatePrefix(attr: str, ns: int = GALAXY_NS) -> bytes:
+    """Prefix covering every part key of one predicate (for drops/moves)."""
+    return bytes([TAG_SPLIT]) + PredicatePrefix(attr, ns)[1:]
+
+
 @dataclass
 class ParsedKey:
     """Decoded key (ref x/keys.go:330 ParsedKey)."""
@@ -120,6 +144,7 @@ class ParsedKey:
     term: Optional[bytes] = None
     count: Optional[int] = None
     count_reverse: bool = False
+    split_start: Optional[int] = None  # set for TAG_SPLIT part keys
 
     @property
     def is_data(self):
@@ -165,6 +190,12 @@ def parse_key(key: bytes) -> ParsedKey:
     rest = key[3 + nlen :]
     if tag in (TAG_SCHEMA, TAG_TYPE):
         return ParsedKey(tag=tag, ns=ns, attr=attr)
+    if tag == TAG_SPLIT:
+        base, start = base_of_split(key)
+        pk = parse_key(base)
+        pk.tag = TAG_SPLIT
+        pk.split_start = start
+        return pk
     kind = rest[0]
     body = rest[1:]
     pk = ParsedKey(tag=tag, ns=ns, attr=attr, kind=kind)

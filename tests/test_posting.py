@@ -34,13 +34,13 @@ def test_record_roundtrip():
             facet_types={"since": TypeID.DEFAULT},
         ),
     ]
-    kind, pk, ps = decode_record(encode_rollup(pack, posts))
+    kind, pk, ps, _ = decode_record(encode_rollup(pack, posts))
     assert kind == 0
     np.testing.assert_array_equal(uidpack.decode(pk), [1, 5, 9])
     assert ps[0].value == b"hello"
     assert ps[1].facets["since"] == b"2006"
 
-    kind, _, ps = decode_record(encode_delta([Posting(uid=3, op=OP_DEL)]))
+    kind, _, ps, _ = decode_record(encode_delta([Posting(uid=3, op=OP_DEL)]))
     assert kind == 1 and ps[0].op == OP_DEL
 
 
@@ -69,7 +69,7 @@ def test_rollup_compacts():
     kv.put(key, 1, encode_rollup(uidpack.encode(np.array([1, 2], np.uint64)), []))
     kv.put(key, 3, encode_delta([Posting(uid=9, op=OP_SET)]))
     pl = PostingList.from_versions(key, kv.versions(key, 10))
-    rec, ts = pl.rollup()
+    rec, ts, _parts = pl.rollup()
     assert ts == 3
     kv.put(key, ts, rec)  # same-ts overwrite (idempotent)
     pl2 = PostingList.from_versions(key, kv.versions(key, 10))
