@@ -1,13 +1,18 @@
-"""Raft consensus: leader election + log replication + commit.
+"""Raft consensus: leader election + log replication + commit + snapshots.
 
 The reference embeds etcd/raft (SURVEY.md §2.7(4)) and drives it from
 worker/draft.go / conn/node.go. Consensus is host-side work, so this is a
 from-scratch Python Raft sized for the framework's needs: elections with
 randomized timeouts, AppendEntries replication with consistency checks and
-backtracking, commit-index advancement by majority match, and snapshot
-installation for lagging peers. Transport is pluggable: InProcNetwork for
-deterministic tests (the dgraphtest analog) and a TCP transport
-(raft/tcp.py) for multi-process clusters.
+backtracking, commit-index advancement by majority match, log compaction
+with snapshot installation for lagging peers (snap_req, ref
+worker/snapshot.go InstallSnapshot + raftwal deleteUntil), and durable
+hardstate/log/snapshot via raft/wal.py (ref raftwal/storage.go:60) —
+persisted BEFORE vote/append responses leave the node.
+
+Transport is pluggable: InProcNetwork for deterministic tests (the
+dgraphtest analog) and a TCP transport (raft/tcp.py) for multi-process
+clusters.
 
 Time is injected (tick(now_ms)) so tests run deterministically with
 virtual clocks — no sleeps, no flaky elections.
@@ -88,22 +93,39 @@ class RaftNode:
         election_timeout: Tuple[int, int] = (150, 300),
         heartbeat: int = 50,
         seed: Optional[int] = None,
+        wal=None,
+        snapshot_cb: Optional[Callable[[], bytes]] = None,
+        restore_cb: Optional[Callable[[bytes, int], None]] = None,
+        compact_every: int = 0,
     ):
+        """wal: raft.wal.RaftWal for durability (None = volatile, test-only).
+        snapshot_cb() -> bytes captures the applied state machine;
+        restore_cb(data, index) replaces it (snapshot install).
+        compact_every > 0: leader auto-snapshots/compacts once the entry
+        window exceeds that many applied entries (draft.go
+        calculateSnapshot analog)."""
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.net = network
         self.apply_cb = apply_cb
         self.rng = random.Random(seed if seed is not None else node_id)
+        self.wal = wal
+        self.snapshot_cb = snapshot_cb
+        self.restore_cb = restore_cb
+        self.compact_every = compact_every
 
-        # persistent state (ref raftwal/: hardstate + entries; in-mem here,
-        # durability via the engine's own WAL above)
+        # persistent state (ref raftwal/): hardstate + entries + snapshot
         self.term = 0
         self.voted_for: Optional[int] = None
+        # log window: log[i] is global index snap_index + 1 + i
         self.log: List[LogEntry] = []
+        self.snap_index = 0
+        self.snap_term = 0
+        self.snapshot_data: Optional[bytes] = None
 
         # volatile
         self.state = FOLLOWER
-        self.commit_index = 0  # 1-based count of committed entries
+        self.commit_index = 0  # global index of last committed entry
         self.last_applied = 0
         self.leader_id: Optional[int] = None
 
@@ -117,6 +139,58 @@ class RaftNode:
         self._last_heartbeat_sent = 0
         self.lock = threading.RLock()
 
+        if wal is not None:
+            self._recover_from_wal()
+
+    # -- durability ----------------------------------------------------------
+
+    def _recover_from_wal(self):
+        hard = self.wal.load_hard()
+        if hard is not None:
+            self.term, self.voted_for, _, _ = hard
+        si, st, entries = self.wal.replay_log()
+        self.snap_index, self.snap_term = si, st
+        self.log = [LogEntry(t, d) for t, d in entries]
+        if si > 0:
+            self.snapshot_data = self.wal.load_snapshot()
+            if self.snapshot_data is not None and self.restore_cb is not None:
+                self.restore_cb(self.snapshot_data, si)
+            self.commit_index = si
+            self.last_applied = si
+
+    def _persist_hard(self):
+        if self.wal is not None:
+            self.wal.save_hard(
+                self.term, self.voted_for, self.snap_index, self.snap_term
+            )
+
+    def _persist_append(self, entry: LogEntry):
+        if self.wal is not None:
+            self.wal.append_entry(entry.term, entry.data)
+
+    def _persist_flush(self):
+        if self.wal is not None:
+            self.wal.flush()
+
+    # -- index helpers (global <-> window) ------------------------------------
+
+    def last_index(self) -> int:
+        return self.snap_index + len(self.log)
+
+    def term_at(self, idx: int) -> int:
+        if idx == self.snap_index:
+            return self.snap_term
+        off = idx - self.snap_index - 1
+        if 0 <= off < len(self.log):
+            return self.log[off].term
+        return 0
+
+    def entry_at(self, idx: int) -> LogEntry:
+        return self.log[idx - self.snap_index - 1]
+
+    def last_log_term(self) -> int:
+        return self.log[-1].term if self.log else self.snap_term
+
     # -- helpers -------------------------------------------------------------
 
     def _reset_election_deadline(self, now: int):
@@ -124,13 +198,11 @@ class RaftNode:
             self.election_lo, self.election_hi
         )
 
-    def last_log_term(self) -> int:
-        return self.log[-1].term if self.log else 0
-
     def _become_follower(self, term: int, now: int):
         self.state = FOLLOWER
         self.term = term
         self.voted_for = None
+        self._persist_hard()
         self._reset_election_deadline(now)
 
     # -- public API -----------------------------------------------------------
@@ -142,8 +214,11 @@ class RaftNode:
         with self.lock:
             if self.state != LEADER:
                 return False
-            self.log.append(LogEntry(self.term, data))
-            self.match_index[self.id] = len(self.log)
+            e = LogEntry(self.term, data)
+            self.log.append(e)
+            self._persist_append(e)
+            self._persist_flush()
+            self.match_index[self.id] = self.last_index()
             return True
 
     def is_leader(self) -> bool:
@@ -161,6 +236,33 @@ class RaftNode:
             elif now >= self.election_deadline:
                 self._start_election(now)
             self._apply_committed()
+            if (
+                self.compact_every
+                and self.snapshot_cb is not None
+                and self.last_applied - self.snap_index >= self.compact_every
+            ):
+                self.take_snapshot()
+
+    def take_snapshot(self):
+        """Snapshot the applied state machine and compact the log up to
+        last_applied (ref worker/draft.go:1756 calculateSnapshot +
+        raftwal deleteUntil)."""
+        with self.lock:
+            if self.snapshot_cb is None or self.last_applied <= self.snap_index:
+                return
+            data = self.snapshot_cb()
+            idx = self.last_applied
+            term = self.term_at(idx)
+            drop = idx - self.snap_index
+            self.log = self.log[drop:]
+            self.snap_index, self.snap_term = idx, term
+            self.snapshot_data = data
+            if self.wal is not None:
+                self.wal.save_snapshot(data)
+                self.wal.rewrite_log(
+                    idx, term, [(e.term, e.data) for e in self.log]
+                )
+                self._persist_hard()
 
     # -- election --------------------------------------------------------------
 
@@ -170,6 +272,7 @@ class RaftNode:
         self.voted_for = self.id
         self.leader_id = None
         self._votes = {self.id}
+        self._persist_hard()
         self._reset_election_deadline(now)
         for p in self.peers:
             self.net.send(
@@ -179,7 +282,7 @@ class RaftNode:
                     p,
                     self.term,
                     {
-                        "last_log_index": len(self.log),
+                        "last_log_index": self.last_index(),
                         "last_log_term": self.last_log_term(),
                     },
                 )
@@ -190,9 +293,9 @@ class RaftNode:
     def _become_leader(self, now: int):
         self.state = LEADER
         self.leader_id = self.id
-        self.next_index = {p: len(self.log) + 1 for p in self.peers}
+        self.next_index = {p: self.last_index() + 1 for p in self.peers}
         self.match_index = {p: 0 for p in self.peers}
-        self.match_index[self.id] = len(self.log)
+        self.match_index[self.id] = self.last_index()
         self._broadcast_append(now)
 
     # -- replication -----------------------------------------------------------
@@ -203,10 +306,29 @@ class RaftNode:
             self._send_append(p)
 
     def _send_append(self, p: int):
-        ni = self.next_index.get(p, len(self.log) + 1)
+        ni = self.next_index.get(p, self.last_index() + 1)
+        if ni <= self.snap_index:
+            # the entries this follower needs were compacted away: install
+            # the snapshot instead (worker/snapshot.go:177 streaming analog)
+            if self.snapshot_data is not None:
+                self.net.send(
+                    Message(
+                        "snap_req",
+                        self.id,
+                        p,
+                        self.term,
+                        {
+                            "index": self.snap_index,
+                            "snap_term": self.snap_term,
+                            "data": self.snapshot_data,
+                        },
+                    )
+                )
+            return
         prev_idx = ni - 1
-        prev_term = self.log[prev_idx - 1].term if prev_idx >= 1 and prev_idx <= len(self.log) else 0
-        entries = [(e.term, e.data) for e in self.log[prev_idx:]]
+        prev_term = self.term_at(prev_idx)
+        off = ni - self.snap_index - 1
+        entries = [(e.term, e.data) for e in self.log[off:]]
         self.net.send(
             Message(
                 "append_req",
@@ -235,18 +357,21 @@ class RaftNode:
             self._on_append_req(m, now)
         elif m.kind == "append_resp":
             self._on_append_resp(m, now)
+        elif m.kind == "snap_req":
+            self._on_snap_req(m, now)
 
     def _on_vote_req(self, m: Message, now: int):
         grant = False
         if m.term >= self.term and self.voted_for in (None, m.frm):
             # up-to-date check (§5.4.1)
-            llt, lli = self.last_log_term(), len(self.log)
+            llt, lli = self.last_log_term(), self.last_index()
             if (m.payload["last_log_term"], m.payload["last_log_index"]) >= (
                 llt,
                 lli,
             ):
                 grant = True
                 self.voted_for = m.frm
+                self._persist_hard()  # durable BEFORE the response leaves
                 self._reset_election_deadline(now)
         self.net.send(
             Message("vote_resp", self.id, m.frm, self.term, {"granted": grant})
@@ -270,32 +395,92 @@ class RaftNode:
             self._reset_election_deadline(now)
             prev_idx = m.payload["prev_idx"]
             prev_term = m.payload["prev_term"]
-            if prev_idx == 0 or (
-                prev_idx <= len(self.log)
-                and self.log[prev_idx - 1].term == prev_term
+            if prev_idx < self.snap_index:
+                # everything at/below our snapshot is already committed;
+                # only accept the suffix beyond it
+                skip = self.snap_index - prev_idx
+                if len(m.payload["entries"]) >= skip:
+                    m.payload["entries"] = m.payload["entries"][skip:]
+                    prev_idx = self.snap_index
+                    prev_term = self.snap_term
+                    m.payload["prev_idx"] = prev_idx
+                    m.payload["prev_term"] = prev_term
+                else:
+                    prev_idx = -1  # stale heartbeat below snapshot: ignore
+            if prev_idx >= 0 and (
+                prev_idx == 0
+                or (
+                    prev_idx <= self.last_index()
+                    and self.term_at(prev_idx) == prev_term
+                )
             ):
                 ok = True
                 # append, truncating conflicts (§5.3)
-                idx = prev_idx
+                idx = prev_idx  # global index of the last matching entry
+                dirty = False
                 for term, data in m.payload["entries"]:
-                    if idx < len(self.log):
-                        if self.log[idx].term != term:
-                            del self.log[idx:]
-                            self.log.append(LogEntry(term, data))
+                    off = idx - self.snap_index
+                    if off < len(self.log):
+                        if self.log[off].term != term:
+                            del self.log[off:]
+                            if self.wal is not None:
+                                self.wal.truncate_from(idx + 1)
+                            e = LogEntry(term, data)
+                            self.log.append(e)
+                            self._persist_append(e)
+                            dirty = True
                     else:
-                        self.log.append(LogEntry(term, data))
+                        e = LogEntry(term, data)
+                        self.log.append(e)
+                        self._persist_append(e)
+                        dirty = True
                     idx += 1
+                if dirty:
+                    self._persist_flush()  # durable BEFORE the ack
                 lc = m.payload["leader_commit"]
                 if lc > self.commit_index:
-                    self.commit_index = min(lc, len(self.log))
+                    self.commit_index = min(lc, self.last_index())
         self.net.send(
             Message(
                 "append_resp",
                 self.id,
                 m.frm,
                 self.term,
-                {"ok": ok, "match": len(self.log) if ok else 0,
-                 "hint": len(self.log)},
+                {"ok": ok, "match": self.last_index() if ok else 0,
+                 "hint": self.last_index()},
+            )
+        )
+
+    def _on_snap_req(self, m: Message, now: int):
+        """Install a leader snapshot (lagging/fresh replica catch-up)."""
+        if m.term < self.term:
+            return
+        self.state = FOLLOWER
+        self.leader_id = m.frm
+        self._reset_election_deadline(now)
+        idx, sterm = m.payload["index"], m.payload["snap_term"]
+        if idx <= self.snap_index:
+            pass  # already have it
+        else:
+            data = m.payload["data"]
+            if self.restore_cb is not None:
+                self.restore_cb(data, idx)
+            self.snapshot_data = data
+            self.log = []
+            self.snap_index, self.snap_term = idx, sterm
+            self.commit_index = max(self.commit_index, idx)
+            self.last_applied = max(self.last_applied, idx)
+            if self.wal is not None:
+                self.wal.save_snapshot(data)
+                self.wal.rewrite_log(idx, sterm, [])
+                self._persist_hard()
+        self.net.send(
+            Message(
+                "append_resp",
+                self.id,
+                m.frm,
+                self.term,
+                {"ok": True, "match": self.snap_index, "hint": self.last_index()},
             )
         )
 
@@ -316,24 +501,24 @@ class RaftNode:
 
     def _advance_commit(self):
         n = len(self.peers) + 1
-        for idx in range(len(self.log), self.commit_index, -1):
+        for idx in range(self.last_index(), self.commit_index, -1):
             votes = sum(
                 1 for mi in self.match_index.values() if mi >= idx
             )
-            if votes * 2 > n and self.log[idx - 1].term == self.term:
+            if votes * 2 > n and self.term_at(idx) == self.term:
                 self.commit_index = idx
                 break
 
     def _apply_committed(self):
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            self.apply_cb(self.last_applied, self.log[self.last_applied - 1].data)
+            self.apply_cb(self.last_applied, self.entry_at(self.last_applied).data)
 
 
 class RaftCluster:
     """Test/embedding helper: a set of nodes + virtual time pump."""
 
-    def __init__(self, n: int, apply_cbs=None, seed: int = 0):
+    def __init__(self, n: int, apply_cbs=None, seed: int = 0, **node_kwargs):
         self.net = InProcNetwork()
         ids = list(range(1, n + 1))
         self.nodes: Dict[int, RaftNode] = {}
@@ -345,7 +530,9 @@ class RaftCluster:
                 if apply_cbs
                 else (lambda idx, d, _i=i: self.applied[_i].append(d))
             )
-            self.nodes[i] = RaftNode(i, ids, self.net, cb, seed=seed * 100 + i)
+            self.nodes[i] = RaftNode(
+                i, ids, self.net, cb, seed=seed * 100 + i, **node_kwargs
+            )
         self.now = 0
 
     def pump(self, ms: int = 10, times: int = 1):

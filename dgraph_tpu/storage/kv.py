@@ -251,6 +251,39 @@ class MemKV(KV):
             os.fsync(f.fileno())
         os.replace(path + ".tmp", path)
 
+    def dump_bytes(self) -> bytes:
+        """Serialize all live versions (raft snapshot payload)."""
+        with self._mu:
+            out = io.BytesIO()
+            for k in self._sorted_keys():
+                for ts, v in self._data.get(k, []):
+                    out.write(_WAL_REC.pack(_OP_PUT, len(k), ts, len(v)))
+                    out.write(k)
+                    out.write(v)
+            return out.getvalue()
+
+    def load_bytes(self, blob: bytes):
+        """Replace contents from a dump_bytes() payload (snapshot install).
+        The WAL is restarted from the snapshot so replay stays consistent."""
+        with self._mu:
+            self._data.clear()
+            self._keys = []
+            self._keys_dirty = False
+            pos, n = 0, len(blob)
+            while pos + _WAL_REC.size <= n:
+                op, klen, ts, vlen = _WAL_REC.unpack_from(blob, pos)
+                pos += _WAL_REC.size
+                key = blob[pos : pos + klen]
+                pos += klen
+                val = blob[pos : pos + vlen]
+                pos += vlen
+                self._put_mem(key, ts, val)
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = open(self._wal_path, "wb")
+                self._wal.write(blob)
+                self._wal.flush()
+
     def close(self):
         with self._mu:
             if self._wal is not None:

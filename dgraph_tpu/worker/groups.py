@@ -20,6 +20,10 @@ both without touching this layer.
 
 from __future__ import annotations
 
+import json
+import os
+import pickle
+import struct
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -74,15 +78,55 @@ class ZeroService:
 
 
 class AlphaNode:
-    """One replica: a Raft member applying deltas to its own KV."""
+    """One replica: a Raft member applying deltas to its own KV.
 
-    def __init__(self, node_id: int, group_id: int, peer_ids: List[int], net):
+    With `data_dir` the replica is durable: KV writes go through a WAL and
+    raft hardstate/log/snapshots persist via raft/wal.py (ref raftwal/,
+    worker/server_state.go's per-alpha badger dirs). Restart replays both;
+    re-applied deltas are idempotent (same-ts puts)."""
+
+    def __init__(
+        self,
+        node_id: int,
+        group_id: int,
+        peer_ids: List[int],
+        net,
+        data_dir: Optional[str] = None,
+        compact_every: int = 0,
+    ):
         self.id = node_id
         self.group_id = group_id
-        self.kv: KV = MemKV()
+        raft_wal = None
+        if data_dir is not None:
+            os.makedirs(data_dir, exist_ok=True)
+            self.kv: KV = MemKV(
+                wal_path=os.path.join(data_dir, f"kv_{node_id}.wal")
+            )
+            from dgraph_tpu.raft.wal import RaftWal
+
+            raft_wal = RaftWal(os.path.join(data_dir, f"raft_{node_id}"))
+        else:
+            self.kv = MemKV()
         self.applied_index = 0
         net.register(node_id)
-        self.raft = RaftNode(node_id, peer_ids, net, self._apply)
+        self.raft = RaftNode(
+            node_id,
+            peer_ids,
+            net,
+            self._apply,
+            wal=raft_wal,
+            snapshot_cb=self._snapshot,
+            restore_cb=self._restore,
+            compact_every=compact_every,
+        )
+        self.applied_index = self.raft.last_applied
+
+    def _snapshot(self) -> bytes:
+        return self.kv.dump_bytes()
+
+    def _restore(self, data: bytes, idx: int):
+        self.kv.load_bytes(data)
+        self.applied_index = idx
 
     def _apply(self, idx: int, data):
         kind, payload = data
@@ -95,10 +139,23 @@ class AlphaNode:
 
 
 class AlphaGroup:
-    def __init__(self, group_id: int, node_ids: List[int], net):
+    def __init__(
+        self,
+        group_id: int,
+        node_ids: List[int],
+        net,
+        data_dir: Optional[str] = None,
+        compact_every: int = 0,
+    ):
         self.id = group_id
         self.net = net
-        self.nodes = [AlphaNode(nid, group_id, node_ids, net) for nid in node_ids]
+        self.nodes = [
+            AlphaNode(
+                nid, group_id, node_ids, net,
+                data_dir=data_dir, compact_every=compact_every,
+            )
+            for nid in node_ids
+        ]
 
     def leader(self) -> Optional[AlphaNode]:
         # a downed node may still believe it is leader — skip it, and
@@ -167,6 +224,72 @@ class RoutingKV(KV):
         raise RuntimeError("RoutingKV is read-only; commit via cluster txns")
 
 
+class IntentLog:
+    """Durable commit-intent journal (ref zero/oracle.go:185 delta stream
+    as the recovery model): an intent is appended BEFORE deltas are
+    proposed to the owning groups and marked done after every group
+    applied them. Restart replays unfinished intents, so a crash between
+    groups can no longer tear a commit."""
+
+    _HDR = struct.Struct("<BI")  # kind, len
+    _K_INTENT = 1
+    _K_DONE = 2
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "ab")
+        self._lock = threading.Lock()
+
+    def append_intent(self, commit_ts: int, per_group: Dict[int, list]):
+        blob = pickle.dumps((commit_ts, per_group))
+        with self._lock:
+            self._f.write(self._HDR.pack(self._K_INTENT, len(blob)))
+            self._f.write(blob)
+            self._f.flush()
+
+    def mark_done(self, commit_ts: int):
+        blob = pickle.dumps(commit_ts)
+        with self._lock:
+            self._f.write(self._HDR.pack(self._K_DONE, len(blob)))
+            self._f.write(blob)
+            self._f.flush()
+
+    def pending(self) -> Dict[int, Dict[int, list]]:
+        """commit_ts -> per_group writes for unfinished intents."""
+        out: Dict[int, Dict[int, list]] = {}
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return out
+        pos, n = 0, len(data)
+        while pos + self._HDR.size <= n:
+            kind, plen = self._HDR.unpack_from(data, pos)
+            if pos + self._HDR.size + plen > n:
+                break
+            blob = data[pos + self._HDR.size : pos + self._HDR.size + plen]
+            pos += self._HDR.size + plen
+            try:
+                obj = pickle.loads(blob)
+            except Exception:
+                break
+            if kind == self._K_INTENT:
+                cts, pg = obj
+                out[cts] = pg
+            elif kind == self._K_DONE:
+                out.pop(obj, None)
+        return out
+
+    def close(self):
+        with self._lock:
+            self._f.close()
+
+
+class PartialCommitError(RuntimeError):
+    """A commit reached some groups but not all before a timeout. The
+    intent is durable; recover_intents() (or restart) completes it."""
+
+
 class DistributedCluster:
     """N predicate-sharded groups x R replicas, Zero coordination.
 
@@ -174,17 +297,33 @@ class DistributedCluster:
     query (DQL text) — but every commit fans deltas out to the owning
     groups' Raft logs (ref worker/mutation.go:711 MutateOverNetwork ->
     populateMutationMap -> proposeOrSend).
+
+    With `data_dir`, every replica persists KV + raft state, Zero state
+    (tablets/leases/schema) lands in zero.json, and commits journal
+    through an IntentLog — a full-cluster restart recovers all committed
+    data and completes interrupted commits.
     """
 
-    def __init__(self, n_groups: int = 2, replicas: int = 3, pump_ms: int = 5):
+    def __init__(
+        self,
+        n_groups: int = 2,
+        replicas: int = 3,
+        pump_ms: int = 5,
+        data_dir: Optional[str] = None,
+        compact_every: int = 0,
+    ):
         self.net = InProcNetwork()
         self.zero = ZeroService(n_groups)
+        self.data_dir = data_dir
         self.groups: Dict[int, AlphaGroup] = {}
         nid = 0
         for g in range(1, n_groups + 1):
             ids = list(range(nid + 1, nid + replicas + 1))
             nid += replicas
-            self.groups[g] = AlphaGroup(g, ids, self.net)
+            gdir = os.path.join(data_dir, f"group_{g}") if data_dir else None
+            self.groups[g] = AlphaGroup(
+                g, ids, self.net, data_dir=gdir, compact_every=compact_every
+            )
             for node in self.groups[g].nodes:
                 self.zero.connect(node.id, g)
         from dgraph_tpu.posting.memlayer import MemoryLayer
@@ -197,11 +336,71 @@ class DistributedCluster:
         # destroyed by the drop; ref predicate_move.go's blocking phase)
         self._commit_lock = threading.Lock()
         self._bootstrap_schema()
+        self.intents: Optional[IntentLog] = None
+        if data_dir is not None:
+            os.makedirs(data_dir, exist_ok=True)
+            self.intents = IntentLog(os.path.join(data_dir, "intents.log"))
+            self._load_zero_state()
         self._stop = False
         self._pump_ms = pump_ms
         self._pump_thread = threading.Thread(target=self._pump_loop, daemon=True)
         self._pump_thread.start()
         self._wait_for_leaders()
+        if data_dir is not None:
+            self.recover_intents()
+
+    # -- durable Zero state (tablets/leases/schema; ref zero raft state) ------
+
+    def _zero_state_path(self) -> str:
+        return os.path.join(self.data_dir, "zero.json")
+
+    def _save_zero_state(self):
+        if self.data_dir is None:
+            return
+        z = self.zero.zero
+        state = {
+            "tablets": self.zero.tablets,
+            "max_ts": z.max_assigned,
+            "max_uid": z._max_uid,
+            "schemas": getattr(self, "_schema_texts", []),
+        }
+        tmp = self._zero_state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self._zero_state_path())
+
+    def _load_zero_state(self):
+        path = self._zero_state_path()
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            state = json.load(f)
+        self.zero.tablets.update(state.get("tablets", {}))
+        z = self.zero.zero
+        if state.get("max_ts", 0) > z.max_assigned:
+            z.next_ts(state["max_ts"] - z.max_assigned)
+        if state.get("max_uid", 0) > z._max_uid:
+            z.assign_uids(state["max_uid"] - z._max_uid)
+        self._schema_texts = list(state.get("schemas", []))
+        for text in self._schema_texts:
+            preds, types = parse_schema(text)
+            for su in preds:
+                self.schema.set(su)
+            for tu in types:
+                self.schema.set_type(tu)
+
+    def recover_intents(self) -> int:
+        """Re-propose every unfinished commit intent (crash replay).
+        Proposals are idempotent (same-ts puts). Returns #replayed."""
+        if self.intents is None:
+            return 0
+        replayed = 0
+        for cts, per_group in sorted(self.intents.pending().items()):
+            for gid, writes in per_group.items():
+                self._propose_and_wait(int(gid), ("delta", writes))
+            self.intents.mark_done(cts)
+            replayed += 1
+        return replayed
 
     # -- infrastructure --------------------------------------------------------
 
@@ -233,6 +432,15 @@ class DistributedCluster:
     def close(self):
         self._stop = True
         self._pump_thread.join(timeout=2)
+        if self.intents is not None:
+            self.intents.close()
+        if self.data_dir is not None:
+            self._save_zero_state()
+        for g in self.groups.values():
+            for n in g.nodes:
+                if n.raft.wal is not None:
+                    n.raft.wal.close()
+                n.kv.close()
 
     # -- schema ----------------------------------------------------------------
 
@@ -250,6 +458,11 @@ class DistributedCluster:
                 )
         for tu in types:
             self.schema.set_type(tu)
+        if self.data_dir is not None:
+            if not hasattr(self, "_schema_texts"):
+                self._schema_texts = []
+            self._schema_texts.append(schema_text)
+            self._save_zero_state()
 
     # -- transactions ------------------------------------------------------------
 
@@ -275,21 +488,25 @@ class DistributedCluster:
                 (key, commit_ts, encode_delta(posts))
             )
         # The oracle decision above is final (like the reference's Zero
-        # commit): deltas MUST reach every owning group. _propose_and_wait
-        # retries across leader changes; a timeout here means a group lost
-        # majority — surfaced as a fatal partial-commit error rather than
-        # silently torn state. (The reference replays via the oracle delta
-        # stream on recovery; our durable-replay equivalent is a later
-        # round's work.)
+        # commit): deltas MUST reach every owning group. The intent is
+        # journaled BEFORE proposing, so a mid-commit crash or majority
+        # loss is recoverable — recover_intents()/restart completes it
+        # instead of tearing state (ref zero/oracle.go:185 delta stream).
+        if self.intents is not None:
+            self.intents.append_intent(commit_ts, per_group)
         done = []
         try:
             for gid, writes in per_group.items():
                 self._propose_and_wait(gid, ("delta", writes))
                 done.append(gid)
+            if self.intents is not None:
+                self.intents.mark_done(commit_ts)
+                self._save_zero_state()
         except TimeoutError as e:
-            raise RuntimeError(
-                f"FATAL partial commit at ts {commit_ts}: groups {done} "
-                f"applied, remaining failed: {e}"
+            raise PartialCommitError(
+                f"commit at ts {commit_ts} reached groups {done} but not "
+                f"all before timeout; intent journaled — recover_intents() "
+                f"or restart completes it: {e}"
             ) from e
         finally:
             self.zero.zero.applied(commit_ts)
@@ -315,7 +532,7 @@ class DistributedCluster:
         while time.time() < deadline:
             leader = group.leader()
             if leader is not None and leader.raft.propose(proposal):
-                target = len(leader.raft.log)
+                target = leader.raft.last_index()
                 while time.time() < deadline:
                     if leader.applied_index >= target:
                         return
