@@ -1,0 +1,6 @@
+"""Cluster RPC layer (ref /root/reference/conn/): pooled connections,
+heartbeat health, request/response framing over TCP."""
+
+from dgraph_tpu.conn.rpc import RpcClient, RpcError, RpcPool, RpcServer
+
+__all__ = ["RpcClient", "RpcError", "RpcPool", "RpcServer"]

@@ -1,0 +1,246 @@
+"""Request/response RPC over TCP: the conn/pool.go equivalent.
+
+The reference maintains one gRPC ClientConn per peer inside a Pool with
+health checks (conn/pool.go:52 Pool, :233 MonitorHealth, :292
+IsHealthy). This is the socket equivalent for dgraph-tpu's cross-process
+cluster: length-prefixed JSON frames (bytes base64-tagged, reusing the
+raft transport's codec), persistent pooled connections with reconnect,
+periodic heartbeat pings, and per-peer health state.
+
+Framing: 4-byte big-endian length + JSON body
+  request:  {"id": n, "m": method, "a": args}
+  response: {"id": n, "r": result} | {"id": n, "e": error_string}
+
+JSON (not pickle) on purpose: the wire should never execute code.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from dgraph_tpu.raft.tcp import _jsonize, _unjsonize
+
+_LEN = struct.Struct(">I")
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+def _send_frame(sock: socket.socket, obj: dict):
+    body = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(body)) + body)
+
+
+def _recv_frame(rfile) -> Optional[dict]:
+    hdr = rfile.read(_LEN.size)
+    if len(hdr) < _LEN.size:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    body = rfile.read(n)
+    if len(body) < n:
+        return None
+    return json.loads(body)
+
+
+class RpcServer:
+    """Serves registered handlers; one thread per connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.handlers: Dict[str, Callable[[dict], Any]] = {}
+        self.register("ping", lambda a: {"pong": True, "t": time.time()})
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        req = _recv_frame(self.rfile)
+                    except (OSError, json.JSONDecodeError):
+                        return
+                    if req is None:
+                        return
+                    rid = req.get("id")
+                    fn = outer.handlers.get(req.get("m"))
+                    try:
+                        if fn is None:
+                            raise RpcError(f"no such method {req.get('m')!r}")
+                        result = fn(_unjsonize(req.get("a") or {}))
+                        resp = {"id": rid, "r": _jsonize(result)}
+                    except Exception as e:  # surface to caller, keep serving
+                        resp = {"id": rid, "e": f"{type(e).__name__}: {e}"}
+                    try:
+                        _send_frame(self.connection, resp)
+                    except OSError:
+                        return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Server((host, port), Handler)
+        self.addr: Tuple[str, int] = self._srv.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+
+    def register(self, method: str, fn: Callable[[dict], Any]):
+        self.handlers[method] = fn
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class RpcClient:
+    """One persistent connection to a peer, with reconnect."""
+
+    def __init__(self, addr: Tuple[str, int], timeout: float = 5.0):
+        self.addr = tuple(addr)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def _connect(self):
+        s = socket.create_connection(self.addr, timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(self.timeout)
+        self._sock = s
+        self._rfile = s.makefile("rb")
+
+    def call(self, method: str, args: Optional[dict] = None, timeout=None):
+        with self._lock:
+            deadline = time.time() + (timeout or self.timeout)
+            last_err: Optional[Exception] = None
+            while time.time() < deadline:
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    self._next_id += 1
+                    rid = self._next_id
+                    if timeout:
+                        self._sock.settimeout(timeout)
+                    _send_frame(
+                        self._sock,
+                        {"id": rid, "m": method, "a": _jsonize(args or {})},
+                    )
+                    resp = _recv_frame(self._rfile)
+                    if resp is None:
+                        raise OSError("connection closed")
+                    if resp.get("e"):
+                        raise RpcError(resp["e"])
+                    return _unjsonize(resp.get("r"))
+                except (OSError, socket.timeout) as e:
+                    last_err = e
+                    self.close_conn()
+                    time.sleep(0.05)
+            raise RpcError(f"rpc {method} to {self.addr} failed: {last_err}")
+
+    def close_conn(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._rfile = None
+
+
+class RpcPool:
+    """Pool of peer clients with heartbeat health (conn/pool.go:233).
+
+    `healthy(addr)` is False once a peer misses `max_misses` consecutive
+    pings; a successful ping (or call) restores it. Dead peers' sockets
+    are pruned so reconnects start fresh."""
+
+    def __init__(
+        self,
+        heartbeat_s: float = 1.0,
+        timeout: float = 5.0,
+        max_misses: int = 3,
+    ):
+        self.timeout = timeout
+        self.heartbeat_s = heartbeat_s
+        self.max_misses = max_misses
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._misses: Dict[Tuple[str, int], int] = {}
+        self._last_ok: Dict[Tuple[str, int], float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    def get(self, addr) -> RpcClient:
+        addr = tuple(addr)
+        with self._lock:
+            c = self._clients.get(addr)
+            if c is None:
+                c = RpcClient(addr, timeout=self.timeout)
+                self._clients[addr] = c
+                self._misses.setdefault(addr, 0)
+            return c
+
+    def call(self, addr, method, args=None, timeout=None):
+        c = self.get(addr)
+        try:
+            out = c.call(method, args, timeout=timeout)
+            self._mark(addr, ok=True)
+            return out
+        except RpcError:
+            self._mark(addr, ok=False)
+            raise
+
+    def _mark(self, addr, ok: bool):
+        addr = tuple(addr)
+        with self._lock:
+            if ok:
+                self._misses[addr] = 0
+                self._last_ok[addr] = time.time()
+            else:
+                self._misses[addr] = self._misses.get(addr, 0) + 1
+                if self._misses[addr] >= self.max_misses:
+                    c = self._clients.get(addr)
+                    if c is not None:
+                        c.close_conn()  # prune the dead socket
+
+    def healthy(self, addr) -> bool:
+        return self._misses.get(tuple(addr), 0) < self.max_misses
+
+    def start_heartbeats(self):
+        """Background pinger marking peer health (MonitorHealth analog)."""
+        if self._hb_thread is not None:
+            return self
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+        return self
+
+    def _hb_loop(self):
+        while not self._stop.wait(self.heartbeat_s):
+            with self._lock:
+                addrs = list(self._clients)
+            for addr in addrs:
+                try:
+                    self.get(addr).call("ping", timeout=self.heartbeat_s)
+                    self._mark(addr, ok=True)
+                except RpcError:
+                    self._mark(addr, ok=False)
+
+    def close(self):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        with self._lock:
+            for c in self._clients.values():
+                c.close_conn()
+            self._clients.clear()

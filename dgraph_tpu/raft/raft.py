@@ -296,6 +296,13 @@ class RaftNode:
         self.next_index = {p: self.last_index() + 1 for p in self.peers}
         self.match_index = {p: 0 for p in self.peers}
         self.match_index[self.id] = self.last_index()
+        # commit a no-op in the new term so prior-term entries commit
+        # immediately (raft §8; etcd does the same on election)
+        e = LogEntry(self.term, ("noop", None))
+        self.log.append(e)
+        self._persist_append(e)
+        self._persist_flush()
+        self.match_index[self.id] = self.last_index()
         self._broadcast_append(now)
 
     # -- replication -----------------------------------------------------------

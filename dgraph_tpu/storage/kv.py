@@ -100,18 +100,26 @@ class MemKV(KV):
         with self._mu:
             self._put_mem(key, ts, value)
             self._wal_append(_OP_PUT, key, ts, value)
+            self._wal_flush()
 
     def put_batch(self, items) -> None:
         with self._mu:
             for k, ts, v in items:
                 self._put_mem(k, ts, v)
                 self._wal_append(_OP_PUT, k, ts, v)
+            self._wal_flush()
 
     def _wal_append(self, op: int, key: bytes, ts: int, value: bytes = b""):
         if self._wal is not None:
             self._wal.write(_WAL_REC.pack(op, len(key), ts, len(value)))
             self._wal.write(key)
             self._wal.write(value)
+
+    def _wal_flush(self):
+        # push buffered records to the OS after every write batch: a
+        # SIGKILLed process loses nothing (fsync durability is sync())
+        if self._wal is not None:
+            self._wal.flush()
 
     def sync(self):
         if self._wal is not None:
@@ -197,6 +205,7 @@ class MemKV(KV):
         with self._mu:
             self._delete_below_mem(key, ts)
             self._wal_append(_OP_DELETE_BELOW, key, ts)
+            self._wal_flush()
 
     def _delete_below_mem(self, key: bytes, ts: int) -> None:
         vers = self._data.get(key)
@@ -208,6 +217,7 @@ class MemKV(KV):
         with self._mu:
             self._drop_prefix_mem(prefix)
             self._wal_append(_OP_DROP_PREFIX, prefix, 0)
+            self._wal_flush()
 
     def _drop_prefix_mem(self, prefix: bytes) -> None:
         for k in [k for k in self._data if k.startswith(prefix)]:

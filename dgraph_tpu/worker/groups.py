@@ -135,6 +135,7 @@ class AlphaNode:
             self.kv.put_batch(payload)
         elif kind == "drop":
             self.kv.drop_prefix(payload)
+        # "noop": leader's term-start entry — nothing to apply
         self.applied_index = idx
 
 
@@ -466,6 +467,9 @@ class DistributedCluster:
 
     # -- transactions ------------------------------------------------------------
 
+    def read_kv(self) -> KV:
+        return RoutingKV(self)
+
     def new_txn(self) -> "ClusterTxn":
         return ClusterTxn(self)
 
@@ -606,7 +610,7 @@ class ClusterTxn:
     def __init__(self, cluster: DistributedCluster):
         self.cluster = cluster
         self.start_ts = cluster.zero.zero.begin_txn()
-        self.txn = Txn(RoutingKV(cluster), self.start_ts, mem=cluster.mem)
+        self.txn = Txn(cluster.read_kv(), self.start_ts, mem=cluster.mem)
 
     def mutate_rdf(self, set_rdf: str = "", del_rdf: str = "", commit_now=False):
         from dgraph_tpu.loaders.rdf import parse_rdf

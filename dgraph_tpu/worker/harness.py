@@ -1,0 +1,246 @@
+"""Multi-process cluster harness (ref dgraphtest/local_cluster.go:92).
+
+Spawns one OS process per Alpha replica (dgraph_tpu.worker.alpha_process),
+runs the Zero/coordinator in the calling process, and exposes the same
+alter / new_txn / query surface as DistributedCluster — but every read is
+a real RPC and every commit is a real cross-process raft proposal.
+
+Fault injection at process granularity: kill(node) SIGKILLs the replica,
+restart(node) respawns it from its data dir (durable mode).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dgraph_tpu.conn.rpc import RpcError, RpcPool
+from dgraph_tpu.posting.lists import Txn
+from dgraph_tpu.schema.schema import State, parse_schema
+from dgraph_tpu.worker.groups import ClusterTxn, IntentLog, ZeroService
+from dgraph_tpu.worker.remote import RemoteGroup, RemoteKV
+from dgraph_tpu.x import keys
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class ProcCluster:
+    def __init__(
+        self,
+        n_groups: int = 1,
+        replicas: int = 3,
+        data_dir: Optional[str] = None,
+        compact_every: int = 0,
+    ):
+        self.zero = ZeroService(n_groups)
+        self.schema = State()
+        from dgraph_tpu.posting.memlayer import MemoryLayer
+
+        self.mem = MemoryLayer()
+        self.vector_indexes: Dict[str, object] = {}
+        self.data_dir = data_dir
+        self.pool = RpcPool(heartbeat_s=0.5, timeout=5.0).start_heartbeats()
+        self.remote_groups: Dict[int, RemoteGroup] = {}
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self._cfgs: Dict[int, dict] = {}
+        self._commit_lock = threading.Lock()
+        self.intents: Optional[IntentLog] = None
+        if data_dir is not None:
+            os.makedirs(data_dir, exist_ok=True)
+            self.intents = IntentLog(os.path.join(data_dir, "intents.log"))
+
+        nid = 0
+        for g in range(1, n_groups + 1):
+            ids = list(range(nid + 1, nid + replicas + 1))
+            nid += replicas
+            raft_ports = _free_ports(replicas)
+            rpc_ports = _free_ports(replicas)
+            raft_addrs = {
+                str(i): ["127.0.0.1", p] for i, p in zip(ids, raft_ports)
+            }
+            addrs = []
+            for i, rp in zip(ids, rpc_ports):
+                cfg = {
+                    "node_id": i,
+                    "group_id": g,
+                    "replica_ids": ids,
+                    "raft_addrs": raft_addrs,
+                    "rpc_addr": ["127.0.0.1", rp],
+                    "compact_every": compact_every,
+                    "data_dir": (
+                        os.path.join(data_dir, f"group_{g}")
+                        if data_dir
+                        else None
+                    ),
+                }
+                self._cfgs[i] = cfg
+                addrs.append(("127.0.0.1", rp))
+                self._spawn(i)
+                self.zero.connect(i, g)
+            self.remote_groups[g] = RemoteGroup(g, addrs, self.pool)
+        self._bootstrap_schema()
+        self._wait_healthy()
+        if self.intents is not None:
+            self.recover_intents()
+
+    # -- process control ------------------------------------------------------
+
+    def _spawn(self, node_id: int):
+        cfg = self._cfgs[node_id]
+        cfg_dir = self.data_dir or "/tmp/dgraph_tpu_proc"
+        os.makedirs(cfg_dir, exist_ok=True)
+        path = os.path.join(cfg_dir, f"alpha_{node_id}.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"  # replicas never need the device
+        # the replica must import dgraph_tpu regardless of the caller's cwd
+        import dgraph_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(dgraph_tpu.__file__))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        log = open(os.path.join(cfg_dir, f"alpha_{node_id}.log"), "ab")
+        self.procs[node_id] = subprocess.Popen(
+            [sys.executable, "-m", "dgraph_tpu.worker.alpha_process", path],
+            env=env,
+            stdout=log,
+            stderr=log,
+        )
+        log.close()
+
+    def kill(self, node_id: int):
+        p = self.procs.get(node_id)
+        if p is not None and p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=5)
+
+    def restart(self, node_id: int):
+        self.kill(node_id)
+        self._spawn(node_id)
+
+    def _wait_healthy(self, timeout: float = 45.0):
+        """Block until every group has an RPC-reachable leader. Bypasses
+        the leader/health caches: after a respawn the caches are stale and
+        freshly-booted replica interpreters can take seconds to bind."""
+        deadline = time.time() + timeout
+        for g in self.remote_groups.values():
+            g._leader = None  # force fresh discovery
+            ok = False
+            while time.time() < deadline and not ok:
+                for a in g.addrs:
+                    try:
+                        h = self.pool.call(a, "health", timeout=1.0)
+                        if h.get("is_leader"):
+                            g._leader = tuple(a)
+                            g._leader_at = time.time()
+                            ok = True
+                            break
+                    except RpcError:
+                        continue
+                if not ok:
+                    time.sleep(0.2)
+            if not ok:
+                raise TimeoutError(f"group {g.gid} never elected a leader")
+
+    def close(self):
+        for nid in list(self.procs):
+            self.kill(nid)
+        self.pool.close()
+        if self.intents is not None:
+            self.intents.close()
+
+    # -- coordinator surface (mirrors DistributedCluster) ---------------------
+
+    def _bootstrap_schema(self):
+        for su in parse_schema(
+            "dgraph.type: [string] @index(exact) .\n"
+            "dgraph.xid: string @index(exact) .\n"
+        )[0]:
+            self.schema.set(su)
+
+    def alter(self, schema_text: str):
+        preds, types = parse_schema(schema_text)
+        for su in preds:
+            self.schema.set(su)
+            self.zero.should_serve(su.predicate)
+        for tu in types:
+            self.schema.set_type(tu)
+
+    def read_kv(self):
+        return RemoteKV(self)
+
+    def new_txn(self) -> ClusterTxn:
+        return ClusterTxn(self)
+
+    def _commit(self, txn: Txn) -> int:
+        with self._commit_lock:
+            return self._commit_locked(txn)
+
+    def _commit_locked(self, txn: Txn) -> int:
+        from dgraph_tpu.posting.pl import encode_delta
+
+        commit_ts = self.zero.zero.commit(
+            txn.start_ts, txn.conflict_keys, track=True
+        )
+        per_group: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
+        for key, posts in txn.cache.deltas.items():
+            if not posts:
+                continue
+            pk = keys.parse_key(key)
+            gid = self.zero.should_serve(pk.attr)
+            per_group.setdefault(gid, []).append(
+                (key, commit_ts, encode_delta(posts))
+            )
+        if self.intents is not None:
+            self.intents.append_intent(commit_ts, per_group)
+        try:
+            for gid, writes in per_group.items():
+                self.remote_groups[gid].propose(("delta", writes))
+            if self.intents is not None:
+                self.intents.mark_done(commit_ts)
+        finally:
+            self.zero.zero.applied(commit_ts)
+            self.mem.invalidate(txn.cache.deltas.keys())
+        return commit_ts
+
+    def recover_intents(self) -> int:
+        if self.intents is None:
+            return 0
+        replayed = 0
+        for cts, per_group in sorted(self.intents.pending().items()):
+            for gid, writes in per_group.items():
+                writes = [(bytes(k), int(ts), bytes(v)) for k, ts, v in writes]
+                self.remote_groups[int(gid)].propose(("delta", writes))
+            self.intents.mark_done(cts)
+            replayed += 1
+        return replayed
+
+    def query(self, q: str, read_ts: Optional[int] = None) -> dict:
+        from dgraph_tpu import dql
+        from dgraph_tpu.posting.lists import LocalCache
+        from dgraph_tpu.query.outputjson import JsonEncoder
+        from dgraph_tpu.query.subgraph import Executor
+
+        ts = read_ts if read_ts is not None else self.zero.zero.read_ts()
+        cache = LocalCache(self.read_kv(), ts, mem=self.mem)
+        ex = Executor(cache, self.schema, vector_indexes=self.vector_indexes)
+        nodes = ex.process(dql.parse(q))
+        enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
+        return {"data": enc.encode_blocks(nodes)}

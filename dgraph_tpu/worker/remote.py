@@ -1,0 +1,174 @@
+"""Coordinator-side view of a multi-process cluster.
+
+Mirrors the reference's query-side fan-out (worker/task.go:2224
+ProcessTaskOverNetwork -> group pick -> gRPC) and mutation forwarding
+(worker/mutation.go proposeOrSend): reads route by tablet to a healthy
+replica of the owning group with request hedging (task.go:60 — a backup
+request fires if the primary is slow; first answer wins), proposals go to
+the group leader with not-leader retry.
+
+The RemoteKV satisfies the same KV read interface the executor uses, so
+the whole query engine runs unchanged against OS-process alphas.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dgraph_tpu.conn.rpc import RpcError, RpcPool
+from dgraph_tpu.storage.kv import KV
+from dgraph_tpu.x import keys
+
+
+class RemoteGroup:
+    """Client handle for one raft group of alpha processes."""
+
+    def __init__(self, gid: int, rpc_addrs: List[Tuple[str, int]], pool: RpcPool):
+        self.gid = gid
+        self.addrs = [tuple(a) for a in rpc_addrs]
+        self.pool = pool
+        self._leader: Optional[Tuple[str, int]] = None
+        self._leader_at = 0.0
+
+    def healthy_addrs(self) -> List[Tuple[str, int]]:
+        healthy = [a for a in self.addrs if self.pool.healthy(a)]
+        return healthy or list(self.addrs)
+
+    def leader_addr(self, timeout: float = 5.0) -> Optional[Tuple[str, int]]:
+        # short-lived cache: reads are leader-first (committed writes wait
+        # only for the leader's apply, so followers may lag) and probing
+        # health on every read would double RPC traffic
+        if self._leader is not None and time.time() - self._leader_at < 1.0:
+            if self.pool.healthy(self._leader):
+                return self._leader
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for a in self.healthy_addrs():
+                try:
+                    h = self.pool.call(a, "health", timeout=1.0)
+                    if h.get("is_leader"):
+                        self._leader = a
+                        self._leader_at = time.time()
+                        return a
+                except RpcError:
+                    continue
+            time.sleep(0.05)
+        return None
+
+    def propose(self, data, timeout: float = 15.0):
+        """Leader-routed proposal with retry across elections."""
+        deadline = time.time() + timeout
+        last = "no leader found"
+        while time.time() < deadline:
+            addr = self.leader_addr(timeout=max(0.1, deadline - time.time()))
+            if addr is None:
+                continue
+            try:
+                out = self.pool.call(
+                    addr, "propose", {"data": data, "timeout": 5.0},
+                    timeout=8.0,
+                )
+            except RpcError as e:
+                last = str(e)
+                continue
+            if out.get("ok"):
+                return out
+            last = f"not leader / timeout from {addr}: {out}"
+            time.sleep(0.05)
+        raise TimeoutError(f"proposal to group {self.gid} failed: {last}")
+
+    def read(self, method: str, args: dict, hedge_after: float = 0.15):
+        """Hedged read (worker/task.go:60): fire at the leader (it has
+        applied every acked commit); if it hasn't answered within
+        `hedge_after`, race a follower and take whichever returns first."""
+        addrs = self.healthy_addrs()
+        lead = self.leader_addr(timeout=2.0)
+        if lead is not None:
+            addrs = [lead] + [a for a in addrs if a != lead]
+        if len(addrs) == 1:
+            return self.pool.call(addrs[0], method, args)
+        ex = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+        try:
+            f1 = ex.submit(self.pool.call, addrs[0], method, args)
+            try:
+                return f1.result(timeout=hedge_after)
+            except concurrent.futures.TimeoutError:
+                pass
+            except RpcError:
+                return self.pool.call(addrs[1], method, args)
+            f2 = ex.submit(self.pool.call, addrs[1], method, args)
+            done, _ = concurrent.futures.wait(
+                [f1, f2], return_when=concurrent.futures.FIRST_COMPLETED
+            )
+            errs = []
+            for f in done:
+                try:
+                    return f.result()
+                except RpcError as e:
+                    errs.append(e)
+            for f in (f1, f2):
+                try:
+                    return f.result(timeout=5.0)
+                except (RpcError, concurrent.futures.TimeoutError) as e:
+                    errs.append(e)
+            raise RpcError(f"all hedged reads failed: {errs}")
+        finally:
+            ex.shutdown(wait=False)
+
+
+class RemoteKV(KV):
+    """Read-only KV routing each key to its tablet's owning group over RPC
+    (the ServeTask seam made real across OS processes)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def _group_for(self, attr: str) -> Optional[RemoteGroup]:
+        gid = self.cluster.zero.belongs_to(attr)
+        if gid is None:
+            return None
+        return self.cluster.remote_groups[gid]
+
+    def get(self, key, read_ts):
+        g = self._group_for(keys.parse_key(key).attr)
+        if g is None:
+            return None
+        got = g.read("kv.get", {"key": key, "ts": read_ts})
+        return None if got is None else (got[0], bytes(got[1]))
+
+    def versions(self, key, read_ts):
+        g = self._group_for(keys.parse_key(key).attr)
+        if g is None:
+            return []
+        return [
+            (ts, bytes(v))
+            for ts, v in g.read("kv.versions", {"key": key, "ts": read_ts})
+        ]
+
+    def iterate(self, prefix, read_ts):
+        attr = keys.attr_of(prefix)
+        groups = (
+            [self._group_for(attr)]
+            if attr is not None
+            else list(self.cluster.remote_groups.values())
+        )
+        for g in groups:
+            if g is None:
+                continue
+            for k, ts, v in g.read(
+                "kv.iterate", {"prefix": prefix, "ts": read_ts}
+            ):
+                yield (bytes(k), ts, bytes(v))
+
+    def iterate_versions(self, prefix, read_ts):
+        for g in self.cluster.remote_groups.values():
+            for k, vers in g.read(
+                "kv.iterate_versions", {"prefix": prefix, "ts": read_ts}
+            ):
+                yield (bytes(k), [(ts, bytes(v)) for ts, v in vers])
+
+    def put(self, key, ts, value):
+        raise RuntimeError("RemoteKV is read-only; commit via cluster txns")

@@ -1,0 +1,148 @@
+"""Real multi-OS-process cluster tests (VERDICT r1 next-round #6).
+
+Spawns alpha replicas as separate python processes (ref
+dgraphtest/local_cluster.go): cross-process raft over TCP, RPC reads with
+hedging, leader-routed proposals, process-kill fault injection, durable
+restart.
+"""
+
+import time
+
+import pytest
+
+from dgraph_tpu.conn.rpc import RpcPool, RpcServer
+from dgraph_tpu.worker.harness import ProcCluster
+
+
+# ---------------------------------------------------------------------------
+# RPC layer
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_roundtrip_and_errors():
+    srv = RpcServer().start()
+    srv.register("echo", lambda a: {"got": a})
+    srv.register("boom", lambda a: 1 / 0)
+    pool = RpcPool(timeout=3.0)
+    out = pool.call(srv.addr, "echo", {"x": 1, "b": b"\x00\xff"})
+    assert out["got"]["x"] == 1 and bytes(out["got"]["b"]) == b"\x00\xff"
+    from dgraph_tpu.conn.rpc import RpcError
+
+    with pytest.raises(RpcError):
+        pool.call(srv.addr, "boom")
+    with pytest.raises(RpcError):
+        pool.call(srv.addr, "nope")
+    assert pool.healthy(srv.addr)
+    srv.close()
+    pool.close()
+
+
+def test_rpc_pool_health_marks_dead_peer():
+    srv = RpcServer().start()
+    pool = RpcPool(timeout=0.3, heartbeat_s=0.1, max_misses=2)
+    pool.call(srv.addr, "ping")
+    addr = srv.addr
+    srv.close()
+    # drop the pooled socket: the listener is gone, reconnects must fail
+    # (an established handler thread would otherwise keep answering)
+    pool.get(addr).close_conn()
+    from dgraph_tpu.conn.rpc import RpcError
+
+    for _ in range(3):
+        try:
+            pool.call(addr, "ping", timeout=0.3)
+        except RpcError:
+            pass
+    assert not pool.healthy(addr)
+    pool.close()
+
+
+# ---------------------------------------------------------------------------
+# Process cluster
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = ProcCluster(n_groups=2, replicas=3)
+    yield c
+    c.close()
+
+
+SCHEMA = "name: string @index(exact) .\nfollows: [uid] .\nage: int @index(int) ."
+
+
+def test_proc_cluster_end_to_end(cluster):
+    cluster.alter(SCHEMA)
+    t = cluster.new_txn()
+    t.mutate_rdf(
+        set_rdf=(
+            '<0x1> <name> "alice" .\n'
+            '<0x2> <name> "bob" .\n'
+            '<0x1> <age> "30"^^<xs:int> .\n'
+            "<0x1> <follows> <0x2> .\n"
+        ),
+        commit_now=True,
+    )
+    out = cluster.query(
+        '{ q(func: eq(name, "alice")) { name age follows { name } } }'
+    )
+    q = out["data"]["q"][0]
+    assert q["name"] == "alice" and q["age"] == 30
+    assert q["follows"][0]["name"] == "bob"
+
+
+def test_proc_cluster_survives_follower_kill(cluster):
+    g = cluster.remote_groups[1]
+    leader = g.leader_addr()
+    victim = None
+    for nid, cfg in cluster._cfgs.items():
+        addr = tuple(cfg["rpc_addr"])
+        if cfg["group_id"] == 1 and addr != leader:
+            victim = nid
+            break
+    cluster.kill(victim)
+    t = cluster.new_txn()
+    t.mutate_rdf(set_rdf='<0x3> <name> "carol" .', commit_now=True)
+    out = cluster.query('{ q(func: eq(name, "carol")) { name } }')
+    assert out["data"]["q"][0]["name"] == "carol"
+    cluster.restart(victim)
+    time.sleep(0.5)
+
+
+def test_proc_cluster_survives_leader_kill(cluster):
+    g = cluster.remote_groups[1]
+    leader = g.leader_addr()
+    victim = None
+    for nid, cfg in cluster._cfgs.items():
+        if tuple(cfg["rpc_addr"]) == tuple(leader):
+            victim = nid
+            break
+    cluster.kill(victim)
+    # remaining two re-elect; commits keep working
+    t = cluster.new_txn()
+    t.mutate_rdf(set_rdf='<0x4> <name> "dave" .', commit_now=True)
+    out = cluster.query('{ q(func: eq(name, "dave")) { name } }')
+    assert out["data"]["q"][0]["name"] == "dave"
+    cluster.restart(victim)
+    time.sleep(0.5)
+
+
+def test_proc_cluster_durable_restart(tmp_path):
+    d = str(tmp_path / "pc")
+    c = ProcCluster(n_groups=1, replicas=3, data_dir=d)
+    try:
+        c.alter("name: string @index(exact) .")
+        c.new_txn().mutate_rdf(set_rdf='<0x9> <name> "zoe" .', commit_now=True)
+        out = c.query('{ q(func: eq(name, "zoe")) { name } }')
+        assert out["data"]["q"][0]["name"] == "zoe"
+        # kill ALL replicas, respawn from disk
+        for nid in list(c.procs):
+            c.kill(nid)
+        for nid in list(c.procs):
+            c._spawn(nid)
+        c._wait_healthy()
+        out = c.query('{ q(func: eq(name, "zoe")) { name } }')
+        assert out["data"]["q"][0]["name"] == "zoe"
+    finally:
+        c.close()
