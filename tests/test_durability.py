@@ -150,7 +150,7 @@ def test_snapshot_compaction_and_lagging_catchup(tmp_path):
     # node 3 rejoins: needs the compacted entries -> snapshot install
     c.net.down.discard(dead)
     assert c.run_until(lambda: c.nodes[dead].snap_index >= 20, max_ms=30_000)
-    assert kvs[dead] == kvs[leader.id]
+    assert c.run_until(lambda: kvs[dead] == kvs[leader.id], max_ms=30_000)
     # and replication continues past the snapshot
     assert leader.propose(("set", 99))
     assert c.run_until(lambda: ("set", 99) in kvs[dead])
