@@ -519,7 +519,15 @@ class RaftNode:
     def _apply_committed(self):
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            self.apply_cb(self.last_applied, self.entry_at(self.last_applied).data)
+            data = self.entry_at(self.last_applied).data
+            # the leader's term-start no-op is raft bookkeeping, not state
+            if (
+                isinstance(data, (tuple, list))
+                and len(data) == 2
+                and data[0] == "noop"
+            ):
+                continue
+            self.apply_cb(self.last_applied, data)
 
 
 class RaftCluster:
