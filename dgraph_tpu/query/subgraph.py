@@ -571,7 +571,122 @@ class Executor:
             uids = self._order_uids(gq, uids)
         return _paginate(uids, gq.first, gq.offset, gq.after)
 
+    def _order_uids_indexed(
+        self, gq: GraphQuery, o: Order, uids: np.ndarray
+    ) -> Optional[np.ndarray]:
+        """Index-walk ordering (ref worker/sort.go:189 sortWithIndex): walk
+        the attr's sortable index buckets in token order — token bytes are
+        order-preserving for exact/int/datetime tokenizers — intersecting
+        each bucket with the candidates, early-stopping at offset+first.
+        One KV read per DISTINCT value instead of one per uid. Returns
+        None when no sortable index applies (caller falls back)."""
+        if o.val_var or o.lang:
+            return None
+        su = self.st.get(o.attr)
+        if su is None:
+            return None
+        tk = next(
+            (t for t in su.tokenizer_objs() if t.is_sortable), None
+        )
+        if tk is None:
+            return None
+        need = None
+        if gq.first is not None and gq.first >= 0 and gq.after is None:
+            need = (gq.offset or 0) + gq.first
+        prefix = keys.IndexPrefix(o.attr, self.ns)
+        ident = bytes([tk.identifier])
+        bucket_keys = [
+            k
+            for k, _, _ in self.cache.kv.iterate(prefix, self.cache.read_ts)
+            if keys.parse_key(k).term.startswith(ident)
+        ]
+        if o.desc:
+            bucket_keys.reverse()
+        out: List[int] = []
+        emitted: set = set()  # a uid with several indexed values (langs,
+        # list preds) appears in several buckets — first bucket wins
+        cand = uids
+        for bk in bucket_keys:
+            if need is not None and len(out) >= need:
+                break
+            bucket = self.cache.uids(bk)
+            if not len(bucket):
+                continue
+            sel = np.intersect1d(bucket, cand, assume_unique=True)
+            sel = np.array(
+                [u for u in sel if int(u) not in emitted], dtype=np.uint64
+            )
+            if not len(sel):
+                continue
+            emitted.update(int(u) for u in sel)
+            if tk.is_lossy and len(sel) > 1:
+                # lossy buckets (float@int, year/...) order between buckets
+                # only: sort inside by actual value (sortWithoutIndex per
+                # bucket in the reference)
+                sub = GraphQuery(attr=gq.attr)
+                sub.order = [Order(attr=o.attr, desc=o.desc, lang=o.lang)]
+                sel = self._order_uids_generic(sub, sel)
+            out.extend(int(u) for u in sel)
+        if need is None or len(out) < need:
+            # uids with no indexed value sink to the end (ref behavior)
+            rest = np.setdiff1d(cand, np.array(out, np.uint64))
+            out.extend(int(u) for u in rest)
+        return np.array(out, dtype=np.uint64)
+
+    def _order_uids_topk(
+        self, gq: GraphQuery, o: Order, uids: np.ndarray
+    ) -> Optional[np.ndarray]:
+        """Device top-k for `first: N` over a numeric value-var ordering:
+        one lax.top_k instead of a host sort (ref pagination path in
+        query/outputnode.go + worker/sort.go)."""
+        if not o.val_var or gq.first is None or gq.first < 0 or gq.after is not None:
+            return None
+        vals = self.val_vars.get(o.val_var, {})
+        need = (gq.offset or 0) + gq.first
+        if len(uids) < 4096 or need >= len(uids):
+            return None  # host sort wins below dispatch overhead
+        scores = np.zeros((len(uids),), np.float64)
+        present_mask = np.zeros((len(uids),), bool)
+        for i, u in enumerate(uids):
+            v = vals.get(int(u))
+            if v is None:
+                continue  # missing values sink to the end
+            if not isinstance(v.value, (int, float)) or isinstance(v.value, bool):
+                return None  # non-numeric ordering: host path
+            scores[i] = float(v.value)
+            present_mask[i] = True
+        import jax
+        import jax.numpy as jnp
+
+        sc = np.where(
+            present_mask,
+            scores if o.desc else -scores,
+            -np.inf,  # missing sink to the end
+        ).astype(np.float32)
+        k = min(need, len(uids))
+        _, idx = jax.lax.top_k(jnp.asarray(sc), k)
+        idx = np.asarray(idx)
+        top = uids[idx]
+        if len(top) < len(uids):
+            rest = np.setdiff1d(uids, top, assume_unique=False)
+            # rest order is unspecified beyond the pagination window
+            return np.concatenate([top, rest])
+        return top
+
     def _order_uids(self, gq: GraphQuery, uids: np.ndarray) -> np.ndarray:
+        if not len(uids) or not gq.order:
+            return uids
+        if len(gq.order) == 1:
+            o = gq.order[0]
+            got = self._order_uids_topk(gq, o, uids)
+            if got is not None:
+                return got
+            got = self._order_uids_indexed(gq, o, uids)
+            if got is not None:
+                return got
+        return self._order_uids_generic(gq, uids)
+
+    def _order_uids_generic(self, gq: GraphQuery, uids: np.ndarray) -> np.ndarray:
         if not len(uids) or not gq.order:
             return uids
 
