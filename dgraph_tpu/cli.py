@@ -50,6 +50,11 @@ def cmd_alpha(args):
         RollupDaemon(engine, interval_s=args.rollup_interval).start()
     srv = HTTPServer(engine, host=args.bind, port=args.port).start()
     print(f"alpha listening on http://{args.bind}:{srv.port}")
+    if args.grpc_port >= 0:
+        from dgraph_tpu.api.grpc_server import serve as grpc_serve
+
+        _, gport = grpc_serve(engine, host=args.bind, port=args.grpc_port)
+        print(f"alpha gRPC (api.Dgraph) on {args.bind}:{gport}")
     try:
         import time
 
@@ -188,6 +193,12 @@ def main(argv=None):
         p.add_argument("-p", default=None, help="data directory (default: in-memory)")
 
     p = sub.add_parser("alpha", help="serve the HTTP API")
+    p.add_argument(
+        "--grpc_port",
+        type=int,
+        default=9080,
+        help="api.Dgraph gRPC port (-1 disables; 0 = OS-assigned)",
+    )
     add_p(p)
     p.add_argument("--bind", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8080)

@@ -1,0 +1,32 @@
+"""Protocol buffers for the public client API.
+
+api_pb2 is generated from api.proto by protoc at first import (and cached
+beside the .proto): checking generated code in would pin a protobuf
+runtime version, and the baked toolchain already has protoc.
+"""
+
+import importlib
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(__file__)
+
+
+def _ensure_generated():
+    gen = os.path.join(_HERE, "api_pb2.py")
+    proto = os.path.join(_HERE, "api.proto")
+    if not os.path.exists(gen) or os.path.getmtime(gen) < os.path.getmtime(
+        proto
+    ):
+        subprocess.run(
+            ["protoc", f"-I{_HERE}", f"--python_out={_HERE}", proto],
+            check=True,
+        )
+
+
+def load_api_pb2():
+    _ensure_generated()
+    if _HERE not in sys.path:
+        sys.path.insert(0, _HERE)
+    return importlib.import_module("api_pb2")
