@@ -214,9 +214,15 @@ class _Handler(BaseHTTPRequestHandler):
                 gql = getattr(self.engine, "graphql", None)
                 if gql is None:
                     return self._error("no GraphQL schema configured", 400)
+                # @auth JWT: read the header named by Dgraph.Authorization
+                token = None
+                if gql.auth_config is not None:
+                    token = self.headers.get(gql.auth_config.header)
                 self._reply(
                     gql.execute(
-                        body.get("query", ""), body.get("variables")
+                        body.get("query", ""),
+                        body.get("variables"),
+                        jwt_token=token,
                     )
                 )
             elif path == "/admin/schema/graphql":

@@ -50,9 +50,15 @@ class GraphQLError(Exception):
 
 class GraphQLServer:
     def __init__(self, engine, sdl: str):
+        import threading
+
+        from dgraph_tpu.graphql.auth import parse_authorization
+
         self.engine = engine
         self.types: Dict[str, GqlType] = parse_sdl(sdl)
         self.sdl = sdl
+        self.auth_config = parse_authorization(sdl)
+        self._tls = threading.local()  # per-request JWT claims
         engine.alter(to_dql_schema(self.types))
 
     # ------------------------------------------------------------------
@@ -60,9 +66,18 @@ class GraphQLServer:
     # ------------------------------------------------------------------
 
     def execute(
-        self, query: str, variables: Optional[Dict[str, Any]] = None
+        self,
+        query: str,
+        variables: Optional[Dict[str, Any]] = None,
+        jwt_token: Optional[str] = None,
+        claims: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         try:
+            if claims is None and jwt_token and self.auth_config:
+                from dgraph_tpu.graphql.auth import claims_from_jwt
+
+                claims = claims_from_jwt(jwt_token, self.auth_config)
+            self._tls.claims = claims or {}
             op = parse_operation(query, variables)
             data = {}
             for sel in op.selections:
@@ -87,8 +102,36 @@ class GraphQLServer:
                     return t
         raise GraphQLError(f"unknown operation {sel_name!r}")
 
+    def _claims(self) -> Dict[str, Any]:
+        return getattr(self._tls, "claims", {}) or {}
+
+    def _auth(self, t: GqlType, op: str):
+        """True | False | filter-dict for the operation (@auth rules,
+        ref graphql/resolve query_rewriter auth injection)."""
+        from dgraph_tpu.graphql.auth import evaluate
+
+        if t.auth is None:
+            return True
+        return evaluate(getattr(t.auth, op), self._claims())
+
+    def _with_auth_filter(self, t: GqlType, fobj, op: str = "query"):
+        """Merge the type's auth rule filter into a filter object. Returns
+        (filter_obj, allowed)."""
+        auth = self._auth(t, op)
+        if auth is True:
+            return fobj, True
+        if auth is False:
+            return fobj, False
+        if not fobj:
+            return auth, True
+        return {"and": [fobj, auth]}, True
+
     def _resolve_query(self, sel: Selection):
         name = sel.name
+        if name == "__schema" or name == "__type":
+            from dgraph_tpu.graphql.introspection import resolve_introspection
+
+            return resolve_introspection(self.types, sel)
         if name.startswith("get"):
             t = self._type_for(name, ["get"])
             return self._get(t, sel)
@@ -105,6 +148,17 @@ class GraphQLServer:
             t = self._type_for(name, ["aggregate"])
             return self._aggregate(t, sel)
         raise GraphQLError(f"unknown query {name!r}")
+
+    @staticmethod
+    def _add_typename(results, t: GqlType, sels: List[Selection]):
+        """Inject __typename literals the encoder doesn't know about."""
+        keys_ = [s.key for s in sels if s.name == "__typename"]
+        if not keys_:
+            return results
+        for r in results:
+            for k in keys_:
+                r[k] = t.name
+        return results
 
     def _run_block(self, gq: GraphQuery) -> List[dict]:
         cache = LocalCache(
@@ -125,6 +179,8 @@ class GraphQLServer:
         out = []
         for s in sels:
             f = t.fields.get(s.name)
+            if s.name == "__typename":
+                continue  # injected post-encode (_add_typename)
             if s.name == "id" or (f and f.type_name == "ID"):
                 out.append(GraphQuery(attr="uid", is_uid=True, alias=s.key))
                 continue
@@ -207,9 +263,12 @@ class GraphQLServer:
         return FilterTree(op="and", children=parts)
 
     def _query_list(self, t: GqlType, sel: Selection) -> List[dict]:
+        fobj, allowed = self._with_auth_filter(t, sel.args.get("filter"))
+        if not allowed:
+            return []
         gq = GraphQuery(attr="q")
         gq.func = FuncSpec(name="type", attr=t.name)
-        gq.filter = self._filter_tree(t, sel.args.get("filter"))
+        gq.filter = self._filter_tree(t, fobj)
         order = sel.args.get("order") or {}
         if "asc" in order:
             gq.order.append(Order(attr=f"{t.name}.{order['asc']}"))
@@ -218,7 +277,7 @@ class GraphQLServer:
         gq.first = sel.args.get("first")
         gq.offset = sel.args.get("offset")
         gq.children = self._selection_children(t, sel.selections)
-        return self._run_block(gq)
+        return self._add_typename(self._run_block(gq), t, sel.selections)
 
     def _get(self, t: GqlType, sel: Selection) -> Optional[dict]:
         gq = GraphQuery(attr="q")
@@ -234,6 +293,16 @@ class GraphQLServer:
                 attr=f"{t.name}.{xf.name}",
                 args=[sel.args[xf.name]],
             )
+        auth = self._auth(t, "query")
+        if auth is False:
+            return None
+        if isinstance(auth, dict):
+            extra = self._filter_tree(t, auth)
+            gq.filter = (
+                extra
+                if gq.filter is None
+                else FilterTree(op="and", children=[gq.filter, extra])
+            )
         gq.children = self._selection_children(t, sel.selections)
         res = self._run_block(gq)
         return res[0] if res else None
@@ -241,9 +310,15 @@ class GraphQLServer:
     def _aggregate(self, t: GqlType, sel: Selection) -> dict:
         """aggregateT(filter) { count fieldMin fieldMax fieldSum fieldAvg }
         (ref gqlschema.go aggregate type synthesis)."""
+        fobj, allowed = self._with_auth_filter(t, sel.args.get("filter"))
+        if not allowed:
+            return {
+                s.key: (0 if s.name == "count" else None)
+                for s in sel.selections
+            }
         gq = GraphQuery(attr="q")
         gq.func = FuncSpec(name="type", attr=t.name)
-        gq.filter = self._filter_tree(t, sel.args.get("filter"))
+        gq.filter = self._filter_tree(t, fobj)
         count_key = next(
             (s.key for s in sel.selections if s.name == "count"), "count"
         )
@@ -408,11 +483,32 @@ class GraphQLServer:
         return uid
 
     def _add(self, t: GqlType, sel: Selection):
+        auth = self._auth(t, "add")
+        if auth is False:
+            raise GraphQLError(f"unauthorized to add {t.name}")
         inputs = _as_list(sel.args.get("input", []))
         txn = self.engine.new_txn()
         created: List[int] = []
         txn.txn._created = created  # nested creates counted in numUids
         uids = [self._upsert_object(txn.txn, t, obj, created) for obj in inputs]
+        if isinstance(auth, dict):
+            # auth filter must reach every new node (post-mutation check,
+            # ref add-rule semantics: newly added nodes are validated)
+            gq = GraphQuery(attr="q")
+            gq.func = FuncSpec(name="uid", args=list(uids))
+            gq.filter = self._filter_tree(t, auth)
+            gq.children = [GraphQuery(attr="uid", is_uid=True)]
+            cache = txn.txn.cache
+            ex = Executor(
+                cache,
+                self.engine.schema,
+                vector_indexes=self.engine.vector_indexes,
+            )
+            nodes = ex.process([gq])
+            ok = {int(u) for u in nodes[0].dest_uids}
+            if not all(u in ok for u in uids):
+                txn.discard()
+                raise GraphQLError(f"unauthorized to add {t.name}")
         txn.commit()
         return self._payload(t, sel, uids, len(created))
 
@@ -425,7 +521,10 @@ class GraphQLServer:
 
     def _update(self, t: GqlType, sel: Selection):
         inp = sel.args.get("input", {})
-        uids = self._match_filter_uids(t, inp.get("filter"))
+        fobj, allowed = self._with_auth_filter(t, inp.get("filter"), "update")
+        if not allowed:
+            raise GraphQLError(f"unauthorized to update {t.name}")
+        uids = self._match_filter_uids(t, fobj)
         txn = self.engine.new_txn()
         for uid in uids:
             for k, v in (inp.get("set") or {}).items():
@@ -444,7 +543,12 @@ class GraphQLServer:
     def _delete(self, t: GqlType, sel: Selection):
         from dgraph_tpu.posting.mutation import delete_entity_attr
 
-        uids = self._match_filter_uids(t, sel.args.get("filter"))
+        fobj, allowed = self._with_auth_filter(
+            t, sel.args.get("filter"), "delete"
+        )
+        if not allowed:
+            raise GraphQLError(f"unauthorized to delete {t.name}")
+        uids = self._match_filter_uids(t, fobj)
         txn = self.engine.new_txn()
         for uid in uids:
             for f in t.fields.values():

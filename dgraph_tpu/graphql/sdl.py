@@ -58,6 +58,7 @@ class GqlField:
 class GqlType:
     name: str
     fields: Dict[str, GqlField] = field(default_factory=dict)
+    auth: object = None  # graphql.auth.TypeAuth when @auth present
 
     def id_field(self) -> Optional[GqlField]:
         for f in self.fields.values():
@@ -89,12 +90,88 @@ class SDLError(Exception):
     pass
 
 
+def _extract_type_auth(sdl: str):
+    """Pull type-header directives (between `type X` and its body `{`) out
+    of the SDL so @auth blobs — which contain braces inside rule strings —
+    don't break the type regex. Returns (cleaned_sdl, {type: auth_blob})."""
+    blobs: Dict[str, str] = {}
+    out = []
+    pos = 0
+    for m in re.finditer(r"\btype\s+(\w+)", sdl):
+        name = m.group(1)
+        i = m.end()
+        in_str = None  # None | '"' | '"""'
+        pdepth = 0  # directive args may contain braces; only the body `{`
+        # at paren depth 0 ends the header
+        while i < len(sdl):
+            ch = sdl[i]
+            if in_str:
+                if in_str == '"""' and sdl.startswith('"""', i):
+                    in_str = None
+                    i += 3
+                    continue
+                if in_str == '"' and ch == '"' and sdl[i - 1] != "\\":
+                    in_str = None
+            elif sdl.startswith('"""', i):
+                in_str = '"""'
+                i += 3
+                continue
+            elif ch == '"':
+                in_str = '"'
+            elif ch == "(":
+                pdepth += 1
+            elif ch == ")":
+                pdepth -= 1
+            elif ch == "{" and pdepth == 0:
+                break
+            i += 1
+        header = sdl[m.end() : i]
+        am = re.search(r"@auth\s*\(", header)
+        if am:
+            # balanced-paren scan, quote-aware
+            j = am.end()
+            depth = 1
+            in_str = None
+            while j < len(header) and depth:
+                ch = header[j]
+                if in_str:
+                    if in_str == '"""' and header.startswith('"""', j):
+                        in_str = None
+                        j += 3
+                        continue
+                    if in_str == '"' and ch == '"' and header[j - 1] != "\\":
+                        in_str = None
+                elif header.startswith('"""', j):
+                    in_str = '"""'
+                    j += 3
+                    continue
+                elif ch == '"':
+                    in_str = '"'
+                elif ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                j += 1
+            blobs[name] = header[am.end() : j - 1]
+            header = header[: am.start()] + header[j:]
+        out.append(sdl[pos : m.end()])
+        out.append(re.sub(r"@auth", "", header))
+        pos = i
+    out.append(sdl[pos:])
+    return "".join(out), blobs
+
+
 def parse_sdl(sdl: str) -> Dict[str, GqlType]:
+    sdl, auth_blobs = _extract_type_auth(sdl)
     sdl = re.sub(r'"""[\s\S]*?"""', "", sdl)  # strip descriptions
     sdl = re.sub(r"#[^\n]*", "", sdl)
     types: Dict[str, GqlType] = {}
     for m in _TYPE_RE.finditer(sdl):
         t = GqlType(name=m.group("name"))
+        if m.group("name") in auth_blobs:
+            from dgraph_tpu.graphql.auth import parse_auth_blob
+
+            t.auth = parse_auth_blob(auth_blobs[m.group("name")])
         body = m.group("body")
         matches = list(_FIELD_RE.finditer(body))
         if not matches and body.strip():
