@@ -92,11 +92,19 @@ class _Handler(BaseHTTPRequestHandler):
                 for p in self.engine.schema.predicates()
             ]
             self._reply({"data": {"schema": "\n".join(lines)}})
+        elif path == "/debug/traces":
+            from dgraph_tpu.utils.observe import TRACER
+
+            self._reply({"spans": TRACER.recent(200)})
         elif path == "/debug/prometheus_metrics":
+            from dgraph_tpu.utils.observe import METRICS
+
             out = []
             for k, v in sorted(self.metrics.items()):
-                out.append(f"# TYPE dgraph_tpu_{k} counter")
-                out.append(f"dgraph_tpu_{k} {v}")
+                out.append(f"# TYPE dgraph_tpu_http_{k} counter")
+                out.append(f"dgraph_tpu_http_{k} {v}")
+            # registry: engine counters/gauges/latency histograms
+            out.append(METRICS.render())
             data = ("\n".join(out) + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")

@@ -425,12 +425,17 @@ class Server:
     def _commit(self, txn: Txn) -> int:
         # serialized: MemKV is single-writer, and readers must not see a
         # commit_ts whose deltas aren't written yet (ADVICE r1 #2)
-        with self._lock:
+        from dgraph_tpu.utils.observe import METRICS, TRACER
+
+        with TRACER.span("commit"), METRICS.timer(
+            "commit_latency_seconds"
+        ), self._lock:
             commit_ts = self.zero.commit(txn.start_ts, txn.conflict_keys, track=True)
             try:
                 txn.write_deltas(self.kv, commit_ts)
             finally:
                 self.zero.applied(commit_ts)
+        METRICS.inc("num_commits")
         self.mem.invalidate(txn.cache.deltas.keys())
         cdc = getattr(self, "_cdc", None)
         if cdc is not None:
@@ -644,10 +649,16 @@ class Server:
         self._audit("query", user=user, ns=ns, body=q)
         import time as _time
 
+        from dgraph_tpu.utils.observe import METRICS, TRACER
+
         t0 = _time.monotonic()
-        out = self._query_parsed(
-            blocks, LocalCache(self.kv, ts, mem=self.mem), ns, allowed
-        )
+        with TRACER.span("query", ns=ns), METRICS.timer(
+            "query_latency_seconds"
+        ):
+            out = self._query_parsed(
+                blocks, LocalCache(self.kv, ts, mem=self.mem), ns, allowed
+            )
+        METRICS.inc("num_queries")
         took_ms = (_time.monotonic() - t0) * 1e3
         if took_ms > self.slow_query_ms:
             # structured slow-query log (ref x/log.go LogSlowOperation,

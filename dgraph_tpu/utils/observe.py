@@ -1,0 +1,186 @@
+"""Observability: metrics registry (counters/gauges/histograms) + spans.
+
+Mirrors /root/reference/x/metrics.go (ostats counters + latency
+distributions exported at /debug/prometheus_metrics) and the opencensus
+span plumbing in x/trace (spans around query/mutation/proposal paths,
+exported to a collector). Stdlib-only: Prometheus text exposition for
+metrics; spans keep an in-process ring buffer and can stream to a JSONL
+file (the OTLP-exporter seam — swap the sink, keep the API).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+# default latency buckets (seconds) — same decade ladder the reference's
+# defaultLatencyMsDistribution covers
+_BUCKETS = [
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+]
+
+
+class Histogram:
+    def __init__(self, buckets: Optional[List[float]] = None):
+        self.buckets = buckets or _BUCKETS
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.total = 0
+
+    def observe(self, v: float):
+        self.sum += v
+        self.total += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class Metrics:
+    """Process-wide registry; render() emits Prometheus text format."""
+
+    def __init__(self, prefix: str = "dgraph_tpu"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    def inc(self, name: str, delta: float = 1.0):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + delta
+
+    def set_gauge(self, name: str, value: float):
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, seconds: float):
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.observe(seconds)
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    def render(self) -> str:
+        out: List[str] = []
+        with self._lock:
+            for k, v in sorted(self._counters.items()):
+                out.append(f"# TYPE {self.prefix}_{k} counter")
+                out.append(f"{self.prefix}_{k} {v}")
+            for k, v in sorted(self._gauges.items()):
+                out.append(f"# TYPE {self.prefix}_{k} gauge")
+                out.append(f"{self.prefix}_{k} {v}")
+            for k, h in sorted(self._hists.items()):
+                base = f"{self.prefix}_{k}"
+                out.append(f"# TYPE {base} histogram")
+                cum = 0
+                for b, c in zip(h.buckets, h.counts):
+                    cum += c
+                    out.append(f'{base}_bucket{{le="{b}"}} {cum}')
+                cum += h.counts[-1]
+                out.append(f'{base}_bucket{{le="+Inf"}} {cum}')
+                out.append(f"{base}_sum {h.sum}")
+                out.append(f"{base}_count {h.total}")
+        return "\n".join(out) + "\n"
+
+
+METRICS = Metrics()
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start", "end", "attrs"
+    )
+
+    def __init__(self, name, trace_id, span_id, parent_id):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.attrs: Dict[str, object] = {}
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "end": self.end,
+            "duration_ms": (
+                None if self.end is None else (self.end - self.start) * 1e3
+            ),
+            "attrs": self.attrs,
+        }
+
+
+class Tracer:
+    """Nested spans with an in-process ring + optional JSONL sink (the
+    exporter seam; an OTLP exporter would replace _emit)."""
+
+    def __init__(self, capacity: int = 2048, sink_path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self.finished: deque = deque(maxlen=capacity)
+        self._tls = threading.local()
+        self._next_id = 0
+        self.sink_path = sink_path
+        self._sink = open(sink_path, "a") if sink_path else None
+
+    def _gen_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        parent = stack[-1] if stack else None
+        sp = Span(
+            name,
+            trace_id=parent.trace_id if parent else self._gen_id(),
+            span_id=self._gen_id(),
+            parent_id=parent.span_id if parent else None,
+        )
+        sp.attrs.update(attrs)
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.end = time.time()
+            stack.pop()
+            with self._lock:
+                self.finished.append(sp)
+                if self._sink is not None:
+                    self._sink.write(json.dumps(sp.to_dict()) + "\n")
+                    self._sink.flush()
+            METRICS.observe(f"span_{name}_seconds", sp.end - sp.start)
+
+    def recent(self, n: int = 100) -> List[dict]:
+        with self._lock:
+            return [s.to_dict() for s in list(self.finished)[-n:]]
+
+
+TRACER = Tracer()

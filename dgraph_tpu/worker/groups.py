@@ -69,6 +69,24 @@ class ZeroService:
     def connect(self, node_id: int, group: int):
         self.members[node_id] = {"group": group, "last_seen": time.time()}
 
+    def heartbeat(self, node_id: int):
+        m = self.members.get(node_id)
+        if m is not None:
+            m["last_seen"] = time.time()
+
+    def prune_dead(self, max_age_s: float = 10.0) -> List[int]:
+        """Drop members that stopped heartbeating (ref conn/pool.go:233
+        MonitorHealth + zero membership pruning). Returns pruned ids."""
+        now = time.time()
+        dead = [
+            nid
+            for nid, m in self.members.items()
+            if now - m["last_seen"] > max_age_s
+        ]
+        for nid in dead:
+            del self.members[nid]
+        return dead
+
     def state(self) -> dict:
         return {
             "tablets": dict(self.tablets),
@@ -344,6 +362,7 @@ class DistributedCluster:
             self._load_zero_state()
         self._stop = False
         self._pump_ms = pump_ms
+        self.auto_rebalance = False  # enable_auto_rebalance() turns on
         self._pump_thread = threading.Thread(target=self._pump_loop, daemon=True)
         self._pump_thread.start()
         self._wait_for_leaders()
@@ -414,12 +433,22 @@ class DistributedCluster:
 
     def _pump_loop(self):
         now = 0
+        ticks = 0
         while not self._stop:
             now += 50  # virtual ms per real pump (fast elections)
+            ticks += 1
             for g in self.groups.values():
                 for n in g.nodes:
                     if n.id not in self.net.down:
                         n.raft.tick(now)
+                        self.zero.heartbeat(n.id)
+            if ticks % 100 == 0:
+                self.zero.prune_dead(max_age_s=5.0)
+                if self.auto_rebalance:
+                    try:
+                        self.rebalance_by_size()
+                    except Exception:
+                        pass  # next tick retries
             time.sleep(self._pump_ms / 1000.0)
 
     def _wait_for_leaders(self, timeout: float = 10.0):
@@ -588,7 +617,7 @@ class DistributedCluster:
 
     def rebalance(self):
         """Move tablets from the most- to the least-loaded group
-        (ref tablet.go:53 rebalanceTablets; size-based there, count here)."""
+        (count-based variant)."""
         load: Dict[int, List[str]] = {g: [] for g in self.groups}
         for pred, g in self.zero.tablets.items():
             load[g].append(pred)
@@ -596,6 +625,54 @@ class DistributedCluster:
         small = min(load, key=lambda g: len(load[g]))
         if len(load[big]) - len(load[small]) >= 2:
             self.move_tablet(load[big][0], small)
+
+    def enable_auto_rebalance(self):
+        self.auto_rebalance = True
+        return self
+
+    def tablet_size_bytes(self, pred: str) -> int:
+        """Approximate on-disk size of one tablet (record bytes of the
+        predicate's data+split regions; ref zero/tablet.go size stream)."""
+        gid = self.zero.belongs_to(pred)
+        if gid is None:
+            return 0
+        kv = self.groups[gid].any_replica().kv
+        total = 0
+        for prefix in (
+            keys.PredicatePrefix(pred),
+            keys.SplitPredicatePrefix(pred),
+        ):
+            for _, vers in kv.iterate_versions(prefix, 1 << 62):
+                for _, rec in vers:
+                    total += len(rec)
+        return total
+
+    def rebalance_by_size(self, min_move_bytes: int = 1 << 10):
+        """Size-based rebalancing (ref zero/tablet.go:53 rebalanceTablets):
+        move the biggest tablet from the most-loaded group (by bytes) to
+        the least-loaded one when it narrows the gap."""
+        sizes: Dict[str, int] = {
+            p: self.tablet_size_bytes(p) for p in self.zero.tablets
+        }
+        load: Dict[int, int] = {g: 0 for g in self.groups}
+        for p, sz in sizes.items():
+            load[self.zero.tablets[p]] += sz
+        big = max(load, key=lambda g: load[g])
+        small = min(load, key=lambda g: load[g])
+        gap = load[big] - load[small]
+        if gap < min_move_bytes:
+            return None
+        # biggest tablet on the loaded group whose move narrows the gap
+        cands = sorted(
+            (p for p, g in self.zero.tablets.items() if g == big),
+            key=lambda p: -sizes[p],
+        )
+        for p in cands:
+            new_gap = abs((load[big] - sizes[p]) - (load[small] + sizes[p]))
+            if sizes[p] > 0 and new_gap < gap:
+                self.move_tablet(p, small)
+                return p
+        return None
 
     # -- failure handling ---------------------------------------------------------
 

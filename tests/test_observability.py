@@ -1,0 +1,102 @@
+"""Metrics histograms, spans, heartbeat pruning, size-based rebalance
+(VERDICT r1 breadth tail; ref x/metrics.go, conn/pool.go:233,
+zero/tablet.go:53).
+"""
+
+import time
+
+from dgraph_tpu.utils.observe import Metrics, Tracer
+
+
+def test_histogram_buckets_and_render():
+    m = Metrics(prefix="t")
+    m.inc("ops")
+    m.inc("ops", 2)
+    m.set_gauge("live", 3)
+    for v in (0.0002, 0.002, 0.02, 0.2, 2.0, 20.0):
+        m.observe("lat_seconds", v)
+    text = m.render()
+    assert "t_ops 3" in text
+    assert "t_live 3" in text
+    assert 't_lat_seconds_bucket{le="+Inf"} 6' in text
+    assert "t_lat_seconds_count 6" in text
+    # cumulative counts are monotone
+    counts = [
+        int(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("t_lat_seconds_bucket")
+    ]
+    assert counts == sorted(counts)
+
+
+def test_timer_contextmanager():
+    m = Metrics()
+    with m.timer("op_seconds"):
+        time.sleep(0.005)
+    assert m._hists["op_seconds"].total == 1
+    assert m._hists["op_seconds"].sum >= 0.005
+
+
+def test_spans_nest_and_record(tmp_path):
+    tr = Tracer(sink_path=str(tmp_path / "spans.jsonl"))
+    with tr.span("outer", op="query"):
+        with tr.span("inner"):
+            pass
+    spans = tr.recent()
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    inner, outer = spans
+    assert inner["trace_id"] == outer["trace_id"]
+    assert inner["parent_id"] == outer["span_id"]
+    assert outer["attrs"] == {"op": "query"}
+    assert (tmp_path / "spans.jsonl").read_text().count("\n") == 2
+
+
+def test_engine_emits_metrics_and_spans():
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.utils.observe import METRICS, TRACER
+
+    s = Server()
+    s.alter("name: string @index(exact) .")
+    s.new_txn().mutate_rdf(set_rdf='_:a <name> "m" .', commit_now=True)
+    s.query('{ q(func: eq(name, "m")) { name } }')
+    text = METRICS.render()
+    assert "dgraph_tpu_num_queries" in text
+    assert "dgraph_tpu_query_latency_seconds_bucket" in text
+    assert "dgraph_tpu_commit_latency_seconds_count" in text
+    names = {sp["name"] for sp in TRACER.recent()}
+    assert {"query", "commit"} <= names
+
+
+def test_membership_prune_and_size_rebalance():
+    from dgraph_tpu.worker.groups import DistributedCluster
+
+    c = DistributedCluster(n_groups=2, replicas=3)
+    try:
+        # all six members heartbeat via the pump loop
+        time.sleep(0.3)
+        assert len(c.zero.members) == 6
+        c.kill_node(1)
+        deadline = time.time() + 15
+        while time.time() < deadline and 1 in c.zero.members:
+            time.sleep(0.2)
+        assert 1 not in c.zero.members  # pruned after missing heartbeats
+        c.revive_node(1)
+
+        # size-based rebalance: pile data onto one group's tablets
+        c.alter("heavy: string .\nlight: string .")
+        gid = c.zero.should_serve("heavy")
+        # force both tablets onto the same group for the test
+        c.zero.tablets["light"] = gid
+        t = c.new_txn()
+        rdf = [f'<0x{i:x}> <heavy> "{"x" * 200}" .' for i in range(1, 60)]
+        rdf += [f'<0x{i:x}> <light> "s" .' for i in range(1, 10)]
+        t.mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+        moved = c.rebalance_by_size(min_move_bytes=100)
+        # moving `heavy` off the shared group narrows the byte gap
+        assert moved == "heavy"
+        assert c.zero.belongs_to(moved) != gid
+        # data still readable after the move
+        out = c.query("{ q(func: uid(0x1)) { heavy } }")
+        assert out["data"]["q"][0]["heavy"].startswith("x")
+    finally:
+        c.close()
