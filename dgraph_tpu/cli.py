@@ -10,6 +10,11 @@ Subcommands mirror the reference's cobra tree (root.go:80):
   increment — smoke-test counter (ref cmd/increment)
   debug    — p-dir inspector (ref cmd/debug)
   mcp      — MCP server on stdio (ref cmd/mcp)
+  cert     — TLS CA/node/client certs (ref cmd/cert)
+  conv     — geo/JSON -> RDF conversion (ref cmd/conv)
+  migrate  — relational CSV -> RDF + schema (ref cmd/migrate)
+  debuginfo — support bundle (ref cmd/debuginfo)
+  upgrade  — on-disk layout migrations (ref upgrade/)
   version
 
 Usage: python -m dgraph_tpu <subcommand> [...]
@@ -184,6 +189,68 @@ def cmd_mcp(args):
     McpServer(_server(args)).serve_stdio()
 
 
+
+
+def cmd_cert(args):
+    from dgraph_tpu import tools
+
+    if args.ls:
+        for row in tools.cert_ls(args.dir):
+            print(row["file"], "|", row["info"].replace("\n", " "))
+        return
+    made = tools.cert_create(
+        args.dir,
+        nodes=[n for n in args.nodes.split(",") if n],
+        client=args.client or None,
+    )
+    for k, v in made.items():
+        print(f"created {k}: {v}")
+
+
+def cmd_conv(args):
+    from dgraph_tpu import tools
+
+    rdf = []
+    if args.geo:
+        rdf += tools.conv_geojson(args.geo)
+    if args.json_file:
+        rdf += tools.conv_json(args.json_file)
+    text = "\n".join(rdf) + "\n"
+    if args.out == "-":
+        sys.stdout.write(text)
+    else:
+        with open(args.out, "w") as f:
+            f.write(text)
+
+
+def cmd_migrate(args):
+    from dgraph_tpu import tools
+
+    tables = dict(kv.split("=", 1) for kv in args.tables.split(","))
+    schema, rdf = tools.migrate_csv(tables)
+    with open(args.out_schema, "w") as f:
+        f.write(schema + "\n")
+    with open(args.out_rdf, "w") as f:
+        f.write("\n".join(rdf) + "\n")
+    print(f"wrote {len(rdf)} nquads to {args.out_rdf}")
+
+
+def cmd_debuginfo(args):
+    from dgraph_tpu import tools
+
+    engine = _server(args)
+    bundle = tools.debuginfo(engine, args.out)
+    print(f"bundle: {bundle}")
+
+
+def cmd_upgrade(args):
+    from dgraph_tpu import tools
+
+    applied = tools.upgrade(args.p)
+    print(
+        f"layout now v{tools.layout_version(args.p)}; applied: {applied or 'none'}"
+    )
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="dgraph-tpu")
     ap.add_argument("--version", action="version", version="dgraph-tpu 0.1.0")
@@ -264,6 +331,35 @@ def main(argv=None):
     p = sub.add_parser("debug", help="inspect a data dir")
     add_p(p)
     p.set_defaults(fn=cmd_debug)
+
+    p = sub.add_parser("cert", help="create/list TLS certificates")
+    p.add_argument("--dir", default="tls")
+    p.add_argument("--nodes", default="", help="comma-separated node CNs")
+    p.add_argument("--client", default="")
+    p.add_argument("--ls", action="store_true")
+    p.set_defaults(fn=cmd_cert)
+
+    p = sub.add_parser("conv", help="convert geojson/json to RDF")
+    p.add_argument("--geo", default="")
+    p.add_argument("--json", dest="json_file", default="")
+    p.add_argument("--out", default="-")
+    p.set_defaults(fn=cmd_conv)
+
+    p = sub.add_parser("migrate", help="relational CSV dump -> RDF")
+    p.add_argument("--tables", required=True,
+                   help="name=path[,name=path...] CSV tables")
+    p.add_argument("--out-rdf", default="migrated.rdf")
+    p.add_argument("--out-schema", default="migrated.schema")
+    p.set_defaults(fn=cmd_migrate)
+
+    p = sub.add_parser("debuginfo", help="collect a support bundle")
+    p.add_argument("-p", default=None)
+    p.add_argument("--out", default=".")
+    p.set_defaults(fn=cmd_debuginfo)
+
+    p = sub.add_parser("upgrade", help="apply on-disk layout migrations")
+    p.add_argument("-p", required=True)
+    p.set_defaults(fn=cmd_upgrade)
 
     p = sub.add_parser("mcp", help="MCP server on stdio")
     add_p(p)
