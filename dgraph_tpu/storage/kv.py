@@ -302,9 +302,18 @@ class MemKV(KV):
                 self._wal = None
 
 
-def open_kv(path: Optional[str] = None) -> KV:
-    """Open the default store; path=None gives a pure in-memory KV."""
+def open_kv(path: Optional[str] = None, backend: Optional[str] = None) -> KV:
+    """Open the default store; path=None gives a pure in-memory KV.
+
+    backend (or DGRAPH_TPU_STORAGE): "mem" (WAL-backed in-memory, default)
+    or "lsm" (spill-to-disk SSTables, storage/lsm.py — for datasets that
+    must not live wholly in RAM)."""
     if path is None:
         return MemKV()
+    backend = backend or os.environ.get("DGRAPH_TPU_STORAGE", "mem")
     os.makedirs(path, exist_ok=True)
+    if backend == "lsm":
+        from dgraph_tpu.storage.lsm import LsmKV
+
+        return LsmKV(os.path.join(path, "lsm"))
     return MemKV(wal_path=os.path.join(path, "wal.log"))
