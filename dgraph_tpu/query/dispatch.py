@@ -146,6 +146,43 @@ class SetOpDispatcher:
     def __init__(self):
         self._jit_cache: Dict[Tuple[str, int, int], object] = {}
         self.device_cache = DeviceCache()
+        self._device_state: Optional[bool] = None  # None=unknown
+
+    def _device_ready(self) -> bool:
+        """Failure detection for the accelerator: the first device use
+        probes backend init under a watchdog. A remote-TPU tunnel that is
+        down (the axon plugin dials it at init) would otherwise hang every
+        query forever; on timeout the dispatcher degrades permanently to
+        the host kernels (elastic recovery, ref SURVEY §5 failure
+        detection)."""
+        if self._device_state is not None:
+            return self._device_state
+        timeout = float(os.environ.get("DGRAPH_TPU_DEVICE_INIT_TIMEOUT_S", 120))
+        import threading
+
+        got: list = []
+
+        def probe():
+            try:
+                got.append(len(jax.devices()) > 0)
+            except Exception:
+                got.append(False)
+
+        th = threading.Thread(target=probe, daemon=True)
+        th.start()
+        th.join(timeout=timeout)
+        if not got:
+            import logging
+
+            logging.getLogger("dgraph_tpu.dispatch").error(
+                "device backend init exceeded %.0fs (tunnel down?) — "
+                "falling back to host kernels permanently",
+                timeout,
+            )
+            self._device_state = False
+        else:
+            self._device_state = bool(got[0])
+        return self._device_state
 
     # -- shared-big-operand fan-out -----------------------------------------
 
@@ -174,7 +211,9 @@ class SetOpDispatcher:
         if not rows:
             return []
         total = sum(len(r) for r in rows) + len(b)
-        if not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL:
+        if (
+            not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL
+        ) or not self._device_ready():
             return [_np_op(op, r, b) for r in rows]
         if (
             op in ("intersect", "difference")
@@ -256,7 +295,9 @@ class SetOpDispatcher:
         if op == "intersect" and any(len(p) == 0 for p in parts):
             return np.zeros((0,), np.uint64)
         total = sum(len(p) for p in parts)
-        if not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL:
+        if (
+            not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL
+        ) or not self._device_ready():
             out = parts[0]
             for p in parts[1:]:
                 out = _np_op(op, out, p)
@@ -377,7 +418,9 @@ class SetOpDispatcher:
         if not pairs:
             return []
         total = sum(len(a) + len(b) for a, b in pairs)
-        if not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL:
+        if (
+            not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL
+        ) or not self._device_ready():
             return [_np_op(op, a, b) for a, b in pairs]
         return self._run_pairs_device(op, pairs)
 
