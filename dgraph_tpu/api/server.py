@@ -168,8 +168,12 @@ class TxnHandle:
 class Server:
     """Single-node engine (Alpha + embedded Zero-lite)."""
 
-    def __init__(self, data_dir: Optional[str] = None):
-        self.kv: KV = open_kv(data_dir)
+    def __init__(
+        self,
+        data_dir: Optional[str] = None,
+        encryption_key: Optional[bytes] = None,
+    ):
+        self.kv: KV = open_kv(data_dir, encryption_key=encryption_key)
         self.zero = ZeroLite()
         self.schema = State()
         self.vector_indexes: Dict[str, object] = {}

@@ -30,7 +30,12 @@ import sys
 def _server(args):
     from dgraph_tpu.api.server import Server
 
-    return Server(data_dir=args.p)
+    key = None
+    if getattr(args, "encryption_key_file", None):
+        from dgraph_tpu.enc.enc import read_key_file
+
+        key = read_key_file(args.encryption_key_file)
+    return Server(data_dir=args.p, encryption_key=key)
 
 
 def cmd_alpha(args):
@@ -260,6 +265,11 @@ def main(argv=None):
         p.add_argument("-p", default=None, help="data directory (default: in-memory)")
 
     p = sub.add_parser("alpha", help="serve the HTTP API")
+    p.add_argument(
+        "--encryption_key_file",
+        default=None,
+        help="AES key file enabling at-rest value encryption",
+    )
     p.add_argument(
         "--grpc_port",
         type=int,

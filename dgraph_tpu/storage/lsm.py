@@ -16,6 +16,13 @@ DB in RAM (VERDICT r1 missing #9). LsmKV bounds memory:
 
 Same KV interface as MemKV, so the posting layer, bulk loader, backup and
 raft snapshot machinery run unchanged on top.
+
+With `enc_key` every file entry — key AND value, WAL and SSTable — is
+AES-CTR sealed (badger's block encryption role, enc/util.go key
+plumbing): nothing about the graph, including value-derived index
+tokens embedded in keys, reaches disk in plaintext. In-memory structures
+and the sparse index (decrypted once at open) stay plaintext for
+ordering/seeks.
 """
 
 from __future__ import annotations
@@ -39,29 +46,55 @@ _OP_DELETE_BELOW = 2
 _INDEX_EVERY = 64  # sparse index stride
 
 
-class _SSTable:
-    """Immutable sorted run: entries ascending by (key, ts)."""
+def _seal(blob: bytes, key: Optional[bytes]) -> bytes:
+    if key is None:
+        return blob
+    from dgraph_tpu.enc.enc import encrypt_stream
 
-    def __init__(self, path: str):
+    return encrypt_stream(blob, key)
+
+
+def _unseal(blob: bytes, key: Optional[bytes]) -> bytes:
+    if key is None:
+        return blob
+    from dgraph_tpu.enc.enc import decrypt_stream
+
+    return decrypt_stream(blob, key)
+
+
+class _SSTable:
+    """Immutable sorted run: entries ascending by (key, ts).
+
+    When `enc_key` is set each entry is one sealed blob
+    [len u32][AES-CTR(key,ts,seq,val)] and the index is sealed wholesale;
+    order still holds because writes happen from sorted plaintext."""
+
+    def __init__(self, path: str, enc_key: Optional[bytes] = None):
         self.path = path
+        self.enc_key = enc_key
         self._f = open(path, "rb")
         self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
         # footer: [index_off u64][n_entries u64]
         idx_off, self.n = struct.unpack("<QQ", self._mm[-16:])
         self._index: List[Tuple[bytes, int]] = []  # (key, file_offset)
-        pos = idx_off
-        end = len(self._mm) - 16
+        idx_blob = _unseal(bytes(self._mm[idx_off : len(self._mm) - 16]), enc_key)
+        pos = 0
+        end = len(idx_blob)
         while pos < end:
-            (klen,) = struct.unpack_from("<I", self._mm, pos)
+            (klen,) = struct.unpack_from("<I", idx_blob, pos)
             pos += 4
-            k = bytes(self._mm[pos : pos + klen])
+            k = idx_blob[pos : pos + klen]
             pos += klen
-            (off,) = struct.unpack_from("<Q", self._mm, pos)
+            (off,) = struct.unpack_from("<Q", idx_blob, pos)
             pos += 8
             self._index.append((k, off))
 
     @staticmethod
-    def write(path: str, entries: Iterator[Tuple[bytes, int, int, bytes]]):
+    def write(
+        path: str,
+        entries: Iterator[Tuple[bytes, int, int, bytes]],
+        enc_key: Optional[bytes] = None,
+    ):
         """entries must be sorted ascending by (key, ts, seq)."""
         tmp = path + ".tmp"
         index: List[Tuple[bytes, int]] = []
@@ -70,27 +103,48 @@ class _SSTable:
             for key, ts, seq, val in entries:
                 if n % _INDEX_EVERY == 0:
                     index.append((key, f.tell()))
-                f.write(_ENT.pack(len(key), ts, seq, len(val)))
-                f.write(key)
-                f.write(val)
+                if enc_key is None:
+                    f.write(_ENT.pack(len(key), ts, seq, len(val)))
+                    f.write(key)
+                    f.write(val)
+                else:
+                    blob = _seal(
+                        _ENT.pack(len(key), ts, seq, len(val)) + key + val,
+                        enc_key,
+                    )
+                    f.write(struct.pack("<I", len(blob)))
+                    f.write(blob)
                 n += 1
             idx_off = f.tell()
+            import io as _io
+
+            ib = _io.BytesIO()
             for k, off in index:
-                f.write(struct.pack("<I", len(k)))
-                f.write(k)
-                f.write(struct.pack("<Q", off))
+                ib.write(struct.pack("<I", len(k)))
+                ib.write(k)
+                ib.write(struct.pack("<Q", off))
+            f.write(_seal(ib.getvalue(), enc_key))
             f.write(struct.pack("<QQ", idx_off, n))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
 
     def _entry_at(self, pos: int):
-        klen, ts, seq, vlen = _ENT.unpack_from(self._mm, pos)
-        pos += _ENT.size
-        key = bytes(self._mm[pos : pos + klen])
-        pos += klen
-        val = bytes(self._mm[pos : pos + vlen])
-        pos += vlen
+        if self.enc_key is None:
+            klen, ts, seq, vlen = _ENT.unpack_from(self._mm, pos)
+            pos += _ENT.size
+            key = bytes(self._mm[pos : pos + klen])
+            pos += klen
+            val = bytes(self._mm[pos : pos + vlen])
+            pos += vlen
+            return key, ts, seq, val, pos
+        (blen,) = struct.unpack_from("<I", self._mm, pos)
+        pos += 4
+        blob = _unseal(bytes(self._mm[pos : pos + blen]), self.enc_key)
+        pos += blen
+        klen, ts, seq, vlen = _ENT.unpack_from(blob, 0)
+        key = blob[_ENT.size : _ENT.size + klen]
+        val = blob[_ENT.size + klen : _ENT.size + klen + vlen]
         return key, ts, seq, val, pos
 
     def _seek(self, key: bytes) -> int:
@@ -139,11 +193,12 @@ class _SSTable:
 
 class LsmKV(KV):
     def __init__(self, dirpath: str, memtable_bytes: int = 8 << 20,
-                 compact_at: int = 6):
+                 compact_at: int = 6, enc_key: Optional[bytes] = None):
         os.makedirs(dirpath, exist_ok=True)
         self.dir = dirpath
         self.memtable_bytes = memtable_bytes
         self.compact_at = compact_at
+        self.enc_key = enc_key
         self._mu = threading.RLock()
         # key -> [(ts, seq, val)] ascending ts
         self._mem: Dict[bytes, List[Tuple[int, int, bytes]]] = {}
@@ -173,7 +228,9 @@ class LsmKV(KV):
             for m in self._markers
         ]
         for name in names:  # manifest order: newest first
-            self._tables.append(_SSTable(os.path.join(self.dir, name)))
+            self._tables.append(
+                _SSTable(os.path.join(self.dir, name), self.enc_key)
+            )
         if os.path.exists(self._wal_path):
             self._replay_wal()
         self._wal = open(self._wal_path, "ab")
@@ -197,15 +254,37 @@ class LsmKV(KV):
         with open(self._wal_path, "rb") as f:
             data = f.read()
         pos, n = 0, len(data)
-        while pos + _WAL_REC.size <= n:
-            op, klen, ts, seq, vlen = _WAL_REC.unpack_from(data, pos)
-            if pos + _WAL_REC.size + klen + vlen > n or op > _OP_DELETE_BELOW:
-                break
-            pos += _WAL_REC.size
-            key = data[pos : pos + klen]
-            pos += klen
-            val = data[pos : pos + vlen]
-            pos += vlen
+        while True:
+            if self.enc_key is None:
+                if pos + _WAL_REC.size > n:
+                    break
+                op, klen, ts, seq, vlen = _WAL_REC.unpack_from(data, pos)
+                if (
+                    pos + _WAL_REC.size + klen + vlen > n
+                    or op > _OP_DELETE_BELOW
+                ):
+                    break
+                pos += _WAL_REC.size
+                key = data[pos : pos + klen]
+                pos += klen
+                val = data[pos : pos + vlen]
+                pos += vlen
+            else:
+                if pos + 4 > n:
+                    break
+                (blen,) = struct.unpack_from("<I", data, pos)
+                if pos + 4 + blen > n:
+                    break
+                try:
+                    blob = _unseal(data[pos + 4 : pos + 4 + blen], self.enc_key)
+                    op, klen, ts, seq, vlen = _WAL_REC.unpack_from(blob, 0)
+                except Exception:
+                    break
+                if op > _OP_DELETE_BELOW:
+                    break
+                key = blob[_WAL_REC.size : _WAL_REC.size + klen]
+                val = blob[_WAL_REC.size + klen : _WAL_REC.size + klen + vlen]
+                pos += 4 + blen
             self._seq = max(self._seq, seq)
             if op == _OP_PUT:
                 self._mem_put(key, ts, seq, val)
@@ -220,9 +299,17 @@ class LsmKV(KV):
     # -- write path -----------------------------------------------------------
 
     def _wal_append(self, op, key, ts, seq, val=b""):
-        self._wal.write(_WAL_REC.pack(op, len(key), ts, seq, len(val)))
-        self._wal.write(key)
-        self._wal.write(val)
+        if self.enc_key is None:
+            self._wal.write(_WAL_REC.pack(op, len(key), ts, seq, len(val)))
+            self._wal.write(key)
+            self._wal.write(val)
+        else:
+            blob = _seal(
+                _WAL_REC.pack(op, len(key), ts, seq, len(val)) + key + val,
+                self.enc_key,
+            )
+            self._wal.write(struct.pack("<I", len(blob)))
+            self._wal.write(blob)
         self._wal.flush()
 
     def _mem_put(self, key, ts, seq, val):
@@ -286,8 +373,8 @@ class LsmKV(KV):
                 for ts, seq, val in self._mem[k]:
                     yield k, ts, seq, val
 
-        _SSTable.write(path, entries())
-        self._tables.insert(0, _SSTable(path))
+        _SSTable.write(path, entries(), self.enc_key)
+        self._tables.insert(0, _SSTable(path, self.enc_key))
         self._mem.clear()
         self._mem_size = 0
         self._save_manifest()
@@ -335,9 +422,9 @@ class LsmKV(KV):
 
         name = f"sst_{self._seq:016x}c.tbl"
         path = os.path.join(self.dir, name)
-        _SSTable.write(path, live())
+        _SSTable.write(path, live(), self.enc_key)
         old = self._tables
-        self._tables = [_SSTable(path)]
+        self._tables = [_SSTable(path, self.enc_key)]
         self._mem.clear()
         self._mem_size = 0
         self._markers = []  # applied physically
