@@ -111,7 +111,7 @@ def generate(target_edges: int = 1_000_000, seed: int = 42) -> Tuple[Corpus, Lis
     # fan-outs: each film -> ~2 genres + 1 date + 1 rating + 1 name = ~5
     # each director -> ~5 films; each actor -> ~3 films
     # edges per film ≈ 5 + (1/5 dir name) + 2 starring + ...; solve approx:
-    n_films = max(10, target_edges // 11)
+    n_films = max(10, target_edges // 9)
     n_directors = max(3, n_films // 5)
     n_actors = max(5, n_films * 2 // 3)
 
