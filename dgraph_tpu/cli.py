@@ -28,13 +28,24 @@ import sys
 
 
 def _server(args):
+    import os
+
     from dgraph_tpu.api.server import Server
+    from dgraph_tpu.x.flags import STORAGE_DEFAULTS, SuperFlag
 
     key = None
     if getattr(args, "encryption_key_file", None):
         from dgraph_tpu.enc.enc import read_key_file
 
         key = read_key_file(args.encryption_key_file)
+    sf = SuperFlag(getattr(args, "storage", "") or "", STORAGE_DEFAULTS)
+    if key is None and sf.get_string("encryption-key-file"):
+        from dgraph_tpu.enc.enc import read_key_file
+
+        key = read_key_file(sf.get_string("encryption-key-file"))
+    backend = sf.get_string("backend", "mem")
+    if backend != "mem":
+        os.environ["DGRAPH_TPU_STORAGE"] = backend
     return Server(data_dir=args.p, encryption_key=key)
 
 
@@ -58,6 +69,13 @@ def cmd_alpha(args):
         from dgraph_tpu.posting.rollup import RollupDaemon
 
         RollupDaemon(engine, interval_s=args.rollup_interval).start()
+    from dgraph_tpu.x.flags import TRACE_DEFAULTS, SuperFlag
+
+    tf = SuperFlag(getattr(args, "trace", "") or "", TRACE_DEFAULTS)
+    if tf.get_string("sink-file"):
+        from dgraph_tpu.utils import observe
+
+        observe.TRACER = observe.Tracer(sink_path=tf.get_string("sink-file"))
     srv = HTTPServer(engine, host=args.bind, port=args.port).start()
     print(f"alpha listening on http://{args.bind}:{srv.port}")
     if args.grpc_port >= 0:
@@ -265,6 +283,16 @@ def main(argv=None):
         p.add_argument("-p", default=None, help="data directory (default: in-memory)")
 
     p = sub.add_parser("alpha", help="serve the HTTP API")
+    p.add_argument(
+        "--storage",
+        default="",
+        help='superflag: "backend=mem|lsm; encryption-key-file=...; memtable-mb=8"',
+    )
+    p.add_argument(
+        "--trace",
+        default="",
+        help='superflag: "sink-file=...; ratio=0.01"',
+    )
     p.add_argument(
         "--encryption_key_file",
         default=None,
