@@ -86,6 +86,13 @@ class DgraphServicer:
             if request.mutations:
                 return self._do_mutations(request, resp, t0)
             variables = dict(request.vars) if request.vars else None
+            if request.resp_format == pb.Request.RDF:
+                resp.rdf = self.engine.query_rdf(
+                    request.query, variables=variables
+                ).encode()
+                resp.txn.start_ts = 0
+                resp.latency.total_ns = time.monotonic_ns() - t0
+                return resp
             if request.read_only:
                 out = self.engine.query(request.query, variables=variables)
                 resp.txn.start_ts = 0

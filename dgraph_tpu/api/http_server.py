@@ -165,6 +165,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply({"data": toks})
             elif path == "/query":
                 self._count("num_queries")
+                if qs.get("respFormat", [""])[0] == "rdf":
+                    raw = self._body().decode("utf-8")
+                    rdf = self.engine.query_rdf(raw)
+                    data = rdf.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/n-quads")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 raw = self._body().decode("utf-8")
                 variables = None
                 if "json" in self.headers.get("Content-Type", ""):

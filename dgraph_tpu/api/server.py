@@ -677,6 +677,26 @@ class Server:
             )
         return out
 
+    def query_rdf(
+        self,
+        q: str,
+        read_ts: Optional[int] = None,
+        variables: Optional[Dict[str, str]] = None,
+    ) -> str:
+        """Query with RDF (N-Quads) response encoding (ref
+        query/outputrdf.go ToRDF; resp_format=RDF on the wire)."""
+        from dgraph_tpu.query.outputrdf import encode_rdf
+
+        ts = read_ts if read_ts is not None else self.zero.read_ts()
+        blocks = dql.parse(q, variables)
+        ex = Executor(
+            LocalCache(self.kv, ts, mem=self.mem),
+            self.schema,
+            vector_indexes=self.vector_indexes,
+        )
+        nodes = ex.process(blocks)
+        return encode_rdf(nodes)
+
     def _query(self, q: str, cache: LocalCache) -> dict:
         return self._query_parsed(dql.parse(q), cache, keys.GALAXY_NS)
 

@@ -1,0 +1,97 @@
+"""RDF response encoding (ref query/outputrdf.go ToRDF; resp_format=RDF)."""
+
+import json
+
+import pytest
+
+from dgraph_tpu.api.server import Server
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = Server()
+    s.alter(
+        "name: string @index(exact) .\nfriend: [uid] .\nage: int .\n"
+        "alive: bool ."
+    )
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf=(
+            '<0x1> <name> "Alice" .\n'
+            '<0x1> <age> "30"^^<xs:int> .\n'
+            '<0x1> <alive> "true"^^<xs:boolean> .\n'
+            "<0x1> <friend> <0x2> .\n"
+            '<0x2> <name> "Bob" .'
+        ),
+        commit_now=True,
+    )
+    return s
+
+
+def test_query_rdf_triples(server):
+    rdf = server.query_rdf(
+        '{ q(func: eq(name, "Alice")) { name age alive friend { name } } }'
+    )
+    lines = set(rdf.strip().splitlines())
+    assert '<0x1> <name> "Alice" .' in lines
+    assert '<0x1> <age> "30"^^<xs:int> .' in lines
+    assert '<0x1> <alive> "true"^^<xs:boolean> .' in lines
+    assert "<0x1> <friend> <0x2> ." in lines
+    assert '<0x2> <name> "Bob" .' in lines
+
+
+def test_rdf_round_trips_through_loader(server):
+    rdf = server.query_rdf(
+        '{ q(func: eq(name, "Alice")) { name age friend { name } } }'
+    )
+    s2 = Server()
+    s2.alter("name: string @index(exact) .\nfriend: [uid] .\nage: int .")
+    s2.new_txn().mutate_rdf(set_rdf=rdf, commit_now=True)
+    out = s2.query('{ q(func: eq(name, "Alice")) { age friend { name } } }')
+    q = out["data"]["q"][0]
+    assert q["age"] == 30 and q["friend"][0]["name"] == "Bob"
+
+
+def test_grpc_resp_format_rdf(server):
+    from dgraph_tpu.api.grpc_server import pb, serve
+
+    import grpc
+
+    gs, port = serve(server)
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        q = ch.unary_unary(
+            "/api.Dgraph/Query",
+            request_serializer=pb.Request.SerializeToString,
+            response_deserializer=pb.Response.FromString,
+        )
+        resp = q(
+            pb.Request(
+                query='{ q(func: eq(name, "Alice")) { name } }',
+                resp_format=pb.Request.RDF,
+                read_only=True,
+            )
+        )
+        assert b'<0x1> <name> "Alice" .' in resp.rdf
+        assert not resp.json
+    finally:
+        gs.stop(0)
+
+
+def test_http_resp_format_rdf(server):
+    from dgraph_tpu.api.http_server import HTTPServer
+    import urllib.request
+
+    srv = HTTPServer(server, port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/query?respFormat=rdf",
+            data=b'{ q(func: eq(name, "Alice")) { name } }',
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as r:
+            body = r.read()
+            assert r.headers["Content-Type"] == "application/n-quads"
+        assert b'<0x1> <name> "Alice" .' in body
+    finally:
+        srv.stop()
