@@ -152,7 +152,7 @@ def _apply_value_edge(txn: Txn, su: SchemaUpdate, edge: DirectedEdge, data_key):
                 if p.uid == puid
             ]
         for old in old_posts:
-            for tokb in build_tokens(old.val(), tokenizers):
+            for tokb in build_tokens(old.val(), tokenizers, lang=old.lang):
                 ikey = keys.IndexKey(edge.attr, tokb, edge.ns)
                 txn.cache.add_delta(
                     ikey, Posting(uid=edge.entity, op=OP_DEL)
@@ -174,7 +174,7 @@ def _apply_value_edge(txn: Txn, su: SchemaUpdate, edge: DirectedEdge, data_key):
     txn.add_conflict_key(data_key if su.upsert else data_key + b"#v")
 
     if tokenizers and edge.op == OP_SET:
-        for tokb in build_tokens(stored, tokenizers):
+        for tokb in build_tokens(stored, tokenizers, lang=edge.lang):
             ikey = keys.IndexKey(edge.attr, tokb, edge.ns)
             txn.cache.add_delta(ikey, Posting(uid=edge.entity, op=OP_SET))
             if su.upsert:
@@ -207,7 +207,7 @@ def delete_entity_attr(txn: Txn, st: State, entity: int, attr: str, ns=keys.GALA
     data_key = keys.DataKey(attr, entity, ns)
     tokenizers = su.tokenizer_objs() if su else []
     for p in txn.cache.values(data_key):
-        for tokb in build_tokens(p.val(), tokenizers):
+        for tokb in build_tokens(p.val(), tokenizers, lang=p.lang):
             ikey = keys.IndexKey(attr, tokb, ns)
             txn.cache.add_delta(ikey, Posting(uid=entity, op=OP_DEL))
     for uid in txn.cache.uids(data_key):

@@ -427,7 +427,7 @@ class FuncRunner:
             )
         tok = get_tokenizer(tokname)
         text = Val(TypeID.STRING, str(fn.args[0]))
-        toks = build_tokens(text, [tok])
+        toks = build_tokens(text, [tok], lang=fn.lang or "")
         if not toks:
             return EMPTY
         lists = [self._index_uids(fn.attr, tb) for tb in toks]

@@ -1,0 +1,150 @@
+"""Light stemmers + stopword lists for multi-language fulltext.
+
+The reference's fulltext tokenizer analyzes per-language via bleve
+(tok/tok.go FullTextTokenizer{lang}, LangBase resolution): stemming and
+stopwords switch on the value's @lang tag. This module provides compact
+"light" suffix-strippers (the Lucene light-stemmer family shape — strip
+plural/gender/case endings, no full snowball tables) for the languages
+the test corpus exercises, with English delegating to the Porter stemmer
+in tok.py. Unknown languages fall back to no-op stemming with an empty
+stopword set — same degradation bleve applies for unsupported langs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, FrozenSet, Tuple
+
+_ES_STOP = frozenset(
+    "de la que el en y a los del se las por un para con no una su al es "
+    "lo como más pero sus le ya o este sí porque esta entre cuando muy "
+    "sin sobre también me hasta hay donde quien desde todo nos durante "
+    "todos uno les ni contra otros ese eso ante ellos e esto mí antes "
+    "algunos qué unos yo otro otras otra él tanto esa estos mucho".split()
+)
+
+_FR_STOP = frozenset(
+    "au aux avec ce ces dans de des du elle en et eux il je la le les leur "
+    "lui ma mais me même mes moi mon ne nos notre nous on ou par pas "
+    "pour qu que qui sa se ses son sur ta te tes toi ton tu un une vos "
+    "votre vous c d j l à m n s t y été étée être".split()
+)
+
+_DE_STOP = frozenset(
+    "aber alle als also am an auch auf aus bei bin bis bist da damit "
+    "dann der den des dem die das daß du er sie es ein eine einem einen "
+    "einer eines für hatte hatten hier ich ihr ihre im in ist ja kann "
+    "können mein mit muss nach nicht noch nun nur oder sehr sind so "
+    "über um und uns unter vom von vor war waren wenn werden wie wieder "
+    "wir wird zu zum zur".split()
+)
+
+_PT_STOP = frozenset(
+    "de a o que e do da em um para é com não uma os no se na por mais "
+    "as dos como mas foi ao ele das tem à seu sua ou ser quando muito "
+    "há nos já está eu também só pelo pela até isso ela entre era "
+    "depois sem mesmo aos ter seus quem nas me esse eles estão você".split()
+)
+
+_IT_STOP = frozenset(
+    "ad al allo ai agli alla alle con col da dal dallo dai dagli dalla "
+    "dalle di del dello dei degli della delle in nel nello nei negli "
+    "nella nelle su sul sullo sui sugli sulla sulle per tra contro io "
+    "tu lui lei noi voi loro mio mia miei mie che chi cui non più e è "
+    "il lo la i gli le un uno una ma ed se perché anche come".split()
+)
+
+_RU_STOP = frozenset(
+    "и в во не что он на я с со как а то все она так его но да ты к у "
+    "же вы за бы по только ее мне было вот от меня еще нет о из ему "
+    "теперь когда даже ну ли если уже или ни быть был него до вас "
+    "нибудь опять уж вам ведь там потом себя ничего ей может они тут "
+    "где есть надо ней для мы тебя их чем была сам чтоб без будто".split()
+)
+
+
+def _strip(word: str, suffixes, min_len: int = 4) -> str:
+    for suf in suffixes:
+        if word.endswith(suf) and len(word) - len(suf) >= min_len - 1:
+            return word[: len(word) - len(suf)]
+    return word
+
+
+def _es(word: str) -> str:
+    return _strip(
+        word,
+        (
+            "amientos", "imientos", "amiento", "imiento", "aciones",
+            "adoras", "adores", "ancias", "ación", "adora", "ador",
+            "ancia", "mente", "ibles", "istas", "able", "ible", "ista",
+            "osos", "osas", "oso", "osa", "ces", "es", "os", "as", "a",
+            "o", "e",
+        ),
+    )
+
+
+def _fr(word: str) -> str:
+    return _strip(
+        word,
+        (
+            "issements", "issement", "atrices", "ateurs", "ations",
+            "atrice", "ateur", "ation", "euses", "ments", "ement",
+            "euse", "ances", "ance", "ence", "ités", "ité", "eurs",
+            "eur", "ives", "ive", "ifs", "if", "es", "s", "e",
+        ),
+    )
+
+
+def _de(word: str) -> str:
+    return _strip(
+        word,
+        ("erinnen", "erin", "heiten", "heit", "keiten", "keit", "ungen",
+         "ung", "isch", "chen", "lein", "ern", "em", "er", "es", "en",
+         "e", "s", "n"),
+    )
+
+
+def _pt(word: str) -> str:
+    return _strip(
+        word,
+        ("amentos", "imentos", "amento", "imento", "adoras", "adores",
+         "aço~es", "ações", "ação", "mente", "idades", "idade", "ismos",
+         "ismo", "istas", "ista", "osos", "osas", "oso", "osa", "es",
+         "os", "as", "a", "o", "e"),
+    )
+
+
+def _it(word: str) -> str:
+    return _strip(
+        word,
+        ("azioni", "azione", "amenti", "imenti", "amento", "imento",
+         "mente", "atrici", "atori", "atore", "anze", "anza", "ichi",
+         "iche", "abili", "abile", "ibili", "ibile", "oso", "osa",
+         "osi", "ose", "i", "e", "a", "o"),
+    )
+
+
+def _ru(word: str) -> str:
+    return _strip(
+        word,
+        ("иями", "ями", "ами", "ией", "иям", "ием", "иях", "ого",
+         "его", "ому", "ему", "ыми", "ими", "ая", "яя", "ое", "ее",
+         "ие", "ые", "ой", "ей", "ий", "ый", "ам", "ям", "ах", "ях",
+         "ов", "ев", "ы", "и", "а", "я", "о", "е", "у", "ю", "ь"),
+        min_len=3,
+    )
+
+
+# lang -> (stemmer, stopwords); "en" resolves inside tok.py (Porter)
+REGISTRY: Dict[str, Tuple[Callable[[str], str], FrozenSet[str]]] = {
+    "es": (_es, _ES_STOP),
+    "fr": (_fr, _FR_STOP),
+    "de": (_de, _DE_STOP),
+    "pt": (_pt, _PT_STOP),
+    "it": (_it, _IT_STOP),
+    "ru": (_ru, _RU_STOP),
+}
+
+
+def lang_base(lang: str) -> str:
+    """'fr-CA' -> 'fr' (ref tok LangBase)."""
+    return (lang or "").split("-")[0].split("_")[0].lower()

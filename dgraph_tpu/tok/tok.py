@@ -106,17 +106,31 @@ class ExactTokenizer(Tokenizer):
 
 
 class FulltextTokenizer(Tokenizer):
+    """Language-aware full-text analysis (ref tok.go FullTextTokenizer:
+    per-@lang bleve analyzers; LangBase resolution). English stems with
+    Porter; other supported languages use the light stemmers in
+    stemmers.py; unknown languages tokenize without stemming."""
+
     name = "fulltext"
     type_id = TypeID.STRING
     identifier = IDENT_FULLTEXT
 
-    def tokens(self, v: Val) -> List[bytes]:
+    def tokens(self, v: Val, lang: str = "") -> List[bytes]:
+        from dgraph_tpu.tok.stemmers import REGISTRY, lang_base
+
         words = _word_re.findall(_normalize(str(v.value)))
-        toks = {
-            _porter_stem(w).encode("utf-8")
-            for w in words
-            if w not in _STOPWORDS
-        }
+        base = lang_base(lang)
+        if base and base != "en" and base in REGISTRY:
+            stem, stop = REGISTRY[base]
+            toks = {
+                stem(w).encode("utf-8") for w in words if w not in stop
+            }
+        else:
+            toks = {
+                _porter_stem(w).encode("utf-8")
+                for w in words
+                if w not in _STOPWORDS
+            }
         return self._wrap(sorted(toks))
 
 
@@ -344,11 +358,15 @@ def default_tokenizer_for(tid: TypeID) -> Tokenizer:
     }.get(tid, get_tokenizer("term"))
 
 
-def build_tokens(v: Val, tokenizers) -> List[bytes]:
+def build_tokens(v: Val, tokenizers, lang: str = "") -> List[bytes]:
     """All index tokens for value v under the given tokenizers
-    (ref posting/index.go:52 indexTokens)."""
+    (ref posting/index.go:52 indexTokens). `lang` reaches the
+    language-aware tokenizers (fulltext) from the posting's @lang tag."""
     out: List[bytes] = []
     for t in tokenizers:
         conv = convert(v, t.type_id) if v.tid != t.type_id else v
-        out.extend(t.tokens(conv))
+        if isinstance(t, FulltextTokenizer):
+            out.extend(t.tokens(conv, lang=lang))
+        else:
+            out.extend(t.tokens(conv))
     return out

@@ -1,0 +1,69 @@
+"""Multi-language fulltext stemming (ref tok.go FullTextTokenizer{lang},
+bleve per-language analyzers).
+"""
+
+import pytest
+
+from dgraph_tpu.api.server import Server
+from dgraph_tpu.tok.stemmers import REGISTRY, lang_base
+from dgraph_tpu.tok.tok import FulltextTokenizer
+from dgraph_tpu.types.types import TypeID, Val
+
+
+def _toks(text, lang=""):
+    t = FulltextTokenizer()
+    return {b[1:].decode() for b in t.tokens(Val(TypeID.STRING, text), lang)}
+
+
+def test_lang_base():
+    assert lang_base("fr-CA") == "fr"
+    assert lang_base("pt_BR") == "pt"
+    assert lang_base("") == ""
+
+
+def test_spanish_stems_and_stopwords():
+    got = _toks("las bibliotecas nacionales", "es")
+    # stopword 'las' dropped; plural endings stripped
+    assert "las" not in got
+    assert _toks("biblioteca nacional", "es") & got
+
+
+def test_french_stems():
+    a = _toks("les nations européennes", "fr")
+    b = _toks("nation européenne", "fr")
+    assert "les" not in a
+    assert a & b
+
+
+def test_german_stems():
+    a = _toks("die Bibliotheken", "de")
+    b = _toks("Bibliothek", "de")
+    assert a & b
+
+
+def test_russian_stopwords():
+    got = _toks("и все книги", "ru")
+    assert "и" not in got
+
+
+def test_unknown_lang_falls_back():
+    # no stemmer: words tokenize as-is through the EN pipeline
+    assert _toks("running waters", "xx")
+
+
+def test_engine_lang_aware_fulltext():
+    s = Server()
+    s.alter("bio: string @index(fulltext) @lang .")
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf=(
+            '<0x1> <bio> "las bibliotecas nacionales"@es .\n'
+            '<0x2> <bio> "national libraries"@en .'
+        ),
+        commit_now=True,
+    )
+    # Spanish query form matches the Spanish-stemmed document
+    out = s.query('{ q(func: alloftext(bio@es, "biblioteca nacional")) { uid } }')
+    assert [x["uid"] for x in out["data"]["q"]] == ["0x1"]
+    out = s.query('{ q(func: alloftext(bio@en, "library national")) { uid } }')
+    assert [x["uid"] for x in out["data"]["q"]] == ["0x2"]
