@@ -127,6 +127,28 @@ def cmd_live(args):
     )
 
 
+def cmd_import(args):
+    """dgraphimport equivalent (ref dgraphimport/, the snapshot-stream
+    import tool): bulk-load an exported dataset (schema + rdf[.gz]) into
+    a fresh or running data dir, picking bulk (offline, rollup writes)
+    or live (transactional) mode."""
+    import glob as _glob
+
+    files = []
+    schema = args.schema
+    for pat in args.files:
+        for path in sorted(_glob.glob(pat)):
+            if path.endswith((".schema", ".schema.gz")):
+                schema = schema or path
+            else:
+                files.append(path)
+    args.files = files
+    args.schema = schema
+    if args.mode == "live":
+        return cmd_live(args)
+    return cmd_bulk(args)
+
+
 def cmd_export(args):
     from dgraph_tpu.admin.export import export
 
@@ -319,6 +341,16 @@ def main(argv=None):
     p.add_argument("--schema", default=None)
     p.add_argument("files", nargs="+")
     p.set_defaults(fn=cmd_bulk)
+
+    p = sub.add_parser(
+        "import", help="import an exported dataset (dgraphimport equivalent)"
+    )
+    p.add_argument("files", nargs="+", help="rdf/schema files or globs")
+    p.add_argument("-p", default=None)
+    p.add_argument("--schema", default=None)
+    p.add_argument("--mode", choices=("bulk", "live"), default="bulk")
+    p.add_argument("--batch", type=int, default=1000)
+    p.set_defaults(fn=cmd_import)
 
     p = sub.add_parser("live", help="transactional load")
     add_p(p)
