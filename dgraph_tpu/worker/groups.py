@@ -37,34 +37,50 @@ from dgraph_tpu.zero.zero import TxnConflictError, ZeroLite
 
 
 class ZeroService:
-    """Coordinator: leases, oracle, tablet map, membership."""
+    """Coordinator: leases, oracle, tablet map, membership.
 
-    def __init__(self, n_groups: int):
-        self.zero = ZeroLite()
+    With a replicated backend (zero/replicated.py ReplicatedZero) every
+    lease/commit/tablet decision goes through the Zero raft quorum; the
+    default standalone backend is ZeroLite."""
+
+    def __init__(self, n_groups: int, zero=None):
+        self.zero = zero if zero is not None else ZeroLite()
         self.n_groups = n_groups
-        self.tablets: Dict[str, int] = {}  # predicate -> group id
+        self._repl = zero if hasattr(zero, "should_serve") else None
+        self._tablets: Dict[str, int] = {}  # predicate -> group id
         self._lock = threading.Lock()
         self.members: Dict[int, dict] = {}  # node_id -> info
 
+    @property
+    def tablets(self) -> Dict[str, int]:
+        if self._repl is not None:
+            return self._repl.tablets
+        return self._tablets
+
     # tablet assignment (ref zero.go:680 ShouldServe)
     def should_serve(self, pred: str) -> int:
+        if self._repl is not None:
+            return self._repl.should_serve(pred)
         with self._lock:
-            gid = self.tablets.get(pred)
+            gid = self._tablets.get(pred)
             if gid is None:
                 # least-loaded group gets the new tablet
                 load = {g: 0 for g in range(1, self.n_groups + 1)}
                 for g in self.tablets.values():
                     load[g] = load.get(g, 0) + 1
                 gid = min(load, key=lambda g: (load[g], g))
-                self.tablets[pred] = gid
+                self._tablets[pred] = gid
             return gid
 
     def belongs_to(self, pred: str) -> Optional[int]:
         return self.tablets.get(pred)
 
     def move_tablet(self, pred: str, dst_group: int):
+        if self._repl is not None:
+            self._repl.move_tablet(pred, dst_group)
+            return
         with self._lock:
-            self.tablets[pred] = dst_group
+            self._tablets[pred] = dst_group
 
     def connect(self, node_id: int, group: int):
         self.members[node_id] = {"group": group, "last_seen": time.time()}
@@ -330,9 +346,30 @@ class DistributedCluster:
         pump_ms: int = 5,
         data_dir: Optional[str] = None,
         compact_every: int = 0,
+        replicated_zero: bool = False,
+        zero_replicas: int = 3,
     ):
         self.net = InProcNetwork()
-        self.zero = ZeroService(n_groups)
+        self.zero_nodes = []
+        zero_impl = None
+        if replicated_zero:
+            from dgraph_tpu.raft.wal import RaftWal
+            from dgraph_tpu.zero.replicated import ReplicatedZero, ZeroReplica
+
+            zids = list(range(901, 901 + zero_replicas))
+            for zid in zids:
+                zwal = None
+                if data_dir is not None:
+                    os.makedirs(data_dir, exist_ok=True)
+                    zwal = RaftWal(os.path.join(data_dir, f"zero_{zid}"))
+                self.zero_nodes.append(
+                    ZeroReplica(
+                        zid, zids, self.net, wal=zwal,
+                        compact_every=compact_every,
+                    )
+                )
+            zero_impl = ReplicatedZero(self.zero_nodes)
+        self.zero = ZeroService(n_groups, zero=zero_impl)
         self.data_dir = data_dir
         self.groups: Dict[int, AlphaGroup] = {}
         nid = 0
@@ -366,6 +403,19 @@ class DistributedCluster:
         self._pump_thread = threading.Thread(target=self._pump_loop, daemon=True)
         self._pump_thread.start()
         self._wait_for_leaders()
+        if self.zero_nodes:
+            # deterministic config entry so every replica assigns tablets
+            # over the same group count
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                lead = next(
+                    (z for z in self.zero_nodes if z.raft.is_leader()), None
+                )
+                if lead is not None and lead.raft.propose(
+                    ("config", self.zero.n_groups)
+                ):
+                    break
+                time.sleep(0.01)
         if data_dir is not None:
             self.recover_intents()
 
@@ -376,6 +426,14 @@ class DistributedCluster:
 
     def _save_zero_state(self):
         if self.data_dir is None:
+            return
+        if self.zero_nodes:
+            # leases/tablets are raft-durable; only schema text needs a file
+            state = {"schemas": getattr(self, "_schema_texts", [])}
+            tmp = self._zero_state_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, self._zero_state_path())
             return
         z = self.zero.zero
         state = {
@@ -395,12 +453,13 @@ class DistributedCluster:
             return
         with open(path) as f:
             state = json.load(f)
-        self.zero.tablets.update(state.get("tablets", {}))
-        z = self.zero.zero
-        if state.get("max_ts", 0) > z.max_assigned:
-            z.next_ts(state["max_ts"] - z.max_assigned)
-        if state.get("max_uid", 0) > z._max_uid:
-            z.assign_uids(state["max_uid"] - z._max_uid)
+        if not self.zero_nodes:
+            self.zero._tablets.update(state.get("tablets", {}))
+            z = self.zero.zero
+            if state.get("max_ts", 0) > z.max_assigned:
+                z.next_ts(state["max_ts"] - z.max_assigned)
+            if state.get("max_uid", 0) > z._max_uid:
+                z.assign_uids(state["max_uid"] - z._max_uid)
         self._schema_texts = list(state.get("schemas", []))
         for text in self._schema_texts:
             preds, types = parse_schema(text)
@@ -442,6 +501,9 @@ class DistributedCluster:
                     if n.id not in self.net.down:
                         n.raft.tick(now)
                         self.zero.heartbeat(n.id)
+            for z in self.zero_nodes:
+                if z.id not in self.net.down:
+                    z.raft.tick(now)
             if ticks % 100 == 0:
                 self.zero.prune_dead(max_age_s=5.0)
                 if self.auto_rebalance:
@@ -454,7 +516,10 @@ class DistributedCluster:
     def _wait_for_leaders(self, timeout: float = 10.0):
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if all(g.leader() is not None for g in self.groups.values()):
+            if all(g.leader() is not None for g in self.groups.values()) and (
+                not self.zero_nodes
+                or any(z.raft.is_leader() for z in self.zero_nodes)
+            ):
                 return
             time.sleep(0.01)
         raise TimeoutError("raft groups failed to elect leaders")
@@ -471,6 +536,9 @@ class DistributedCluster:
                 if n.raft.wal is not None:
                     n.raft.wal.close()
                 n.kv.close()
+        for z in self.zero_nodes:
+            if z.raft.wal is not None:
+                z.raft.wal.close()
 
     # -- schema ----------------------------------------------------------------
 

@@ -1,0 +1,334 @@
+"""Raft-replicated Zero: the coordinator as a consensus state machine.
+
+Mirrors /root/reference/dgraph/cmd/zero (raft-backed Zero quorum:
+zero/raft.go applies proposals to the shared state, oracle.go decides
+commits, assign.go leases in blocks, zero.go:680 ShouldServe assigns
+tablets): every coordinator decision — timestamp/uid leases, tablet
+assignment, commit-or-abort — is a raft proposal applied deterministically
+on every Zero replica, so the cluster survives Zero crashes and restarts
+with no lost leases or split-brain commit decisions.
+
+The state machine is deterministic: `commit` re-runs conflict detection
+inside apply, so every replica reaches the same verdict. The client-side
+wrapper (`ReplicatedZero`) keeps the ZeroLite interface (begin_txn /
+read_ts / commit(track)/applied / assign_uids), leasing timestamps in
+blocks (assign.go's lease batching) so the common path doesn't pay one
+consensus round per timestamp.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from dgraph_tpu.raft.raft import RaftNode
+from dgraph_tpu.zero.zero import TxnConflictError
+
+
+class ZeroStateMachine:
+    """Deterministic coordinator state, mutated only by raft apply."""
+
+    def __init__(self):
+        self.max_ts = 0
+        self.max_uid = 1
+        self.commits: Dict[int, int] = {}  # conflict fp -> commit_ts
+        self.aborted: Set[int] = set()
+        self.tablets: Dict[str, int] = {}
+        self.n_groups = 1
+        # proposal results keyed by (proposer, req_id): the proposing
+        # node's wrapper reads its own result after apply
+        self.results: Dict[Tuple[int, int], object] = {}
+
+    def apply(self, op: tuple):
+        kind = op[0]
+        if kind == "config":
+            self.n_groups = int(op[1])
+            return None
+        _, proposer, req_id, *args = op
+        key = (proposer, req_id)
+        if key in self.results:
+            # a client that re-proposed across a leader change: the first
+            # committed copy decided; re-applying (e.g. a commit op) would
+            # flip the verdict (dedup, ref zero proposal keys)
+            return self.results[key]
+        out = self._apply_inner(kind, args)
+        self.results[(proposer, req_id)] = out
+        # bound the results map: entries are read once by the proposer
+        if len(self.results) > 10_000:
+            self.results.clear()
+        return out
+
+    def _apply_inner(self, kind: str, args):
+        if kind == "lease_ts":
+            (count,) = args
+            first = self.max_ts + 1
+            self.max_ts += count
+            return first
+        if kind == "lease_uid":
+            (count,) = args
+            first = self.max_uid + 1
+            self.max_uid += count
+            return first
+        if kind == "commit":
+            start_ts, cks = args
+            for ck in cks:
+                if self.commits.get(ck, 0) > start_ts:
+                    self.aborted.add(start_ts)
+                    return ("abort", self.commits[ck])
+            self.max_ts += 1
+            for ck in cks:
+                self.commits[ck] = self.max_ts
+            return ("commit", self.max_ts)
+        if kind == "abort":
+            (start_ts,) = args
+            self.aborted.add(start_ts)
+            return ("ok",)
+        if kind == "tablet":
+            (pred,) = args
+            gid = self.tablets.get(pred)
+            if gid is None:
+                load = {g: 0 for g in range(1, self.n_groups + 1)}
+                for g in self.tablets.values():
+                    load[g] = load.get(g, 0) + 1
+                gid = min(load, key=lambda g: (load[g], g))
+                self.tablets[pred] = gid
+            return gid
+        if kind == "move_tablet":
+            pred, gid = args
+            self.tablets[pred] = int(gid)
+            return ("ok",)
+        if kind == "gc":
+            (floor,) = args
+            for ck in [c for c, ts in self.commits.items() if ts <= floor]:
+                del self.commits[ck]
+            self.aborted = {t for t in self.aborted if t >= floor}
+            return ("ok",)
+        raise ValueError(f"unknown zero op {kind!r}")
+
+    # -- snapshot support ----------------------------------------------------
+
+    def dump(self) -> bytes:
+        import pickle
+
+        return pickle.dumps(
+            (
+                self.max_ts,
+                self.max_uid,
+                self.commits,
+                self.aborted,
+                self.tablets,
+                self.n_groups,
+            )
+        )
+
+    def load(self, blob: bytes):
+        import pickle
+
+        (
+            self.max_ts,
+            self.max_uid,
+            self.commits,
+            self.aborted,
+            self.tablets,
+            self.n_groups,
+        ) = pickle.loads(blob)
+        self.results = {}
+
+
+class ZeroReplica:
+    """One Zero raft member: state machine + raft node."""
+
+    def __init__(self, node_id: int, peer_ids: List[int], net, wal=None,
+                 compact_every: int = 0):
+        self.id = node_id
+        self.net = net
+        self.sm = ZeroStateMachine()
+        net.register(node_id)
+        self.raft = RaftNode(
+            node_id,
+            peer_ids,
+            net,
+            lambda idx, data: self.sm.apply(tuple(data)),
+            wal=wal,
+            snapshot_cb=self.sm.dump,
+            restore_cb=lambda blob, idx: self.sm.load(blob),
+            compact_every=compact_every,
+        )
+
+
+class ReplicatedZero:
+    """ZeroLite-compatible client over a quorum of ZeroReplica nodes.
+
+    Timestamps lease in blocks (TS_BLOCK) from consensus and are handed
+    out locally; every other decision (uids, commits, tablets) is one
+    proposal. The read_ts visibility barrier (pending commits) is
+    client-side volatile state, exactly like the oracle's MaxAssigned
+    wait — it gates reads, not safety."""
+
+    TS_BLOCK = 128
+
+    def __init__(self, replicas: List[ZeroReplica], pump=None):
+        self.replicas = replicas
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._req_id = 0
+        self._ts_next = 0
+        self._ts_end = -1  # exhausted
+        # highest commit_ts this client observed: block remnants below it
+        # are stale for snapshot purposes (a "fresh" ts must order after
+        # every acknowledged commit, like Zero's Timestamps() contract)
+        self._floor = 0
+        self._active: Set[int] = set()
+        self._pending: Set[int] = set()
+        self._client_id = 10_000 + id(self) % 10_000
+
+    # -- consensus plumbing --------------------------------------------------
+
+    def _leader(self, timeout: float = 5.0) -> ZeroReplica:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            down = getattr(self.replicas[0].net, "down", set())
+            live = [
+                r
+                for r in self.replicas
+                if r.raft.is_leader() and r.id not in down
+            ]
+            if live:
+                # highest term wins: a partitioned stale leader lingers
+                # until it hears the new term
+                return max(live, key=lambda r: r.raft.term)
+            time.sleep(0.002)
+        raise TimeoutError("no zero leader")
+
+    def _propose(self, kind: str, *args, timeout: float = 10.0):
+        """Propose and wait until OUR replica set applies it; read the
+        deterministic result from the leader's state machine."""
+        with self._lock:
+            self._req_id += 1
+            rid = self._req_id
+        op = (kind, self._client_id, rid, *args)
+        key = (self._client_id, rid)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            leader = self._leader(timeout=max(0.01, deadline - time.time()))
+            if not leader.raft.propose(op):
+                continue
+            # bounded wait per attempt: if leadership flips mid-flight we
+            # re-propose; the state machine dedups by (client, req_id)
+            attempt_end = min(deadline, time.time() + 1.5)
+            while time.time() < attempt_end:
+                if key in leader.sm.results:
+                    return leader.sm.results[key]
+                # the op may have committed via a NEW leader
+                for r in self.replicas:
+                    if key in r.sm.results and r.raft.is_leader():
+                        return r.sm.results[key]
+                time.sleep(0.001)
+        raise TimeoutError(f"zero proposal {kind} timed out")
+
+    # -- ZeroLite interface --------------------------------------------------
+
+    def next_ts(self, count: int = 1) -> int:
+        with self._lock:
+            if (
+                count == 1
+                and self._ts_next <= self._ts_end
+                and self._ts_next > self._floor
+            ):
+                ts = self._ts_next
+                self._ts_next += 1
+                return ts
+        if count == 1:
+            first = self._propose("lease_ts", self.TS_BLOCK)
+            with self._lock:
+                self._ts_next = first + 1
+                self._ts_end = first + self.TS_BLOCK - 1
+                return first
+        return self._propose("lease_ts", count)
+
+    def begin_txn(self) -> int:
+        ts = self.next_ts()
+        with self._lock:
+            self._active.add(ts)
+        return ts
+
+    def read_ts(self) -> int:
+        ts = self.next_ts()
+        with self._cv:
+            deadline = 30.0
+            while self._pending and min(self._pending) < ts and deadline > 0:
+                t0 = time.monotonic()
+                self._cv.wait(timeout=min(1.0, deadline))
+                deadline -= time.monotonic() - t0
+        return ts
+
+    def assign_uids(self, count: int) -> int:
+        return self._propose("lease_uid", count)
+
+    @property
+    def max_assigned(self) -> int:
+        try:
+            return self._leader(timeout=1.0).sm.max_ts
+        except TimeoutError:
+            return max(r.sm.max_ts for r in self.replicas)
+
+    @property
+    def _max_uid(self) -> int:
+        try:
+            return self._leader(timeout=1.0).sm.max_uid
+        except TimeoutError:
+            return max(r.sm.max_uid for r in self.replicas)
+
+    def commit(self, start_ts: int, conflict_keys, track: bool = False) -> int:
+        verdict = self._propose("commit", start_ts, sorted(conflict_keys))
+        with self._lock:
+            self._active.discard(start_ts)
+        if verdict[0] == "abort":
+            with self._lock:
+                self._floor = max(self._floor, verdict[1])
+            raise TxnConflictError(
+                f"conflict (committed at {verdict[1]} > start {start_ts})"
+            )
+        commit_ts = verdict[1]
+        with self._lock:
+            self._floor = max(self._floor, commit_ts)
+            if track:
+                self._pending.add(commit_ts)
+        # opportunistic conflict-map GC below the oldest active txn
+        with self._lock:
+            floor = min(self._active) if self._active else None
+        if floor is not None:
+            try:
+                self._propose("gc", floor, timeout=1.0)
+            except TimeoutError:
+                pass
+        return commit_ts
+
+    def applied(self, commit_ts: int):
+        with self._cv:
+            self._pending.discard(commit_ts)
+            self._cv.notify_all()
+
+    def abort(self, start_ts: int):
+        with self._lock:
+            self._active.discard(start_ts)
+        try:
+            self._propose("abort", start_ts, timeout=2.0)
+        except TimeoutError:
+            pass  # aborts are advisory bookkeeping
+
+    # -- tablet ops (ZeroService face) ---------------------------------------
+
+    def should_serve(self, pred: str) -> int:
+        return int(self._propose("tablet", pred))
+
+    def move_tablet(self, pred: str, gid: int):
+        self._propose("move_tablet", pred, gid)
+
+    @property
+    def tablets(self) -> Dict[str, int]:
+        try:
+            return dict(self._leader(timeout=1.0).sm.tablets)
+        except TimeoutError:
+            return dict(self.replicas[0].sm.tablets)
