@@ -794,25 +794,82 @@ def _json_preds(obj) -> set:
 
 
 def _eval_cond(cond: str, uid_vars) -> bool:
-    """Evaluate '@if(eq(len(v), N))'-style upsert conditions
-    (ref dql/upsert parsing of conditional mutations)."""
+    """Evaluate '@if(...)' upsert conditions: len(var) comparisons
+    combined with AND/OR/NOT and parentheses (ref dql conditional
+    mutations, edgraph/server.go parseMutationObject cond handling)."""
     import re as _re
 
-    m = _re.match(
-        r"\s*@if\s*\(\s*(eq|lt|le|gt|ge)\s*\(\s*len\s*\(\s*(\w+)\s*\)\s*,\s*(\d+)\s*\)\s*\)\s*",
-        cond,
-    )
+    m = _re.match(r"\s*@if\s*\((.*)\)\s*$", cond, _re.S)
     if not m:
         raise ValueError(f"unsupported upsert condition {cond!r}")
-    op, var, n = m.group(1), m.group(2), int(m.group(3))
-    ln = len(uid_vars.get(var, []))
-    return {
-        "eq": ln == n,
-        "lt": ln < n,
-        "le": ln <= n,
-        "gt": ln > n,
-        "ge": ln >= n,
-    }[op]
+    expr = m.group(1)
+
+    tokens = _re.findall(
+        r"\(|\)|AND\b|OR\b|NOT\b|and\b|or\b|not\b|"
+        r"(?:eq|lt|le|gt|ge)\s*\(\s*len\s*\(\s*\w+\s*\)\s*,\s*\d+\s*\)",
+        expr,
+    )
+    if not tokens or "".join(tokens).replace(" ", "") != expr.replace(" ", ""):
+        raise ValueError(f"unsupported upsert condition {cond!r}")
+    pos = 0
+
+    def atom(tok: str) -> bool:
+        am = _re.match(
+            r"(eq|lt|le|gt|ge)\s*\(\s*len\s*\(\s*(\w+)\s*\)\s*,\s*(\d+)\s*\)",
+            tok,
+        )
+        op, var, n = am.group(1), am.group(2), int(am.group(3))
+        ln = len(uid_vars.get(var, []))
+        return {
+            "eq": ln == n,
+            "lt": ln < n,
+            "le": ln <= n,
+            "gt": ln > n,
+            "ge": ln >= n,
+        }[op]
+
+    def parse_or() -> bool:
+        nonlocal pos
+        left = parse_and()
+        while pos < len(tokens) and tokens[pos].lower() == "or":
+            pos += 1
+            right = parse_and()
+            left = left or right
+        return left
+
+    def parse_and() -> bool:
+        nonlocal pos
+        left = parse_not()
+        while pos < len(tokens) and tokens[pos].lower() == "and":
+            pos += 1
+            right = parse_not()
+            left = left and right
+        return left
+
+    def parse_not() -> bool:
+        nonlocal pos
+        if pos < len(tokens) and tokens[pos].lower() == "not":
+            pos += 1
+            return not parse_not()
+        return parse_primary()
+
+    def parse_primary() -> bool:
+        nonlocal pos
+        tok = tokens[pos]
+        if tok == "(":
+            pos += 1
+            v = parse_or()
+            if pos >= len(tokens) or tokens[pos] != ")":
+                raise ValueError(f"unbalanced parens in {cond!r}")
+            pos += 1
+            return v
+        pos += 1
+        return atom(tok)
+
+    out = parse_or()
+    if pos != len(tokens):
+        raise ValueError(f"trailing tokens in upsert condition {cond!r}")
+    return out
 
 
 def _as_list(x):

@@ -132,6 +132,11 @@ class GraphQLServer:
             from dgraph_tpu.graphql.introspection import resolve_introspection
 
             return resolve_introspection(self.types, sel)
+        qt = self.types.get("Query")
+        if qt is not None:
+            f = qt.fields.get(name)
+            if f is not None and f.custom is not None:
+                return self._resolve_custom(f, sel)
         if name.startswith("get"):
             t = self._type_for(name, ["get"])
             return self._get(t, sel)
@@ -159,6 +164,43 @@ class GraphQLServer:
             for k in keys_:
                 r[k] = t.name
         return results
+
+    def _resolve_custom(self, f: GqlField, sel: Selection):
+        """@custom(http: {...}) resolver (ref graphql/schema/remote.go +
+        resolve/http.go): substitute $args into the URL/body template,
+        call the endpoint, project the selection over the JSON reply."""
+        import json as _json
+        import urllib.parse
+        import urllib.request
+
+        from dgraph_tpu.graphql.introspection import _project
+
+        cfg = (f.custom or {}).get("http")
+        if not cfg:
+            raise GraphQLError(f"@custom field {f.name} has no http config")
+        url = cfg.get("url", "")
+        for k, v in sel.args.items():
+            url = url.replace(f"${k}", urllib.parse.quote(str(v)))
+        method = str(cfg.get("method", "GET")).upper()
+        body = None
+        if cfg.get("body"):
+            from dgraph_tpu.graphql.auth import _parse_gql_object, _substitute
+
+            tmpl = _parse_gql_object(cfg["body"]) if isinstance(
+                cfg["body"], str
+            ) else cfg["body"]
+            body = _json.dumps(_substitute(tmpl, sel.args)).encode()
+        req = urllib.request.Request(url, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                payload = _json.loads(r.read() or b"null")
+        except Exception as e:
+            raise GraphQLError(f"@custom http call failed: {e}") from e
+        if sel.selections and isinstance(payload, (dict, list)):
+            return _project(payload, sel.selections)
+        return payload
 
     def _run_block(self, gq: GraphQuery) -> List[dict]:
         cache = LocalCache(
@@ -384,6 +426,11 @@ class GraphQLServer:
         if getattr(self.engine, "draining", False):
             raise GraphQLError("the server is in draining mode")
         name = sel.name
+        mt = self.types.get("Mutation")
+        if mt is not None:
+            f = mt.fields.get(name)
+            if f is not None and f.custom is not None:
+                return self._resolve_custom(f, sel)
         if name.startswith("add"):
             return self._add(self._type_for(name, ["add"]), sel)
         if name.startswith("update"):

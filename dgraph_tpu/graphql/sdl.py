@@ -46,6 +46,7 @@ class GqlField:
     has_inverse: str = ""  # field name on target type
     is_embedding: bool = False
     is_scalar: bool = True
+    custom: Optional[dict] = None  # @custom(http: {...}) config
 
     @property
     def dql_type(self) -> str:
@@ -78,7 +79,7 @@ _TYPE_RE = re.compile(
     re.DOTALL,
 )
 _FIELD_RE = re.compile(
-    r"""(?P<name>\w+)\s*:\s*
+    r"""(?P<name>\w+)\s*(?P<args>\((?:[^()]|\([^()]*\))*\))?\s*:\s*
     (?P<list>\[)?\s*(?P<type>\w+)\s*(?P<inner_nn>!)?\s*\]?\s*(?P<nn>!)?\s*
     (?P<directives>(?:@\w+(?:\((?:[^()]|\([^()]*\))*\))?\s*)*)""",
     re.VERBOSE,
@@ -161,18 +162,52 @@ def _extract_type_auth(sdl: str):
     return "".join(out), blobs
 
 
+def _scan_bodies(sdl: str):
+    """Extract (type_name, body_text) with quote- and brace-aware scanning
+    — directive args may contain braces (@custom http configs, @auth
+    rules), which a `[^}]*` regex body would truncate."""
+    out = []
+    for m in re.finditer(r"\btype\s+(\w+)[^{]*\{", sdl):
+        name = m.group(1)
+        i = m.end()
+        depth = 1
+        in_str = None
+        start = i
+        while i < len(sdl) and depth:
+            ch = sdl[i]
+            if in_str:
+                if in_str == '"""' and sdl.startswith('"""', i):
+                    in_str = None
+                    i += 3
+                    continue
+                if in_str == '"' and ch == '"' and sdl[i - 1] != "\\":
+                    in_str = None
+            elif sdl.startswith('"""', i):
+                in_str = '"""'
+                i += 3
+                continue
+            elif ch == '"':
+                in_str = '"'
+            elif ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+            i += 1
+        out.append((name, sdl[start : i - 1]))
+    return out
+
+
 def parse_sdl(sdl: str) -> Dict[str, GqlType]:
     sdl, auth_blobs = _extract_type_auth(sdl)
     sdl = re.sub(r'"""[\s\S]*?"""', "", sdl)  # strip descriptions
     sdl = re.sub(r"#[^\n]*", "", sdl)
     types: Dict[str, GqlType] = {}
-    for m in _TYPE_RE.finditer(sdl):
-        t = GqlType(name=m.group("name"))
-        if m.group("name") in auth_blobs:
+    for tname, body in _scan_bodies(sdl):
+        t = GqlType(name=tname)
+        if tname in auth_blobs:
             from dgraph_tpu.graphql.auth import parse_auth_blob
 
-            t.auth = parse_auth_blob(auth_blobs[m.group("name")])
-        body = m.group("body")
+            t.auth = parse_auth_blob(auth_blobs[tname])
         matches = list(_FIELD_RE.finditer(body))
         if not matches and body.strip():
             raise SDLError(f"cannot parse fields of type {t.name}: {body!r}")
@@ -207,6 +242,10 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
                 elif dname == "embedding":
                     f.is_embedding = True
                     f.is_scalar = True
+                elif dname == "custom":
+                    from dgraph_tpu.graphql.auth import _parse_gql_object
+
+                    f.custom = _parse_gql_object("{" + dargs + "}")
             t.fields[f.name] = f
         types[t.name] = t
     return types
@@ -216,10 +255,14 @@ def to_dql_schema(types: Dict[str, GqlType]) -> str:
     """Generate the internal schema text (ref schemagen.go)."""
     lines: List[str] = []
     for t in types.values():
+        if t.name in ("Query", "Mutation"):
+            continue  # virtual roots hold @custom resolvers, not data
         tfields = []
         for f in t.fields.values():
             if f.type_name == "ID":
                 continue  # internal uid, no predicate
+            if f.custom is not None:
+                continue  # resolved remotely, never stored
             pred = f"{t.name}.{f.name}"
             tfields.append(pred)
             dtype = f.dql_type

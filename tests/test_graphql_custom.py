@@ -1,0 +1,95 @@
+"""GraphQL @custom HTTP resolvers (ref graphql/schema/remote.go,
+resolve/http.go: custom queries/mutations/fields hitting external
+endpoints with $arg substitution).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dgraph_tpu.api.server import Server
+from dgraph_tpu.graphql.resolve import GraphQLServer
+
+
+class _Api(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _send(self, obj):
+        data = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path.startswith("/weather"):
+            city = self.path.split("city=")[1]
+            self._send({"city": city, "temp": 21.5})
+        else:
+            self._send(None)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        self._send({"echoed": body, "ok": True})
+
+
+@pytest.fixture(scope="module")
+def api_port():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Api)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+@pytest.fixture()
+def gql(api_port):
+    sdl = f'''
+type Person {{
+  id: ID!
+  name: String @search(by: [exact])
+}}
+type Query {{
+  getWeather(city: String!): WeatherPayload @custom(http: {{url: "http://127.0.0.1:{api_port}/weather?city=$city", method: GET}})
+}}
+type Mutation {{
+  notify(msg: String!): NotifyPayload @custom(http: {{url: "http://127.0.0.1:{api_port}/notify", method: POST, body: "{{message: $msg}}"}})
+}}
+type WeatherPayload {{ city: String temp: Float }}
+type NotifyPayload {{ ok: Boolean }}
+'''
+    return GraphQLServer(Server(), sdl)
+
+
+def test_custom_query_get(gql):
+    out = gql.execute('{ getWeather(city: "lisbon") { city temp } }')
+    assert out["data"]["getWeather"] == {"city": "lisbon", "temp": 21.5}
+
+
+def test_custom_mutation_post(gql):
+    out = gql.execute('mutation { notify(msg: "hi") { ok } }')
+    assert out["data"]["notify"]["ok"] is True
+
+
+def test_custom_does_not_create_predicates(gql):
+    # Query/Mutation virtual roots + custom fields generate no schema
+    preds = gql.engine.schema.predicates()
+    assert not any(p.startswith("Query.") for p in preds)
+    assert not any(p.startswith("Mutation.") for p in preds)
+    # and the regular generated API still works alongside
+    out = gql.execute('mutation { addPerson(input: [{name: "pc"}]) { numUids } }')
+    assert out["data"]["addPerson"]["numUids"] == 1
+
+
+def test_custom_error_surfaces(gql):
+    bad = GraphQLServer(
+        Server(),
+        'type Q2 { id: ID! }\n'
+        'type Query { broken: Q2 @custom(http: {url: "http://127.0.0.1:1/x", method: GET}) }',
+    )
+    out = bad.execute("{ broken { id } }")
+    assert out["errors"] and "http call failed" in out["errors"][0]["message"]

@@ -336,3 +336,45 @@ def test_query_vars_in_uid_depth_and_negative_default():
     with pytest.raises(ParseError):
         s.query("query q($x: in) { q(func: ge(age, $x)) { uid } }",
                 variables={"$x": "5"})
+
+
+def test_upsert_cond_combinators():
+    """@if with AND/OR/NOT + parens (ref conditional upsert semantics)."""
+    from dgraph_tpu.api.server import _eval_cond
+
+    uv = {"a": [1, 2], "b": []}
+    assert _eval_cond("@if(eq(len(a), 2))", uv)
+    assert _eval_cond("@if(eq(len(a), 2) AND eq(len(b), 0))", uv)
+    assert not _eval_cond("@if(eq(len(a), 2) AND gt(len(b), 0))", uv)
+    assert _eval_cond("@if(eq(len(a), 9) OR eq(len(b), 0))", uv)
+    assert _eval_cond("@if(NOT eq(len(a), 9))", uv)
+    assert _eval_cond("@if((eq(len(a), 9) OR eq(len(b), 0)) AND ge(len(a), 1))", uv)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        _eval_cond("@if(bogus)", uv)
+
+
+def test_upsert_cond_engine_path():
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter("email: string @index(exact) @upsert .\nname: string @index(exact) .")
+    t = s.new_txn()
+    # create only if absent AND the name isn't taken
+    t.upsert(
+        '{ u as var(func: eq(email, "a@x.io")) \n n as var(func: eq(name, "taken")) }',
+        set_rdf='_:new <email> "a@x.io" .\n_:new <name> "fresh" .',
+        cond="@if(eq(len(u), 0) AND eq(len(n), 0))",
+    )
+    out = s.query('{ q(func: eq(email, "a@x.io")) { name } }')
+    assert out["data"]["q"][0]["name"] == "fresh"
+    # second run: condition false, nothing added
+    t2 = s.new_txn()
+    t2.upsert(
+        '{ u as var(func: eq(email, "a@x.io")) \n n as var(func: eq(name, "taken")) }',
+        set_rdf='_:new <email> "a@x.io" .\n_:new <name> "dupe" .',
+        cond="@if(eq(len(u), 0) AND eq(len(n), 0))",
+    )
+    out = s.query('{ q(func: eq(email, "a@x.io")) { name } }')
+    assert len(out["data"]["q"]) == 1
