@@ -161,6 +161,7 @@ class GraphQuery:
     # facets
     facets: bool = False
     facet_names: List[str] = field(default_factory=list)
+    facet_vars: Dict[str, str] = field(default_factory=dict)  # var -> facet
     facet_filter: Optional["FuncSpec"] = None
     facet_order: str = ""
     facet_order_desc: bool = False
@@ -624,6 +625,13 @@ def _parse_directives(p: _P, gq: GraphQuery):
                         p.expect(":")
                         gq.facet_order = p.next().text
                         gq.facet_order_desc = t.text == "orderdesc"
+                    elif p.peek().text == "as":
+                        # `w as weight`: bind the facet into a value var
+                        # (ref query facet var bindings)
+                        p.next()  # as
+                        fname = p.next().text
+                        gq.facet_vars[t.text] = fname
+                        gq.facet_names.append(fname)
                     else:
                         gq.facet_names.append(t.text)
                     p.accept(",")

@@ -437,6 +437,16 @@ class Executor:
         # (dest_uids is recomputed by the caller after order/pagination)
         if cgq.facets:
             cnode.edge_facet_maps = fmaps  # type: ignore[attr-defined]
+        # `w as weight` facet vars: target uid -> facet value, visible to
+        # later blocks/children (ref facet var bindings in query.go)
+        for var, fname in cgq.facet_vars.items():
+            vals = self.val_vars.setdefault(var, {})
+            for i, row in enumerate(cnode.uid_matrix):
+                fmap = fmaps[i] if i < len(fmaps) else {}
+                for u in row:
+                    fv = fmap.get(int(u), {}).get(fname)
+                    if fv is not None:
+                        vals[int(u)] = fv
 
     def _resolve_expand(
         self, gqs: List[GraphQuery], uids: np.ndarray

@@ -90,3 +90,32 @@ def test_lang_chain(server):
     # missing language entirely -> absent field
     res = server.query('{ q(func: uid(0x6)) { name@fr } }')["data"]
     assert res["q"] == []
+
+
+def test_facet_value_vars():
+    """`@facets(w as weight)` binds target-uid -> facet value into a
+    value var usable by later blocks (ref facet var bindings)."""
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter("name: string @index(exact) .\nfollows: [uid] .")
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf=(
+            '<0x1> <name> "hub" .\n'
+            "<0x1> <follows> <0x2> (weight=0.9) .\n"
+            "<0x1> <follows> <0x3> (weight=0.1) .\n"
+            '<0x2> <name> "heavy" .\n'
+            '<0x3> <name> "light" .'
+        ),
+        commit_now=True,
+    )
+    out = s.query(
+        """{
+          var(func: eq(name, "hub")) { follows @facets(w as weight) }
+          q(func: uid(w), orderdesc: val(w)) { name score: val(w) }
+        }"""
+    )
+    q = out["data"]["q"]
+    assert [x["name"] for x in q] == ["heavy", "light"]
+    assert q[0]["score"] == 0.9
