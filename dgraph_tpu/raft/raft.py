@@ -97,17 +97,26 @@ class RaftNode:
         snapshot_cb: Optional[Callable[[], bytes]] = None,
         restore_cb: Optional[Callable[[bytes, int], None]] = None,
         compact_every: int = 0,
+        learner: bool = False,
+        learner_ids: Optional[set] = None,
     ):
         """wal: raft.wal.RaftWal for durability (None = volatile, test-only).
         snapshot_cb() -> bytes captures the applied state machine;
         restore_cb(data, index) replaces it (snapshot install).
         compact_every > 0: leader auto-snapshots/compacts once the entry
         window exceeds that many applied entries (draft.go
-        calculateSnapshot analog)."""
+        calculateSnapshot analog).
+        learner: non-voting member (etcd raft learners / the reference's
+        --raft learner nodes): replicates and applies the log but never
+        votes, campaigns, or counts toward commit quorum — cheap read
+        replicas. learner_ids: the cluster-wide learner set (so voters
+        exclude them from majority math)."""
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.net = network
         self.apply_cb = apply_cb
+        self.learner = learner
+        self.learner_ids = set(learner_ids or ())
         self.rng = random.Random(seed if seed is not None else node_id)
         self.wal = wal
         self.snapshot_cb = snapshot_cb
@@ -233,7 +242,7 @@ class RaftNode:
             if self.state == LEADER:
                 if now - self._last_heartbeat_sent >= self.heartbeat_ms:
                     self._broadcast_append(now)
-            elif now >= self.election_deadline:
+            elif now >= self.election_deadline and not self.learner:
                 self._start_election(now)
             self._apply_committed()
             if (
@@ -369,6 +378,14 @@ class RaftNode:
 
     def _on_vote_req(self, m: Message, now: int):
         grant = False
+        if self.learner or m.frm in self.learner_ids:
+            # learners neither vote nor get elected
+            self.net.send(
+                Message(
+                    "vote_resp", self.id, m.frm, self.term, {"granted": False}
+                )
+            )
+            return
         if m.term >= self.term and self.voted_for in (None, m.frm):
             # up-to-date check (§5.4.1)
             llt, lli = self.last_log_term(), self.last_index()
@@ -384,12 +401,16 @@ class RaftNode:
             Message("vote_resp", self.id, m.frm, self.term, {"granted": grant})
         )
 
+    def _voting_size(self) -> int:
+        voters = {self.id, *self.peers} - self.learner_ids
+        return len(voters)
+
     def _on_vote_resp(self, m: Message, now: int):
         if self.state != CANDIDATE or m.term != self.term:
             return
         if m.payload["granted"]:
             self._votes.add(m.frm)
-            if len(self._votes) * 2 > len(self.peers) + 1:
+            if len(self._votes - self.learner_ids) * 2 > self._voting_size():
                 self._become_leader(now)
 
     def _on_append_req(self, m: Message, now: int):
@@ -507,10 +528,14 @@ class RaftNode:
             self._send_append(p)
 
     def _advance_commit(self):
-        n = len(self.peers) + 1
+        # majority over VOTING members only (learners replicate but never
+        # count toward quorum)
+        n = self._voting_size()
         for idx in range(self.last_index(), self.commit_index, -1):
             votes = sum(
-                1 for mi in self.match_index.values() if mi >= idx
+                1
+                for nid, mi in self.match_index.items()
+                if mi >= idx and nid not in self.learner_ids
             )
             if votes * 2 > n and self.term_at(idx) == self.term:
                 self.commit_index = idx

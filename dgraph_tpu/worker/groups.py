@@ -127,9 +127,12 @@ class AlphaNode:
         net,
         data_dir: Optional[str] = None,
         compact_every: int = 0,
+        learner: bool = False,
+        learner_ids: Optional[set] = None,
     ):
         self.id = node_id
         self.group_id = group_id
+        self.learner = learner
         raft_wal = None
         if data_dir is not None:
             os.makedirs(data_dir, exist_ok=True)
@@ -152,6 +155,8 @@ class AlphaNode:
             snapshot_cb=self._snapshot,
             restore_cb=self._restore,
             compact_every=compact_every,
+            learner=learner,
+            learner_ids=learner_ids,
         )
         self.applied_index = self.raft.last_applied
 
@@ -181,13 +186,16 @@ class AlphaGroup:
         net,
         data_dir: Optional[str] = None,
         compact_every: int = 0,
+        learner_ids: Optional[set] = None,
     ):
         self.id = group_id
         self.net = net
+        learner_ids = set(learner_ids or ())
         self.nodes = [
             AlphaNode(
                 nid, group_id, node_ids, net,
                 data_dir=data_dir, compact_every=compact_every,
+                learner=nid in learner_ids, learner_ids=learner_ids,
             )
             for nid in node_ids
         ]
@@ -348,6 +356,7 @@ class DistributedCluster:
         compact_every: int = 0,
         replicated_zero: bool = False,
         zero_replicas: int = 3,
+        learners_per_group: int = 0,
     ):
         self.net = InProcNetwork()
         self.zero_nodes = []
@@ -374,11 +383,16 @@ class DistributedCluster:
         self.groups: Dict[int, AlphaGroup] = {}
         nid = 0
         for g in range(1, n_groups + 1):
-            ids = list(range(nid + 1, nid + replicas + 1))
-            nid += replicas
+            total = replicas + learners_per_group
+            ids = list(range(nid + 1, nid + total + 1))
+            # learners are the tail ids of each group (non-voting readers,
+            # ref etcd raft learners / --raft learner)
+            lids = set(ids[replicas:])
+            nid += total
             gdir = os.path.join(data_dir, f"group_{g}") if data_dir else None
             self.groups[g] = AlphaGroup(
-                g, ids, self.net, data_dir=gdir, compact_every=compact_every
+                g, ids, self.net, data_dir=gdir,
+                compact_every=compact_every, learner_ids=lids,
             )
             for node in self.groups[g].nodes:
                 self.zero.connect(node.id, g)

@@ -181,3 +181,65 @@ def test_raft_over_tcp_sockets():
             t.join(timeout=1)
         for net in nets:
             net.close()
+
+
+def test_learner_replicates_but_never_votes_or_leads():
+    """Non-voting learners (ref etcd raft learners): replicate + apply,
+    excluded from quorum — commits proceed with a majority of VOTERS even
+    when every learner is down."""
+    from dgraph_tpu.raft.raft import RaftCluster
+
+    c = RaftCluster(4, learner_ids={4})
+    c.nodes[4].learner = True
+    leader = c.elect()
+    assert leader.id != 4
+    assert leader.propose({"op": 1})
+    assert c.run_until(lambda: all(len(c.applied[i]) == 1 for i in c.nodes))
+    # kill the learner: quorum is 2/3 voters, commits continue
+    c.net.down.add(4)
+    assert leader.propose({"op": 2})
+    assert c.run_until(
+        lambda: all(len(c.applied[i]) == 2 for i in (1, 2, 3))
+    )
+    # kill one VOTER too (2/3 voters remain = still majority)
+    dead_voter = next(i for i in (1, 2, 3) if i != leader.id)
+    c.net.down.add(dead_voter)
+    assert c.run_until(lambda: c.leader() is not None)
+    lead2 = c.leader()
+    assert lead2.propose({"op": 3})
+    live_voter = next(i for i in (1, 2, 3) if i not in (dead_voter,))
+    assert c.run_until(lambda: len(c.applied[lead2.id]) == 3)
+    # learner rejoins and catches up without ever voting
+    c.net.down.discard(4)
+    assert c.run_until(lambda: len(c.applied[4]) == 3)
+    assert c.nodes[4].state == "follower"
+
+
+def test_cluster_learners_serve_reads():
+    from dgraph_tpu.worker.groups import DistributedCluster
+
+    c = DistributedCluster(n_groups=1, replicas=3, learners_per_group=1)
+    try:
+        c.alter("name: string @index(exact) .")
+        c.new_txn().mutate_rdf(set_rdf='<0x1> <name> "lr" .', commit_now=True)
+        learner = c.groups[1].nodes[-1]
+        assert learner.raft.learner
+        # the learner applied the committed delta and can serve the read
+        import time
+
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            got = learner.kv.get(
+                __import__("dgraph_tpu.x.keys", fromlist=["DataKey"]).DataKey(
+                    "name", 1
+                ),
+                1 << 60,
+            )
+            if got is not None:
+                break
+            time.sleep(0.05)
+        assert got is not None
+        out = c.query('{ q(func: eq(name, "lr")) { name } }')
+        assert out["data"]["q"][0]["name"] == "lr"
+    finally:
+        c.close()
