@@ -184,6 +184,9 @@ class Server:
         self.audit = None  # enabled via enable_audit()
         self.slow_query_ms = 1000.0  # slow-query log threshold
         self.mem = MemoryLayer()  # shared decoded-list read cache
+        from dgraph_tpu.utils.cmsketch import StatsHolder
+
+        self.stats = StatsHolder()  # selectivity stats (auto-fed on commit)
         self._bootstrap_schema()
         if data_dir is not None:
             self._load_persisted_state()
@@ -441,6 +444,7 @@ class Server:
                 self.zero.applied(commit_ts)
         METRICS.inc("num_commits")
         self.mem.invalidate(txn.cache.deltas.keys())
+        self._feed_stats(txn.cache.deltas)
         cdc = getattr(self, "_cdc", None)
         if cdc is not None:
             cdc.emit_commit(commit_ts, txn.cache.deltas)
@@ -458,6 +462,17 @@ class Server:
                     elif p.op == OP_DEL:
                         vidx.remove(pk.uid)
         return commit_ts
+
+    def _feed_stats(self, deltas):
+        """Count index-key postings into the cm-sketch (ref posting/stats
+        collection feeding planForEqFilter)."""
+        for key, posts in deltas.items():
+            try:
+                pk = keys.parse_key(key)
+            except Exception:
+                continue
+            if pk.is_index and posts:
+                self.stats.record(pk.attr, pk.term, len(posts))
 
     # -- mutations -------------------------------------------------------------
 
@@ -709,6 +724,7 @@ class Server:
             ns=ns,
             vector_indexes=self.vector_indexes,
             allowed_preds=allowed_preds,
+            stats=self.stats,
         )
         nodes = ex.process(blocks)
         enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)

@@ -156,8 +156,12 @@ class BulkLoader:
                 )
             )
 
+        stats = getattr(server, "stats", None)
         for key, uids in self._index_uids.items():
             u = np.unique(np.asarray(uids, np.uint64))
+            if stats is not None:
+                pk = keys.parse_key(key)
+                stats.record(pk.attr, pk.term, len(u))
             writes.extend(rollup_writes(key, u, [], ts))
 
         for (attr, cnt, ns), uids in self._counts.items():

@@ -38,13 +38,15 @@ class FuncRunner:
     """Executes FuncSpecs against a LocalCache + schema state."""
 
     def __init__(self, cache: LocalCache, st: State, ns: int = keys.GALAXY_NS,
-                 vector_indexes=None, uid_vars=None, val_vars=None):
+                 vector_indexes=None, uid_vars=None, val_vars=None,
+                 stats=None):
         self.cache = cache
         self.st = st
         self.ns = ns
         self.vector_indexes = vector_indexes or {}
         self.uid_vars = uid_vars or {}
         self.val_vars = val_vars or {}
+        self.stats = stats  # StatsHolder: selectivity-ordered index scans
 
     # -- helpers -------------------------------------------------------------
 
@@ -430,14 +432,22 @@ class FuncRunner:
         toks = build_tokens(text, [tok], lang=fn.lang or "")
         if not toks:
             return EMPTY
-        lists = [self._index_uids(fn.attr, tb) for tb in toks]
-        out = lists[0]
-        for l in lists[1:]:
-            out = (
-                np.intersect1d(out, l, assume_unique=True)
-                if require_all
-                else np.union1d(out, l)
-            )
+        if require_all and self.stats is not None and len(toks) > 1:
+            # cheapest (rarest) token first so the intersection collapses
+            # early and the remaining lists never load (ref worker/task.go
+            # planForEqFilter selectivity ordering via cm-sketch stats)
+            toks = self.stats.plan_eq_order(fn.attr, toks)
+        out = None
+        for tb in toks:
+            l = self._index_uids(fn.attr, tb)
+            if out is None:
+                out = l
+            elif require_all:
+                out = np.intersect1d(out, l, assume_unique=True)
+            else:
+                out = np.union1d(out, l)
+            if require_all and not len(out):
+                return EMPTY  # early exit: later lists never load
         if src is not None:
             out = np.intersect1d(out, src, assume_unique=True)
         return out.astype(np.uint64)

@@ -56,10 +56,12 @@ class Executor:
         ns: int = keys.GALAXY_NS,
         vector_indexes=None,
         allowed_preds=None,
+        stats=None,
     ):
         self.cache = cache
         self.st = st
         self.ns = ns
+        self.stats = stats
         self.vector_indexes = vector_indexes or {}
         # None = unrestricted; a set filters expand(_all_) expansion to
         # ACL-readable predicates (ref expand filtering in edgraph auth)
@@ -75,6 +77,7 @@ class Executor:
             vector_indexes=self.vector_indexes,
             uid_vars=self.uid_vars,
             val_vars=self.val_vars,
+            stats=self.stats,
         )
 
     # ------------------------------------------------------------------

@@ -258,3 +258,30 @@ def test_count_min_sketch():
     st.record("name", b"b", 5)
     st.record("name", b"c", 50)
     assert st.plan_eq_order("name", [b"a", b"b", b"c"]) == [b"b", b"c", b"a"]
+
+
+def test_stats_auto_fed_and_planning():
+    """cm-sketch selectivity stats are fed by commits/bulk and order
+    allofterms scans rarest-token-first (ref worker/task.go
+    planForEqFilter)."""
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter("bio: string @index(term) .")
+    t = s.new_txn()
+    rdf = []
+    # 'common' appears in 50 docs, 'rare' in 2
+    for i in range(1, 51):
+        extra = " rare" if i <= 2 else ""
+        rdf.append(f'<0x{i:x}> <bio> "common{extra} filler{i}" .')
+    t.mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+    # stats recorded per token
+    common_est = s.stats.estimate("bio", b"\x01common")
+    rare_est = s.stats.estimate("bio", b"\x01rare")
+    assert common_est > rare_est >= 2
+    # plan orders rare first
+    order = s.stats.plan_eq_order("bio", [b"\x01common", b"\x01rare"])
+    assert order[0] == b"\x01rare"
+    # and the query is correct
+    out = s.query('{ q(func: allofterms(bio, "common rare")) { uid } }')
+    assert len(out["data"]["q"]) == 2
