@@ -153,7 +153,7 @@ class FuncRunner:
             return self._match(fn, src)
         if name == "similar_to":
             return self._similar_to(fn, src)
-        if name in ("near", "within"):
+        if name in ("near", "within", "contains", "intersects"):
             return self._geo(fn, name, src)
         if name == "checkpwd":
             return self._checkpwd(fn, src)
@@ -183,6 +183,91 @@ class FuncRunner:
             except ValueError:
                 continue
         return _as_uids(out)
+
+    def _geo_cells_of_point(self, lon: float, lat: float):
+        from dgraph_tpu.tok.tok import GeoTokenizer
+
+        tok = get_tokenizer("geo")
+        return [
+            tok.prefix() + GeoTokenizer.cell_at(lon, lat, lvl)
+            for lvl in range(GeoTokenizer.MIN_LEVEL, GeoTokenizer.MAX_LEVEL + 1)
+        ]
+
+    def _geo_contains(self, fn: FuncSpec, src) -> np.ndarray:
+        """contains(loc, [lon,lat]): stored areal geometries containing
+        the point (ref types/geofilter.go QueryTypeContains)."""
+        pt = fn.args[0]
+        lon, lat = float(pt[0]), float(pt[1])
+        cands = set()
+        for key_tok in self._geo_cells_of_point(lon, lat):
+            for u in self._index_uids(fn.attr, key_tok):
+                cands.add(int(u))
+        out = []
+        for u in sorted(cands):
+            got = self._value_of(fn.attr, u)
+            if got is None:
+                continue
+            for ring in _geo_rings(got.value):
+                if _point_in_poly(lon, lat, ring):
+                    out.append(u)
+                    break
+        res = _as_uids(out)
+        if src is not None:
+            res = np.intersect1d(res, src, assume_unique=True)
+        return res
+
+    def _geo_intersects(self, fn: FuncSpec, src) -> np.ndarray:
+        """intersects(loc, polygon): stored geometries intersecting the
+        query polygon (ref QueryTypeIntersects)."""
+        ring = fn.args[0] if fn.args else None
+        if isinstance(ring, list) and ring and isinstance(ring[0], list) and ring[0] and isinstance(ring[0][0], list):
+            ring = ring[0]
+        if not isinstance(ring, list) or len(ring) < 3:
+            raise QueryError("intersects() needs a polygon of >=3 points")
+        qring = [(float(p[0]), float(p[1])) for p in ring]
+        # candidates: cover cells of the query polygon bbox across levels
+        from dgraph_tpu.tok.tok import GeoTokenizer
+
+        tok = get_tokenizer("geo")
+        lons = [p[0] for p in qring]
+        lats = [p[1] for p in qring]
+        lon0, lon1 = min(lons), max(lons)
+        lat0, lat1 = min(lats), max(lats)
+        cands = set()
+        for lvl in range(GeoTokenizer.MIN_LEVEL, GeoTokenizer.MAX_LEVEL + 1):
+            cw = 360.0 / (1 << lvl)
+            ch = 180.0 / (1 << lvl)
+            if ((lon1 - lon0) / cw + 2) * ((lat1 - lat0) / ch + 2) > 512:
+                break
+            x = lon0
+            while x <= lon1 + cw:
+                y = lat0
+                while y <= lat1 + ch:
+                    cell = GeoTokenizer.cell_at(min(x, lon1), min(y, lat1), lvl)
+                    for u in self._index_uids(fn.attr, tok.prefix() + cell):
+                        cands.add(int(u))
+                    y += ch
+                x += cw
+        out = []
+        for u in sorted(cands):
+            got = self._value_of(fn.attr, u)
+            if got is None:
+                continue
+            geo = got.value
+            rings = _geo_rings(geo)
+            if rings:
+                if any(_polys_intersect(qring, r) for r in rings):
+                    out.append(u)
+            else:
+                c = geo.get("coordinates", [None, None])
+                if c[0] is not None and _point_in_poly(
+                    float(c[0]), float(c[1]), qring
+                ):
+                    out.append(u)
+        res = _as_uids(out)
+        if src is not None:
+            res = np.intersect1d(res, src, assume_unique=True)
+        return res
 
     # -- implementations -----------------------------------------------------
 
@@ -547,6 +632,10 @@ class FuncRunner:
         su = self._schema(fn.attr)
         if "geo" not in su.tokenizers:
             raise QueryError(f"predicate {fn.attr!r} needs @index(geo)")
+        if op == "contains":
+            return self._geo_contains(fn, src)
+        if op == "intersects":
+            return self._geo_intersects(fn, src)
         if op == "near":
             coords, dist_m = fn.args[0], float(fn.args[1])
             lon, lat = float(coords[0]), float(coords[1])
@@ -677,6 +766,41 @@ def _levenshtein(a: str, b: str) -> int:
             cur.append(min(prev[j] + 1, cur[-1] + 1, prev[j - 1] + (ca != cb)))
         prev = cur
     return prev[-1]
+
+
+def _geo_rings(geo) -> list:
+    """Outer rings of a stored polygon/multipolygon GeoJSON value."""
+    t = geo.get("type", "").lower()
+    c = geo.get("coordinates")
+    if t == "polygon":
+        return [c[0]] if c else []
+    if t == "multipolygon":
+        return [poly[0] for poly in c if poly]
+    return []
+
+
+def _segments_intersect(p1, p2, p3, p4) -> bool:
+    def ccw(a, b, c):
+        return (c[1] - a[1]) * (b[0] - a[0]) > (b[1] - a[1]) * (c[0] - a[0])
+
+    return ccw(p1, p3, p4) != ccw(p2, p3, p4) and ccw(p1, p2, p3) != ccw(
+        p1, p2, p4
+    )
+
+
+def _polys_intersect(ring_a, ring_b) -> bool:
+    """Outer-ring intersection test: vertex containment either way or any
+    edge crossing (sufficient for simple polygons, ref geofilter
+    Intersects verification)."""
+    if any(_point_in_poly(p[0], p[1], ring_b) for p in ring_a):
+        return True
+    if any(_point_in_poly(p[0], p[1], ring_a) for p in ring_b):
+        return True
+    ea = list(zip(ring_a, ring_a[1:] + ring_a[:1]))
+    eb = list(zip(ring_b, ring_b[1:] + ring_b[:1]))
+    return any(
+        _segments_intersect(a1, a2, b1, b2) for a1, a2 in ea for b1, b2 in eb
+    )
 
 
 def _point_in_poly(x: float, y: float, ring) -> bool:

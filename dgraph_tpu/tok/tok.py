@@ -289,6 +289,29 @@ class GeoTokenizer(Tokenizer):
         for lon, lat in coords:
             for lvl in range(self.MIN_LEVEL, self.MAX_LEVEL + 1):
                 toks.add(self.cell_at(lon, lat, lvl))
+        # areal geometries additionally index their bbox COVER cells per
+        # level (bounded per level), so contains(point)/intersects(poly)
+        # lookups hit interior cells — the S2 covering contract
+        # (ref types/s2index.go IndexCells for regions)
+        if geo.get("type", "").lower() in ("polygon", "multipolygon"):
+            lons = [p[0] for p in coords]
+            lats = [p[1] for p in coords]
+            lon0, lon1 = min(lons), max(lons)
+            lat0, lat1 = min(lats), max(lats)
+            for lvl in range(self.MIN_LEVEL, self.MAX_LEVEL + 1):
+                cw = 360.0 / (1 << lvl)
+                ch = 180.0 / (1 << lvl)
+                nx = int((lon1 - lon0) / cw) + 2
+                ny = int((lat1 - lat0) / ch) + 2
+                if nx * ny > 256:
+                    break  # finer levels explode; coarse cover suffices
+                x = lon0
+                while x <= lon1 + cw:
+                    y = lat0
+                    while y <= lat1 + ch:
+                        toks.add(self.cell_at(min(x, lon1), min(y, lat1), lvl))
+                        y += ch
+                    x += cw
         return self._wrap(sorted(toks))
 
 

@@ -378,3 +378,46 @@ def test_upsert_cond_engine_path():
     )
     out = s.query('{ q(func: eq(email, "a@x.io")) { name } }')
     assert len(out["data"]["q"]) == 1
+
+
+def test_geo_contains_and_intersects():
+    """contains(point-in-polygon) + intersects(polygon-polygon) over the
+    quadtree geo index (ref types/geofilter.go QueryTypeContains/
+    Intersects)."""
+    import json
+
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter("area: geo @index(geo) .\nname: string @index(exact) .")
+    square = {
+        "type": "Polygon",
+        "coordinates": [[[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]]],
+    }
+    far = {
+        "type": "Polygon",
+        "coordinates": [[[50, 50], [60, 50], [60, 60], [50, 60], [50, 50]]],
+    }
+    pt = {"type": "Point", "coordinates": [5, 5]}
+    t = s.new_txn()
+    t.mutate_json(
+        set_obj=[
+            {"uid": "0x1", "name": "square", "area": json.dumps(square)},
+            {"uid": "0x2", "name": "far", "area": json.dumps(far)},
+            {"uid": "0x3", "name": "pt", "area": json.dumps(pt)},
+        ],
+        commit_now=True,
+    )
+    # the square (not 'far') contains (5,5)
+    out = s.query("{ q(func: contains(area, [5.0, 5.0])) { name } }")
+    assert [x["name"] for x in out["data"]["q"]] == ["square"]
+    # a polygon overlapping the square intersects it and the inner point
+    out = s.query(
+        "{ q(func: intersects(area, [[[4.0,4.0],[12.0,4.0],[12.0,6.0],[4.0,6.0],[4.0,4.0]]])) { name } }"
+    )
+    assert sorted(x["name"] for x in out["data"]["q"]) == ["pt", "square"]
+    # a disjoint polygon matches nothing
+    out = s.query(
+        "{ q(func: intersects(area, [[[80.0,80.0],[85.0,80.0],[85.0,85.0],[80.0,85.0],[80.0,80.0]]])) { name } }"
+    )
+    assert out["data"]["q"] == []
