@@ -214,6 +214,29 @@ class SetOpDispatcher:
         if (
             not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL
         ) or not self._device_ready():
+            if op in ("intersect", "difference") and len(rows) > 4:
+                # vectorized host fallback: ONE searchsorted over the
+                # concatenated rows beats per-row native calls (ctypes
+                # marshaling dominates at small sizes)
+                b64 = np.asarray(b, np.uint64)
+                cat = np.concatenate(
+                    [np.asarray(r, np.uint64) for r in rows]
+                )
+                if len(b64) and len(cat):
+                    idx = np.searchsorted(b64, cat)
+                    idx_c = np.minimum(idx, len(b64) - 1)
+                    mask = b64[idx_c] == cat
+                else:
+                    mask = np.zeros(len(cat), bool)
+                if op == "difference":
+                    mask = ~mask
+                out = []
+                off = 0
+                for r in rows:
+                    n = len(r)
+                    out.append(cat[off : off + n][mask[off : off + n]])
+                    off += n
+                return out
             return [_np_op(op, r, b) for r in rows]
         if (
             op in ("intersect", "difference")
@@ -298,6 +321,8 @@ class SetOpDispatcher:
         if (
             not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL
         ) or not self._device_ready():
+            if op == "union" and len(parts) > 4:
+                return np.unique(np.concatenate(parts))
             out = parts[0]
             for p in parts[1:]:
                 out = _np_op(op, out, p)

@@ -779,6 +779,10 @@ def _merge_rows(rows: List[np.ndarray]) -> np.ndarray:
     nonempty = [r for r in rows if len(r)]
     if not nonempty:
         return EMPTY
+    if len(nonempty) > 64:
+        # many tiny rows: one concat+unique beats the k-way merge's
+        # per-list marshaling
+        return np.unique(np.concatenate(nonempty)).astype(np.uint64)
     from dgraph_tpu import native
 
     return native.merge_sorted(nonempty).astype(np.uint64)
