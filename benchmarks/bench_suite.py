@@ -12,6 +12,14 @@ Prints one JSON object with all results (bench.py stays the single-line
 driver contract; this is the detailed harness).
 """
 
+import sys as _sys
+
+_sys.path.insert(0, "/root/repo") if "/root/repo" not in _sys.path else None
+from dgraph_tpu.devsetup import maybe_force_cpu
+
+maybe_force_cpu()  # JAX_PLATFORMS=cpu must also unregister the axon plugin
+
+
 import argparse
 import json
 import sys

@@ -18,6 +18,13 @@ Usage: python benchmarks/scale_suite.py [--edges 1000000] [--json out]
 
 from __future__ import annotations
 
+import sys as _sys
+
+_sys.path.insert(0, "/root/repo") if "/root/repo" not in _sys.path else None
+from dgraph_tpu.devsetup import maybe_force_cpu
+
+maybe_force_cpu()  # JAX_PLATFORMS=cpu must also unregister the axon plugin
+
 import argparse
 import json
 import sys

@@ -1,0 +1,50 @@
+"""Backend setup helpers for scripts and benchmarks.
+
+The environment's sitecustomize registers a remote-TPU ("axon") PJRT
+backend in every python process. When that tunnel is down, ANY jax
+backend initialization can hang — even with JAX_PLATFORMS=cpu, because
+enumeration still initializes registered plugins. The reliable
+neutralization (same as tests/conftest.py) is to unregister the factory
+before the first backend init.
+
+Call `force_cpu()` at the top of a script that must run on the host, or
+set DGRAPH_TPU_FORCE_CPU=1 (honored by the benchmarks and by bench.py's
+fallback path).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(device_count: int = 1) -> None:
+    """Unregister the axon backend and pin jax to the CPU platform.
+    Must run before any jax backend is initialized."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if device_count > 1:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+        flags = (
+            flags + f" --xla_force_host_platform_device_count={device_count}"
+        ).strip()
+        os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+
+
+def maybe_force_cpu() -> None:
+    """Honor DGRAPH_TPU_FORCE_CPU=1 or JAX_PLATFORMS=cpu."""
+    if (
+        os.environ.get("DGRAPH_TPU_FORCE_CPU") == "1"
+        or os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    ):
+        force_cpu()
