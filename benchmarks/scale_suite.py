@@ -31,13 +31,21 @@ import sys
 import time
 
 
-def load(edges: int):
+def load(edges: int, storage: str = "mem", data_dir=None):
     from benchmarks.movie_corpus import SCHEMA, generate
     from dgraph_tpu.api.server import Server
     from dgraph_tpu.loaders.bulk import BulkLoader
 
     corpus, rdf = generate(edges)
-    s = Server()
+    if storage == "lsm":
+        import os as _os
+        import tempfile
+
+        _os.environ["DGRAPH_TPU_STORAGE"] = "lsm"
+        data_dir = data_dir or tempfile.mkdtemp(prefix="dgraph_scale_lsm_")
+        s = Server(data_dir=data_dir)
+    else:
+        s = Server()
     s.alter(SCHEMA)
     loader = BulkLoader(s)
     t0 = time.time()
@@ -194,12 +202,14 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--edges", type=int, default=1_000_000)
     ap.add_argument("--json", default=None)
+    ap.add_argument("--storage", choices=("mem", "lsm"), default="mem")
     args = ap.parse_args()
 
-    corpus, server, load_s = load(args.edges)
+    corpus, server, load_s = load(args.edges, storage=args.storage)
     res = run_suite(corpus, server)
     out = {
         "edges": corpus.n_edges,
+        "storage": args.storage,
         "load_seconds": round(load_s, 2),
         "load_edges_per_sec": int(corpus.n_edges / load_s),
         "queries": res,

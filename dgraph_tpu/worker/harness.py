@@ -48,18 +48,57 @@ class ProcCluster:
         replicas: int = 3,
         data_dir: Optional[str] = None,
         compact_every: int = 0,
+        replicated_zero: bool = False,
+        zero_replicas: int = 3,
     ):
-        self.zero = ZeroService(n_groups)
+        self.pool = RpcPool(heartbeat_s=0.5, timeout=5.0).start_heartbeats()
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self._cfgs: Dict[int, dict] = {}
+        self.data_dir = data_dir
+        zero_impl = None
+        if replicated_zero:
+            from dgraph_tpu.zero.remote import RemoteZero
+
+            zids = list(range(901, 901 + zero_replicas))
+            zraft = _free_ports(zero_replicas)
+            zrpc = _free_ports(zero_replicas)
+            raft_addrs = {
+                str(i): ["127.0.0.1", p] for i, p in zip(zids, zraft)
+            }
+            zaddrs = []
+            for i, rp in zip(zids, zrpc):
+                cfg = {
+                    "node_id": i,
+                    "replica_ids": zids,
+                    "raft_addrs": raft_addrs,
+                    "rpc_addr": ["127.0.0.1", rp],
+                    "n_groups": n_groups,
+                    "data_dir": (
+                        os.path.join(data_dir, "zero") if data_dir else None
+                    ),
+                    "_module": "dgraph_tpu.zero.zero_process",
+                }
+                self._cfgs[i] = cfg
+                zaddrs.append(("127.0.0.1", rp))
+                self._spawn(i)
+            zero_impl = RemoteZero(zaddrs, self.pool)
+            # wait for the zero quorum's leader
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    zero_impl._exec("lease_ts", 1, timeout=2.0)
+                    break
+                except TimeoutError:
+                    time.sleep(0.2)
+            else:
+                raise TimeoutError("zero quorum never elected a leader")
+        self.zero = ZeroService(n_groups, zero=zero_impl)
         self.schema = State()
         from dgraph_tpu.posting.memlayer import MemoryLayer
 
         self.mem = MemoryLayer()
         self.vector_indexes: Dict[str, object] = {}
-        self.data_dir = data_dir
-        self.pool = RpcPool(heartbeat_s=0.5, timeout=5.0).start_heartbeats()
         self.remote_groups: Dict[int, RemoteGroup] = {}
-        self.procs: Dict[int, subprocess.Popen] = {}
-        self._cfgs: Dict[int, dict] = {}
         self._commit_lock = threading.Lock()
         self.intents: Optional[IntentLog] = None
         if data_dir is not None:
@@ -104,6 +143,7 @@ class ProcCluster:
 
     def _spawn(self, node_id: int):
         cfg = self._cfgs[node_id]
+        module = cfg.get("_module", "dgraph_tpu.worker.alpha_process")
         cfg_dir = self.data_dir or "/tmp/dgraph_tpu_proc"
         os.makedirs(cfg_dir, exist_ok=True)
         path = os.path.join(cfg_dir, f"alpha_{node_id}.json")
@@ -118,7 +158,7 @@ class ProcCluster:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         log = open(os.path.join(cfg_dir, f"alpha_{node_id}.log"), "ab")
         self.procs[node_id] = subprocess.Popen(
-            [sys.executable, "-m", "dgraph_tpu.worker.alpha_process", path],
+            [sys.executable, "-m", module, path],
             env=env,
             stdout=log,
             stderr=log,

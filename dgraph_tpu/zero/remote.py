@@ -1,0 +1,170 @@
+"""Coordinator-side client for an OS-process Zero quorum.
+
+Same ZeroLite-compatible face as zero/replicated.ReplicatedZero, but the
+quorum members are zero_process.py servers reached over conn/rpc —
+leases, commit verdicts and tablet decisions are zero.exec RPCs routed to
+the Zero leader with not-leader retry (ref the alphas' Zero gRPC client,
+worker/zero.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from dgraph_tpu.conn.rpc import RpcError, RpcPool
+from dgraph_tpu.zero.zero import TxnConflictError
+
+
+class RemoteZero:
+    TS_BLOCK = 128
+
+    def __init__(self, rpc_addrs: List[Tuple[str, int]], pool: RpcPool):
+        self.addrs = [tuple(a) for a in rpc_addrs]
+        self.pool = pool
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._ts_next = 0
+        self._ts_end = -1
+        self._floor = 0
+        self._active: Set[int] = set()
+        self._pending: Set[int] = set()
+        self._leader: Optional[Tuple[str, int]] = None
+
+    # -- rpc plumbing --------------------------------------------------------
+
+    def _exec(self, kind: str, *args, timeout: float = 15.0):
+        deadline = time.time() + timeout
+        last = "no zero leader"
+        while time.time() < deadline:
+            order = (
+                [self._leader] + [a for a in self.addrs if a != self._leader]
+                if self._leader
+                else list(self.addrs)
+            )
+            for addr in order:
+                try:
+                    out = self.pool.call(
+                        addr,
+                        "zero.exec",
+                        {"kind": kind, "args": list(args), "timeout": 5.0},
+                        timeout=8.0,
+                    )
+                except RpcError as e:
+                    last = str(e)
+                    continue
+                if out.get("ok"):
+                    self._leader = addr
+                    return out["result"]
+                last = f"{addr}: {out}"
+            time.sleep(0.05)
+        raise TimeoutError(f"zero.exec {kind} failed: {last}")
+
+    # -- ZeroLite face -------------------------------------------------------
+
+    def next_ts(self, count: int = 1) -> int:
+        with self._lock:
+            if (
+                count == 1
+                and self._ts_next <= self._ts_end
+                and self._ts_next > self._floor
+            ):
+                ts = self._ts_next
+                self._ts_next += 1
+                return ts
+        if count == 1:
+            first = self._exec("lease_ts", self.TS_BLOCK)
+            with self._lock:
+                self._ts_next = first + 1
+                self._ts_end = first + self.TS_BLOCK - 1
+                return first
+        return self._exec("lease_ts", count)
+
+    def begin_txn(self) -> int:
+        ts = self.next_ts()
+        with self._lock:
+            self._active.add(ts)
+        return ts
+
+    def read_ts(self) -> int:
+        ts = self.next_ts()
+        with self._cv:
+            deadline = 30.0
+            while self._pending and min(self._pending) < ts and deadline > 0:
+                t0 = time.monotonic()
+                self._cv.wait(timeout=min(1.0, deadline))
+                deadline -= time.monotonic() - t0
+        return ts
+
+    def assign_uids(self, count: int) -> int:
+        return self._exec("lease_uid", count)
+
+    @property
+    def max_assigned(self) -> int:
+        for addr in self.addrs:
+            try:
+                return int(self.pool.call(addr, "zero.state", timeout=2.0)["max_ts"])
+            except RpcError:
+                continue
+        return 0
+
+    @property
+    def _max_uid(self) -> int:
+        for addr in self.addrs:
+            try:
+                return int(
+                    self.pool.call(addr, "zero.state", timeout=2.0)["max_uid"]
+                )
+            except RpcError:
+                continue
+        return 1
+
+    def commit(self, start_ts: int, conflict_keys, track: bool = False) -> int:
+        verdict = self._exec("commit", start_ts, sorted(conflict_keys))
+        with self._lock:
+            self._active.discard(start_ts)
+        if verdict[0] == "abort":
+            with self._lock:
+                self._floor = max(self._floor, int(verdict[1]))
+            raise TxnConflictError(
+                f"conflict (committed at {verdict[1]} > start {start_ts})"
+            )
+        commit_ts = int(verdict[1])
+        with self._lock:
+            self._floor = max(self._floor, commit_ts)
+            if track:
+                self._pending.add(commit_ts)
+        return commit_ts
+
+    def applied(self, commit_ts: int):
+        with self._cv:
+            self._pending.discard(commit_ts)
+            self._cv.notify_all()
+
+    def abort(self, start_ts: int):
+        with self._lock:
+            self._active.discard(start_ts)
+        try:
+            self._exec("abort", start_ts, timeout=3.0)
+        except TimeoutError:
+            pass
+
+    # -- tablet ops ----------------------------------------------------------
+
+    def should_serve(self, pred: str) -> int:
+        return int(self._exec("tablet", pred))
+
+    def move_tablet(self, pred: str, gid: int):
+        self._exec("move_tablet", pred, int(gid))
+
+    @property
+    def tablets(self) -> Dict[str, int]:
+        for addr in self.addrs:
+            try:
+                return dict(
+                    self.pool.call(addr, "zero.state", timeout=2.0)["tablets"]
+                )
+            except RpcError:
+                continue
+        return {}

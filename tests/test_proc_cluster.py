@@ -146,3 +146,35 @@ def test_proc_cluster_durable_restart(tmp_path):
         assert out["data"]["q"][0]["name"] == "zoe"
     finally:
         c.close()
+
+
+def test_proc_cluster_with_zero_quorum_processes(tmp_path):
+    """Full cross-process topology: alphas AND the Zero quorum as OS
+    processes (ref dgraph/cmd/zero run.go); leases/commits/tablets via
+    zero.exec RPC; zero-leader kill tolerated."""
+    c = ProcCluster(
+        n_groups=1, replicas=3, replicated_zero=True, zero_replicas=3
+    )
+    try:
+        c.alter("name: string @index(exact) .")
+        t = c.new_txn()
+        t.mutate_rdf(set_rdf='<0x1> <name> "zq-alice" .', commit_now=True)
+        out = c.query('{ q(func: eq(name, "zq-alice")) { name } }')
+        assert out["data"]["q"][0]["name"] == "zq-alice"
+        # tablets decided by the zero quorum
+        assert c.zero.belongs_to("name") == 1
+        # kill the zero leader process: remaining two re-elect
+        lead_addr = c.zero.zero._leader
+        victim = next(
+            nid
+            for nid, cfg in c._cfgs.items()
+            if cfg.get("_module", "").endswith("zero_process")
+            and tuple(cfg["rpc_addr"]) == tuple(lead_addr)
+        )
+        c.kill(victim)
+        t2 = c.new_txn()
+        t2.mutate_rdf(set_rdf='<0x2> <name> "zq-bob" .', commit_now=True)
+        out = c.query('{ q(func: eq(name, "zq-bob")) { name } }')
+        assert out["data"]["q"][0]["name"] == "zq-bob"
+    finally:
+        c.close()
