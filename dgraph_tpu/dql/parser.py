@@ -682,10 +682,15 @@ def parse_child(p: _P) -> GraphQuery:
 
     if name in ("min", "max", "sum", "avg"):
         p.expect("(")
-        p.expect("val")
-        p.expect("(")
-        gq.val_var = p.next().text
-        p.expect(")")
+        if p.peek().text == "val":
+            p.next()
+            p.expect("(")
+            gq.val_var = p.next().text
+            p.expect(")")
+        else:
+            # min(age): aggregate a predicate directly (@groupby children,
+            # ref query/groupby.go aggregates)
+            gq.attr = _strip_angle(p.next().text)
         p.expect(")")
         gq.aggregator = name
         return gq

@@ -368,11 +368,61 @@ class Executor:
                 k = tuple(key_parts)
                 b = buckets.get(k)
                 if b is None:
-                    buckets[k] = b = {**disp, "count": 0}
+                    buckets[k] = b = {**disp, "count": 0, "__members__": []}
                 b["count"] += 1
-            cnode.groups[int(pu)] = [
+                b["__members__"].append(int(cu))
+            # per-bucket aggregations over predicates: min/max/sum/avg(age)
+            # (ref query/groupby.go aggregateGroup)
+            aggs = [
+                c
+                for c in cgq.children
+                if c.aggregator and c.attr and not c.val_var
+            ]
+            for b in buckets.values():
+                members = b.pop("__members__")
+                for agg in aggs:
+                    vals = []
+                    for cu in members:
+                        v = self.cache.value(
+                            keys.DataKey(agg.attr, cu, self.ns)
+                        )
+                        if v is not None and isinstance(
+                            v.value, (int, float)
+                        ) and not isinstance(v.value, bool):
+                            vals.append(v.value)
+                    key_name = agg.alias or f"{agg.aggregator}({agg.attr})"
+                    if not vals:
+                        b[key_name] = None
+                    elif agg.aggregator == "min":
+                        b[key_name] = min(vals)
+                    elif agg.aggregator == "max":
+                        b[key_name] = max(vals)
+                    elif agg.aggregator == "sum":
+                        b[key_name] = sum(vals)
+                    else:
+                        b[key_name] = sum(vals) / len(vals)
+            ordered = [
                 buckets[k] for k in sorted(buckets, key=lambda t: str(t))
             ]
+            cnode.groups[int(pu)] = ordered
+            # `x as count(uid)` inside a single-uid-pred @groupby binds a
+            # val var keyed by the group's target uid (the groupby-var
+            # pattern, ref groupby.go + query.go var bindings)
+            if len(cgq.groupby_attrs) == 1:
+                ga = cgq.groupby_attrs[0]
+                su = self.st.get(ga)
+                if su is not None and su.value_type == TypeID.UID:
+                    for c in cgq.children:
+                        if c.var_name and c.is_count and c.attr == "uid":
+                            vals = self.val_vars.setdefault(c.var_name, {})
+                            for k, b in buckets.items():
+                                if k[0] is not None:
+                                    from dgraph_tpu.types.types import (
+                                        TypeID as _T,
+                                        Val as _V,
+                                    )
+
+                                    vals[int(k[0])] = _V(_T.INT, b["count"])
 
     def _apply_edge_facets(self, cnode: ExecNode, cgq, parent, reverse: bool):
         """Edge-facet filtering / ordering / projection for uid predicates

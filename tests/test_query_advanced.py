@@ -421,3 +421,58 @@ def test_geo_contains_and_intersects():
         "{ q(func: intersects(area, [[[80.0,80.0],[85.0,80.0],[85.0,85.0],[80.0,85.0],[80.0,80.0]]])) { name } }"
     )
     assert out["data"]["q"] == []
+
+
+def test_groupby_aggregations_and_var():
+    """@groupby with min/max/avg aggregates + the groupby-var pattern
+    (x as count(uid) keyed by the grouped uid; ref query/groupby.go)."""
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter(
+        "name: string @index(exact) .\nage: int .\nlives_in: uid .\n"
+        "follows: [uid] ."
+    )
+    t = s.new_txn()
+    rdf = ['<0x100> <name> "cityA" .', '<0x101> <name> "cityB" .']
+    ages = {1: 20, 2: 30, 3: 40, 4: 50}
+    city = {1: 0x100, 2: 0x100, 3: 0x101, 4: 0x101}
+    rdf.append('<0x10> <name> "root" .')
+    for u, a in ages.items():
+        rdf.append(f'<0x{u:x}> <age> "{a}"^^<xs:int> .')
+        rdf.append(f"<0x{u:x}> <lives_in> <0x{city[u]:x}> .")
+        rdf.append(f"<0x10> <follows> <0x{u:x}> .")
+    t.mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+
+    out = s.query(
+        """{
+          q(func: eq(name, "root")) {
+            follows @groupby(lives_in) {
+              count(uid)
+              min(age)
+              m: max(age)
+              avg(age)
+            }
+          }
+        }"""
+    )
+    groups = out["data"]["q"][0]["follows"][0]["@groupby"]
+    by_city = {g["lives_in"]: g for g in groups}
+    a = by_city["0x100"]
+    assert a["count"] == 2 and a["min(age)"] == 20 and a["m"] == 30
+    assert a["avg(age)"] == 25.0
+    b = by_city["0x101"]
+    assert b["count"] == 2 and b["min(age)"] == 40
+
+    # groupby-var: per-city follower counts usable in a later block
+    out = s.query(
+        """{
+          var(func: eq(name, "root")) {
+            follows @groupby(lives_in) { c as count(uid) }
+          }
+          cities(func: uid(c), orderdesc: val(c)) { name total: val(c) }
+        }"""
+    )
+    cities = out["data"]["cities"]
+    assert {x["name"] for x in cities} == {"cityA", "cityB"}
+    assert all(x["total"] == 2 for x in cities)
