@@ -123,7 +123,7 @@ class _Handler(BaseHTTPRequestHandler):
         # admin/DDL routes are guardian-only once ACL is enabled
         # (ref edgraph alter/admin guardian checks)
         _GUARDED = (
-            "/alter", "/admin/export", "/admin/backup",
+            "/alter", "/admin", "/admin/export", "/admin/backup",
             "/admin/schema/graphql", "/admin/draining", "/admin/shutdown",
             "/admin/task",
             # GraphQL resolvers run inside the engine without per-predicate
@@ -241,6 +241,16 @@ class _Handler(BaseHTTPRequestHandler):
                         body.get("query", ""),
                         body.get("variables"),
                         jwt_token=token,
+                    )
+                )
+            elif path == "/admin":
+                # the admin GraphQL schema (ref graphql/admin/admin.go)
+                from dgraph_tpu.graphql.admin import AdminGraphQL
+
+                body = json.loads(self._body().decode("utf-8"))
+                self._reply(
+                    AdminGraphQL(self.engine).execute(
+                        body.get("query", ""), body.get("variables")
                     )
                 )
             elif path == "/admin/schema/graphql":

@@ -30,9 +30,13 @@ from dgraph_tpu.x import keys
 class _Edges:
     """Neighbor + per-edge-cost reader over the path predicates."""
 
-    def __init__(self, cache, st, preds, weight_facets, ns):
+    def __init__(self, cache, st, preds, weight_facets, ns, node_filter=None):
         self.cache = cache
         self.ns = ns
+        # node_filter(uids ndarray) -> surviving uids; applied to every
+        # expansion frontier (ref shortest.go applying the block @filter
+        # to intermediate nodes)
+        self.node_filter = node_filter
         self.upreds: List[Tuple[str, Optional[str]]] = []
         for i, p in enumerate(preds):
             su = st.get(p.lstrip("~"))
@@ -56,6 +60,10 @@ class _Edges:
             vs = self.cache.uids(key)
             if not len(vs):
                 continue
+            if self.node_filter is not None:
+                vs = self.node_filter(vs)
+                if not len(vs):
+                    continue
             fmap = self.cache.edge_facets(key) if wf else {}
             for v in vs:
                 v = int(v)
@@ -79,7 +87,10 @@ class _Edges:
                 outs.append(o)
         if not outs:
             return np.zeros((0,), np.uint64)
-        return np.unique(np.concatenate(outs))
+        out = np.unique(np.concatenate(outs))
+        if self.node_filter is not None:
+            out = self.node_filter(out)
+        return out
 
 
 def k_shortest_paths(
@@ -94,12 +105,14 @@ def k_shortest_paths(
     weight_facets: Optional[List[Optional[str]]] = None,
     min_weight: Optional[float] = None,
     max_weight: Optional[float] = None,
+    node_filter=None,
 ) -> List[Tuple[List[int], float]]:
     """Returns up to num_paths (uid-path, total_cost) pairs, cheapest first.
 
     weight_facets[i] names the facet carrying pred[i]'s edge cost (None =
-    unit cost, matching the reference's default)."""
-    edges = _Edges(cache, st, preds, weight_facets, ns)
+    unit cost, matching the reference's default). node_filter prunes
+    intermediate nodes (the block @filter)."""
+    edges = _Edges(cache, st, preds, weight_facets, ns, node_filter=node_filter)
     if not edges.upreds:
         return []
     if src == dst:

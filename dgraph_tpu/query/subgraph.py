@@ -792,6 +792,21 @@ class Executor:
         wfacets = [
             (c.facet_names[0] if c.facet_names else None) for c in gq.children
         ]
+        # the first child filter prunes intermediate nodes (except the
+        # destination, which always completes a path — ref shortest.go)
+        nf = None
+        child_filters = [c.filter for c in gq.children if c.filter is not None]
+        if child_filters:
+            ftree = child_filters[0]
+
+            def nf(uids, _f=ftree, _dst=dst):
+                kept = self.eval_filter(_f, uids)
+                if _dst in uids and _dst not in kept:
+                    kept = np.sort(
+                        np.append(kept, np.uint64(_dst))
+                    ).astype(np.uint64)
+                return kept
+
         routes = k_shortest_paths(
             self.cache,
             self.st,
@@ -804,6 +819,7 @@ class Executor:
             weight_facets=wfacets,
             min_weight=gq.min_weight,
             max_weight=gq.max_weight,
+            node_filter=nf,
         )
         node = ExecNode(gq=gq, attr="_path_")
         node.dest_uids = _as_uids(routes[0][0]) if routes else EMPTY

@@ -142,3 +142,30 @@ def test_graphql_endpoint(http):
         ctype="application/json",
     )
     assert out["data"]["queryCity"] == [{"name": "Oslo"}]
+
+
+def test_admin_graphql_endpoint(http):
+    """/admin serves the ops GraphQL schema (ref graphql/admin/admin.go)."""
+    import json as _json
+
+    def admin(q, variables=None):
+        return _post(
+            http, "/admin", _json.dumps({"query": q}),
+            ctype="application/json",
+        )
+
+    out = admin("{ health { instance status uptime } }")
+    assert out["data"]["health"][0]["status"] == "healthy"
+    out = admin("{ state }")
+    assert out["data"]["state"]["counter"] >= 0
+    out = admin('mutation { draining(enable: true) { response { code } } }')
+    assert out["data"]["draining"]["response"]["code"] == "Success"
+    out = admin('mutation { draining(enable: false) { response { code } } }')
+    assert out["data"]["draining"]["response"]["code"] == "Success"
+    out = admin(
+        'mutation { updateGQLSchema(input: {set: {schema: "type T { id: ID! n: String }"}}) '
+        "{ gqlSchema { schema } }"
+    )
+    assert "type T" in out["data"]["updateGQLSchema"]["gqlSchema"]["schema"]
+    out = admin("{ getGQLSchema { schema } }")
+    assert "type T" in out["data"]["getGQLSchema"]["schema"]

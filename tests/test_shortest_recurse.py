@@ -133,3 +133,21 @@ def test_recurse_expands_all_uid_preds(server):
     follow_child = q["follow"][0]
     assert follow_child["name"] == "n3"
     assert follow_child["rail"][0]["name"] == "n5"
+
+
+def test_shortest_with_node_filter(server):
+    """The path predicate's @filter prunes intermediate nodes
+    (ref shortest.go intermediate filtering); the destination always
+    completes a path."""
+    # block B (0x2): the only cheap route A->B->D is cut off by the
+    # filter, so the path must go A->C->D (cost 6) or direct (10)
+    out = server.query(
+        """{
+          shortest(from: 0x1, to: 0x4) {
+            connects @filter(NOT uid(0x2)) @facets(w)
+          }
+        }"""
+    )
+    paths = out["data"]["_path_"]
+    assert [p["uid"] for p in paths[0]["_path_"]] == ["0x1", "0x3", "0x4"]
+    assert paths[0]["_weight_"] == 6.0
