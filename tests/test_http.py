@@ -164,8 +164,9 @@ def test_admin_graphql_endpoint(http):
     assert out["data"]["draining"]["response"]["code"] == "Success"
     out = admin(
         'mutation { updateGQLSchema(input: {set: {schema: "type T { id: ID! n: String }"}}) '
-        "{ gqlSchema { schema } }"
+        "{ gqlSchema { schema } } }"
     )
+    assert not out.get("errors"), out["errors"]
     assert "type T" in out["data"]["updateGQLSchema"]["gqlSchema"]["schema"]
     out = admin("{ getGQLSchema { schema } }")
     assert "type T" in out["data"]["getGQLSchema"]["schema"]
