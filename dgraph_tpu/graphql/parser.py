@@ -68,9 +68,13 @@ class _P:
         self.i = 0
 
     def peek(self):
+        if self.i >= len(self.toks):
+            raise GqlParseError("unexpected end of query")
         return self.toks[self.i]
 
     def next(self):
+        if self.i >= len(self.toks):
+            raise GqlParseError("unexpected end of query")
         t = self.toks[self.i]
         self.i += 1
         return t
