@@ -71,6 +71,18 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         u64p, ctypes.POINTER(i64), i64, u64p, u64p
     ]
     lib.merge_sorted_u64.restype = i64
+    i64p = ctypes.POINTER(i64)
+    lib.sst_seek.argtypes = [u8p, i64, i64, u8p, i64]
+    lib.sst_seek.restype = i64
+    lib.sst_versions.argtypes = [
+        u8p, i64, i64, u8p, i64, i64, u64p, u64p, i64p, i64p
+    ]
+    lib.sst_versions.restype = i64
+    lib.sst_scan.argtypes = [
+        u8p, i64, i64, u8p, i64, i64,
+        i64p, i64p, u64p, u64p, i64p, i64p, i64p,
+    ]
+    lib.sst_scan.restype = i64
     return lib
 
 
@@ -179,3 +191,71 @@ def merge_sorted(lists) -> np.ndarray:
         _ptr(scratch, ctypes.c_uint64),
     )
     return out[:n]
+
+
+def sst_available() -> bool:
+    return _LIB is not None
+
+
+def sst_seek(buf: np.ndarray, end: int, off: int, key: bytes) -> int:
+    kb = np.frombuffer(key, dtype=np.uint8)
+    return int(
+        _LIB.sst_seek(
+            _ptr(buf, ctypes.c_uint8), end, off,
+            _ptr(kb, ctypes.c_uint8), len(key),
+        )
+    )
+
+
+def sst_versions(buf: np.ndarray, end: int, off: int, key: bytes, cap: int = 64):
+    """(tss, seqs, val_offs, val_lens) arrays for entries == key."""
+    kb = np.frombuffer(key, dtype=np.uint8)
+    while True:
+        tss = np.empty(cap, np.uint64)
+        seqs = np.empty(cap, np.uint64)
+        voffs = np.empty(cap, np.int64)
+        vlens = np.empty(cap, np.int64)
+        n = int(
+            _LIB.sst_versions(
+                _ptr(buf, ctypes.c_uint8), end, off,
+                _ptr(kb, ctypes.c_uint8), len(key), cap,
+                _ptr(tss, ctypes.c_uint64), _ptr(seqs, ctypes.c_uint64),
+                _ptr(voffs, ctypes.c_int64), _ptr(vlens, ctypes.c_int64),
+            )
+        )
+        if n < cap:
+            return tss[:n], seqs[:n], voffs[:n], vlens[:n]
+        cap *= 4
+
+
+def sst_scan(buf: np.ndarray, end: int, off: int, prefix: bytes, batch: int = 1024):
+    """Yield (key_off, key_len, ts, seq, val_off, val_len) per entry while
+    keys match `prefix`, scanning from `off`."""
+    pb = np.frombuffer(prefix, dtype=np.uint8) if prefix else np.zeros(1, np.uint8)
+    pos = off
+    nxt = np.zeros(1, np.int64)
+    while pos < end:
+        koffs = np.empty(batch, np.int64)
+        klens = np.empty(batch, np.int64)
+        tss = np.empty(batch, np.uint64)
+        seqs = np.empty(batch, np.uint64)
+        voffs = np.empty(batch, np.int64)
+        vlens = np.empty(batch, np.int64)
+        n = int(
+            _LIB.sst_scan(
+                _ptr(buf, ctypes.c_uint8), end, pos,
+                _ptr(pb, ctypes.c_uint8), len(prefix), batch,
+                _ptr(koffs, ctypes.c_int64), _ptr(klens, ctypes.c_int64),
+                _ptr(tss, ctypes.c_uint64), _ptr(seqs, ctypes.c_uint64),
+                _ptr(voffs, ctypes.c_int64), _ptr(vlens, ctypes.c_int64),
+                _ptr(nxt, ctypes.c_int64),
+            )
+        )
+        for i in range(n):
+            yield (
+                int(koffs[i]), int(klens[i]), int(tss[i]), int(seqs[i]),
+                int(voffs[i]), int(vlens[i]),
+            )
+        if n < batch:
+            break
+        pos = int(nxt[0])

@@ -139,3 +139,99 @@ int64_t merge_sorted_u64(const uint64_t* flat, const int64_t* lens,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// SSTable entry scans (storage/lsm.py plaintext format):
+//   [u32 klen][u64 ts][u64 seq][u32 vlen][key bytes][val bytes]
+// The Python per-entry struct unpacking dominated LSM reads; these scan
+// in native code and hand back offsets for zero-copy value slicing.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+static inline int64_t ent_read(const uint8_t* buf, int64_t pos,
+                               uint32_t* klen, uint64_t* ts, uint64_t* seq,
+                               uint32_t* vlen) {
+    memcpy(klen, buf + pos, 4);
+    memcpy(ts, buf + pos + 4, 8);
+    memcpy(seq, buf + pos + 12, 8);
+    memcpy(vlen, buf + pos + 20, 4);
+    return pos + 24;
+}
+
+static inline int keycmp(const uint8_t* a, int64_t na, const uint8_t* b,
+                         int64_t nb) {
+    int64_t n = na < nb ? na : nb;
+    int c = memcmp(a, b, (size_t)n);
+    if (c != 0) return c;
+    return na < nb ? -1 : (na > nb ? 1 : 0);
+}
+
+// First entry offset with entry_key >= key, scanning from `off`.
+int64_t sst_seek(const uint8_t* buf, int64_t end, int64_t off,
+                 const uint8_t* key, int64_t klen) {
+    int64_t pos = off;
+    while (pos + 24 <= end) {
+        uint32_t kl, vl; uint64_t ts, seq;
+        int64_t body = ent_read(buf, pos, &kl, &ts, &seq, &vl);
+        if (keycmp(buf + body, kl, key, klen) >= 0) return pos;
+        pos = body + kl + vl;
+    }
+    return end;
+}
+
+// Versions of exactly `key` from `off` (which must be at/before the first
+// match): writes (ts, seq, val_off, val_len) per version; returns count.
+int64_t sst_versions(const uint8_t* buf, int64_t end, int64_t off,
+                     const uint8_t* key, int64_t klen, int64_t max_out,
+                     uint64_t* tss, uint64_t* seqs, int64_t* val_offs,
+                     int64_t* val_lens) {
+    int64_t pos = sst_seek(buf, end, off, key, klen);
+    int64_t n = 0;
+    while (pos + 24 <= end && n < max_out) {
+        uint32_t kl, vl; uint64_t ts, seq;
+        int64_t body = ent_read(buf, pos, &kl, &ts, &seq, &vl);
+        if (keycmp(buf + body, kl, key, klen) != 0) break;
+        tss[n] = ts;
+        seqs[n] = seq;
+        val_offs[n] = body + kl;
+        val_lens[n] = vl;
+        n++;
+        pos = body + kl + vl;
+    }
+    return n;
+}
+
+// Entry headers from `off` while keys start with `prefix` (or all when
+// prefix_len == 0): writes (key_off, key_len, ts, seq, val_off, val_len);
+// returns count (callers loop with growing max_out).
+int64_t sst_scan(const uint8_t* buf, int64_t end, int64_t off,
+                 const uint8_t* prefix, int64_t prefix_len, int64_t max_out,
+                 int64_t* key_offs, int64_t* key_lens, uint64_t* tss,
+                 uint64_t* seqs, int64_t* val_offs, int64_t* val_lens,
+                 int64_t* next_pos) {
+    int64_t pos = off;
+    int64_t n = 0;
+    while (pos + 24 <= end && n < max_out) {
+        uint32_t kl, vl; uint64_t ts, seq;
+        int64_t body = ent_read(buf, pos, &kl, &ts, &seq, &vl);
+        if (prefix_len > 0) {
+            if ((int64_t)kl < prefix_len ||
+                memcmp(buf + body, prefix, (size_t)prefix_len) != 0) {
+                break;
+            }
+        }
+        key_offs[n] = body;
+        key_lens[n] = kl;
+        tss[n] = ts;
+        seqs[n] = seq;
+        val_offs[n] = body + kl;
+        val_lens[n] = vl;
+        n++;
+        pos = body + kl + vl;
+    }
+    *next_pos = pos;
+    return n;
+}
+
+}  // extern "C"

@@ -74,6 +74,15 @@ class _SSTable:
         self.enc_key = enc_key
         self._f = open(path, "rb")
         self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        # native scan fast path (plaintext tables only)
+        from dgraph_tpu import native as _native
+
+        self._native = enc_key is None and _native.sst_available()
+        self._buf = (
+            __import__("numpy").frombuffer(self._mm, dtype="uint8")
+            if self._native
+            else None
+        )
         # footer: [index_off u64][n_entries u64]
         idx_off, self.n = struct.unpack("<QQ", self._mm[-16:])
         self._index: List[Tuple[bytes, int]] = []  # (key, file_offset)
@@ -166,6 +175,17 @@ class _SSTable:
 
     def versions_of(self, key: bytes) -> List[Tuple[int, int, bytes]]:
         """(ts, seq, val) ascending ts for one key."""
+        if self._native:
+            from dgraph_tpu import native as _native
+
+            start = self._index_start(key)
+            tss, seqs, voffs, vlens = _native.sst_versions(
+                self._buf, self._end(), start, key
+            )
+            return [
+                (int(t), int(q), self._mm[vo : vo + vl])
+                for t, q, vo, vl in zip(tss, seqs, voffs, vlens)
+            ]
         out = []
         pos = self._seek(key)
         end = self._end()
@@ -176,8 +196,29 @@ class _SSTable:
             out.append((ts, seq, val))
         return out
 
+    def _index_start(self, key: bytes) -> int:
+        i = bisect.bisect_right(self._index, (key, -1)) - 1
+        if i >= 0:
+            return self._index[i][1]
+        return self._index[0][1] if self._index else 0
+
     def scan(self, prefix: bytes = b""):
         """Yield (key, ts, seq, val) ascending from the first prefixed key."""
+        if self._native:
+            from dgraph_tpu import native as _native
+
+            start = self._index_start(prefix) if prefix else 0
+            if prefix:
+                start = _native.sst_seek(
+                    self._buf, self._end(), start, prefix
+                )
+            for ko, kl, ts, seq, vo, vl in _native.sst_scan(
+                self._buf, self._end(), start, prefix
+            ):
+                yield (
+                    self._mm[ko : ko + kl], ts, seq, self._mm[vo : vo + vl]
+                )
+            return
         pos = self._seek(prefix) if prefix else 0
         end = self._end()
         while pos < end:
@@ -187,6 +228,7 @@ class _SSTable:
             yield k, ts, seq, val
 
     def close(self):
+        self._buf = None  # release the numpy buffer export before close
         self._mm.close()
         self._f.close()
 
