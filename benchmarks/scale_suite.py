@@ -51,6 +51,8 @@ def load(edges: int, storage: str = "mem", data_dir=None):
     t0 = time.time()
     loader.add_rdf("\n".join(rdf))
     loader.finish()
+    if hasattr(s.kv, "compact"):
+        s.kv.compact()  # flatten tables post-bulk (badger Flatten)
     load_s = time.time() - t0
     return corpus, s, load_s
 

@@ -103,6 +103,9 @@ class VectorIndex:
     # -- device state ---------------------------------------------------------
 
     def _sync_device(self):
+        import os as _os
+
+        import jax
         import jax.numpy as jnp
 
         if not self._dirty and self._device is not None:
@@ -115,6 +118,41 @@ class VectorIndex:
         uids[: self._n] = np.asarray(self._uids, np.uint64)
         valid = np.zeros((cap,), bool)
         valid[: self._n] = True
+        self._mesh = None
+        shard = _os.environ.get("DGRAPH_TPU_SHARD_VECTORS", "") == "1"
+        if shard and len(jax.devices()) > 1:
+            # row-shard the corpus over the device mesh: per-shard top-k,
+            # all_gather, global reduce (parallel/mesh.py sharded_topk —
+            # the TP-over-rows data plane for 1M×768-class corpora)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from dgraph_tpu.parallel import mesh as pmesh
+
+            mesh = pmesh.make_mesh()
+            ndev = mesh.devices.size
+            rows = -(-cap // ndev) * ndev
+            if rows != cap:
+                mat = np.vstack([mat, np.zeros((rows - cap, d), np.float32)])
+                uids = np.concatenate(
+                    [uids, np.zeros((rows - cap,), np.uint64)]
+                )
+                valid = np.concatenate(
+                    [valid, np.zeros((rows - cap,), bool)]
+                )
+            sh = NamedSharding(mesh, P("data"))
+            self._mesh = mesh
+            self._device = {
+                "vecs": jax.device_put(jnp.asarray(mat), sh),
+                "uids": uids,  # host: gathered indices map back to uids
+                "valid": jax.device_put(jnp.asarray(valid), sh),
+                "sqnorm": None,
+            }
+            self._dirty = False
+            if self._n >= self.ivf_threshold:
+                self._train_ivf(mat[: self._n])
+            else:
+                self._ivf = None
+            return
         self._device = {
             "vecs": jnp.asarray(mat),
             "uids": jnp.asarray(uids),
@@ -160,7 +198,20 @@ class VectorIndex:
         # widen the candidate pool until k survivors or the whole set seen
         # (the HNSW analog is raising ef; ref index.go VectorIndexOptions)
         while True:
-            if self._ivf is not None:
+            if getattr(self, "_mesh", None) is not None:
+                from dgraph_tpu.parallel import mesh as pmesh
+
+                npool = min(max(pool, kk), self._n)
+                dd, idx = pmesh.sharded_topk(
+                    self._mesh,
+                    self._device["vecs"],
+                    self._device["valid"],
+                    jnp.asarray(q),
+                    npool,
+                )
+                cand_dists = np.asarray(dd)
+                cand_uids = self._device["uids"][np.asarray(idx)]
+            elif self._ivf is not None:
                 cand_uids, cand_dists = self._ivf_search(q, max(pool, 4 * kk))
             else:
                 dists = _distances(

@@ -97,6 +97,9 @@ class _SSTable:
             (off,) = struct.unpack_from("<Q", idx_blob, pos)
             pos += 8
             self._index.append((k, off))
+        # key-range bounds for table pruning (badger table min/max keys)
+        self.min_key = self._index[0][0] if self._index else b""
+        self.max_key = None  # lazily: last entry's key
 
     @staticmethod
     def write(
@@ -173,8 +176,25 @@ class _SSTable:
         idx_off, _ = struct.unpack("<QQ", self._mm[-16:])
         return idx_off
 
+    def _max_key(self) -> bytes:
+        if self.max_key is None:
+            last = b""
+            # scan the final index stride only
+            pos = self._index[-1][1] if self._index else 0
+            end = self._end()
+            while pos < end:
+                k, ts, seq, val, pos = self._entry_at(pos)
+                last = k
+            self.max_key = last
+        return self.max_key
+
+    def may_contain(self, key: bytes) -> bool:
+        return self.min_key <= key <= self._max_key()
+
     def versions_of(self, key: bytes) -> List[Tuple[int, int, bytes]]:
         """(ts, seq, val) ascending ts for one key."""
+        if not self.may_contain(key):
+            return []
         if self._native:
             from dgraph_tpu import native as _native
 
