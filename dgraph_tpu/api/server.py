@@ -608,6 +608,31 @@ class Server:
                 pred = k
                 if "@" in k:
                     pred, lang = k.split("@", 1)
+                su = self.schema.get(pred)
+                if (
+                    su is not None
+                    and su.value_type == TypeID.VFLOAT
+                    and isinstance(v, list)
+                    and v
+                    and isinstance(v[0], (int, float))
+                ):
+                    # a numeric list on a vector predicate is ONE value
+                    # (ref chunker json: vector literals), not a list pred
+                    apply_edge(
+                        txn,
+                        self.schema,
+                        DirectedEdge(
+                            uid,
+                            pred,
+                            value=Val(
+                                TypeID.VFLOAT,
+                                np.asarray(v, dtype=np.float32),
+                            ),
+                            op=op,
+                            ns=ns,
+                        ),
+                    )
+                    continue
                 vs = v if isinstance(v, list) else [v]
                 for item in vs:
                     if isinstance(item, dict):

@@ -183,8 +183,8 @@ def test_mesh_sharded_engine_search(monkeypatch):
         for i in range(50)
     ]
     t.mutate_json(set_obj=objs, commit_now=True)
+    vec_str = "[" + ", ".join(f"{x:.6f}" for x in V[7]) + "]"
     out = s.query(
-        '{ q(func: similar_to(emb, 3, "%s")) { name } }'
-        % V[7].tolist()
+        '{ q(func: similar_to(emb, 3, "%s")) { name } }' % vec_str
     )
     assert out["data"]["q"][0]["name"] == "v8"
