@@ -549,6 +549,30 @@ class LsmKV(KV):
 
     def iterate(self, prefix: bytes, read_ts: int):
         with self._mu:
+            single = (
+                len(self._tables) == 1
+                and not self._mem
+                and not self._markers
+            )
+            if single:
+                table = self._tables[0]
+        if single:
+            # post-compaction common case: ONE streaming pass over the
+            # sorted table — no per-key re-probes (badger iterator shape)
+            cur_key = None
+            best = None
+            for k, ts, seq, val in table.scan(prefix):
+                if k != cur_key:
+                    if best is not None:
+                        yield (cur_key, best[0], best[1])
+                    cur_key = k
+                    best = None
+                if ts <= read_ts:
+                    best = (ts, val)  # ascending ts: last wins
+            if best is not None:
+                yield (cur_key, best[0], best[1])
+            return
+        with self._mu:
             ks = list(self._merged_keys(prefix))
         for k in ks:
             got = self.get(k, read_ts)
