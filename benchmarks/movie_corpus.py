@@ -81,6 +81,28 @@ class Corpus:
                 out.add(a)
         return sorted(out)
 
+    def actors_of_director(self, d: int) -> List[int]:
+        """3-hop: director -> films -> starring actors."""
+        films = set(self.director_films.get(d, []))
+        out: Set[int] = set()
+        for a, fs in self.actor_films.items():
+            if films.intersection(fs):
+                out.add(a)
+        return sorted(out)
+
+    def genres_by_film_count(self) -> List[tuple]:
+        """(genre uid, #films) sorted by count desc then uid."""
+        counts = {g: 0 for g in self.genres.values()}
+        for gs in self.film_genres.values():
+            for g in gs:
+                counts[g] += 1
+        return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+
+    def prolific_directors(self, min_films: int) -> List[int]:
+        return sorted(
+            d for d, fs in self.director_films.items() if len(fs) >= min_films
+        )
+
     def top_rated(self, n: int) -> List[int]:
         return [
             f

@@ -182,6 +182,57 @@ def run_suite(corpus, server, repeat: int = 3) -> dict:
         "n": len(got),
     }
 
+    # 3-hop: a director's co-working actors (director->films->starring)
+    d0 = next(iter(corpus.director_films))
+    q3 = (
+        "{ d as var(func: uid(0x%x)) { f as director.film }\n"
+        "  q(func: has(starring)) @filter(uid_in(starring, uid(f))) { uid } }"
+        % d0
+    )
+    out, lat = timed(q3)
+    results["actors_of_director_3hop"] = {
+        "latency_ms": round(lat, 2),
+        "ok": _uids_of(out) == corpus.actors_of_director(d0),
+        "n": len(corpus.actors_of_director(d0)),
+    }
+
+    # count(count-index): directors with >= 8 films via eq/ge(count())
+    out, lat = timed(
+        "{ q(func: ge(count(director.film), 8)) { uid } }"
+    )
+    results["prolific_directors_count_index"] = {
+        "latency_ms": round(lat, 2),
+        "ok": _uids_of(out) == corpus.prolific_directors(8),
+        "n": len(corpus.prolific_directors(8)),
+    }
+
+    # groupby at scale: films per genre with per-group counts
+    out, lat = timed(
+        "{ q(func: has(genre)) @groupby(genre) { count(uid) } }"
+    )
+    got_counts = {
+        int(g["genre"], 16): g["count"]
+        for g in out["data"]["q"][0]["@groupby"]
+    }
+    want_counts = dict(corpus.genres_by_film_count())
+    results["groupby_genre_counts"] = {
+        "latency_ms": round(lat, 2),
+        "ok": got_counts == {g: c for g, c in want_counts.items() if c > 0},
+        "n": len(got_counts),
+    }
+
+    # cascade: films that have BOTH a rating and a 2005 release
+    out, lat = timed(
+        '{ q(func: between(initial_release_date, "2005-01-01", "2005-12-31")) '
+        "@cascade { uid rating initial_release_date } }"
+    )
+    want = corpus.films_in_year(2005)
+    results["cascade_year_rating"] = {
+        "latency_ms": round(lat, 2),
+        "ok": _uids_of(out) == want,  # every film carries a rating
+        "n": len(want),
+    }
+
     # bulk 2-hop fanout: genre -> films -> starring actors (edges/sec)
     qf = (
         '{ g(func: eq(name, "%s")) { ~genre { starring_count: count(~starring) } } }' % g

@@ -94,6 +94,10 @@ class JsonEncoder:
             elif c.gq.is_count and c.gq.attr == "uid":
                 out.append({_display_name(c): int(len(node.dest_uids))})
 
+        if getattr(node, "root_groups", None) is not None:
+            # root-level @groupby block (data.q = [{"@groupby": [...]}])
+            return [{"@groupby": node.root_groups}]  # type: ignore
+
         if getattr(node, "paths", None):
             # shortest-path block: emit the path uid chains + total cost
             # (ref outputnode.go _path_ / _weight_)

@@ -186,6 +186,17 @@ class Executor:
         if gq.var_name:
             self.uid_vars[gq.var_name] = node.dest_uids
 
+        if gq.groupby_attrs:
+            # root-level @groupby: group the block's own result set
+            # (ref query/groupby.go processGroupBy on the root SubGraph)
+            fake_parent = ExecNode(
+                gq=gq, dest_uids=np.array([0], dtype=np.uint64)
+            )
+            fake_child = ExecNode(gq=gq, uid_matrix=[node.dest_uids])
+            self._group_children(gq, fake_child, fake_parent)
+            node.root_groups = fake_child.groups.get(0, [])  # type: ignore
+            return node
+
         if gq.recurse:
             self._expand_recurse(node)
         else:
@@ -348,29 +359,41 @@ class Executor:
         for i, pu in enumerate(parent.dest_uids):
             row = cnode.uid_matrix[i] if i < len(cnode.uid_matrix) else []
             buckets: Dict[tuple, dict] = {}
+            import itertools as _it
+
             for cu in row:
-                key_parts = []
-                disp = {}
+                # a multi-valued uid groupby attr lands the entity in ONE
+                # bucket PER target (ref groupby.go: each edge groups)
+                options = []
                 for ga in cgq.groupby_attrs:
                     su = self.st.get(ga)
                     if su is not None and su.value_type == TypeID.UID:
-                        tgt = self.cache.uids(
+                        tgts = self.cache.uids(
                             keys.DataKey(ga, int(cu), self.ns)
                         )
-                        kv = int(tgt[0]) if len(tgt) else None
-                        key_parts.append(kv)
-                        disp[ga] = hex(kv) if kv is not None else None
+                        if len(tgts):
+                            options.append(
+                                [
+                                    (ga, int(t), hex(int(t)))
+                                    for t in tgts
+                                ]
+                            )
+                        else:
+                            options.append([(ga, None, None)])
                     else:
                         v = self.cache.value(keys.DataKey(ga, int(cu), self.ns))
                         kv = None if v is None else v.value
-                        key_parts.append(kv)
-                        disp[ga] = kv
-                k = tuple(key_parts)
-                b = buckets.get(k)
-                if b is None:
-                    buckets[k] = b = {**disp, "count": 0, "__members__": []}
-                b["count"] += 1
-                b["__members__"].append(int(cu))
+                        options.append([(ga, kv, kv)])
+                for combo in _it.product(*options):
+                    k = tuple(kv for _, kv, _d in combo)
+                    disp = {ga: d for ga, _kv, d in combo}
+                    b = buckets.get(k)
+                    if b is None:
+                        buckets[k] = b = {
+                            **disp, "count": 0, "__members__": []
+                        }
+                    b["count"] += 1
+                    b["__members__"].append(int(cu))
             # per-bucket aggregations over predicates: min/max/sum/avg(age)
             # (ref query/groupby.go aggregateGroup)
             aggs = [
