@@ -22,8 +22,8 @@ def test_facade_txn_roundtrip(facade):
     facade.alter("name: string @index(exact) .\nfriend: [uid] .")
     t = facade.new_txn()
     uids = t.mutate_rdf(
-        set_rdf='_:a <name> "fc-alice" .\n_:a <friend> <0x2> .\n'
-        '<0x2> <name> "fc-bob" .',
+        set_rdf='_:a <name> "fc-alice" .\n_:a <friend> _:b .\n'
+        '_:b <name> "fc-bob" .',
         commit_now=True,
     )
     assert "a" in uids
