@@ -52,7 +52,25 @@ def _server(args):
 def cmd_alpha(args):
     from dgraph_tpu.api.http_server import HTTPServer
 
-    engine = _server(args)
+    if getattr(args, "cluster", ""):
+        from dgraph_tpu.worker.facade import ClusterFacade
+        from dgraph_tpu.worker.groups import DistributedCluster
+        from dgraph_tpu.x.flags import SuperFlag
+
+        cf = SuperFlag(
+            args.cluster,
+            "groups=2; replicas=3; learners=0; replicated-zero=false",
+        )
+        cluster = DistributedCluster(
+            n_groups=cf.get_int("groups", 2),
+            replicas=cf.get_int("replicas", 3),
+            data_dir=args.p,
+            learners_per_group=cf.get_int("learners", 0),
+            replicated_zero=cf.get_bool("replicated-zero"),
+        )
+        engine = ClusterFacade(cluster)
+    else:
+        engine = _server(args)
     if args.schema:
         with open(args.schema) as f:
             engine.alter(f.read())
@@ -309,6 +327,12 @@ def main(argv=None):
         "--storage",
         default="",
         help='superflag: "backend=mem|lsm; encryption-key-file=...; memtable-mb=8"',
+    )
+    p.add_argument(
+        "--cluster",
+        default="",
+        help='serve a sharded cluster: "groups=2; replicas=3; '
+        'learners=0; replicated-zero=true"',
     )
     p.add_argument(
         "--trace",

@@ -158,10 +158,12 @@ class ClusterFacade:
 
     def alter(self, schema_text: str = "", drop_attr: str = "",
               drop_all: bool = False):
-        if drop_all or drop_attr:
-            raise NotImplementedError(
-                "cluster drops route through tablet moves; not exposed here"
-            )
+        if drop_all:
+            self.cluster.drop_all()
+            return
+        if drop_attr:
+            self.cluster.drop_attr(drop_attr)
+            return
         self.cluster.alter(schema_text)
 
     def new_txn(self, read_only: bool = False) -> _TxnFace:

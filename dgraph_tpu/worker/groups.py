@@ -576,6 +576,32 @@ class DistributedCluster:
             self._schema_texts.append(schema_text)
             self._save_zero_state()
 
+    def drop_attr(self, pred: str):
+        """Drop one predicate cluster-wide (ref alter DropAttr: data +
+        split parts + schema on the owning group)."""
+        gid = self.zero.belongs_to(pred)
+        if gid is not None:
+            with self._commit_lock:
+                self._propose_and_wait(
+                    gid, ("drop", keys.PredicatePrefix(pred))
+                )
+                self._propose_and_wait(
+                    gid, ("drop", keys.SplitPredicatePrefix(pred))
+                )
+        self.schema.delete(pred)
+        self.vector_indexes.pop(pred, None)
+        self.mem.clear()
+
+    def drop_all(self):
+        """DropAll: wipe every group's data and reset schema."""
+        with self._commit_lock:
+            for gid in self.groups:
+                self._propose_and_wait(gid, ("drop", b""))
+        self.schema = State()
+        self.vector_indexes.clear()
+        self._bootstrap_schema()
+        self.mem.clear()
+
     # -- transactions ------------------------------------------------------------
 
     def read_kv(self) -> KV:

@@ -85,3 +85,17 @@ def test_grpc_over_cluster(facade):
         assert json.loads(out.json)["q"][0]["name"] == "fc-grpc"
     finally:
         gs.stop(0)
+
+
+def test_cluster_drop_attr_and_all(facade):
+    facade.alter("tmp1: string @index(exact) .\nkeep: string @index(exact) .")
+    t = facade.new_txn()
+    t.mutate_rdf(
+        set_rdf='_:a <tmp1> "gone" .\n_:b <keep> "stays" .', commit_now=True
+    )
+    facade.alter(drop_attr="tmp1")
+    assert facade.schema.get("tmp1") is None
+    out = facade.query('{ q(func: eq(keep, "stays")) { keep } }')
+    assert out["data"]["q"][0]["keep"] == "stays"
+    facade.alter(drop_all=True)
+    assert facade.schema.get("keep") is None
