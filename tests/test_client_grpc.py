@@ -1,0 +1,70 @@
+"""Bundled pydgraph-style gRPC client against the api.Dgraph server."""
+
+import pytest
+
+from dgraph_tpu.api.grpc_server import serve
+from dgraph_tpu.api.server import Server
+from dgraph_tpu.client_grpc import DgraphClient, DgraphClientStub
+
+
+@pytest.fixture(scope="module")
+def client():
+    engine = Server()
+    gs, port = serve(engine)
+    stub = DgraphClientStub(f"127.0.0.1:{port}")
+    c = DgraphClient(stub)
+    yield c
+    stub.close()
+    gs.stop(0)
+
+
+def test_client_lifecycle(client):
+    assert client.check_version() == "dgraph-tpu"
+    client.alter(schema="name: string @index(exact) .\nage: int .")
+
+    txn = client.txn()
+    uids = txn.mutate(set_nquads='_:a <name> "cg-alice" .\n_:a <age> "30"^^<xs:int> .')
+    assert "a" in uids
+    # visible inside the txn, not outside
+    assert txn.query('{ q(func: eq(name, "cg-alice")) { age } }')["q"][0]["age"] == 30
+    ro = client.txn(read_only=True)
+    assert ro.query('{ q(func: eq(name, "cg-alice")) { uid } }')["q"] == []
+    assert txn.commit() > 0
+    assert (
+        client.txn(read_only=True)
+        .query('{ q(func: eq(name, "cg-alice")) { age } }')["q"][0]["age"]
+        == 30
+    )
+
+
+def test_client_commit_now_and_json(client):
+    txn = client.txn()
+    txn.mutate(set_obj={"uid": "_:j", "name": "cg-json"}, commit_now=True)
+    got = client.txn(read_only=True).query(
+        '{ q(func: eq(name, "cg-json")) { name } }'
+    )
+    assert got["q"][0]["name"] == "cg-json"
+
+
+def test_client_discard(client):
+    txn = client.txn()
+    txn.mutate(set_nquads='_:g <name> "cg-ghost" .')
+    txn.discard()
+    got = client.txn(read_only=True).query(
+        '{ q(func: eq(name, "cg-ghost")) { uid } }'
+    )
+    assert got["q"] == []
+
+
+def test_client_upsert_do_request(client):
+    client.txn().mutate(
+        set_nquads='_:e <name> "cg-upsertee" .', commit_now=True
+    )
+    out = client.txn().do_request(
+        '{ u as var(func: eq(name, "cg-upsertee")) }',
+        [('uid(u) <age> "44"^^<xs:int> .', None)],
+    )
+    got = client.txn(read_only=True).query(
+        '{ q(func: eq(name, "cg-upsertee")) { age } }'
+    )
+    assert got["q"][0]["age"] == 44
