@@ -185,8 +185,18 @@ class _Handler(BaseHTTPRequestHandler):
                     variables = body.get("variables")
                     if variables is not None and not isinstance(variables, dict):
                         raise ValueError('"variables" must be an object')
+                timeout_ms = None
+                if qs.get("timeout"):
+                    t = qs["timeout"][0]  # "5s" / "500ms" (ref ?timeout=)
+                    timeout_ms = (
+                        float(t[:-2]) if t.endswith("ms")
+                        else float(t.rstrip("s")) * 1e3
+                    )
                 res = self.engine.query(
-                    raw, access_jwt=token, variables=variables
+                    raw,
+                    access_jwt=token,
+                    variables=variables,
+                    timeout_ms=timeout_ms,
                 )
                 res["extensions"] = {
                     "server_latency": {

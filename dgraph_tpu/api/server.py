@@ -667,8 +667,10 @@ class Server:
         read_ts: Optional[int] = None,
         access_jwt: Optional[str] = None,
         variables: Optional[Dict[str, str]] = None,
+        timeout_ms: Optional[float] = None,
     ) -> dict:
-        """Run a read-only query at a fresh (or given) read ts."""
+        """Run a read-only query at a fresh (or given) read ts.
+        timeout_ms bounds execution (ref x/limits --query timeout)."""
         ts = read_ts if read_ts is not None else self.zero.read_ts()
         blocks = dql.parse(q, variables)
         ns = keys.GALAXY_NS
@@ -696,11 +698,20 @@ class Server:
         from dgraph_tpu.utils.observe import METRICS, TRACER
 
         t0 = _time.monotonic()
+        deadline = (
+            _time.monotonic() + timeout_ms / 1e3
+            if timeout_ms is not None
+            else None
+        )
         with TRACER.span("query", ns=ns), METRICS.timer(
             "query_latency_seconds"
         ):
             out = self._query_parsed(
-                blocks, LocalCache(self.kv, ts, mem=self.mem), ns, allowed
+                blocks,
+                LocalCache(self.kv, ts, mem=self.mem),
+                ns,
+                allowed,
+                deadline=deadline,
             )
         METRICS.inc("num_queries")
         took_ms = (_time.monotonic() - t0) * 1e3
@@ -741,7 +752,12 @@ class Server:
         return self._query_parsed(dql.parse(q), cache, keys.GALAXY_NS)
 
     def _query_parsed(
-        self, blocks, cache: LocalCache, ns: int, allowed_preds=None
+        self,
+        blocks,
+        cache: LocalCache,
+        ns: int,
+        allowed_preds=None,
+        deadline=None,
     ) -> dict:
         ex = Executor(
             cache,
@@ -750,6 +766,7 @@ class Server:
             vector_indexes=self.vector_indexes,
             allowed_preds=allowed_preds,
             stats=self.stats,
+            deadline=deadline,
         )
         nodes = ex.process(blocks)
         enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)

@@ -57,11 +57,15 @@ class Executor:
         vector_indexes=None,
         allowed_preds=None,
         stats=None,
+        deadline: Optional[float] = None,
     ):
         self.cache = cache
         self.st = st
         self.ns = ns
         self.stats = stats
+        # absolute time.monotonic() budget (ref x/limits query timeout);
+        # checked at block and expansion boundaries
+        self.deadline = deadline
         self.vector_indexes = vector_indexes or {}
         # None = unrestricted; a set filters expand(_all_) expansion to
         # ACL-readable predicates (ref expand filtering in edgraph auth)
@@ -84,6 +88,13 @@ class Executor:
     # Block orchestration (ref query.Request.Process query.go:3046)
     # ------------------------------------------------------------------
 
+    def _check_deadline(self):
+        if self.deadline is not None:
+            import time as _time
+
+            if _time.monotonic() > self.deadline:
+                raise QueryError("query exceeded its time budget")
+
     def process(self, blocks: List[GraphQuery]) -> List[ExecNode]:
         pending = list(blocks)
         done: List[Tuple[GraphQuery, ExecNode]] = []
@@ -94,6 +105,7 @@ class Executor:
             progress = False
             still = []
             for b in pending:
+                self._check_deadline()
                 if self._deps_ready(b):
                     node = self.execute_block(b)
                     executed[idx[id(b)]] = node
@@ -231,6 +243,7 @@ class Executor:
         return su is not None and su.value_type == TypeID.UID
 
     def _expand_children(self, node: ExecNode, depth: int = 0):
+        self._check_deadline()
         gqs = list(node.gq.children)
         # expand(_all_)/expand(Type) -> concrete children (ref query.go:2038)
         gqs = self._resolve_expand(gqs, node.dest_uids)

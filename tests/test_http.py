@@ -170,3 +170,19 @@ def test_admin_graphql_endpoint(http):
     assert "type T" in out["data"]["updateGQLSchema"]["gqlSchema"]["schema"]
     out = admin("{ getGQLSchema { schema } }")
     assert "type T" in out["data"]["getGQLSchema"]["schema"]
+
+
+def test_query_timeout(http):
+    """?timeout= bounds query execution (ref x/limits query timeout)."""
+    import urllib.error
+
+    # an impossible budget trips immediately with a 400-class error
+    try:
+        _post(http, "/query?timeout=0ms", "{ q(func: has(name)) { name } }")
+        raised = False
+    except urllib.error.HTTPError as e:
+        raised = e.code == 400
+    assert raised
+    # a sane budget succeeds
+    out = _post(http, "/query?timeout=5s", "{ q(func: has(name)) { uid } }")
+    assert "q" in out["data"]
