@@ -175,7 +175,10 @@ class ClusterFacade:
         read_ts: Optional[int] = None,
         access_jwt: Optional[str] = None,
         variables: Optional[Dict[str, str]] = None,
+        timeout_ms: Optional[float] = None,
     ) -> dict:
+        import time as _time
+
         from dgraph_tpu import dql
         from dgraph_tpu.posting.lists import LocalCache
         from dgraph_tpu.query.outputjson import JsonEncoder
@@ -188,6 +191,11 @@ class ClusterFacade:
             self.cluster.schema,
             vector_indexes=self.cluster.vector_indexes,
             stats=self.stats,
+            deadline=(
+                _time.monotonic() + timeout_ms / 1e3
+                if timeout_ms is not None
+                else None
+            ),
         )
         nodes = ex.process(dql.parse(q, variables))
         enc = JsonEncoder(val_vars=ex.val_vars, schema=self.cluster.schema)
