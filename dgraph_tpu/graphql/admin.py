@@ -131,4 +131,22 @@ class AdminGraphQL:
             if cache is not None:
                 self.engine.cache_mb = cache
             return {"response": {"code": "Success", "message": "Done"}}
+        if sel.name == "addNamespace":
+            from dgraph_tpu.admin.namespace import NamespaceManager
+
+            pw = sel.args.get("input", {}).get("password", "password")
+            ns = NamespaceManager(self.engine).create_namespace(pw)
+            return {
+                "namespaceId": ns,
+                "message": f"Created namespace {ns}",
+            }
+        if sel.name == "deleteNamespace":
+            from dgraph_tpu.admin.namespace import NamespaceManager
+
+            ns = int(sel.args.get("input", {}).get("namespaceId", -1))
+            NamespaceManager(self.engine).delete_namespace(ns)
+            return {
+                "namespaceId": ns,
+                "message": f"Deleted namespace {ns}",
+            }
         raise ValueError(f"unknown admin mutation {sel.name!r}")

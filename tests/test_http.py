@@ -186,3 +186,21 @@ def test_query_timeout(http):
     # a sane budget succeeds
     out = _post(http, "/query?timeout=5s", "{ q(func: has(name)) { uid } }")
     assert "q" in out["data"]
+
+
+def test_admin_namespace_mutations(http):
+    """addNamespace/deleteNamespace over the admin GraphQL
+    (ref edgraph/multi_tenancy.go via graphql/admin)."""
+    import json as _json
+
+    def admin(q):
+        return _post(http, "/admin", _json.dumps({"query": q}),
+                     ctype="application/json")
+
+    out = admin('mutation { addNamespace(input: {password: "pw"}) { namespaceId } }')
+    ns = out["data"]["addNamespace"]["namespaceId"]
+    assert ns >= 1
+    out = admin(
+        'mutation { deleteNamespace(input: {namespaceId: %d}) { message } }' % ns
+    )
+    assert "Deleted" in out["data"]["deleteNamespace"]["message"]
