@@ -272,6 +272,35 @@ class ProcCluster:
             replayed += 1
         return replayed
 
+    def move_tablet(self, pred: str, dst_group: int):
+        """Cross-process predicate move (ref worker/predicate_move.go:120):
+        stream every version of the tablet (data + split parts) out of the
+        source group over the read RPC, propose them into the destination
+        group's raft log, flip ownership, then drop at the source. The
+        commit lock fences writes for the duration (the reference's
+        blocking phase)."""
+        with self._commit_lock:
+            src_gid = self.zero.belongs_to(pred)
+            if src_gid is None or src_gid == dst_group:
+                return
+            src = self.remote_groups[src_gid]
+            writes = []
+            for prefix in (
+                keys.PredicatePrefix(pred),
+                keys.SplitPredicatePrefix(pred),
+            ):
+                for k, vers in src.read(
+                    "kv.iterate_versions", {"prefix": prefix, "ts": 1 << 62}
+                ):
+                    for ts, val in reversed(vers):  # oldest first
+                        writes.append((bytes(k), int(ts), bytes(val)))
+            if writes:
+                self.remote_groups[dst_group].propose(("delta", writes))
+            self.zero.move_tablet(pred, dst_group)
+            src.propose(("drop", keys.PredicatePrefix(pred)))
+            src.propose(("drop", keys.SplitPredicatePrefix(pred)))
+            self.mem.clear()
+
     def query(self, q: str, read_ts: Optional[int] = None) -> dict:
         from dgraph_tpu import dql
         from dgraph_tpu.posting.lists import LocalCache

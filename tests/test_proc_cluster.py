@@ -178,3 +178,31 @@ def test_proc_cluster_with_zero_quorum_processes(tmp_path):
         assert out["data"]["q"][0]["name"] == "zq-bob"
     finally:
         c.close()
+
+
+def test_proc_cluster_predicate_move(cluster):
+    """Cross-process tablet move: stream out of the source group's
+    replicas, raft-propose into the destination, flip, drop
+    (ref worker/predicate_move.go)."""
+    cluster.alter("movable: string @index(exact) .")
+    t = cluster.new_txn()
+    t.mutate_rdf(
+        set_rdf="\n".join(
+            f'<0x{i:x}> <movable> "m{i}" .' for i in range(0x60, 0x70)
+        ),
+        commit_now=True,
+    )
+    src = cluster.zero.belongs_to("movable")
+    dst = next(g for g in cluster.remote_groups if g != src)
+    cluster.move_tablet("movable", dst)
+    assert cluster.zero.belongs_to("movable") == dst
+    out = cluster.query('{ q(func: eq(movable, "m97")) { movable } }')
+    assert out["data"]["q"][0]["movable"] == "m97"
+    out = cluster.query("{ q(func: has(movable)) { uid } }")
+    assert len(out["data"]["q"]) == 16
+    # and writes keep landing on the new owner
+    cluster.new_txn().mutate_rdf(
+        set_rdf='<0x70> <movable> "m112" .', commit_now=True
+    )
+    out = cluster.query('{ q(func: eq(movable, "m112")) { movable } }')
+    assert out["data"]["q"][0]["movable"] == "m112"
