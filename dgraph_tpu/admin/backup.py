@@ -97,3 +97,70 @@ def restore(server, backup_dir: str, until: Optional[int] = None) -> int:
     # a prior alter() (ref online_restore schema handling)
     server._load_persisted_state()
     return total
+
+
+def restore_to_cluster(cluster, backup_dir: str, until: Optional[int] = None) -> int:
+    """Online restore into a LIVE distributed cluster (ref worker/
+    online_restore.go): backup records are sharded by their owning tablet
+    and proposed through each group's raft log, so every replica applies
+    them; schema lines re-alter the cluster and leases advance past the
+    restored timestamps."""
+    manifest = _load_manifest(backup_dir)
+    if not manifest["backups"]:
+        raise FileNotFoundError(f"no backups in {backup_dir}")
+    from dgraph_tpu.x import keys as xkeys
+
+    total = 0
+    max_ts = 0
+    max_uid = 0
+    per_group: dict = {}
+    schema_texts = []
+    for entry in manifest["backups"]:
+        if until is not None and entry["since"] >= until:
+            break
+        path = os.path.join(backup_dir, entry["path"])
+        with gzip.open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + _REC.size <= len(data):
+            klen, ts, vlen = _REC.unpack_from(data, pos)
+            pos += _REC.size
+            key = data[pos : pos + klen]
+            pos += klen
+            val = data[pos : pos + vlen]
+            pos += vlen
+            if until is not None and ts > until:
+                continue
+            max_ts = max(max_ts, ts)
+            total += 1
+            try:
+                pk = xkeys.parse_key(key)
+            except Exception:
+                continue  # meta keys stay coordinator-local
+            if pk.is_schema or pk.is_type:
+                schema_texts.append(val.decode("utf-8"))
+                continue
+            if pk.uid is not None:
+                max_uid = max(max_uid, pk.uid)
+            gid = cluster.zero.should_serve(pk.attr)
+            per_group.setdefault(gid, []).append((key, ts, val))
+    for text in schema_texts:
+        cluster.alter(text)
+    for gid, writes in per_group.items():
+        # chunked proposals keep raft entries bounded
+        for i in range(0, len(writes), 5000):
+            chunk = writes[i : i + 5000]
+            if hasattr(cluster, "remote_groups"):
+                cluster.remote_groups[gid].propose(("delta", chunk))
+            else:
+                cluster._propose_and_wait(gid, ("delta", chunk))
+    # advance leases past everything restored
+    z = cluster.zero.zero
+    if max_ts > z.max_assigned:
+        z.next_ts(max_ts - z.max_assigned)
+    if max_uid:
+        cur = getattr(z, "_max_uid", 1)
+        if isinstance(cur, int) and max_uid >= cur:
+            z.assign_uids(max_uid - cur + 1)
+    cluster.mem.clear()
+    return total
