@@ -173,16 +173,28 @@ class MemKV(KV):
     def iterate(
         self, prefix: bytes, read_ts: int
     ) -> Iterator[Tuple[bytes, int, bytes]]:
+        # snapshot the latest versions under ONE lock acquisition — the
+        # per-key get() path paid a lock + dict lookup per key, which
+        # dominated has()-style tablet scans
         keys = self._sorted_keys()
         i = bisect.bisect_left(keys, prefix)
-        while i < len(keys):
-            k = keys[i]
-            if not k.startswith(prefix):
-                break
-            got = self.get(k, read_ts)
-            if got is not None:
-                yield (k, got[0], got[1])
-            i += 1
+        out = []
+        with self._mu:
+            n = len(keys)
+            data = self._data
+            while i < n:
+                k = keys[i]
+                if not k.startswith(prefix):
+                    break
+                vers = data.get(k)
+                if vers:
+                    j = bisect.bisect_right(
+                        vers, read_ts, key=lambda x: x[0]
+                    )
+                    if j:
+                        out.append((k, vers[j - 1][0], vers[j - 1][1]))
+                i += 1
+        return iter(out)
 
     def iterate_versions(
         self, prefix: bytes, read_ts: int
