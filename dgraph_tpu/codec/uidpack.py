@@ -87,6 +87,19 @@ def encode(uids: np.ndarray) -> UidPack:
             offsets=np.zeros((0, BLOCK_SIZE), np.uint32),
             num_uids=0,
         )
+    if n <= BLOCK_SIZE and (uids[-1] >> np.uint64(32)) == (
+        uids[0] >> np.uint64(32)
+    ):
+        # single-block fast path: the dominant bulk-load shape (small
+        # per-key lists) — no segment scan, no per-block loop
+        offsets = np.full((1, BLOCK_SIZE), 0xFFFFFFFF, np.uint32)
+        offsets[0, :n] = (uids - uids[0]).astype(np.uint32)
+        return UidPack(
+            bases=uids[:1].copy(),
+            counts=np.array([n], np.int32),
+            offsets=offsets,
+            num_uids=n,
+        )
     hi = (uids >> np.uint64(32)).astype(np.uint64)
     # block boundary every BLOCK_SIZE elements or at hi-32 changes
     seg_starts = np.flatnonzero(np.concatenate([[True], hi[1:] != hi[:-1]]))

@@ -109,6 +109,16 @@ def bitpack(vals: np.ndarray, width: int) -> bytes:
     if width == 0 or n == 0:
         return b""
     nbytes = (n * width + 7) // 8
+    if n <= 32:
+        # tiny arrays: ctypes marshaling costs more than packing — build
+        # one big int and slice its bytes (bulk loads are dominated by
+        # small per-key lists)
+        acc = 0
+        shift = 0
+        for v in vals.tolist():
+            acc |= int(v) << shift
+            shift += width
+        return acc.to_bytes(nbytes, "little")
     if _LIB is not None:
         out = np.zeros((nbytes + 8,), np.uint8)  # slack for the 5-byte write
         _LIB.bitpack(
@@ -123,6 +133,14 @@ def bitpack(vals: np.ndarray, width: int) -> bytes:
 def bitunpack(data: bytes, count: int, width: int) -> np.ndarray:
     if width == 0 or count == 0:
         return np.zeros((count,), np.uint32)
+    if count <= 32:
+        acc = int.from_bytes(data[: (count * width + 7) // 8], "little")
+        mask = (1 << width) - 1
+        return np.fromiter(
+            ((acc >> (i * width)) & mask for i in range(count)),
+            dtype=np.uint32,
+            count=count,
+        )
     if _LIB is not None:
         buf = np.frombuffer(data, dtype=np.uint8)
         out = np.empty((count,), np.uint32)
