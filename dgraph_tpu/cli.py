@@ -363,6 +363,11 @@ def main(argv=None):
     p = sub.add_parser("bulk", help="offline bulk load")
     add_p(p)
     p.add_argument("--schema", default=None)
+    p.add_argument(
+        "--storage",
+        default="",
+        help='superflag: "backend=mem|lsm; encryption-key-file=..."',
+    )
     p.add_argument("files", nargs="+")
     p.set_defaults(fn=cmd_bulk)
 
