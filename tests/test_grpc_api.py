@@ -189,3 +189,26 @@ def _commit_now_nquads(nq: bytes) -> "pb.Request":
     m = req.mutations.add()
     m.set_nquads = nq
     return req
+
+
+def test_grpc_login_with_acl():
+    """Login over gRPC against an ACL-enabled engine returns working
+    JWTs (ref edgraph/access_ee login flow)."""
+    import json as _json
+
+    engine = Server()
+    engine.enable_acl(groot_password="secret123")
+    server, port = serve(engine)
+    try:
+        c = MiniDgraphClient(f"127.0.0.1:{port}")
+        resp = c.login(pb.LoginRequest(userid="groot", password="secret123"))
+        jwt = _json.loads(resp.json)
+        assert jwt["accessJwt"]
+        # wrong password -> UNAUTHENTICATED
+        import grpc as _grpc
+
+        with pytest.raises(_grpc.RpcError) as ei:
+            c.login(pb.LoginRequest(userid="groot", password="nope"))
+        assert ei.value.code() == _grpc.StatusCode.UNAUTHENTICATED
+    finally:
+        server.stop(0)
