@@ -185,13 +185,56 @@ class Executor:
             want = gq.func.args[0]
             vals = self.val_vars.get(gq.func.val_var, {})
             root = _as_uids(u for u in vals if _vals_equal(vals[u], want))
+            if gq.filter is not None:
+                root = self.eval_filter(gq.filter, root)
         else:
-            root = runner.run_root(gq.func)
+            root = self._run_root_filtered(gq)
 
         node = ExecNode(gq=gq, attr=gq.attr, dest_uids=root)
-        if gq.filter is not None:
-            node.dest_uids = self.eval_filter(gq.filter, node.dest_uids)
+        return self._finish_block(gq, node)
 
+    def _selective_seed(self, ft: FilterTree) -> Optional[np.ndarray]:
+        """A cheap rootless candidate set from the filter tree: uid(...)
+        literals/vars, or uid_in over a @reverse predicate (answered from
+        the targets' reverse lists). Used to invert has()-root plans
+        (ref worker/task.go planning: run the selective side first)."""
+        if ft.func is not None:
+            fn = ft.func
+            if fn.name == "uid":
+                return self._runner()._run(fn, src=None)
+            if fn.name == "uid_in" and fn.attr:
+                su = self.st.get(fn.attr)
+                if su is not None and su.directive_reverse:
+                    return self._runner()._run(fn, src=None)
+            return None
+        if ft.op == "and":
+            for c in ft.children:
+                got = self._selective_seed(c)
+                if got is not None:
+                    return got
+        return None
+
+    def _run_root_filtered(self, gq: GraphQuery) -> np.ndarray:
+        """Root + filter with plan inversion: a has() root whose filter
+        carries a selective seed verifies has() per candidate instead of
+        scanning the whole tablet."""
+        runner = self._runner()
+        if gq.func.name == "has" and gq.filter is not None and not gq.func.attr.startswith("~"):
+            seed = self._selective_seed(gq.filter)
+            if seed is not None:
+                attr = gq.func.attr
+                root = _as_uids(
+                    int(u)
+                    for u in seed
+                    if self.cache.has(keys.DataKey(attr, int(u), self.ns))
+                )
+                return self.eval_filter(gq.filter, root)
+        root = runner.run_root(gq.func)
+        if gq.filter is not None:
+            root = self.eval_filter(gq.filter, root)
+        return root
+
+    def _finish_block(self, gq: GraphQuery, node: ExecNode) -> ExecNode:
         # ordering & pagination at root (ref applyOrderAndPagination :2511)
         node.dest_uids = self._order_and_paginate(gq, node.dest_uids)
 
