@@ -188,6 +188,13 @@ class Executor:
             if gq.filter is not None:
                 root = self.eval_filter(gq.filter, root)
         else:
+            pre = self._try_index_only_order(gq)
+            if pre is not None:
+                node = ExecNode(gq=gq, attr=gq.attr, dest_uids=pre)
+                node.dest_uids = _paginate(
+                    node.dest_uids, gq.first, gq.offset, gq.after
+                )
+                return self._finish_block(gq, node, skip_order=True)
             root = self._run_root_filtered(gq)
 
         node = ExecNode(gq=gq, attr=gq.attr, dest_uids=root)
@@ -234,9 +241,65 @@ class Executor:
             root = self.eval_filter(gq.filter, root)
         return root
 
-    def _finish_block(self, gq: GraphQuery, node: ExecNode) -> ExecNode:
+    def _try_index_only_order(self, gq: GraphQuery) -> Optional[np.ndarray]:
+        """has(X) ordered by X with a sortable index: every bucket member
+        IS a candidate, so the ordered result comes straight off the index
+        walk — no tablet scan (sortWithIndex without the intersect)."""
+        if (
+            gq.func is None
+            or gq.func.name != "has"
+            or gq.filter is not None
+            or len(gq.order) != 1
+            or gq.order[0].attr != gq.func.attr
+            or gq.order[0].val_var
+            or gq.order[0].lang
+            or gq.func.attr.startswith("~")
+        ):
+            return None
+        o = gq.order[0]
+        su = self.st.get(o.attr)
+        if su is None:
+            return None
+        tk = next((t for t in su.tokenizer_objs() if t.is_sortable), None)
+        if tk is None:
+            return None
+        need = None
+        if gq.first is not None and gq.first >= 0 and gq.after is None:
+            need = (gq.offset or 0) + gq.first
+        prefix = keys.IndexPrefix(o.attr, self.ns)
+        ident = bytes([tk.identifier])
+        bucket_keys = [
+            k
+            for k, _, _ in self.cache.kv.iterate(prefix, self.cache.read_ts)
+            if keys.parse_key(k).term.startswith(ident)
+        ]
+        if o.desc:
+            bucket_keys.reverse()
+        out: List[int] = []
+        emitted: set = set()
+        for bk in bucket_keys:
+            if need is not None and len(out) >= need:
+                break
+            sel = self.cache.uids(bk)
+            sel = np.array(
+                [u for u in sel if int(u) not in emitted], dtype=np.uint64
+            )
+            if not len(sel):
+                continue
+            emitted.update(int(u) for u in sel)
+            if tk.is_lossy and len(sel) > 1:
+                sub = GraphQuery(attr=gq.attr)
+                sub.order = [Order(attr=o.attr, desc=o.desc)]
+                sel = self._order_uids_generic(sub, sel)
+            out.extend(int(u) for u in sel)
+        return np.array(out, dtype=np.uint64)
+
+    def _finish_block(
+        self, gq: GraphQuery, node: ExecNode, skip_order: bool = False
+    ) -> ExecNode:
         # ordering & pagination at root (ref applyOrderAndPagination :2511)
-        node.dest_uids = self._order_and_paginate(gq, node.dest_uids)
+        if not skip_order:
+            node.dest_uids = self._order_and_paginate(gq, node.dest_uids)
 
         if gq.var_name:
             self.uid_vars[gq.var_name] = node.dest_uids
