@@ -188,6 +188,9 @@ class Executor:
             if gq.filter is not None:
                 root = self.eval_filter(gq.filter, root)
         else:
+            pre_g = self._try_reverse_only_groupby(gq)
+            if pre_g is not None:
+                return pre_g
             pre = self._try_index_only_order(gq)
             if pre is not None:
                 node = ExecNode(gq=gq, attr=gq.attr, dest_uids=pre)
@@ -240,6 +243,42 @@ class Executor:
         if gq.filter is not None:
             root = self.eval_filter(gq.filter, root)
         return root
+
+    def _try_reverse_only_groupby(self, gq: GraphQuery) -> Optional[ExecNode]:
+        """has(X) @groupby(X) with @reverse and count-only children: the
+        buckets ARE the reverse lists — zero tablet scans, one read per
+        DISTINCT target (groupby.go over the index, degenerate case)."""
+        if (
+            gq.func is None
+            or gq.func.name != "has"
+            or gq.filter is not None
+            or gq.order
+            or gq.var_name
+            or gq.groupby_attrs != [gq.func.attr]
+        ):
+            return None
+        if any(
+            not (c.is_count and c.attr == "uid") or c.var_name
+            for c in gq.children
+        ):
+            return None
+        su = self.st.get(gq.func.attr)
+        if su is None or su.value_type != TypeID.UID or not su.directive_reverse:
+            return None
+        attr = gq.func.attr
+        buckets = []
+        for k, _, _ in self.cache.kv.iterate(
+            keys.ReversePrefix(attr, self.ns), self.cache.read_ts
+        ):
+            pk = keys.parse_key(k)
+            n = len(self.cache.uids(k))
+            if n:
+                buckets.append(((int(pk.uid),), {attr: hex(pk.uid), "count": n}))
+        node = ExecNode(gq=gq, attr=gq.attr)
+        node.root_groups = [  # type: ignore[attr-defined]
+            b for _, b in sorted(buckets, key=lambda kb: str(kb[0]))
+        ]
+        return node
 
     def _try_index_only_order(self, gq: GraphQuery) -> Optional[np.ndarray]:
         """has(X) ordered by X with a sortable index: every bucket member
@@ -314,6 +353,10 @@ class Executor:
             self._group_children(gq, fake_child, fake_parent)
             node.root_groups = fake_child.groups.get(0, [])  # type: ignore
             return node
+
+        return self._finish_expand(gq, node)
+
+    def _finish_expand(self, gq: GraphQuery, node: ExecNode) -> ExecNode:
 
         if gq.recurse:
             self._expand_recurse(node)
