@@ -31,7 +31,7 @@ SCHEMA = """
 name: string @index(exact, term) .
 initial_release_date: datetime @index(year) .
 genre: [uid] @reverse .
-director.film: [uid] @reverse .
+director.film: [uid] @reverse @count .
 starring: [uid] @reverse .
 rating: float @index(float) .
 """
