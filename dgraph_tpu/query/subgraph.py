@@ -254,6 +254,9 @@ class Executor:
             or gq.filter is not None
             or gq.order
             or gq.var_name
+            or gq.first is not None
+            or gq.offset
+            or gq.after
             or gq.groupby_attrs != [gq.func.attr]
         ):
             return None

@@ -12,9 +12,12 @@ per-node directory —
 
 Raft safety requires hardstate + appended entries be on disk BEFORE a
 vote/append response leaves the node (raft paper §5; the reference fsyncs
-via badger WAL). `sync=True` fsyncs on every flush; tests run sync=False
-(flush-only) for speed — the ordering is still crash-consistent because a
-torn tail is truncated at replay.
+via badger WAL). `sync=True` fsyncs on every flush and is the production
+default (alpha_process/zero_process cfg `wal_sync`, default True); tests
+run sync=False (flush-only) for speed — that model survives process
+crashes (data is in the OS page cache) but NOT power loss / kernel
+panics. Either way the ordering is crash-consistent: a torn tail is
+truncated at replay.
 """
 
 from __future__ import annotations

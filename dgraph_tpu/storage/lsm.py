@@ -72,6 +72,10 @@ class _SSTable:
     def __init__(self, path: str, enc_key: Optional[bytes] = None):
         self.path = path
         self.enc_key = enc_key
+        self._ref_mu = threading.Lock()
+        self._refs = 1  # owner (LsmKV._tables) reference
+        self._unlink = False
+        self._closed = False
         self._f = open(path, "rb")
         self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
         # native scan fast path (plaintext tables only)
@@ -247,10 +251,33 @@ class _SSTable:
                 break
             yield k, ts, seq, val
 
-    def close(self):
+    def retain(self):
+        with self._ref_mu:
+            self._refs += 1
+
+    def release(self):
+        with self._ref_mu:
+            self._refs -= 1
+            if self._refs > 0 or self._closed:
+                return
+            self._closed = True
+            unlink = self._unlink
         self._buf = None  # release the numpy buffer export before close
         self._mm.close()
         self._f.close()
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+
+    def close(self, unlink: bool = False):
+        """Drop the owner reference. Resources are freed (and the file
+        unlinked, if requested) once in-flight iterators release theirs —
+        compaction must not yank an mmap out from under a live scan."""
+        with self._ref_mu:
+            self._unlink = self._unlink or unlink
+        self.release()
 
 
 class LsmKV(KV):
@@ -473,14 +500,20 @@ class LsmKV(KV):
         merged = heapq.merge(*streams, key=lambda e: (e[0], e[1], e[2]))
 
         def live():
-            last = None
+            # Same (key, ts) may appear in several layers (e.g. rollup_key
+            # rewrites at the latest version's ts). The read path
+            # (_all_versions) resolves these newest-seq-wins, so compaction
+            # must too: buffer the current (key, ts) group and emit its
+            # highest-seq record (merged yields ascending seq within a group).
+            pending = None
             for k, ts, seq, val in merged:
                 if not self._visible(k, ts, seq):
                     continue
-                if last == (k, ts):  # same (key, ts): newest seq wins
-                    continue
-                last = (k, ts)
-                yield k, ts, seq, val
+                if pending is not None and (pending[0], pending[1]) != (k, ts):
+                    yield pending
+                pending = (k, ts, seq, val)
+            if pending is not None:
+                yield pending
 
         name = f"sst_{self._seq:016x}c.tbl"
         path = os.path.join(self.dir, name)
@@ -494,8 +527,7 @@ class LsmKV(KV):
         self._wal.close()
         self._wal = open(self._wal_path, "wb")
         for t in old:
-            t.close()
-            os.unlink(t.path)
+            t.close(unlink=True)
 
     def compact(self):
         with self._mu:
@@ -556,21 +588,25 @@ class LsmKV(KV):
             )
             if single:
                 table = self._tables[0]
+                table.retain()  # concurrent compaction must not unlink it
         if single:
             # post-compaction common case: ONE streaming pass over the
             # sorted table — no per-key re-probes (badger iterator shape)
-            cur_key = None
-            best = None
-            for k, ts, seq, val in table.scan(prefix):
-                if k != cur_key:
-                    if best is not None:
-                        yield (cur_key, best[0], best[1])
-                    cur_key = k
-                    best = None
-                if ts <= read_ts:
-                    best = (ts, val)  # ascending ts: last wins
-            if best is not None:
-                yield (cur_key, best[0], best[1])
+            try:
+                cur_key = None
+                best = None
+                for k, ts, seq, val in table.scan(prefix):
+                    if k != cur_key:
+                        if best is not None:
+                            yield (cur_key, best[0], best[1])
+                        cur_key = k
+                        best = None
+                    if ts <= read_ts:
+                        best = (ts, val)  # ascending ts: last wins
+                if best is not None:
+                    yield (cur_key, best[0], best[1])
+            finally:
+                table.release()
             return
         with self._mu:
             ks = list(self._merged_keys(prefix))
@@ -608,8 +644,7 @@ class LsmKV(KV):
 
         with self._mu:
             for t in self._tables:
-                t.close()
-                os.unlink(t.path)
+                t.close(unlink=True)
             self._tables = []
             self._mem.clear()
             self._mem_size = 0

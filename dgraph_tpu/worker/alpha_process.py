@@ -60,7 +60,13 @@ class AlphaProcess:
             self.kv = MemKV(
                 wal_path=os.path.join(data_dir, f"kv_{self.node_id}.wal")
             )
-            raft_wal = RaftWal(os.path.join(data_dir, f"raft_{self.node_id}"))
+            # default True: hardstate/entries must hit disk before vote/
+            # append responses leave the node or power loss can un-vote us
+            # (raft §5). Tests pass wal_sync=False (process-crash model).
+            raft_wal = RaftWal(
+                os.path.join(data_dir, f"raft_{self.node_id}"),
+                sync=bool(cfg.get("wal_sync", True)),
+            )
         else:
             self.kv = MemKV()
 

@@ -129,6 +129,7 @@ class AlphaNode:
         compact_every: int = 0,
         learner: bool = False,
         learner_ids: Optional[set] = None,
+        wal_sync: bool = False,
     ):
         self.id = node_id
         self.group_id = group_id
@@ -141,7 +142,9 @@ class AlphaNode:
             )
             from dgraph_tpu.raft.wal import RaftWal
 
-            raft_wal = RaftWal(os.path.join(data_dir, f"raft_{node_id}"))
+            raft_wal = RaftWal(
+                os.path.join(data_dir, f"raft_{node_id}"), sync=wal_sync
+            )
         else:
             self.kv = MemKV()
         self.applied_index = 0
@@ -187,6 +190,7 @@ class AlphaGroup:
         data_dir: Optional[str] = None,
         compact_every: int = 0,
         learner_ids: Optional[set] = None,
+        wal_sync: bool = False,
     ):
         self.id = group_id
         self.net = net
@@ -196,6 +200,7 @@ class AlphaGroup:
                 nid, group_id, node_ids, net,
                 data_dir=data_dir, compact_every=compact_every,
                 learner=nid in learner_ids, learner_ids=learner_ids,
+                wal_sync=wal_sync,
             )
             for nid in node_ids
         ]

@@ -50,7 +50,9 @@ class ProcCluster:
         compact_every: int = 0,
         replicated_zero: bool = False,
         zero_replicas: int = 3,
+        wal_sync: bool = False,  # tests: process-crash durability suffices
     ):
+        self.wal_sync = wal_sync
         self.pool = RpcPool(heartbeat_s=0.5, timeout=5.0).start_heartbeats()
         self.procs: Dict[int, subprocess.Popen] = {}
         self._cfgs: Dict[int, dict] = {}
@@ -76,6 +78,7 @@ class ProcCluster:
                     "data_dir": (
                         os.path.join(data_dir, "zero") if data_dir else None
                     ),
+                    "wal_sync": wal_sync,
                     "_module": "dgraph_tpu.zero.zero_process",
                 }
                 self._cfgs[i] = cfg
@@ -128,6 +131,7 @@ class ProcCluster:
                         if data_dir
                         else None
                     ),
+                    "wal_sync": wal_sync,
                 }
                 self._cfgs[i] = cfg
                 addrs.append(("127.0.0.1", rp))

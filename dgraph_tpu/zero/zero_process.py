@@ -44,7 +44,10 @@ class ZeroProcess:
         wal = None
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
-            wal = RaftWal(os.path.join(data_dir, f"zeroraft_{self.node_id}"))
+            wal = RaftWal(
+                os.path.join(data_dir, f"zeroraft_{self.node_id}"),
+                sync=bool(cfg.get("wal_sync", True)),
+            )
         self.net = TcpNetwork(raft_addrs)
         self.net.register(self.node_id)
         self._apply_cv = threading.Condition()

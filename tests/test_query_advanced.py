@@ -476,3 +476,30 @@ def test_groupby_aggregations_and_var():
     cities = out["data"]["cities"]
     assert {x["name"] for x in cities} == {"cityA", "cityB"}
     assert all(x["total"] == 2 for x in cities)
+
+
+def test_root_groupby_with_pagination_matches_slow_path():
+    """ADVICE r2 (medium): `has(X), first: N @groupby(X)` must apply root
+    pagination before grouping (the reverse-index fast path would bucket
+    the whole tablet)."""
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter("follows: [uid] @reverse .\nname: string .")
+    t = s.new_txn()
+    rdf = []
+    # 6 followers: 4 follow 0x64, 2 follow 0x65
+    for i, tgt in enumerate([0x64, 0x64, 0x64, 0x64, 0x65, 0x65]):
+        rdf.append(f"<0x{i+1:x}> <follows> <0x{tgt:x}> .")
+    t.mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+
+    full = s.query(
+        "{ q(func: has(follows)) @groupby(follows) { count(uid) } }"
+    )["data"]["q"][0]["@groupby"]
+    assert sorted(g["count"] for g in full) == [2, 4]
+
+    # first:2 takes the two lowest-uid followers (both follow 0x64)
+    paged = s.query(
+        "{ q(func: has(follows), first: 2) @groupby(follows) { count(uid) } }"
+    )["data"]["q"][0]["@groupby"]
+    assert [g["count"] for g in paged] == [2]
