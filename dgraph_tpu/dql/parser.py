@@ -295,7 +295,7 @@ def _parse_name_with_lang(p: _P) -> tuple[str, str]:
     name = _strip_angle(p.next().text)
     lang = ""
     if p.peek().text == "@" and (
-        p.toks[p.i + 1].kind == "name" or p.toks[p.i + 1].text == "."
+        p.toks[p.i + 1].kind == "name" or p.toks[p.i + 1].text in (".", "*")
     ):
         # name@en / name@en:fr:. (no whitespace enforced; lexer-level in ref)
         p.next()
@@ -725,7 +725,10 @@ def parse_child(p: _P) -> GraphQuery:
     # lang tag / preference chain (name@en, name@fr:pt:.)
     if (
         p.peek().text == "@"
-        and (p.toks[p.i + 1].kind == "name" or p.toks[p.i + 1].text == ".")
+        and (
+            p.toks[p.i + 1].kind == "name"
+            or p.toks[p.i + 1].text in (".", "*")
+        )
         and p.toks[p.i + 1].text
         not in ("filter", "facets", "cascade", "normalize", "recurse", "groupby")
     ):

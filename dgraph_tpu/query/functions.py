@@ -71,7 +71,17 @@ class FuncRunner:
         return (toks[0], True) if toks else (None, True)
 
     def _value_of(self, attr: str, uid: int, lang: str = "") -> Optional[Val]:
-        return self.cache.value(keys.DataKey(attr, int(uid), self.ns), lang)
+        """Value for function evaluation, honoring @lang semantics (ref
+        worker/task.go langForFunc + posting ValueForTag): on an @lang
+        predicate an untagged lookup matches ONLY the untagged value (no
+        any-language fallback — eq(name, "") must not see name@hi), a
+        tagged lookup matches that tag, '.' prefers untagged then any."""
+        key = keys.DataKey(attr, int(uid), self.ns)
+        su = self._schema(attr)
+        if su is None or not su.lang:
+            return self.cache.value(key, lang)
+        posts = [p for p in self.cache.values(key) if p.is_value]
+        return _pick_lang_val(posts, lang)
 
     def _scan_data_uids(self, attr: str) -> np.ndarray:
         """All entities having attr (full tablet scan; ref has at root
@@ -125,8 +135,11 @@ class FuncRunner:
                 if fn.uid_var in self.uid_vars:
                     uids.extend(int(u) for u in self.uid_vars[fn.uid_var])
                 elif fn.uid_var in self.val_vars:
-                    # uid(value-var): the var's uid key set (ref query.go)
-                    uids.extend(self.val_vars[fn.uid_var].keys())
+                    # uid(value-var): the var's uid key set (ref query.go);
+                    # -1 is the broadcast-scalar sentinel, not a uid
+                    uids.extend(
+                        u for u in self.val_vars[fn.uid_var] if u != -1
+                    )
             out = _as_uids(uids)
             if src is not None:
                 out = np.intersect1d(out, src, assume_unique=True)
@@ -137,6 +150,8 @@ class FuncRunner:
             return self._type(fn, src)
         if name == "has":
             return self._has(fn, src)
+        if fn.val_var and name in ("eq", "le", "lt", "ge", "gt", "between"):
+            return self._val_var_cmp(fn, name, src)
         if name == "eq":
             return self._eq(fn, src)
         if name in ("le", "lt", "ge", "gt"):
@@ -327,6 +342,29 @@ class FuncRunner:
 
     def _has(self, fn: FuncSpec, src) -> np.ndarray:
         attr = fn.attr
+        su = self.st.get(attr)  # None for reverse (~pred) / unknown attrs
+        if su is not None and su.lang:
+            # has(name) on an @lang pred = untagged value present;
+            # has(name@hi) = that tag present; has(name@.) = any value
+            def ok(u: int) -> bool:
+                posts = [
+                    p
+                    for p in self.cache.values(
+                        keys.DataKey(attr, int(u), self.ns)
+                    )
+                    if p.is_value
+                ]
+                if not fn.lang:
+                    return any(p.lang == "" for p in posts)
+                for lang in fn.lang.split(":"):
+                    if lang == "." and posts:
+                        return True
+                    if any(p.lang == lang for p in posts):
+                        return True
+                return False
+
+            cands = src if src is not None else self._scan_data_uids(attr)
+            return _as_uids([int(u) for u in cands if ok(int(u))])
         if src is not None:
             out = [
                 int(u)
@@ -395,6 +433,10 @@ class FuncRunner:
         tok, needs_verify = (None, True)
         if su.directive_index:
             tok, needs_verify = self._eq_tokenizer(su)
+            if su.lang:
+                # index tokens come from every language; the lang (or the
+                # strict-untagged default) is enforced by value re-check
+                needs_verify = True
         for v in vals:
             val = _coerce(v, su.value_type)
             if tok is not None:
@@ -419,12 +461,54 @@ class FuncRunner:
             out = np.intersect1d(out, src, assume_unique=True)
         return out.astype(np.uint64)
 
+    def _val_var_cmp(self, fn: FuncSpec, op: str, src) -> np.ndarray:
+        """eq/ineq against a value variable: gt(val(a), 18) keeps uids
+        whose var value compares true (ref query.go ineq on value vars)."""
+        vmap = self.val_vars.get(fn.val_var, {})
+        if src is not None:
+            cands = [int(u) for u in src]
+        else:
+            cands = [u for u in vmap if u != -1]
+        out = []
+        for u in cands:
+            got = vmap.get(u, vmap.get(-1))
+            if got is None:
+                continue
+            try:
+                if op == "eq":
+                    hit = any(
+                        compare_vals(got, _coerce(a, got.tid)) == 0
+                        for a in fn.args
+                    )
+                elif op == "between":
+                    lo = _coerce(fn.args[0], got.tid)
+                    hi = _coerce(fn.args[1], got.tid)
+                    hit = (
+                        compare_vals(got, lo) >= 0
+                        and compare_vals(got, hi) <= 0
+                    )
+                else:
+                    c = compare_vals(got, _coerce(fn.args[0], got.tid))
+                    hit = (
+                        (op == "le" and c <= 0)
+                        or (op == "lt" and c < 0)
+                        or (op == "ge" and c >= 0)
+                        or (op == "gt" and c > 0)
+                    )
+            except (ValueError, TypeError):
+                continue
+            if hit:
+                out.append(u)
+        return _as_uids(out)
+
     def _compare(self, fn: FuncSpec, op: str, src) -> np.ndarray:
         su = self._schema(fn.attr)
         val = _coerce(fn.args[0], su.value_type)
         # indexed range scan over sortable tokenizer (ref sortWithIndex path)
         sortable = None
-        if su.directive_index:
+        if su.directive_index and not su.lang:
+            # @lang preds take the value-scan path: the index mixes all
+            # languages, so each hit needs a lang-aware value re-check
             for t in su.tokenizer_objs():
                 if t.is_sortable:
                     sortable = t
@@ -535,6 +619,20 @@ class FuncRunner:
                 return EMPTY  # early exit: later lists never load
         if src is not None:
             out = np.intersect1d(out, src, assume_unique=True)
+        if su.lang:
+            # lang-aware re-check: the index matched tokens from any
+            # language; re-tokenize the value in the requested lang
+            want = set(toks)
+            verified = []
+            for u in out:
+                got = self._value_of(fn.attr, int(u), fn.lang)
+                if got is None:
+                    continue
+                have = set(build_tokens(got, [tok], lang=fn.lang or ""))
+                hit = want <= have if require_all else bool(want & have)
+                if hit:
+                    verified.append(int(u))
+            out = _as_uids(verified)
         return out.astype(np.uint64)
 
     def _regexp(self, fn: FuncSpec, src) -> np.ndarray:
@@ -731,6 +829,29 @@ def _coerce(arg, tid: TypeID) -> Val:
     if tid not in (TypeID.DEFAULT,) and v.tid != tid:
         return convert(v, tid)
     return v
+
+
+def _pick_lang_val(posts, chain: str):
+    """Language-preference value pick for @lang predicates (ref dql lang
+    list semantics): '' = untagged only, 'en:fr' = first tag with a value,
+    '.' = untagged else any."""
+    if not chain:
+        for p in posts:
+            if p.lang == "":
+                return p.val()
+        return None
+    for lang in chain.split(":"):
+        if lang == ".":
+            for p in posts:
+                if p.lang == "":
+                    return p.val()
+            if posts:
+                return posts[0].val()
+            continue
+        for p in posts:
+            if p.lang == lang:
+                return p.val()
+    return None
 
 
 def _val_eq(got: Optional[Val], want: Val) -> bool:

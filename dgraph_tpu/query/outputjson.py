@@ -21,7 +21,10 @@ from dgraph_tpu.types.types import TypeID, Val
 def _json_val(v: Val) -> Any:
     x = v.value
     if isinstance(x, _dt.datetime):
-        return x.isoformat()
+        # RFC3339 like the reference (outputnode.go -> time.Time.MarshalJSON):
+        # naive datetimes are UTC and print with the Z suffix
+        s = x.isoformat()
+        return s + "Z" if x.tzinfo is None else s.replace("+00:00", "Z")
     if v.tid == TypeID.VFLOAT:
         return [float(f) for f in x]
     if isinstance(x, bytes):
@@ -84,6 +87,14 @@ class JsonEncoder:
         # (ref outputnode: aggregations emit their own fastJson nodes)
         for c in node.children:
             if c.gq.aggregator:
+                if c.math_vals:
+                    # computed by the executor (same-level scalar at -1;
+                    # per-parent values are emitted inside each entity)
+                    if -1 in c.math_vals:
+                        out.append(
+                            {_display_name(c): _json_val(c.math_vals[-1])}
+                        )
+                    continue
                 vals = self.val_vars.get(c.gq.val_var, {})
                 xs = [
                     vals[int(u)]
@@ -156,9 +167,12 @@ class JsonEncoder:
                 if g:
                     obj[name] = [{"@groupby": g}]
             elif gq.aggregator:
-                continue  # emitted at list level
+                if uid in c.math_vals:  # per-parent aggregate
+                    obj[name] = _json_val(c.math_vals[uid])
+                continue  # scalar aggregates emit at list level
             elif gq.val_var and not gq.aggregator:
-                v = self.val_vars.get(gq.val_var, {}).get(uid)
+                vals = self.val_vars.get(gq.val_var, {})
+                v = vals.get(uid, vals.get(-1))
                 if v is not None:
                     obj[name] = _json_val(v)
             elif gq.is_count:
@@ -202,6 +216,14 @@ class JsonEncoder:
                         kids.append(kid)
                 if kids:
                     obj[name] = kids
+            elif gq.lang == "*":
+                # name@* fans out one field per language; untagged value
+                # keeps the bare name (ref outputnode langs handling)
+                posts = c.values.get(uid)
+                base = gq.alias or gq.attr
+                for p in posts or []:
+                    key = f"{base}@{p.lang}" if p.lang else base
+                    obj[key] = _json_val(p.val())
             else:
                 posts = c.values.get(uid)
                 if posts:

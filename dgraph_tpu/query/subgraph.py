@@ -46,6 +46,12 @@ class ExecNode:
     is_uid_pred: bool = False
     math_vals: Dict[int, Val] = field(default_factory=dict)
     groups: Dict[int, List[dict]] = field(default_factory=dict)
+    # value-variable levels (ref query.go variable propagation): vars whose
+    # maps are keyed by THIS node's dest_uids, and ancestor-level vars
+    # propagated down to this level (summed over all paths)
+    own_vars: set = field(default_factory=set)
+    level_vars: Dict[str, Dict[int, Val]] = field(default_factory=dict)
+    parent_node: Optional["ExecNode"] = None
 
 
 class Executor:
@@ -71,7 +77,10 @@ class Executor:
         # ACL-readable predicates (ref expand filtering in edgraph auth)
         self.allowed_preds = allowed_preds
         self.uid_vars: Dict[str, np.ndarray] = {}
+        # value vars; scalar (block-wide) vars broadcast via key -1
         self.val_vars: Dict[str, Dict[int, Val]] = {}
+        # where each value var is keyed (for per-parent aggregation)
+        self.var_def_node: Dict[str, ExecNode] = {}
 
     def _runner(self) -> FuncRunner:
         return FuncRunner(
@@ -346,6 +355,15 @@ class Executor:
         if gq.var_name:
             self.uid_vars[gq.var_name] = node.dest_uids
 
+        # `f as count(uid)`: the block's row count as a broadcast scalar
+        # var (ref query.go count-uid var; math(f) sees the constant)
+        if not gq.groupby_attrs:
+            for c in gq.children:
+                if c.is_count and c.attr == "uid" and c.var_name:
+                    self.val_vars[c.var_name] = {
+                        -1: Val(TypeID.INT, int(len(node.dest_uids)))
+                    }
+
         if gq.groupby_attrs:
             # root-level @groupby: group the block's own result set
             # (ref query/groupby.go processGroupBy on the root SubGraph)
@@ -399,24 +417,73 @@ class Executor:
         gqs = list(node.gq.children)
         # expand(_all_)/expand(Type) -> concrete children (ref query.go:2038)
         gqs = self._resolve_expand(gqs, node.dest_uids)
+        # two phases, preserving output order: structural children (and
+        # their subtrees) first so sibling math/aggregate nodes can consume
+        # vars defined anywhere below (ref query.go dependency execution)
+        made: Dict[int, ExecNode] = {}
+        deferred = []
         for cgq in gqs:
+            if cgq.math_expr is not None or (cgq.aggregator and cgq.val_var):
+                deferred.append(cgq)
+                continue
             cnode = self._make_child(node, cgq)
             if cnode is None:
                 continue
-            node.children.append(cnode)
+            made[id(cgq)] = cnode
             if cnode.is_uid_pred and len(cnode.dest_uids) and cgq.children:
+                self._propagate_level_vars(node, cnode)
                 self._expand_children(cnode, depth + 1)
+        for cgq in deferred:
+            cnode = self._make_child(node, cgq)
+            if cnode is not None:
+                made[id(cgq)] = cnode
+        node.children.extend(
+            made[id(g)] for g in gqs if id(g) in made
+        )
+
+    def _propagate_level_vars(self, node: ExecNode, cnode: ExecNode):
+        """Push value vars available at `node`'s level one hop down into
+        `cnode`'s level, summing over all parent paths (ref query.go
+        variable propagation: a var used deeper than its definition takes
+        the path-sum of ancestor values)."""
+        avail: Dict[str, Dict[int, Val]] = dict(node.level_vars)
+        for v in node.own_vars:
+            if v in self.val_vars:
+                avail[v] = self.val_vars[v]
+        if not avail:
+            return
+        src_idx = {int(u): i for i, u in enumerate(node.dest_uids)}
+        for v, vmap in avail.items():
+            prop: Dict[int, float] = {}
+            for p, i in src_idx.items():
+                pv = vmap.get(p)
+                if pv is None or i >= len(cnode.uid_matrix):
+                    continue
+                x = pv.value
+                if isinstance(x, bool) or not isinstance(x, (int, float)):
+                    continue
+                for d in cnode.uid_matrix[i]:
+                    prop[int(d)] = prop.get(int(d), 0) + x
+            cnode.level_vars[v] = {
+                u: Val(
+                    TypeID.INT if isinstance(x, int) else TypeID.FLOAT, x
+                )
+                for u, x in prop.items()
+            }
 
     def _make_child(self, parent: ExecNode, cgq: GraphQuery) -> Optional[ExecNode]:
         attr = cgq.attr
         if cgq.math_expr is not None:
             return self._make_math_child(parent, cgq)
+        if cgq.aggregator and cgq.val_var:
+            return self._make_agg_child(parent, cgq)
         if cgq.is_uid or cgq.aggregator or cgq.val_var or (cgq.is_count and attr == "uid"):
             return ExecNode(gq=cgq, attr=attr, src_uids=parent.dest_uids)
 
         reverse = attr.startswith("~")
         su = self.st.get(attr[1:] if reverse else attr)
         cnode = ExecNode(gq=cgq, attr=attr, src_uids=parent.dest_uids)
+        cnode.parent_node = parent
         if su is not None and (su.value_type == TypeID.UID or reverse):
             if reverse and not su.directive_reverse:
                 raise QueryError(f"predicate {attr[1:]!r} has no @reverse index")
@@ -460,14 +527,26 @@ class Executor:
                     for u, r in zip(parent.dest_uids, cnode.uid_matrix)
                 }
             if cgq.var_name:
-                self.uid_vars[cgq.var_name] = cnode.dest_uids
+                if cgq.is_count:
+                    # `c as count(follow)`: a VALUE var keyed by the parent
+                    # (ref query.go count-var binding)
+                    self.val_vars[cgq.var_name] = {
+                        u: Val(TypeID.INT, c)
+                        for u, c in cnode.counts.items()
+                    }
+                    parent.own_vars.add(cgq.var_name)
+                    self.var_def_node[cgq.var_name] = parent
+                else:
+                    self.uid_vars[cgq.var_name] = cnode.dest_uids
         else:
             if attr.startswith("~"):
                 raise QueryError(f"reverse on non-uid predicate {attr[1:]!r}")
             # value predicate: fetch postings per parent uid
             for u in parent.dest_uids:
                 posts = self.cache.values(keys.DataKey(attr, int(u), self.ns))
-                if cgq.lang:
+                if cgq.lang == "*":
+                    pass  # @* keeps every language; encoder fans out fields
+                elif cgq.lang:
                     posts = _pick_lang(posts, cgq.lang)
                 elif su is not None and su.lang:
                     # untagged read on an @lang predicate returns only the
@@ -484,7 +563,76 @@ class Executor:
                 self.val_vars[cgq.var_name] = {
                     u: ps[0].val() for u, ps in cnode.values.items()
                 }
+                parent.own_vars.add(cgq.var_name)
+                self.var_def_node[cgq.var_name] = parent
         return cnode
+
+    def _make_agg_child(self, parent: ExecNode, cgq: GraphQuery) -> ExecNode:
+        """`n as min(val(x))`: aggregate a value var (ref query.go
+        valueVarAggregation). If x is keyed at this node's own level the
+        result is one block-wide scalar (broadcast via key -1); if x lives
+        in a descendant subtree, aggregate per parent uid over the uids
+        reachable from that parent at x's level."""
+        cnode = ExecNode(gq=cgq, attr=cgq.aggregator, src_uids=parent.dest_uids)
+        var = cgq.val_var
+        vmap = self.val_vars.get(var, {})
+        dnode = self.var_def_node.get(var)
+        out: Dict[int, Val] = {}
+        if dnode is None or dnode is parent:
+            xs = [
+                vmap[int(u)] for u in parent.dest_uids if int(u) in vmap
+            ]
+            agg = _agg_vals(cgq.aggregator, xs)
+            if agg is not None:
+                out[-1] = agg
+        else:
+            chain = self._node_chain(parent, dnode)
+            if chain is None:
+                # var from an unrelated subtree: aggregate the whole map
+                xs = list(vmap.values())
+                agg = _agg_vals(cgq.aggregator, xs)
+                if agg is not None:
+                    out[-1] = agg
+            else:
+                hop_idx = [
+                    {int(u): j for j, u in enumerate(h.src_uids)}
+                    for h in chain
+                ]
+                for p in parent.dest_uids:
+                    uids = {int(p)}
+                    for h, idx in zip(chain, hop_idx):
+                        nxt: set = set()
+                        for u in uids:
+                            j = idx.get(u)
+                            if j is not None and j < len(h.uid_matrix):
+                                nxt.update(int(x) for x in h.uid_matrix[j])
+                        uids = nxt
+                    xs = [vmap[u] for u in uids if u in vmap]
+                    agg = _agg_vals(cgq.aggregator, xs)
+                    if agg is not None:
+                        out[int(p)] = agg
+        cnode.math_vals = out
+        if cgq.var_name:
+            self.val_vars[cgq.var_name] = out
+            parent.own_vars.add(cgq.var_name)
+            self.var_def_node[cgq.var_name] = parent
+        return cnode
+
+    def _node_chain(
+        self, ancestor: ExecNode, dnode: ExecNode
+    ) -> Optional[List[ExecNode]]:
+        """uid-pred hops from `ancestor` down to `dnode` (inclusive),
+        via parent_node links; None if dnode isn't below ancestor."""
+        chain: List[ExecNode] = []
+        n = dnode
+        while n is not None and n is not ancestor:
+            if n.is_uid_pred:
+                chain.append(n)
+            n = n.parent_node
+        if n is None:
+            return None
+        chain.reverse()
+        return chain
 
     def _make_math_child(self, parent: ExecNode, cgq: GraphQuery) -> ExecNode:
         """math(...) over value vars, per parent uid (ref query/math.go)."""
@@ -502,7 +650,14 @@ class Executor:
             env = {}
             ok = True
             for v in needed:
-                val = self.val_vars.get(v, {}).get(int(u))
+                vmap = self.val_vars.get(v, {})
+                val = vmap.get(int(u))
+                if val is None:
+                    # ancestor-level var propagated down (path-summed),
+                    # then block-wide scalars (key -1)
+                    val = parent.level_vars.get(v, {}).get(int(u))
+                if val is None:
+                    val = vmap.get(-1)
                 if val is None:
                     ok = False
                     break
@@ -516,6 +671,8 @@ class Executor:
         cnode.math_vals = out
         if cgq.var_name:
             self.val_vars[cgq.var_name] = out
+            parent.own_vars.add(cgq.var_name)
+            self.var_def_node[cgq.var_name] = parent
         return cnode
 
     def _group_children(self, cgq: GraphQuery, cnode: ExecNode, parent: ExecNode):
@@ -738,6 +895,8 @@ class Executor:
                     fv = fmap.get(int(u), {}).get(fname)
                     if fv is not None:
                         vals[int(u)] = fv
+            cnode.own_vars.add(var)
+            self.var_def_node[var] = cnode
 
     def _resolve_expand(
         self, gqs: List[GraphQuery], uids: np.ndarray
@@ -1105,11 +1264,39 @@ def _paginate(uids: np.ndarray, first, offset, after) -> np.ndarray:
     return uids
 
 
+def _agg_vals(op: str, xs: List[Val]) -> Optional[Val]:
+    """min/max/sum/avg over value-var Vals (ref query.go aggregations)."""
+    if not xs:
+        return None
+    if op == "min":
+        return min(xs, key=_sort_key_of)
+    if op == "max":
+        return max(xs, key=_sort_key_of)
+    nums = [
+        x.value
+        for x in xs
+        if isinstance(x.value, (int, float)) and not isinstance(x.value, bool)
+    ]
+    if not nums:
+        return None
+    if op == "sum":
+        t = sum(nums)
+        return Val(TypeID.INT if isinstance(t, int) else TypeID.FLOAT, t)
+    if op == "avg":
+        return Val(TypeID.FLOAT, sum(nums) / len(nums))
+    return None
+
+
 def _pick_lang(posts: List[Posting], chain: str) -> List[Posting]:
     """Language preference list: name@en:fr:. — first language in the chain
     with values wins; '.' accepts any (ref dql lang list semantics)."""
     for lang in chain.split(":"):
         if lang == ".":
+            # '.' prefers the untagged value, then any language
+            # (ref TestFilterHas golden: lossy@. -> "Badger")
+            untagged = [p for p in posts if p.lang == ""]
+            if untagged:
+                return untagged[:1]
             if posts:
                 return posts[:1]
             continue

@@ -52,8 +52,9 @@ _ID2NAME = {v: k for k, v in _NAMES.items()}
 
 
 def type_from_name(name: str) -> TypeID:
+    # case-insensitive: the reference schema spells both dateTime/datetime
     try:
-        return _NAMES[name]
+        return _NAMES[name.lower()]
     except KeyError:
         raise ValueError(f"unknown type name {name!r}") from None
 
@@ -208,7 +209,8 @@ def convert(v: Val, to: TypeID) -> Val:
             if src == TypeID.BINARY:
                 return Val(to, np.frombuffer(x, dtype=np.float32).copy())
         if to == TypeID.GEO and src in (TypeID.STRING, TypeID.DEFAULT):
-            return Val(to, json.loads(str(x)))
+            # single quotes tolerated like ref types/conversion.go:213
+            return Val(to, json.loads(str(x).replace("'", '"')))
         if to == TypeID.PASSWORD and src in (TypeID.STRING, TypeID.DEFAULT):
             # plaintext is hashed at ingest (ref types/conversion.go:220
             # StringID->PasswordID bcrypt): stored form = hex(salt||PBKDF2)

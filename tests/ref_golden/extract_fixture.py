@@ -1,0 +1,101 @@
+"""Extract the reference query-suite dataset into checked-in data files.
+
+The reference's query tests run against a fixed fixture defined in
+/root/reference/query/common_test.go (testSchema + populateCluster): a
+self-contained ~700-triple graph whose golden answers appear in
+query0..4_test.go et al. This script mechanically extracts that fixture —
+the schema string, every addTriplesToCluster block, the geo helper calls,
+and the regex-pattern loop — into:
+
+    tests/ref_golden/schema.txt   (DQL schema, verbatim)
+    tests/ref_golden/triples.rdf  (N-Quads, verbatim + synthesized geo/regex)
+
+Run from the repo root:  python tests/ref_golden/extract_fixture.py
+Both outputs are checked in so the conformance suite is self-contained.
+"""
+
+import os
+import re
+
+REF = "/root/reference/query/common_test.go"
+OUT_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    src = open(REF, encoding="utf-8").read()
+
+    # -- schema ---------------------------------------------------------------
+    m = re.search(r"var testSchema = `(.*?)`", src, re.S)
+    schema = m.group(1)
+
+    # -- raw triples blocks ---------------------------------------------------
+    blocks = re.findall(r"addTriplesToCluster\(`(.*?)`\)", src, re.S)
+    # skip the per-pattern loop template (contains %d/%s placeholders)
+    blocks = [b for b in blocks if "%d" not in b]
+
+    out = []
+    for b in blocks:
+        out.append(b)
+
+    # -- geo helpers (addGeoPointToCluster etc.) ------------------------------
+    for mm in re.finditer(
+        r'addGeoPointToCluster\((\d+),\s*"(\w+)",\s*\[\]float64\{([^}]*)\}\)', src
+    ):
+        uid, pred, coords = mm.group(1), mm.group(2), mm.group(3)
+        out.append(
+            f"<{uid}> <{pred}> \"{{'type':'Point', 'coordinates':[{coords}]}}\"^^<geo:geojson> ."
+        )
+
+    def fmt_ring(ring_src):
+        pts = re.findall(r"\{([-\d.]+),\s*([-\d.]+)\}", ring_src)
+        return "[" + ",".join(f"[{x}, {y}]" for x, y in pts) + "]"
+
+    for mm in re.finditer(
+        r'addGeoPolygonToCluster\((\d+),\s*"(\w+)",\s*\[\]\[\]\[\]float64\{\s*\{(.*?)\}\s*,?\s*\}\)\)',
+        src,
+        re.S,
+    ):
+        uid, pred, body = mm.group(1), mm.group(2), mm.group(3)
+        coords = "[" + fmt_ring(body) + "]"
+        out.append(
+            f"<{uid}> <{pred}> \"{{'type':'Polygon', 'coordinates': {coords}}}\"^^<geo:geojson> ."
+        )
+
+    mm = re.search(
+        r"addGeoMultiPolygonToCluster\((\d+),\s*\[\]\[\]\[\]\[\]float64\{(.*?)\}\)\)\s*\n",
+        src,
+        re.S,
+    )
+    if mm:
+        uid, body = mm.group(1), mm.group(2)
+        polys = []
+        for poly_src in re.findall(r"\{\{\{(.*?)\}\}\}", src[mm.start() : mm.end()], re.S):
+            polys.append("[" + fmt_ring(poly_src) + "]")
+        coords = "[" + ",".join(polys) + "]"
+        out.append(
+            f"<{uid}> <geometry> \"{{'type':'MultiPolygon', 'coordinates': {coords}}}\"^^<geo:geojson> ."
+        )
+
+    # -- regex pattern loop ---------------------------------------------------
+    mm = re.search(r"patterns := \[\]string\{(.*?)\}", src, re.S)
+    patterns = re.findall(r'"([^"]+)"', mm.group(1))
+    next_id = 0x2000
+    for p in patterns:
+        out.append(f'<{next_id}> <value> "{p}" .')
+        out.append(f"<0x1234> <pattern> <{next_id}> .")
+        next_id += 1
+
+    with open(os.path.join(OUT_DIR, "schema.txt"), "w", encoding="utf-8") as f:
+        f.write(schema.strip() + "\n")
+    with open(os.path.join(OUT_DIR, "triples.rdf"), "w", encoding="utf-8") as f:
+        f.write("\n".join(out) + "\n")
+    n = sum(
+        1
+        for ln in "\n".join(out).splitlines()
+        if ln.strip() and not ln.strip().startswith("#")
+    )
+    print(f"schema.txt + triples.rdf written ({n} triples)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,176 @@
+"""Extract golden (query, expected-JSON) cases from the reference query suites.
+
+The reference asserts ~600 golden answers over the common_test.go fixture in
+/root/reference/query/query{0..4}_test.go, query_facets_test.go, etc., in a
+mechanical shape:
+
+    query := `...`
+    js := processQueryNoErr(t, query)
+    require.JSONEq(t, `{"data": {...}}`, js)
+
+This script walks those files and extracts every such triple into
+tests/ref_golden/cases.json. Functions that mutate shared cluster state
+(addTriplesToCluster / setSchema / dropPredicate / deleteTriplesInCluster)
+are excluded — their goldens depend on in-test mutations, not the fixture.
+Sprintf-built queries and var-based queries are skipped (not extractable
+statically).
+
+Run from the repo root:  python tests/ref_golden/extract_goldens.py
+cases.json is checked in so the conformance suite is self-contained.
+"""
+
+import json
+import os
+import re
+
+REF_DIR = "/root/reference/query"
+FILES = [
+    "query0_test.go",
+    "query1_test.go",
+    "query2_test.go",
+    "query3_test.go",
+    "query4_test.go",
+    "query_facets_test.go",
+    "math_test.go",
+]
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cases.json")
+
+MUTATORS = (
+    "addTriplesToCluster",
+    "deleteTriplesInCluster",
+    "setSchema",
+    "dropPredicate",
+    "addGeoPointToCluster",
+    "addGeoPolygonToCluster",
+    "client.Alter",
+    "txn.Mutate",
+)
+
+
+def split_functions(src):
+    """Yield (name, body) for each top-level test func."""
+    for m in re.finditer(r"func (Test\w+)\(t \*testing\.T\) \{", src):
+        start = m.end()
+        depth = 1
+        i = start
+        in_raw = False
+        in_str = False
+        while i < len(src) and depth:
+            c = src[i]
+            if in_raw:
+                if c == "`":
+                    in_raw = False
+            elif in_str:
+                if c == "\\":
+                    i += 1
+                elif c == '"':
+                    in_str = False
+            elif c == "`":
+                in_raw = True
+            elif c == '"':
+                in_str = True
+            elif c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+            i += 1
+        yield m.group(1), src[start : i - 1]
+
+
+# one statement shapes we recognize (raw strings only — Sprintf etc. skipped)
+RE_ASSIGN = re.compile(r"(\w+)\s*:?=\s*`", re.S)
+RE_EXEC = re.compile(r"(\w+)\s*:?=\s*processQueryNoErr\(t,\s*(\w+)\)")
+RE_JSONEQ = re.compile(r"require\.JSONEq\(t,\s*", re.S)
+
+
+def read_raw(src, i):
+    """src[i] == '`' — return (string content, index after closing tick)."""
+    j = src.index("`", i + 1)
+    return src[i + 1 : j], j + 1
+
+
+def extract_from_body(name, body, fname):
+    cases = []
+    svars = {}  # var name -> raw string value
+    jsvars = {}  # js var name -> query text it holds results of
+    i = 0
+    n = len(body)
+    k = 0
+    while i < n:
+        # next interesting token
+        m_assign = RE_ASSIGN.search(body, i)
+        m_exec = RE_EXEC.search(body, i)
+        m_eq = RE_JSONEQ.search(body, i)
+        starts = [
+            (m.start(), kind, m)
+            for kind, m in (("assign", m_assign), ("exec", m_exec), ("eq", m_eq))
+            if m
+        ]
+        if not starts:
+            break
+        starts.sort()
+        _, kind, m = starts[0]
+        if kind == "assign":
+            raw, after = read_raw(body, body.index("`", m.start()))
+            svars[m.group(1)] = raw
+            i = after
+        elif kind == "exec":
+            jsvars[m.group(1)] = svars.get(m.group(2))
+            i = m.end()
+        else:  # require.JSONEq(t, <expected>, <jsvar>)
+            j = m.end()
+            # expected: raw string, quoted string, or a var naming one
+            if body[j] == "`":
+                expected, after = read_raw(body, j)
+            elif body[j] == '"':
+                # quoted Go string — decode escapes via json tricks
+                mm = re.match(r'"((?:[^"\\]|\\.)*)"', body[j:])
+                if not mm:
+                    i = j
+                    continue
+                expected = json.loads('"' + mm.group(1) + '"')
+                after = j + mm.end()
+            else:
+                mm = re.match(r"(\w+)", body[j:])
+                expected = svars.get(mm.group(1)) if mm else None
+                after = j + (mm.end() if mm else 0)
+            if expected is None:
+                i = after
+                continue
+            # the actual arg after expected
+            mm = re.match(r"\s*,\s*(\w+)\s*\)", body[after:])
+            i = after
+            if not mm:
+                continue
+            qtext = jsvars.get(mm.group(1))
+            if qtext is None:
+                continue
+            cases.append(
+                {
+                    "id": f"{name}/{k}",
+                    "file": fname,
+                    "query": qtext,
+                    "expected": expected,
+                }
+            )
+            k += 1
+    return cases
+
+
+def main():
+    all_cases = []
+    skipped_mutating = 0
+    for fname in FILES:
+        src = open(os.path.join(REF_DIR, fname), encoding="utf-8").read()
+        for name, body in split_functions(src):
+            if any(mu in body for mu in MUTATORS):
+                skipped_mutating += 1
+                continue
+            all_cases.extend(extract_from_body(name, body, fname))
+    with open(OUT, "w", encoding="utf-8") as f:
+        json.dump(all_cases, f, indent=1)
+    print(f"{len(all_cases)} cases extracted; {skipped_mutating} mutating funcs skipped")
+
+
+if __name__ == "__main__":
+    main()
