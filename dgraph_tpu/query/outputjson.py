@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from dgraph_tpu.query.subgraph import ExecNode
+from dgraph_tpu.query.subgraph import MAXUID, ExecNode
 from dgraph_tpu.types.types import TypeID, Val
 
 
@@ -52,6 +52,8 @@ def _display_name(c: ExecNode) -> str:
         return f"{gq.aggregator}(val({gq.val_var}))"
     if gq.val_var and not gq.aggregator:
         return f"val({gq.val_var})"
+    if gq.checkpwd_val is not None:
+        return f"checkpwd({gq.attr})"
     if gq.is_count:
         return "count" if gq.attr == "uid" else f"count({gq.attr})"
     name = gq.attr
@@ -87,21 +89,15 @@ class JsonEncoder:
         # (ref outputnode: aggregations emit their own fastJson nodes)
         for c in node.children:
             if c.gq.aggregator:
-                if c.math_vals:
-                    # computed by the executor (same-level scalar at -1;
-                    # per-parent values are emitted inside each entity)
-                    if -1 in c.math_vals:
-                        out.append(
-                            {_display_name(c): _json_val(c.math_vals[-1])}
-                        )
-                    continue
-                vals = self.val_vars.get(c.gq.val_var, {})
-                xs = [
-                    vals[int(u)]
-                    for u in node.dest_uids
-                    if int(u) in vals
-                ]
-                out.append({_display_name(c): _aggregate(c.gq.aggregator, xs)})
+                # scalar aggregates (computed by the executor) emit one
+                # standalone object — null when over no values (ref
+                # TestAggregateEmptyData golden)
+                if getattr(c, "agg_scalar", False):
+                    v = c.math_vals.get(MAXUID)
+                    out.append(
+                        {_display_name(c): None if v is None else _json_val(v)}
+                    )
+                continue  # per-parent aggregates emit inside entities
             elif c.gq.is_count and c.gq.attr == "uid":
                 out.append({_display_name(c): int(len(node.dest_uids))})
 
@@ -158,6 +154,10 @@ class JsonEncoder:
             gq = c.gq
             if gq.is_uid:
                 obj[name] = encode_uid(uid)
+            elif gq.checkpwd_val is not None:
+                v = c.math_vals.get(uid)
+                if v is not None:
+                    obj[name] = bool(v.value)
             elif gq.math_expr is not None:
                 v = c.math_vals.get(uid)
                 if v is not None:
@@ -172,7 +172,7 @@ class JsonEncoder:
                 continue  # scalar aggregates emit at list level
             elif gq.val_var and not gq.aggregator:
                 vals = self.val_vars.get(gq.val_var, {})
-                v = vals.get(uid, vals.get(-1))
+                v = vals.get(uid, vals.get(MAXUID))
                 if v is not None:
                     obj[name] = _json_val(v)
             elif gq.is_count:

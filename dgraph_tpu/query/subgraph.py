@@ -23,7 +23,13 @@ from dgraph_tpu.dql.parser import FilterTree, GraphQuery, Order
 from dgraph_tpu.posting.lists import LocalCache
 from dgraph_tpu.posting.pl import Posting
 from dgraph_tpu.query.dispatch import DISPATCHER
-from dgraph_tpu.query.functions import EMPTY, FuncRunner, QueryError, _as_uids
+from dgraph_tpu.query.functions import (
+    EMPTY,
+    MAXUID,
+    FuncRunner,
+    QueryError,
+    _as_uids,
+)
 from dgraph_tpu.schema.schema import State
 from dgraph_tpu.types.types import TypeID, Val, compare_vals, convert
 from dgraph_tpu.x import keys
@@ -77,7 +83,8 @@ class Executor:
         # ACL-readable predicates (ref expand filtering in edgraph auth)
         self.allowed_preds = allowed_preds
         self.uid_vars: Dict[str, np.ndarray] = {}
-        # value vars; scalar (block-wide) vars broadcast via key -1
+        # value vars; scalar (block-wide) vars broadcast via key MAXUID
+        # (ref query.go:1593 count-var stored at math.MaxUint64)
         self.val_vars: Dict[str, Dict[int, Val]] = {}
         # where each value var is keyed (for per-parent aggregation)
         self.var_def_node: Dict[str, ExecNode] = {}
@@ -166,6 +173,7 @@ class Executor:
                 deps.add(g.shortest_to[1])
             if g.var_name:
                 defined.add(g.var_name)
+            defined.update(g.facet_vars.keys())
             for c in g.children:
                 walk(c)
 
@@ -188,7 +196,11 @@ class Executor:
 
         runner = self._runner()
         if gq.func is None:
-            raise QueryError(f"block {gq.attr!r} missing func")
+            # func-less block: `me() { sum(val(a)) }` — aggregate-root /
+            # math-only blocks operate on var maps with no uid set
+            # (ref query.go Params.IsEmpty aggregate-root handling)
+            node = ExecNode(gq=gq, attr=gq.attr, dest_uids=EMPTY)
+            return self._finish_block(gq, node, skip_order=True)
         if gq.func.name == "eq" and gq.func.val_var:
             # eq(val(x), v): keep uids whose var value == arg
             want = gq.func.args[0]
@@ -361,7 +373,7 @@ class Executor:
             for c in gq.children:
                 if c.is_count and c.attr == "uid" and c.var_name:
                     self.val_vars[c.var_name] = {
-                        -1: Val(TypeID.INT, int(len(node.dest_uids)))
+                        MAXUID: Val(TypeID.INT, int(len(node.dest_uids)))
                     }
 
         if gq.groupby_attrs:
@@ -477,7 +489,13 @@ class Executor:
             return self._make_math_child(parent, cgq)
         if cgq.aggregator and cgq.val_var:
             return self._make_agg_child(parent, cgq)
+        if cgq.checkpwd_val is not None:
+            return self._make_checkpwd_child(parent, cgq)
         if cgq.is_uid or cgq.aggregator or cgq.val_var or (cgq.is_count and attr == "uid"):
+            if cgq.is_uid and cgq.var_name:
+                # `f as uid`: bind the enclosing level's uids as a uid var
+                # (ref query.go uid-var on the uid leaf)
+                self.uid_vars[cgq.var_name] = parent.dest_uids
             return ExecNode(gq=cgq, attr=attr, src_uids=parent.dest_uids)
 
         reverse = attr.startswith("~")
@@ -567,10 +585,33 @@ class Executor:
                 self.var_def_node[cgq.var_name] = parent
         return cnode
 
+    def _make_checkpwd_child(self, parent: ExecNode, cgq: GraphQuery) -> ExecNode:
+        """checkpwd(pred, "pw") selection field -> per-uid boolean
+        (ref query.go checkpwd emission)."""
+        from dgraph_tpu.acl.acl import _hash_password
+
+        import hmac as _hmac
+
+        cnode = ExecNode(gq=cgq, attr=cgq.attr, src_uids=parent.dest_uids)
+        for u in parent.dest_uids:
+            got = self.cache.value(keys.DataKey(cgq.attr, int(u), self.ns))
+            ok = False
+            if got is not None:
+                try:
+                    raw = bytes.fromhex(str(got.value))
+                    salt, want = raw[:16], raw[16:]
+                    ok = _hmac.compare_digest(
+                        _hash_password(cgq.checkpwd_val, salt), want
+                    )
+                except ValueError:
+                    ok = False
+            cnode.math_vals[int(u)] = Val(TypeID.BOOL, ok)
+        return cnode
+
     def _make_agg_child(self, parent: ExecNode, cgq: GraphQuery) -> ExecNode:
         """`n as min(val(x))`: aggregate a value var (ref query.go
         valueVarAggregation). If x is keyed at this node's own level the
-        result is one block-wide scalar (broadcast via key -1); if x lives
+        result is one block-wide scalar (broadcast via key MAXUID); if x lives
         in a descendant subtree, aggregate per parent uid over the uids
         reachable from that parent at x's level."""
         cnode = ExecNode(gq=cgq, attr=cgq.aggregator, src_uids=parent.dest_uids)
@@ -579,20 +620,26 @@ class Executor:
         dnode = self.var_def_node.get(var)
         out: Dict[int, Val] = {}
         if dnode is None or dnode is parent:
-            xs = [
-                vmap[int(u)] for u in parent.dest_uids if int(u) in vmap
-            ]
+            if len(parent.dest_uids):
+                xs = [
+                    vmap[int(u)] for u in parent.dest_uids if int(u) in vmap
+                ]
+            else:
+                # aggregate-root (`me() { sum(val(a)) }`): the whole map
+                xs = [v for u, v in vmap.items() if u != MAXUID]
             agg = _agg_vals(cgq.aggregator, xs)
+            cnode.agg_scalar = True  # type: ignore[attr-defined]
             if agg is not None:
-                out[-1] = agg
+                out[MAXUID] = agg
         else:
             chain = self._node_chain(parent, dnode)
             if chain is None:
                 # var from an unrelated subtree: aggregate the whole map
                 xs = list(vmap.values())
                 agg = _agg_vals(cgq.aggregator, xs)
+                cnode.agg_scalar = True  # type: ignore[attr-defined]
                 if agg is not None:
-                    out[-1] = agg
+                    out[MAXUID] = agg
             else:
                 hop_idx = [
                     {int(u): j for j, u in enumerate(h.src_uids)}
@@ -657,7 +704,7 @@ class Executor:
                     # then block-wide scalars (key -1)
                     val = parent.level_vars.get(v, {}).get(int(u))
                 if val is None:
-                    val = vmap.get(-1)
+                    val = vmap.get(MAXUID)
                 if val is None:
                     ok = False
                     break
@@ -835,38 +882,13 @@ class Executor:
             fmaps.append(fmap)
             row = cnode.uid_matrix[i] if i < len(cnode.uid_matrix) else EMPTY
             if cgq.facet_filter is not None:
-                ff = cgq.facet_filter
-                keep = []
-                for u in row:
-                    fv = fmap.get(int(u), {}).get(ff.attr)
-                    if fv is None:
-                        continue
-                    if ff.name in ("allofterms", "anyofterms"):
-                        from dgraph_tpu.tok.tok import _normalize, _word_re
-
-                        have = set(_word_re.findall(_normalize(str(fv.value))))
-                        want_terms = set(
-                            _word_re.findall(_normalize(str(ff.args[0])))
-                        )
-                        ok = (
-                            want_terms <= have
-                            if ff.name == "allofterms"
-                            else bool(want_terms & have)
-                        )
-                        if ok:
-                            keep.append(int(u))
-                        continue
-                    try:
-                        want = _coerce(ff.args[0], fv.tid)
-                        c = compare_vals(convert(fv, want.tid), want)
-                    except (ValueError, TypeError):
-                        continue
-                    ok = {
-                        "eq": c == 0, "le": c <= 0, "lt": c < 0,
-                        "ge": c >= 0, "gt": c > 0,
-                    }.get(ff.name, False)
-                    if ok:
-                        keep.append(int(u))
+                keep = [
+                    int(u)
+                    for u in row
+                    if _facet_tree_match(
+                        cgq.facet_filter, fmap.get(int(u), {})
+                    )
+                ]
                 row = np.array(keep, dtype=np.uint64)
             if cgq.facet_order:
                 with_v = [
@@ -917,9 +939,10 @@ class Executor:
                         if tu:
                             preds.extend(tu.fields)
             else:
-                tu = self.st.get_type(g.expand)
-                if tu:
-                    preds.extend(tu.fields)
+                for tname in g.expand.split(","):  # expand(Type1, Type2)
+                    tu = self.st.get_type(tname)
+                    if tu:
+                        preds.extend(tu.fields)
             seen = set()
             for pname in preds:
                 if pname in seen:
@@ -932,6 +955,8 @@ class Executor:
                 seen.add(pname)
                 child = GraphQuery(attr=pname)
                 child.children = list(g.children)
+                # expand(...) @filter(...) applies to every expanded edge
+                child.filter = g.filter
                 out.append(child)
         return out
 
@@ -1262,6 +1287,44 @@ def _paginate(uids: np.ndarray, first, offset, after) -> np.ndarray:
         else:
             uids = uids[first:]
     return uids
+
+
+def _facet_tree_match(ft: FilterTree, facets: Dict[str, Val]) -> bool:
+    """Evaluate an @facets(...) boolean filter tree against one edge's
+    facet map (ref worker/task.go facets filtering with AND/OR/NOT)."""
+    if ft.func is not None:
+        ff = ft.func
+        fv = facets.get(ff.attr)
+        if fv is None:
+            return False
+        if ff.name in ("allofterms", "anyofterms"):
+            from dgraph_tpu.tok.tok import _normalize, _word_re
+
+            have = set(_word_re.findall(_normalize(str(fv.value))))
+            want_terms = set(_word_re.findall(_normalize(str(ff.args[0]))))
+            return (
+                want_terms <= have
+                if ff.name == "allofterms"
+                else bool(want_terms & have)
+            )
+        from dgraph_tpu.query.functions import _coerce
+
+        try:
+            want = _coerce(ff.args[0], fv.tid)
+            c = compare_vals(convert(fv, want.tid), want)
+        except (ValueError, TypeError):
+            return False
+        return {
+            "eq": c == 0, "le": c <= 0, "lt": c < 0,
+            "ge": c >= 0, "gt": c > 0,
+        }.get(ff.name, False)
+    if ft.op == "and":
+        return all(_facet_tree_match(c, facets) for c in ft.children)
+    if ft.op == "or":
+        return any(_facet_tree_match(c, facets) for c in ft.children)
+    if ft.op == "not":
+        return not _facet_tree_match(ft.children[0], facets)
+    return False
 
 
 def _agg_vals(op: str, xs: List[Val]) -> Optional[Val]:

@@ -145,6 +145,10 @@ def extract_from_body(name, body, fname):
             qtext = jsvars.get(mm.group(1))
             if qtext is None:
                 continue
+            # Go-side string concatenation (query := `...` + poly + `...`)
+            # leaves an unbalanced fragment — not statically extractable
+            if qtext.count("{") != qtext.count("}"):
+                continue
             cases.append(
                 {
                     "id": f"{name}/{k}",
