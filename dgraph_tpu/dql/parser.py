@@ -311,8 +311,9 @@ def parse_func(p: _P) -> FuncSpec:
     p.expect("(")
     fn = FuncSpec(name=name)
     if name == "uid":
-        # uid(0x1, 0x2) or uid(varname) or uid($queryvar)
+        # uid(0x1, 0x2) or uid(var1, var2) or uid($queryvar)
         args = []
+        uvars = []
         while p.peek().text != ")":
             t = p.next()
             if t.kind == "num":
@@ -320,9 +321,10 @@ def parse_func(p: _P) -> FuncSpec:
             elif t.kind == "name" and t.text.startswith("$"):
                 args.append(_uid_value(_parse_value(t, p), t))
             elif t.kind == "name":
-                fn.uid_var = t.text
+                uvars.append(t.text)
             p.accept(",")
         p.expect(")")
+        fn.uid_var = ",".join(uvars)  # uid(L, B) unions several vars
         fn.args = args
         return fn
     if name == "uid_in":

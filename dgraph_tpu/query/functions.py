@@ -135,14 +135,14 @@ class FuncRunner:
             return self._count_func(fn, name, src)
         if name == "uid":
             uids = list(fn.args)
-            if fn.uid_var:
-                if fn.uid_var in self.uid_vars:
-                    uids.extend(int(u) for u in self.uid_vars[fn.uid_var])
-                elif fn.uid_var in self.val_vars:
+            for v in fn.uid_var.split(",") if fn.uid_var else ():
+                if v in self.uid_vars:
+                    uids.extend(int(u) for u in self.uid_vars[v])
+                elif v in self.val_vars:
                     # uid(value-var): the var's uid key set — INCLUDING the
                     # MaxUint64 count-var key (ref query.go:1593; uid(f) on
                     # `f as count(uid)` yields that sentinel row)
-                    uids.extend(self.val_vars[fn.uid_var].keys())
+                    uids.extend(self.val_vars[v].keys())
             out = _as_uids(uids)
             if src is not None:
                 out = np.intersect1d(out, src, assume_unique=True)

@@ -124,22 +124,28 @@ class JsonEncoder:
             obj = self.encode_entity(
                 node, int(u), i,
                 ancestors=ancestors if node.gq.ignore_reflex else None,
+                only_aliased=node.gq.normalize,
             )
             if obj:
                 if node.gq.normalize:
                     for flat in _normalize_flatten(obj):
-                        out.append(flat)
+                        if flat:
+                            out.append(flat)
                 else:
                     out.append(obj)
         return out
 
     def encode_entity(
-        self, node: ExecNode, uid: int, row: int, ancestors=None
+        self, node: ExecNode, uid: int, row: int, ancestors=None,
+        only_aliased: bool = False,
     ) -> Dict[str, Any]:
         """ancestors: when not None, @ignorereflex is active — edges back
         to any uid on the current path are dropped at encode time (the
         only place the actual path exists; matrix rows are shared across
-        parents so executor-side pruning cannot be path-correct)."""
+        parents so executor-side pruning cannot be path-correct).
+
+        only_aliased: inside an @normalize subtree only ALIASED leaves are
+        kept (ref outputnode.go normalize handling)."""
         obj: Dict[str, Any] = {}
         banned = None
         if ancestors is not None:
@@ -152,6 +158,9 @@ class JsonEncoder:
             if name is None:
                 name = c._disp_name = _display_name(c)  # type: ignore[attr-defined]
             gq = c.gq
+            if only_aliased and not gq.alias and not c.is_uid_pred:
+                # inside @normalize only aliased leaves survive
+                continue
             if gq.is_uid:
                 obj[name] = encode_uid(uid)
             elif gq.checkpwd_val is not None:
@@ -187,6 +196,7 @@ class JsonEncoder:
                     obj[name] = c.counts.get(uid, 0)
             elif c.is_uid_pred:
                 kids = []
+                sub_norm = only_aliased or gq.normalize
                 r = c.uid_matrix[row] if row < len(c.uid_matrix) else []
                 dest_idx = getattr(c, "_dest_idx", None)
                 if dest_idx is None:
@@ -200,13 +210,13 @@ class JsonEncoder:
                     kid = (
                         self.encode_entity(
                             c, int(v), dest_idx.get(int(v), 0),
-                            ancestors=banned,
+                            ancestors=banned, only_aliased=sub_norm,
                         )
                         if c.children
                         else {}
                     )
                     if not c.children:
-                        kid = {"uid": encode_uid(int(v))}
+                        kid = {} if sub_norm else {"uid": encode_uid(int(v))}
                     if fmaps is not None and row < len(fmaps):
                         for fk, fv in fmaps[row].get(int(v), {}).items():
                             if gq.facet_names and fk not in gq.facet_names:
@@ -214,8 +224,29 @@ class JsonEncoder:
                             kid[f"{name}|{fk}"] = _json_val(fv)
                     if kid:
                         kids.append(kid)
+                if gq.normalize:
+                    # subquery-level @normalize: flatten each target's
+                    # subtree into aliased-leaf rows, concatenated
+                    kids = [
+                        flat
+                        for k in kids
+                        for flat in _normalize_flatten(k)
+                        if flat
+                    ]
                 if kids:
-                    obj[name] = kids
+                    su = self.schema.get(c.attr) if self.schema else None
+                    if (
+                        su is not None
+                        and not su.is_list
+                        and not c.attr.startswith("~")
+                        and not gq.normalize
+                        and not only_aliased
+                    ):
+                        # non-list uid predicate encodes as ONE object
+                        # (ref outputnode: best_friend {} not [])
+                        obj[name] = kids[0]
+                    else:
+                        obj[name] = kids
             elif gq.lang == "*":
                 # name@* fans out one field per language; untagged value
                 # keeps the bare name (ref outputnode langs handling)

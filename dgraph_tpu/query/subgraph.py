@@ -144,7 +144,7 @@ class Executor:
             if fn is None:
                 return
             if fn.uid_var:
-                deps.add(fn.uid_var)
+                deps.update(fn.uid_var.split(","))
             if fn.val_var:
                 deps.add(fn.val_var)
 
@@ -360,9 +360,14 @@ class Executor:
     def _finish_block(
         self, gq: GraphQuery, node: ExecNode, skip_order: bool = False
     ) -> ExecNode:
-        # ordering & pagination at root (ref applyOrderAndPagination :2511)
+        # ordering & pagination at root (ref applyOrderAndPagination :2511);
+        # @cascade defers pagination until after the subtree is pruned
         if not skip_order:
-            node.dest_uids = self._order_and_paginate(gq, node.dest_uids)
+            if gq.cascade:
+                if gq.order:
+                    node.dest_uids = self._order_uids(gq, node.dest_uids)
+            else:
+                node.dest_uids = self._order_and_paginate(gq, node.dest_uids)
 
         if gq.var_name:
             self.uid_vars[gq.var_name] = node.dest_uids
@@ -820,6 +825,7 @@ class Executor:
             for c in cgq.children
             if c.aggregator and c.attr and not c.val_var
         ]
+        sizes = {k: len(b["__members__"]) for k, b in buckets.items()}
         for b in buckets.values():
             members = b.pop("__members__")
             for agg in aggs:
@@ -843,8 +849,19 @@ class Executor:
                     b[key_name] = sum(vals)
                 else:
                     b[key_name] = sum(vals) / len(vals)
+        # determinism order: group SIZE ascending, then key values
+        # ascending (ref groupby.go:385 groupLess)
+        def _gk(k):
+            return tuple(
+                (0, float(v), "")
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+                else (1, 0.0, str(v))
+                for v in k
+            )
+
         ordered = [
-            buckets[k] for k in sorted(buckets, key=lambda t: str(t))
+            buckets[k]
+            for k in sorted(buckets, key=lambda k: (sizes[k], _gk(k)))
         ]
         cnode.groups[pu] = ordered
         # `x as count(uid)` inside a single-uid-pred @groupby binds a
@@ -1023,23 +1040,100 @@ class Executor:
     # ------------------------------------------------------------------
 
     def _apply_cascade(self, node: ExecNode):
-        keep = []
-        for i, u in enumerate(node.dest_uids):
-            ok = True
-            for c in node.children:
-                if c.gq.is_uid or c.gq.is_count or c.gq.aggregator or c.gq.val_var:
+        """@cascade prunes RECURSIVELY: an entity at ANY level survives
+        only if every queried field at its level is present — including
+        uid-pred children whose own subtrees survived (ref query.go
+        applyCascade bottom-up pruning)."""
+        valids: Dict[int, set] = {}
+
+        def compute(n: ExecNode) -> set:
+            for c in n.children:
+                if c.is_uid_pred and c.children:
+                    compute(c)
+            valid = set()
+            for i, u in enumerate(n.dest_uids):
+                ok = True
+                for c in n.children:
+                    gq = c.gq
+                    if (
+                        gq.is_uid
+                        or gq.is_count
+                        or gq.aggregator
+                        or gq.val_var
+                        or gq.math_expr is not None
+                        or gq.checkpwd_val is not None
+                    ):
+                        continue
+                    if c.is_uid_pred:
+                        row = (
+                            c.uid_matrix[i]
+                            if i < len(c.uid_matrix)
+                            else ()
+                        )
+                        cv = valids.get(id(c))
+                        if not any(
+                            cv is None or int(v) in cv for v in row
+                        ):
+                            ok = False
+                            break
+                    elif int(u) not in c.values:
+                        ok = False
+                        break
+                if ok:
+                    valid.add(int(u))
+            valids[id(n)] = valid
+            return valid
+
+        root_valid = compute(node)
+
+        # prune matrix CONTENTS by the valid sets (row alignment with each
+        # parent's dest list is preserved; dest stays a superset, which the
+        # encoder tolerates — it walks rows, not dest)
+        def prune_contents(n: ExecNode, n_valid: set):
+            for c in n.children:
+                if not c.is_uid_pred:
                     continue
-                if c.is_uid_pred:
-                    if i >= len(c.uid_matrix) or len(c.uid_matrix[i]) == 0:
-                        ok = False
-                        break
-                else:
-                    if int(u) not in c.values:
-                        ok = False
-                        break
-            if ok:
-                keep.append(int(u))
-        kept = _as_uids(keep)
+                cv = valids.get(id(c))
+                rows = []
+                for i, row in enumerate(c.uid_matrix):
+                    pu = (
+                        int(n.dest_uids[i])
+                        if i < len(n.dest_uids)
+                        else None
+                    )
+                    if pu is not None and pu not in n_valid:
+                        rows.append(EMPTY)  # parent itself was pruned
+                    elif cv is not None:
+                        rows.append(
+                            _as_uids(v for v in row if int(v) in cv)
+                        )
+                    else:
+                        rows.append(row)
+                c.uid_matrix = rows
+                # uid vars bound in a cascaded subtree see the PRUNED set
+                # (ref TestUseVarsMultiCascade golden)
+                if c.gq.var_name and not c.gq.is_count:
+                    self.uid_vars[c.gq.var_name] = _merge_rows(
+                        c.uid_matrix
+                    )
+                if c.children:
+                    prune_contents(
+                        c,
+                        cv
+                        if cv is not None
+                        else {int(x) for x in c.dest_uids},
+                    )
+
+        prune_contents(node, root_valid)
+
+        # root pagination was deferred for cascade blocks: apply it now,
+        # preserving any ordering already applied to dest_uids
+        gq = node.gq
+        kept = np.array(
+            [int(u) for u in node.dest_uids if int(u) in root_valid],
+            dtype=np.uint64,
+        )
+        kept = _paginate(kept, gq.first, gq.offset, gq.after)
         idx = {int(u): i for i, u in enumerate(node.dest_uids)}
         for c in node.children:
             if c.uid_matrix:
@@ -1112,10 +1206,8 @@ class Executor:
                 sub.order = [Order(attr=o.attr, desc=o.desc, lang=o.lang)]
                 sel = self._order_uids_generic(sub, sel)
             out.extend(int(u) for u in sel)
-        if need is None or len(out) < need:
-            # uids with no indexed value sink to the end (ref behavior)
-            rest = np.setdiff1d(cand, np.array(out, np.uint64))
-            out.extend(int(u) for u in rest)
+        # uids with no indexed value are DROPPED: sorted queries exclude
+        # nodes missing the sort predicate (ref worker/sort.go semantics)
         return np.array(out, dtype=np.uint64)
 
     def _order_uids_topk(
@@ -1146,14 +1238,17 @@ class Executor:
         sc = np.where(
             present_mask,
             scores if o.desc else -scores,
-            -np.inf,  # missing sink to the end
+            -np.inf,  # missing rank last, then get dropped below
         ).astype(np.float32)
-        k = min(need, len(uids))
+        k = min(need, int(present_mask.sum()))
+        if k == 0:
+            return np.zeros((0,), np.uint64)
         _, idx = jax.lax.top_k(jnp.asarray(sc), k)
         idx = np.asarray(idx)
         top = uids[idx]
-        if len(top) < len(uids):
-            rest = np.setdiff1d(uids, top, assume_unique=False)
+        present = uids[present_mask]
+        if len(top) < len(present):
+            rest = np.setdiff1d(present, top, assume_unique=False)
             # rest order is unspecified beyond the pagination window
             return np.concatenate([top, rest])
         return top
@@ -1183,17 +1278,20 @@ class Executor:
             )
 
         # multi-key ordering: stable sorts applied in reverse key order
-        # (ref query.go multiSort); missing-valued uids sink to the end
+        # (ref query.go multiSort). Sorted queries EXCLUDE nodes missing
+        # the primary sort value (ref worker/sort.go); secondary-key
+        # missing values sink within their group.
         ordered = [int(u) for u in uids]
         try:
-            for o in reversed(gq.order):
+            for ki, o in enumerate(reversed(gq.order)):
                 vals = {u: key_of(o, u) for u in ordered}
                 present = [u for u in ordered if vals[u] is not None]
                 missing = [u for u in ordered if vals[u] is None]
                 present.sort(
                     key=lambda u: _sort_key_of(vals[u]), reverse=o.desc
                 )
-                ordered = present + missing
+                is_primary = ki == len(gq.order) - 1
+                ordered = present if is_primary else present + missing
         except TypeError:
             names = ", ".join(o.attr or o.val_var for o in gq.order)
             raise QueryError(f"unorderable values for {names}") from None

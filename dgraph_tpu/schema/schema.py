@@ -98,6 +98,10 @@ class State:
         su = self._preds.get(pred)
         if su is None:
             su = SchemaUpdate(predicate=pred, value_type=tid)
+            if tid == TypeID.UID:
+                # inferred uid predicates default to [uid] (ref schema
+                # inference: createSchema lists uid edges)
+                su.is_list = True
             self._preds[pred] = su
         return su
 

@@ -175,9 +175,10 @@ GOLDEN = [
         """{ q(func: eq(name, "Ridley Scott")) {
              director.film @groupby(running_time) { count(uid) }
         } }""",
+        # groups order by SIZE asc then key (ref groupby.go:385 groupLess)
         {"q": [{"director.film": [{"@groupby": [
-            {"running_time": 117, "count": 2},
-            {"running_time": 144, "count": 1}]}]}]},
+            {"running_time": 144, "count": 1},
+            {"running_time": 117, "count": 2}]}]}]},
     ),
 ]
 

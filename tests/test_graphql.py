@@ -77,7 +77,8 @@ def test_add_and_query(gql):
     q = res["data"]["queryAuthor"]
     assert q[0]["name"] == "Jane"
     # @hasInverse wired both directions
-    assert q[0]["posts"][0]["author"][0]["name"] == "Jane"
+    # non-list field `author: Author` returns an object (ref GraphQL shape)
+    assert q[0]["posts"][0]["author"]["name"] == "Jane"
 
 
 def test_filters_order_pagination(gql):
