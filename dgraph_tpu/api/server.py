@@ -751,6 +751,54 @@ class Server:
     def _query(self, q: str, cache: LocalCache) -> dict:
         return self._query_parsed(dql.parse(q), cache, keys.GALAXY_NS)
 
+    def _schema_query(self, gq) -> dict:
+        """schema {} / schema(pred: ...) / schema(type: ...) blocks
+        (ref dql parseSchema + worker schema retrieval; golden shapes in
+        query0_test.go TestSchemaBlock*)."""
+        from dgraph_tpu.types.types import type_name as _tn
+
+        if gq.expand:  # schema(type: A) / schema(type: [A, B])
+            types = []
+            for tname in sorted(gq.expand.split(",")):
+                tu = self.schema.get_type(tname)
+                if tu is not None:
+                    types.append(
+                        {
+                            "name": tu.name,
+                            "fields": [{"name": f} for f in tu.fields],
+                        }
+                    )
+            return {"data": {"types": types} if types else {}}
+        want = set(gq.facet_names)  # requested fields ({} = all)
+        preds = gq.groupby_attrs or sorted(self.schema.predicates())
+        out = []
+        for pred in preds:
+            su = self.schema.get(pred)
+            if su is None:
+                continue  # unknown preds silently dropped (ref behavior)
+            row: dict = {"predicate": pred}
+
+            def put(field, value, truthy=True):
+                if want and field not in want:
+                    return
+                if truthy and not value:
+                    return
+                row[field] = value
+
+            put("type", _tn(su.value_type), truthy=False)
+            put("index", bool(su.directive_index))
+            if su.directive_index:
+                put("tokenizer", list(su.tokenizers))
+            put("reverse", su.directive_reverse)
+            put("count", su.count)
+            put("lang", su.lang)
+            put("list", su.is_list)
+            put("upsert", su.upsert)
+            put("unique", su.unique)
+            put("no_conflict", su.no_conflict)
+            out.append(row)
+        return {"data": {"schema": out}}
+
     def _query_parsed(
         self,
         blocks,
@@ -759,6 +807,8 @@ class Server:
         allowed_preds=None,
         deadline=None,
     ) -> dict:
+        if len(blocks) == 1 and blocks[0].attr == "__schema__":
+            return self._schema_query(blocks[0])
         ex = Executor(
             cache,
             self.schema,

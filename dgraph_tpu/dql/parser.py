@@ -51,7 +51,7 @@ _TOKEN_RE = re.compile(
   | (?P<regex>/(?:\\.|[^/\\])+/[i]?)
   | (?P<num>0x[0-9a-fA-F]+|\d+\.\d+|\d+)
   | (?P<name>~?[a-zA-Z_][\w.~]*|<[^>]+>|\$[a-zA-Z_]\w*)
-  | (?P<punct>@|\(|\)|\{|\}|\[|\]|:|,|==|=|\*|\+|-|/|%|<=|>=|<|>|\.)
+  | (?P<punct>@|\(|\)|\{|\}|\[|\]|:|,|==|!=|=|\*|\+|-|/|%|<=|>=|<|>|\.|!)
 """,
     re.VERBOSE,
 )
@@ -442,6 +442,17 @@ def parse_math(p: _P) -> MathNode:
 
 
 def _math_expr(p: _P) -> MathNode:
+    # comparisons are the loosest-binding math level (ref query/math.go
+    # ops: cond(a > 10, ..) / a == 38 / a != 38)
+    left = _math_addsub(p)
+    while p.peek().text in ("==", "!=", "<", ">", "<=", ">="):
+        op = p.next().text
+        right = _math_addsub(p)
+        left = MathNode(op=op, children=[left, right])
+    return left
+
+
+def _math_addsub(p: _P) -> MathNode:
     left = _math_term(p)
     while p.peek().text in ("+", "-"):
         op = p.next().text
@@ -673,7 +684,7 @@ def parse_child(p: _P) -> GraphQuery:
     name = _strip_angle(t.text)
 
     # `x as pred` variable definition
-    if p.peek().text == "as":
+    if p.peek().text.lower() == "as":
         p.next()
         gq.var_name = name
         t2 = p.next()
@@ -684,6 +695,11 @@ def parse_child(p: _P) -> GraphQuery:
         p.next()
         gq.alias = name
         name = _strip_angle(p.next().text)
+        # `alias: x as math(...)` — alias AND var on one field
+        if p.peek().text.lower() == "as":
+            p.next()
+            gq.var_name = name
+            name = _strip_angle(p.next().text)
 
     if name == "count":
         p.expect("(")
@@ -693,21 +709,40 @@ def parse_child(p: _P) -> GraphQuery:
             gq.attr = "uid"
         else:
             gq.attr = inner
-            # count(pred@lang ...) / count(pred (first:N) @filter(...))
+            # count(pred@lang ...) / count(pred @filter(...) (first:N))
             if p.peek().text == "@" and p.toks[p.i + 1].kind == "name" and \
                     p.toks[p.i + 1].text not in ("filter", "facets"):
                 p.next()
                 gq.lang = _parse_lang_chain(p)
-            if p.accept("("):
-                _parse_args_into(p, gq, stop=")")
-            while p.peek().text == "@":
-                p.next()
-                d = p.next().text.lower()
-                if d == "filter":
-                    gq.filter = parse_filter(p)
+            while True:
+                if p.peek().text == "(":
+                    p.next()
+                    _parse_args_into(p, gq, stop=")")
+                elif p.peek().text == "@":
+                    p.next()
+                    d = p.next().text.lower()
+                    if d == "filter":
+                        gq.filter = parse_filter(p)
+                    else:
+                        raise ParseError(
+                            f"@{d} inside count() not supported"
+                        )
                 else:
-                    raise ParseError(f"@{d} inside count() not supported")
+                    break
         p.expect(")")
+        # trailing directives: count(boss) @facets(eq(company, "x"))
+        # restricts the counted edges by facet (ref facets count tests)
+        while p.peek().text == "@":
+            p.next()
+            d = p.next().text.lower()
+            if d == "facets":
+                p.expect("(")
+                gq.facet_filter = _parse_or(p)
+                p.expect(")")
+            elif d == "filter":
+                gq.filter = parse_filter(p)
+            else:
+                raise ParseError(f"@{d} after count() not supported")
         return gq
 
     if name in ("min", "max", "sum", "avg"):
@@ -754,10 +789,17 @@ def parse_child(p: _P) -> GraphQuery:
 
     if name == "expand":
         p.expect("(")
-        parts = [p.next().text]
-        while p.accept(","):  # expand(Type1, Type2)
-            parts.append(p.next().text)
-        gq.expand = ",".join(parts)
+        if p.peek().text == "val" and p.toks[p.i + 1].text == "(":
+            # expand(val(x)): predicates named by the var's values
+            p.next()
+            p.expect("(")
+            gq.expand = "val:" + p.next().text
+            p.expect(")")
+        else:
+            parts = [p.next().text]
+            while p.accept(","):  # expand(Type1, Type2)
+                parts.append(p.next().text)
+            gq.expand = ",".join(parts)
         p.expect(")")
         gq.attr = "expand"
         _parse_directives(p, gq)  # expand(_all_) @filter(type(X))
@@ -800,7 +842,7 @@ def parse_query_block(p: _P) -> GraphQuery:
     name = t.text
 
     # `x as var(func: ...)` or `name as shortest(...)`?
-    if p.peek().text == "as":
+    if p.peek().text.lower() == "as":
         p.next()
         gq.var_name = name
         name = p.next().text
@@ -867,6 +909,43 @@ def parse(text: str, variables=None) -> List[GraphQuery]:
     (ref dql/parser.go parseQueryWithVars); `variables` maps "$a" -> value.
     """
     p = _P(tokenize(text), text, variables=dict(variables or {}))
+    if p.peek().text == "schema":
+        # schema {} | schema(pred: name) {...} | schema(pred: [a, b]) {}
+        # | schema(type: T) {} (ref dql/parser.go parseSchema)
+        p.next()
+        gq = GraphQuery(attr="__schema__")
+        if p.accept("("):
+            while p.peek().text != ")":
+                key = p.next().text
+                p.expect(":")
+                if key == "pred":
+                    if p.accept("["):
+                        while p.peek().text != "]":
+                            gq.groupby_attrs.append(
+                                _strip_angle(p.next().text)
+                            )
+                            p.accept(",")
+                        p.expect("]")
+                    else:
+                        gq.groupby_attrs.append(_strip_angle(p.next().text))
+                elif key == "type":
+                    if p.accept("["):
+                        names = []
+                        while p.peek().text != "]":
+                            names.append(p.next().text)
+                            p.accept(",")
+                        p.expect("]")
+                        gq.expand = ",".join(names)
+                    else:
+                        gq.expand = p.next().text
+                else:
+                    raise ParseError(f"unknown schema arg {key!r}")
+                p.accept(",")
+            p.expect(")")
+        if p.accept("{"):
+            while not p.accept("}"):
+                gq.facet_names.append(p.next().text)
+        return [gq]
     if p.peek().text == "query":
         p.next()
         if p.peek().kind == "name" and not p.peek().text.startswith("$"):

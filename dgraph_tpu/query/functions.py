@@ -529,7 +529,16 @@ class FuncRunner:
 
     def _compare(self, fn: FuncSpec, op: str, src) -> np.ndarray:
         su = self._schema(fn.attr)
-        val = _coerce(fn.args[0], su.value_type)
+        arg = fn.args[0]
+        if isinstance(arg, tuple) and len(arg) == 2 and arg[0] == "valarg":
+            # ge(number, val(x)): compare against the var's (scalar) value;
+            # an empty var matches nothing (ref TestAggregateEmpty3)
+            vmap = self.val_vars.get(arg[1], {})
+            xs = list(vmap.values())
+            if not xs:
+                return EMPTY
+            arg = xs[0].value if isinstance(xs[0], Val) else xs[0]
+        val = _coerce(arg, su.value_type)
         # indexed range scan over sortable tokenizer (ref sortWithIndex path)
         sortable = None
         if su.directive_index and not su.lang:
@@ -664,9 +673,14 @@ class FuncRunner:
     def _regexp(self, fn: FuncSpec, src) -> np.ndarray:
         su = self._schema(fn.attr)
         arg = fn.args[0]
+        if isinstance(arg, str) and len(arg) >= 2 and arg.startswith("/"):
+            # $var substitution delivers the literal "/pattern/flags" text
+            body, _, fl = arg[1:].rpartition("/")
+            arg = ("regex", body, fl)
         if not (isinstance(arg, tuple) and arg[0] == "regex"):
             raise QueryError("regexp expects /pattern/flags")
         pattern, flags = arg[1], arg[2]
+        pattern = _go_inline_flags(pattern)
         rx = re.compile(pattern, re.IGNORECASE if "i" in flags else 0)
         # trigram prefilter (ref worker/task.go:1240 + tok trigram)
         cands = None
@@ -729,10 +743,23 @@ class FuncRunner:
         attr = fn.attr
         idx = self.vector_indexes.get(attr)
         if idx is None:
+            # an empty val(v) query arg means no query vector at all —
+            # return empty rather than erroring (ref TestAggregateEmpty4)
+            qa = fn.args[1] if len(fn.args) > 1 else None
+            if isinstance(qa, tuple) and qa and qa[0] == "valarg" and \
+                    not self.val_vars.get(qa[1]):
+                return EMPTY
             raise QueryError(f"no vector index on predicate {attr!r}")
         k = int(fn.args[0])
         qarg = fn.args[1]
-        if isinstance(qarg, str):
+        if isinstance(qarg, tuple) and qarg and qarg[0] == "valarg":
+            # similar_to(pred, k, val(v)): query by a var's vector value
+            vmap = self.val_vars.get(qarg[1], {})
+            vecs = [v.value for v in vmap.values()]
+            if not vecs:
+                return EMPTY
+            qvec = np.asarray(vecs[0], dtype=np.float32)
+        elif isinstance(qarg, str):
             qvec = np.asarray(_json.loads(qarg), dtype=np.float32)
         elif isinstance(qarg, (int,)):
             got = self._value_of(attr, qarg)
@@ -888,6 +915,15 @@ def _val_eq(got: Optional[Val], want: Val) -> bool:
         return compare_vals(convert(got, want.tid), want) == 0
     except ValueError:
         return False
+
+
+def _go_inline_flags(pattern: str) -> str:
+    """Translate Go/RE2 inline flag toggles Python re lacks: the common
+    `(?i)X(?-i)Y` form becomes `(?i:X)Y` (scoped group)."""
+    if "(?-" not in pattern:
+        return pattern
+    out = re.sub(r"\(\?i\)(.*?)\(\?-i\)", r"(?i:\1)", pattern)
+    return out
 
 
 def _required_trigrams(pattern: str, flags: str = "") -> List[str]:

@@ -23,7 +23,18 @@ def eval_math(node: MathNode, env: Dict[str, Any]):
             raise KeyError(node.var)
         v = env[node.var]
         return v.value if isinstance(v, Val) else v
+    if op == "cond":
+        # LAZY branches (ref math.go): the untaken side may be undefined
+        # (logbase of a non-positive value etc.)
+        c = eval_math(node.children[0], env)
+        return eval_math(node.children[1 if c else 2], env)
     args = [eval_math(c, env) for c in node.children]
+    if op in ("==", "!=", "<", ">", "<=", ">="):
+        a, b = args
+        return {
+            "==": a == b, "!=": a != b, "<": a < b,
+            ">": a > b, "<=": a <= b, ">=": a >= b,
+        }[op]
     if op == "+":
         return args[0] + args[1]
     if op == "-":

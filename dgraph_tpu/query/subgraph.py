@@ -447,7 +447,9 @@ class Executor:
             if cnode is None:
                 continue
             made[id(cgq)] = cnode
-            if cnode.is_uid_pred and len(cnode.dest_uids) and cgq.children:
+            if cnode.is_uid_pred and cgq.children:
+                # descend even with no dest uids: the subtree may define
+                # vars later blocks depend on (empty bindings)
                 self._propagate_level_vars(node, cnode)
                 self._expand_children(cnode, depth + 1)
         for cgq in deferred:
@@ -501,6 +503,17 @@ class Executor:
                 # `f as uid`: bind the enclosing level's uids as a uid var
                 # (ref query.go uid-var on the uid leaf)
                 self.uid_vars[cgq.var_name] = parent.dest_uids
+            if (
+                cgq.is_count
+                and attr == "uid"
+                and cgq.var_name
+                and not parent.gq.groupby_attrs  # groupby binds per-group
+            ):
+                # `s as count(uid)` at a child level: the level's row count
+                # as a broadcast scalar (ref query.go:1579 count-uid var)
+                self.val_vars[cgq.var_name] = {
+                    MAXUID: Val(TypeID.INT, int(len(parent.dest_uids)))
+                }
             return ExecNode(gq=cgq, attr=attr, src_uids=parent.dest_uids)
 
         reverse = attr.startswith("~")
@@ -718,8 +731,9 @@ class Executor:
                 continue
             try:
                 out[int(u)] = to_val(eval_math(cgq.math_expr, env))
-            except (MathError, KeyError):
-                continue
+            except (MathError, KeyError, ValueError, OverflowError,
+                    ZeroDivisionError):
+                continue  # domain errors drop the uid (ref math.go)
         cnode.math_vals = out
         if cgq.var_name:
             self.val_vars[cgq.var_name] = out
@@ -834,10 +848,14 @@ class Executor:
                     v = self.cache.value(
                         keys.DataKey(agg.attr, cu, self.ns)
                     )
-                    if v is not None and isinstance(
-                        v.value, (int, float)
-                    ) and not isinstance(v.value, bool):
+                    if v is None or isinstance(v.value, bool):
+                        continue
+                    if isinstance(v.value, (int, float)):
                         vals.append(v.value)
+                    elif agg.aggregator in ("min", "max") and isinstance(
+                        v.value, str
+                    ):
+                        vals.append(v.value)  # string min/max (max(name))
                 key_name = agg.alias or f"{agg.aggregator}({agg.attr})"
                 if not vals:
                     b[key_name] = None
@@ -876,12 +894,24 @@ class Executor:
                         vals = self.val_vars.setdefault(c.var_name, {})
                         for k, b in buckets.items():
                             if k[0] is not None:
-                                from dgraph_tpu.types.types import (
-                                    TypeID as _T,
-                                    Val as _V,
+                                vals[int(k[0])] = Val(TypeID.INT, b["count"])
+                    elif c.var_name and c.aggregator and c.attr:
+                        # `a as max(name)` in @groupby(uidpred): bind the
+                        # per-group aggregate keyed by the group target
+                        # (ref groupby.go fillGroupedVars)
+                        vals = self.val_vars.setdefault(c.var_name, {})
+                        key_name = c.alias or f"{c.aggregator}({c.attr})"
+                        for k, b in buckets.items():
+                            v = b.get(key_name)
+                            if k[0] is not None and v is not None:
+                                vals[int(k[0])] = (
+                                    Val(TypeID.INT, v)
+                                    if isinstance(v, int)
+                                    and not isinstance(v, bool)
+                                    else Val(TypeID.FLOAT, v)
+                                    if isinstance(v, float)
+                                    else Val(TypeID.STRING, str(v))
                                 )
-
-                                vals[int(k[0])] = _V(_T.INT, b["count"])
 
     def _apply_edge_facets(self, cnode: ExecNode, cgq, parent, reverse: bool):
         """Edge-facet filtering / ordering / projection for uid predicates
@@ -990,6 +1020,11 @@ class Executor:
         preds = [c for c in node.gq.children if not (c.is_uid or c.val_var)]
         seen = [node.dest_uids.copy()]  # single-element holder (shared state)
         self._recurse_level(node, preds, seen, depth, node.gq.recurse_loop)
+        # `a as uid` under @recurse binds every VISITED node (root + all
+        # expansion levels; ref recurse.go uid-var assignment)
+        for c in node.gq.children:
+            if c.is_uid and c.var_name:
+                self.uid_vars[c.var_name] = seen[0]
 
     def _recurse_level(
         self,
@@ -1014,12 +1049,34 @@ class Executor:
                 lang=cgq.lang,
                 first=cgq.first,
                 offset=cgq.offset,
+                var_name=cgq.var_name,
+            )
+            prev_vals = (
+                dict(self.val_vars.get(cgq.var_name, {}))
+                if cgq.var_name
+                else None
+            )
+            prev_uids = (
+                self.uid_vars.get(cgq.var_name, EMPTY)
+                if cgq.var_name
+                else None
             )
             cnode = self._make_child(frontier_node, c2)
             if cnode is None:
                 continue
+            # vars under @recurse accumulate across ALL levels
+            # (ref recurse.go variable assignment per expansion)
+            if cgq.var_name and prev_vals is not None and \
+                    cgq.var_name in self.val_vars:
+                merged = prev_vals
+                merged.update(self.val_vars[cgq.var_name])
+                self.val_vars[cgq.var_name] = merged
             frontier_node.children.append(cnode)
             if cnode.is_uid_pred:
+                if cgq.var_name:
+                    self.uid_vars[cgq.var_name] = np.union1d(
+                        prev_uids, cnode.dest_uids
+                    ).astype(np.uint64)
                 if not loop:
                     new = DISPATCHER.run_pairs(
                         "difference", [(cnode.dest_uids, snapshot)]
@@ -1306,6 +1363,14 @@ class Executor:
 
         src = self._resolve_endpoint(gq.shortest_from)
         dst = self._resolve_endpoint(gq.shortest_to)
+        if src is None or dst is None:
+            # unmatched endpoint var: no paths (ref shortest.go empty-from)
+            node = ExecNode(gq=gq, attr="_path_", dest_uids=EMPTY)
+            node.paths = []  # type: ignore[attr-defined]
+            node.path_weights = []  # type: ignore[attr-defined]
+            if gq.var_name:
+                self.uid_vars[gq.var_name] = EMPTY
+            return node
         preds = [c.attr for c in gq.children]
         # @facets(<name>) on a path predicate names its edge-cost facet
         # (ref shortest.go:141 expandOut facet costs)
@@ -1350,11 +1415,11 @@ class Executor:
             self.uid_vars[gq.var_name] = node.dest_uids
         return node
 
-    def _resolve_endpoint(self, ep) -> int:
+    def _resolve_endpoint(self, ep) -> Optional[int]:
         if isinstance(ep, tuple) and ep[0] == "var":
             uids = self.uid_vars.get(ep[1], EMPTY)
             if not len(uids):
-                raise QueryError(f"empty uid var {ep[1]!r} in shortest")
+                return None  # no match -> empty path result (ref behavior)
             return int(uids[0])
         if ep is None:
             raise QueryError("shortest requires from: and to:")

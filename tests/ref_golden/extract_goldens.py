@@ -149,6 +149,14 @@ def extract_from_body(name, body, fname):
             # leaves an unbalanced fragment — not statically extractable
             if qtext.count("{") != qtext.count("}"):
                 continue
+            # fully commented-out test bodies leave junk goldens
+            try:
+                json.loads(expected)
+            except ValueError:
+                continue
+            stripped = re.sub(r"//[^\n]*", "", qtext)
+            if stripped.count("{") != stripped.count("}"):
+                continue
             cases.append(
                 {
                     "id": f"{name}/{k}",
