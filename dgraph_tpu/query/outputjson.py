@@ -78,7 +78,11 @@ class JsonEncoder:
                 continue
             name = node.gq.alias or node.gq.attr
             if node.attr == "_path_":
-                name = "_path_"  # ref query/outputnode.go shortest block key
+                # ref query/outputnode.go: shortest blocks key "_path_",
+                # omitted entirely when no path was found
+                if not getattr(node, "paths", None):
+                    continue
+                name = "_path_"
             arr = self.encode_node_list(node)
             out[name] = arr
         return out
@@ -106,18 +110,30 @@ class JsonEncoder:
             return [{"@groupby": node.root_groups}]  # type: ignore
 
         if getattr(node, "paths", None):
-            # shortest-path block: emit the path uid chains + total cost
-            # (ref outputnode.go _path_ / _weight_)
+            # shortest-path block: each path is a NESTED chain starting at
+            # the source uid, hops keyed by the predicate that carried the
+            # edge, facet costs as "pred|facet" on the target object, and
+            # "_weight_" (total) on the outermost object
+            # (ref outputnode.go _path_ shape, TestKShortestPathWeighted)
             weights = getattr(node, "path_weights", None) or [
                 float(len(p) - 1) for p in node.paths  # type: ignore
             ]
-            return [
-                {
-                    "_path_": [{"uid": encode_uid(u)} for u in p],
-                    "_weight_": w,
-                }
-                for p, w in zip(node.paths, weights)  # type: ignore
+            all_hops = getattr(node, "path_hops", None) or [
+                [("", None)] * (len(p) - 1) for p in node.paths  # type: ignore
             ]
+            fnames = getattr(node, "path_facet_names", {})
+            out_paths = []
+            for p, w, hops in zip(node.paths, weights, all_hops):  # type: ignore
+                cur = {"uid": encode_uid(p[-1])}
+                for i in range(len(p) - 2, -1, -1):
+                    pred, fcost = hops[i]
+                    fname = fnames.get(pred)
+                    if fname is not None and fcost is not None:
+                        cur[f"{pred}|{fname}"] = fcost
+                    cur = {"uid": encode_uid(p[i]), pred or "path": cur}
+                cur["_weight_"] = w
+                out_paths.append(cur)
+            return out_paths
 
         ancestors = frozenset()
         for i, u in enumerate(node.dest_uids):
@@ -308,10 +324,24 @@ def _normalize_flatten(obj: Dict[str, Any]) -> List[Dict[str, Any]]:
             scalars[k] = v
     if not lists:
         return [scalars]
+
+    def merge(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+        # same alias at several levels accumulates into an array
+        # (ref outputnode normalize: @recurse @normalize path values)
+        out = dict(a)
+        for k, v in b.items():
+            if k in out:
+                prev = out[k]
+                prev = prev if isinstance(prev, list) else [prev]
+                out[k] = prev + (v if isinstance(v, list) else [v])
+            else:
+                out[k] = v
+        return out
+
     out = [scalars]
     for _, items in lists:
         flat_items: List[Dict[str, Any]] = []
         for it in items:
             flat_items.extend(_normalize_flatten(it))
-        out = [{**a, **b} for a in out for b in flat_items]
+        out = [merge(a, b) for a in out for b in flat_items]
     return out

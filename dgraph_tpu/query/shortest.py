@@ -156,6 +156,39 @@ def k_shortest_paths(
     return results
 
 
+def annotate_hops(
+    cache: LocalCache,
+    st: State,
+    path: List[int],
+    preds: List[str],
+    weight_facets: Optional[List[Optional[str]]] = None,
+    ns: int = keys.GALAXY_NS,
+) -> List[Tuple[str, Optional[float]]]:
+    """Per-hop (pred, facet_cost) along a found uid path — which predicate
+    carried each edge, and its facet cost when @facets(weight) was asked
+    (ref shortest.go route reconstruction for the _path_ tree)."""
+    edges = _Edges(cache, st, preds, weight_facets, ns)
+    hops: List[Tuple[str, Optional[float]]] = []
+    for u, v in zip(path, path[1:]):
+        found = (preds[0] if preds else "", None)
+        for pred, wf in edges.upreds:
+            key = edges._key(pred, int(u))
+            vs = edges.cache.uids(key)
+            if int(v) in {int(x) for x in vs}:
+                cost = None
+                if wf:
+                    fv = edges.cache.edge_facets(key).get(int(v), {}).get(wf)
+                    if fv is not None:
+                        try:
+                            cost = float(fv.value)
+                        except (TypeError, ValueError):
+                            cost = None
+                found = (pred, cost)
+                break
+        hops.append(found)
+    return hops
+
+
 def _bfs_single(edges: _Edges, src: int, dst: int, max_depth: int):
     """Unweighted single-path BFS with batched level expansion."""
     parents: Dict[int, set] = {src: set()}

@@ -1033,14 +1033,26 @@ class Executor:
         seen: List[np.ndarray],
         remaining: int,
         loop: bool,
+        frontier: Optional[np.ndarray] = None,
     ):
+        """One recursion level. With loop:false, edges INTO already-visited
+        nodes are still shown (ref recurse.go: Rick's friend Michonne
+        appears), but only unvisited nodes EXPAND further — `frontier` is
+        the subset of this level's uids allowed to grow uid-pred children.
+        """
         if remaining <= 0 or not len(frontier_node.dest_uids):
             return
-        uid_children: List[ExecNode] = []
-        # expand every pred from this frontier first (level-synchronous:
-        # the seen snapshot is shared by all preds of one level)
+        # expand(_all_)/expand(Type) resolves per level against the
+        # frontier's types (ref recurse.go preExpand)
+        preds = self._resolve_expand(preds, frontier_node.dest_uids)
+        uid_children = []
         snapshot = seen[0]
         new_sets = []
+        fr = (
+            None
+            if frontier is None
+            else {int(x) for x in frontier}
+        )
         for cgq in preds:
             c2 = GraphQuery(
                 attr=cgq.attr,
@@ -1073,6 +1085,15 @@ class Executor:
                 self.val_vars[cgq.var_name] = merged
             frontier_node.children.append(cnode)
             if cnode.is_uid_pred:
+                if fr is not None:
+                    # visited parents stop expanding: blank their rows
+                    cnode.uid_matrix = [
+                        row if int(pu) in fr else EMPTY
+                        for pu, row in zip(
+                            frontier_node.dest_uids, cnode.uid_matrix
+                        )
+                    ]
+                    cnode.dest_uids = _merge_rows(cnode.uid_matrix)
                 if cgq.var_name:
                     self.uid_vars[cgq.var_name] = np.union1d(
                         prev_uids, cnode.dest_uids
@@ -1081,16 +1102,17 @@ class Executor:
                     new = DISPATCHER.run_pairs(
                         "difference", [(cnode.dest_uids, snapshot)]
                     )[0]
-                    cnode.uid_matrix = DISPATCHER.run_rows_vs_one(
-                        "intersect", cnode.uid_matrix, new
-                    )
-                    cnode.dest_uids = new
                     new_sets.append(new)
-                uid_children.append(cnode)
+                    uid_children.append((cnode, new))
+                else:
+                    uid_children.append((cnode, cnode.dest_uids))
         if not loop and new_sets:
             seen[0] = DISPATCHER.run_chain("union", [seen[0]] + new_sets)
-        for cnode in uid_children:
-            self._recurse_level(cnode, preds, seen, remaining - 1, loop)
+        for cnode, nxt in uid_children:
+            self._recurse_level(
+                cnode, preds, seen, remaining - 1, loop,
+                frontier=None if loop else nxt,
+            )
 
     # ------------------------------------------------------------------
     # @cascade: prune uids missing any child (ref query.go cascade)
@@ -1410,6 +1432,18 @@ class Executor:
         node.dest_uids = _as_uids(routes[0][0]) if routes else EMPTY
         node.paths = [p for p, _ in routes]  # type: ignore[attr-defined]
         node.path_weights = [w for _, w in routes]  # type: ignore[attr-defined]
+        # per-hop predicate + facet cost for the nested _path_ encoding
+        # (ref outputnode: {"uid": A, "pred": {"uid": B, "pred|f": w}})
+        from dgraph_tpu.query.shortest import annotate_hops
+
+        node.path_hops = [  # type: ignore[attr-defined]
+            annotate_hops(self.cache, self.st, p, preds, wfacets, self.ns)
+            for p, _ in routes
+        ]
+        node.path_facet_names = {  # type: ignore[attr-defined]
+            c.attr: (c.facet_names[0] if c.facet_names else None)
+            for c in gq.children
+        }
         if gq.var_name:
             # path var holds the uids on the best path (ref shortest.go)
             self.uid_vars[gq.var_name] = node.dest_uids

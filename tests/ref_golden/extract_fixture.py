@@ -85,6 +85,34 @@ def main():
         out.append(f"<0x1234> <pattern> <{next_id}> .")
         next_id += 1
 
+    # -- facets fixture (query_facets_test.go populateClusterWithFacets) ------
+    fsrc = open(
+        "/root/reference/query/query_facets_test.go", encoding="utf-8"
+    ).read()
+    mfn = re.search(
+        r"func populateClusterWithFacets\(\) error \{(.*?)\n\}", fsrc, re.S
+    )
+    body = mfn.group(1)
+    fout = []
+    mm = re.search(r"triples := `(.*?)`", body, re.S)
+    fout.append(mm.group(1))
+    # fmt.Sprintf expansion: resolve `name := "(...)"` vars then templates
+    fvars = {
+        m.group(1): m.group(2).replace('\\"', '"')
+        for m in re.finditer(r"(\w+) := \"(\(.*?\))\"", body)
+    }
+    for m in re.finditer(
+        r'triples \+= fmt\.Sprintf\("(.*?)(?:\\n)?",\s*(\w+)\)', body
+    ):
+        tmpl, var = m.group(1), m.group(2)
+        fout.append(
+            tmpl.replace("%s", fvars[var]).replace('\\"', '"')
+        )
+    with open(
+        os.path.join(OUT_DIR, "triples_facets.rdf"), "w", encoding="utf-8"
+    ) as f:
+        f.write("\n".join(fout) + "\n")
+
     with open(os.path.join(OUT_DIR, "schema.txt"), "w", encoding="utf-8") as f:
         f.write(schema.strip() + "\n")
     with open(os.path.join(OUT_DIR, "triples.rdf"), "w", encoding="utf-8") as f:

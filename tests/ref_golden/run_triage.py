@@ -42,7 +42,7 @@ def canon_unordered(x):
     return x
 
 
-def build_server():
+def build_server(facets=False):
     from dgraph_tpu.api.server import Server
 
     s = Server()
@@ -51,6 +51,14 @@ def build_server():
     t.mutate_rdf(
         set_rdf=open(os.path.join(HERE, "triples.rdf")).read(), commit_now=True
     )
+    if facets:
+        # query_facets_test.go cases run with populateClusterWithFacets
+        # applied on top of the base fixture
+        t = s.new_txn()
+        t.mutate_rdf(
+            set_rdf=open(os.path.join(HERE, "triples_facets.rdf")).read(),
+            commit_now=True,
+        )
     return s
 
 
@@ -60,11 +68,13 @@ def main():
     if filt:
         cases = [c for c in cases if filt in c["id"]]
     s = build_server()
+    sf = build_server(facets=True)
     ok = okuo = 0
     errors, wrong = [], []
     for c in cases:
+        eng = sf if c["file"] == "query_facets_test.go" else s
         try:
-            got = {"data": s.query(c["query"])["data"]}
+            got = {"data": eng.query(c["query"])["data"]}
         except Exception as e:
             errors.append((c["id"], f"{type(e).__name__}: {e}"))
             continue

@@ -240,9 +240,11 @@ def test_shortest_path(server):
         }
         """
     )["data"]
-    # 0x17 -> 0x1 -> 0x18
-    uids = [o["uid"] for o in res["_path_"][0]["_path_"]]
-    assert uids == ["0x17", "0x1", "0x18"]
+    # 0x17 -> 0x1 -> 0x18 (nested reference shape)
+    p0 = res["_path_"][0]
+    assert p0["uid"] == "0x17"
+    assert p0["friend"]["uid"] == "0x1"
+    assert p0["friend"]["friend"]["uid"] == "0x18"
     assert {o["name"] for o in res["names"]} == {
         "Rick Grimes",
         "Michonne",

@@ -57,7 +57,19 @@ def server():
 
 
 def _path_uids(entry):
-    return [p["uid"] for p in entry["_path_"]]
+    # nested reference shape: {"uid": A, "<pred>": {"uid": B, ...}}
+    out = []
+    cur = entry
+    while isinstance(cur, dict):
+        out.append(cur["uid"])
+        nxt = None
+        for k, v in cur.items():
+            if k not in ("uid", "_weight_") and "|" not in k and isinstance(
+                v, dict
+            ):
+                nxt = v
+        cur = nxt
+    return out
 
 
 def test_weighted_shortest_uses_facet_costs(server):
@@ -149,5 +161,5 @@ def test_shortest_with_node_filter(server):
         }"""
     )
     paths = out["data"]["_path_"]
-    assert [p["uid"] for p in paths[0]["_path_"]] == ["0x1", "0x3", "0x4"]
+    assert _path_uids(paths[0]) == ["0x1", "0x3", "0x4"]
     assert paths[0]["_weight_"] == 6.0
