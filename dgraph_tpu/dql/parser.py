@@ -159,6 +159,7 @@ class GraphQuery:
     # math & groupby
     math_expr: Optional["MathNode"] = None
     groupby_attrs: List[str] = field(default_factory=list)
+    groupby_aliases: Dict[str, str] = field(default_factory=dict)  # attr->alias
     # facets
     facets: bool = False
     facet_names: List[str] = field(default_factory=list)
@@ -365,6 +366,9 @@ def parse_func(p: _P) -> FuncSpec:
         fn.attr = _strip_angle(p.next().text)
         fn.is_count = True
         p.expect(")")
+    elif p.peek().kind == "string":
+        # quoted first arg: type("Person") (ref parser tolerance)
+        fn.attr = _unquote(p.next().text)
     else:
         fn.attr, fn.lang = _parse_name_with_lang(p)
 
@@ -631,7 +635,11 @@ def _parse_directives(p: _P, gq: GraphQuery):
         elif d == "groupby":
             p.expect("(")
             while p.peek().text != ")":
-                gq.groupby_attrs.append(_strip_angle(p.next().text))
+                name = _strip_angle(p.next().text)
+                if p.accept(":"):  # @groupby(ALIAS: attr, ...)
+                    gq.groupby_aliases[_strip_angle(p.peek().text)] = name
+                    name = _strip_angle(p.next().text)
+                gq.groupby_attrs.append(name)
                 p.accept(",")
             p.expect(")")
         elif d == "facets":

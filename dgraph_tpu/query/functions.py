@@ -465,10 +465,16 @@ class FuncRunner:
                 needs_verify = True
         for v in vals:
             val = _coerce(v, su.value_type)
-            if tok is not None:
+            toks_v = build_tokens(val, [tok]) if tok is not None else []
+            if tok is not None and toks_v:
                 cand = EMPTY
-                for tb in build_tokens(val, [tok]):
+                for tb in toks_v:
                     cand = np.union1d(cand, self._index_uids(fn.attr, tb))
+            elif tok is not None and not toks_v:
+                # value produced no tokens (eq(room, "") on a term index):
+                # fall back to a value scan (ref handles empty-string eq)
+                cand = src if src is not None else self._scan_data_uids(fn.attr)
+                needs_verify = True
             else:
                 # unindexed eq over src or full scan (ref requires index at
                 # root; as filter we value-test)

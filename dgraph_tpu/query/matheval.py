@@ -54,8 +54,11 @@ def eval_math(node: MathNode, env: Dict[str, Any]):
     if op == "max":
         return max(args)
     if op == "sqrt":
-        return math.sqrt(args[0])
+        return math.sqrt(args[0])  # <0 raises -> uid dropped
     if op == "ln":
+        # Go math.Log(0) = -Inf (JSON-encoded as -MaxFloat64)
+        if args[0] == 0:
+            return float("-inf")
         return math.log(args[0])
     if op == "exp":
         return math.exp(args[0])

@@ -32,13 +32,18 @@ def _json_val(v: Val) -> Any:
 
         return base64.b64encode(x).decode()
     if isinstance(x, np.floating):
-        return float(x)
+        x = float(x)
     if isinstance(x, np.integer):
         return int(x)
     from decimal import Decimal
 
     if isinstance(x, Decimal):
-        return float(x)
+        x = float(x)
+    if isinstance(x, float) and (x == float("inf") or x == float("-inf")):
+        # Go json marshals ±Inf as ±MaxFloat64 (ref outputnode floats)
+        import sys as _sys
+
+        return _sys.float_info.max if x > 0 else -_sys.float_info.max
     return x
 
 
@@ -47,7 +52,8 @@ def _display_name(c: ExecNode) -> str:
     if gq.alias:
         return gq.alias
     if gq.math_expr is not None:
-        return gq.var_name or "math"
+        # `L4 as math(...)` displays as val(L4) (ref outputnode naming)
+        return f"val({gq.var_name})" if gq.var_name else "math"
     if gq.aggregator:
         return f"{gq.aggregator}(val({gq.val_var}))"
     if gq.val_var and not gq.aggregator:
@@ -77,6 +83,11 @@ class JsonEncoder:
             if node is None or node.gq.is_var_block:
                 continue
             name = node.gq.alias or node.gq.attr
+            rg = getattr(node, "root_groups", None)
+            if rg is not None and not rg:
+                # empty root @groupby omits the whole block
+                # (ref TestGroupByRootEmpty: {"data": {}})
+                continue
             if node.attr == "_path_":
                 # ref query/outputnode.go: shortest blocks key "_path_",
                 # omitted entirely when no path was found
@@ -106,7 +117,10 @@ class JsonEncoder:
                 out.append({_display_name(c): int(len(node.dest_uids))})
 
         if getattr(node, "root_groups", None) is not None:
-            # root-level @groupby block (data.q = [{"@groupby": [...]}])
+            # root-level @groupby block (data.q = [{"@groupby": [...]}]);
+            # an empty grouping omits the block (ref TestGroupByRootEmpty)
+            if not node.root_groups:  # type: ignore[attr-defined]
+                return []
             return [{"@groupby": node.root_groups}]  # type: ignore
 
         if getattr(node, "paths", None):
@@ -190,7 +204,11 @@ class JsonEncoder:
             elif c.groups:
                 g = c.groups.get(uid)
                 if g:
-                    obj[name] = [{"@groupby": g}]
+                    prev = obj.get(name)
+                    gb = [{"@groupby": g}]
+                    # `friend @groupby(..)` and a plain `friend` block share
+                    # one output list (ref TestGroupBy_RepeatAttr)
+                    obj[name] = (prev + gb) if isinstance(prev, list) else gb
             elif gq.aggregator:
                 if uid in c.math_vals:  # per-parent aggregate
                     obj[name] = _json_val(c.math_vals[uid])
@@ -210,6 +228,8 @@ class JsonEncoder:
                     )
                 else:
                     obj[name] = c.counts.get(uid, 0)
+            elif c.groups is not None and c.gq.groupby_attrs:
+                continue  # groupby child with no groups for this uid
             elif c.is_uid_pred:
                 kids = []
                 sub_norm = only_aliased or gq.normalize
@@ -240,6 +260,21 @@ class JsonEncoder:
                             kid[f"{name}|{fk}"] = _json_val(fv)
                     if kid:
                         kids.append(kid)
+                # `friend { count(uid) }`: the row count appends as one
+                # extra {"count": n} object in the child list
+                # (ref outputnode + TestCountAtRoot3 golden)
+                n_live = (
+                    len(r)
+                    if banned is None
+                    else sum(1 for v in r if int(v) not in banned)
+                )
+                for cc in c.children:
+                    if (
+                        cc.gq.is_count
+                        and cc.gq.attr == "uid"
+                        and not cc.gq.var_name
+                    ):
+                        kids.append({cc.gq.alias or "count": int(n_live)})
                 if gq.normalize:
                     # subquery-level @normalize: flatten each target's
                     # subtree into aliased-leaf rows, concatenated

@@ -713,20 +713,27 @@ class Executor:
         out: Dict[int, Val] = {}
         for u in parent.dest_uids:
             env = {}
-            ok = True
+            present = 0
             for v in needed:
                 vmap = self.val_vars.get(v, {})
-                val = vmap.get(int(u))
+                # ancestor-level vars use the PROPAGATED (path-summed)
+                # value — the raw map is keyed at the ancestor level and
+                # may collide with this level's uids (ref query.go
+                # transformTo path maps)
+                val = parent.level_vars.get(v, {}).get(int(u))
                 if val is None:
-                    # ancestor-level var propagated down (path-summed),
-                    # then block-wide scalars (key -1)
-                    val = parent.level_vars.get(v, {}).get(int(u))
+                    val = vmap.get(int(u))
                 if val is None:
                     val = vmap.get(MAXUID)
                 if val is None:
-                    ok = False
-                    break
-                env[v] = val
+                    # a uid with AT LEAST one bound var evaluates with the
+                    # rest defaulting to 0 (ref math.go zero-fill); a uid
+                    # with none stays out of the result entirely
+                    env[v] = Val(TypeID.INT, 0)
+                else:
+                    present += 1
+                    env[v] = val
+            ok = present > 0 or not needed
             if not ok:
                 continue
             try:
@@ -798,36 +805,48 @@ class Executor:
 
             for cu in row:
                 # a multi-valued uid groupby attr lands the entity in ONE
-                # bucket PER target (ref groupby.go: each edge groups)
+                # bucket PER target (ref groupby.go: each edge groups);
+                # members missing ANY groupby attr fall out of the result
+                # (dedupMap only collects uids with values)
                 options = []
+                skip = False
                 for ga in cgq.groupby_attrs:
                     su = self.st.get(ga)
+                    disp_key = cgq.groupby_aliases.get(ga, ga)
                     if su is not None and su.value_type == TypeID.UID:
                         tgts = self.cache.uids(
                             keys.DataKey(ga, int(cu), self.ns)
                         )
-                        if len(tgts):
-                            options.append(
-                                [
-                                    (ga, int(t), hex(int(t)))
-                                    for t in tgts
-                                ]
-                            )
-                        else:
-                            options.append([(ga, None, None)])
+                        if not len(tgts):
+                            skip = True
+                            break
+                        options.append(
+                            [
+                                (disp_key, int(t), hex(int(t)))
+                                for t in tgts
+                            ]
+                        )
                     else:
                         v = self.cache.value(keys.DataKey(ga, int(cu), self.ns))
-                        kv = None if v is None else v.value
-                        options.append([(ga, kv, kv)])
+                        if v is None:
+                            skip = True
+                            break
+                        options.append([(disp_key, v.value, v.value)])
+                if skip:
+                    continue
+                cnt_key = "count"
+                for cc in cgq.children:
+                    if cc.is_count and cc.attr == "uid" and cc.alias:
+                        cnt_key = cc.alias  # `Count: count(uid)` alias
                 for combo in _it.product(*options):
                     k = tuple(kv for _, kv, _d in combo)
                     disp = {ga: d for ga, _kv, d in combo}
                     b = buckets.get(k)
                     if b is None:
                         buckets[k] = b = {
-                            **disp, "count": 0, "__members__": []
+                            **disp, cnt_key: 0, "__members__": []
                         }
-                    b["count"] += 1
+                    b[cnt_key] += 1
                     b["__members__"].append(int(cu))
             self._finish_groupby(cgq, cnode, buckets, int(pu))
 
@@ -892,9 +911,10 @@ class Executor:
                 for c in cgq.children:
                     if c.var_name and c.is_count and c.attr == "uid":
                         vals = self.val_vars.setdefault(c.var_name, {})
+                        ck = c.alias or "count"
                         for k, b in buckets.items():
-                            if k[0] is not None:
-                                vals[int(k[0])] = Val(TypeID.INT, b["count"])
+                            if k[0] is not None and ck in b:
+                                vals[int(k[0])] = Val(TypeID.INT, b[ck])
                     elif c.var_name and c.aggregator and c.attr:
                         # `a as max(name)` in @groupby(uidpred): bind the
                         # per-group aggregate keyed by the group target
@@ -962,7 +982,21 @@ class Executor:
                 fmap = fmaps[i] if i < len(fmaps) else {}
                 for u in row:
                     fv = fmap.get(int(u), {}).get(fname)
-                    if fv is not None:
+                    if fv is None:
+                        continue
+                    prev = vals.get(int(u))
+                    if prev is not None and isinstance(
+                        prev.value, (int, float)
+                    ) and isinstance(fv.value, (int, float)) and not (
+                        isinstance(prev.value, bool)
+                        or isinstance(fv.value, bool)
+                    ):
+                        # a facet var hit via several edges SUMS
+                        # (ref query.go facet var aggregation)
+                        vals[int(u)] = Val(
+                            TypeID.FLOAT, prev.value + fv.value
+                        )
+                    else:
                         vals[int(u)] = fv
             cnode.own_vars.add(var)
             self.var_def_node[var] = cnode
@@ -1476,7 +1510,7 @@ def _merge_rows(rows: List[np.ndarray]) -> np.ndarray:
 def _paginate(uids: np.ndarray, first, offset, after) -> np.ndarray:
     if after is not None:
         uids = uids[uids > np.uint64(after)]
-    if offset:
+    if offset and offset > 0:  # negative offset = 0 (ref TestNegativeOffset)
         uids = uids[offset:]
     if first is not None:
         if first >= 0:
