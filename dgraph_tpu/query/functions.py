@@ -687,7 +687,10 @@ class FuncRunner:
             raise QueryError("regexp expects /pattern/flags")
         pattern, flags = arg[1], arg[2]
         pattern = _go_inline_flags(pattern)
-        rx = re.compile(pattern, re.IGNORECASE if "i" in flags else 0)
+        try:
+            rx = re.compile(pattern, re.IGNORECASE if "i" in flags else 0)
+        except re.error as e:
+            raise QueryError(f"bad regexp {pattern!r}: {e}") from None
         # trigram prefilter (ref worker/task.go:1240 + tok trigram)
         cands = None
         if "trigram" in su.tokenizers:
@@ -929,6 +932,8 @@ def _go_inline_flags(pattern: str) -> str:
     if "(?-" not in pattern:
         return pattern
     out = re.sub(r"\(\?i\)(.*?)\(\?-i\)", r"(?i:\1)", pattern)
+    # strip any unpaired leftovers Python re would reject outright
+    out = out.replace("(?-i)", "")
     return out
 
 

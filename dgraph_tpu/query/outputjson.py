@@ -284,6 +284,11 @@ class JsonEncoder:
                         for flat in _normalize_flatten(k)
                         if flat
                     ]
+                has_count_row = any(
+                    cc.gq.is_count and cc.gq.attr == "uid"
+                    and not cc.gq.var_name
+                    for cc in c.children
+                )
                 if kids:
                     su = self.schema.get(c.attr) if self.schema else None
                     if (
@@ -292,6 +297,7 @@ class JsonEncoder:
                         and not c.attr.startswith("~")
                         and not gq.normalize
                         and not only_aliased
+                        and not has_count_row  # count rows need the list
                     ):
                         # non-list uid predicate encodes as ONE object
                         # (ref outputnode: best_friend {} not [])

@@ -739,8 +739,8 @@ class Executor:
             try:
                 out[int(u)] = to_val(eval_math(cgq.math_expr, env))
             except (MathError, KeyError, ValueError, OverflowError,
-                    ZeroDivisionError):
-                continue  # domain errors drop the uid (ref math.go)
+                    ZeroDivisionError, TypeError):
+                continue  # domain/type errors drop the uid (ref math.go)
         cnode.math_vals = out
         if cgq.var_name:
             self.val_vars[cgq.var_name] = out
@@ -1077,7 +1077,9 @@ class Executor:
         if remaining <= 0 or not len(frontier_node.dest_uids):
             return
         # expand(_all_)/expand(Type) resolves per level against the
-        # frontier's types (ref recurse.go preExpand)
+        # frontier's types (ref recurse.go preExpand); keep the original
+        # unresolved list for the recursive calls
+        orig_preds = preds
         preds = self._resolve_expand(preds, frontier_node.dest_uids)
         uid_children = []
         snapshot = seen[0]
@@ -1144,7 +1146,7 @@ class Executor:
             seen[0] = DISPATCHER.run_chain("union", [seen[0]] + new_sets)
         for cnode, nxt in uid_children:
             self._recurse_level(
-                cnode, preds, seen, remaining - 1, loop,
+                cnode, orig_preds, seen, remaining - 1, loop,
                 frontier=None if loop else nxt,
             )
 
@@ -1253,6 +1255,9 @@ class Executor:
                 c.uid_matrix = [c.uid_matrix[idx[int(u)]] for u in kept]
             c.src_uids = kept
         node.dest_uids = kept
+        if gq.var_name:
+            # the block's own uid var must see the pruned set too
+            self.uid_vars[gq.var_name] = kept
 
     # ------------------------------------------------------------------
     # Ordering / pagination
