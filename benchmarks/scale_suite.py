@@ -34,7 +34,7 @@ import time
 def load(edges: int, storage: str = "mem", data_dir=None):
     from benchmarks.movie_corpus import SCHEMA, generate
     from dgraph_tpu.api.server import Server
-    from dgraph_tpu.loaders.bulk import BulkLoader
+    from dgraph_tpu.loaders.bulk2 import ParallelBulkLoader
 
     corpus, rdf = generate(edges)
     if storage == "lsm":
@@ -47,12 +47,9 @@ def load(edges: int, storage: str = "mem", data_dir=None):
     else:
         s = Server()
     s.alter(SCHEMA)
-    loader = BulkLoader(s)
+    loader = ParallelBulkLoader(s)
     t0 = time.time()
-    loader.add_rdf("\n".join(rdf))
-    loader.finish()
-    if hasattr(s.kv, "compact"):
-        s.kv.compact()  # flatten tables post-bulk (badger Flatten)
+    loader.load_text("\n".join(rdf))
     load_s = time.time() - t0
     return corpus, s, load_s
 

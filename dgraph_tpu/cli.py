@@ -113,18 +113,16 @@ def cmd_alpha(args):
 def cmd_bulk(args):
     import time
 
-    from dgraph_tpu.loaders.bulk import BulkLoader
+    from dgraph_tpu.loaders.bulk2 import ParallelBulkLoader
 
     engine = _server(args)
     if args.schema:
         with open(args.schema) as f:
             engine.alter(f.read())
     t0 = time.time()
-    loader = BulkLoader(engine)
-    for path in args.files:
-        loader.add_rdf_file(path)
-    n = loader._nquads
-    loader.finish()
+    loader = ParallelBulkLoader(engine)
+    loader.load_files(list(args.files))
+    n = loader.nquads
     engine.kv.sync() if hasattr(engine.kv, "sync") else None
     print(f"bulk loaded {n} nquads in {time.time()-t0:.1f}s")
 

@@ -172,14 +172,16 @@ def _dec_posting(data: bytes, pos: int) -> Tuple[Posting, int]:
 
 
 def encode_rollup(
-    pack: uidpack.UidPack,
+    pack,
     postings: List[Posting],
     split_starts: Optional[List[int]] = None,
 ) -> bytes:
     """Main rollup record. When `split_starts` is non-empty the pack holds
     only value/facet postings' context — the uid set lives in part records
-    (one per start uid) under keys.SplitKey(main_key, start)."""
-    pb = uidpack.serialize(pack)
+    (one per start uid) under keys.SplitKey(main_key, start).
+
+    `pack` is a UidPack or pre-serialized pack bytes (bulk fast path)."""
+    pb = pack if isinstance(pack, bytes) else uidpack.serialize(pack)
     out = [struct.pack("<BI", KIND_ROLLUP, len(pb)), pb]
     out.append(struct.pack("<I", len(postings)))
     for p in postings:
@@ -242,7 +244,9 @@ def rollup_writes(
     PostingList.rollup)."""
     uids = np.asarray(uids, np.uint64)
     if len(uids) <= MAX_PART_UIDS:
-        return [(key, ts, encode_rollup(uidpack.encode(uids), list(posts)))]
+        return [
+            (key, ts, encode_rollup(uidpack.serialize_uids(uids), list(posts)))
+        ]
     from dgraph_tpu.x import keys as _keys
 
     per = max(1, MAX_PART_UIDS // 2)

@@ -664,6 +664,28 @@ class LsmKV(KV):
                 self._wal_append(_OP_PUT, key, ts, self._seq, val)
             self._save_manifest()
 
+    def ingest_sorted(self, entries):
+        """Stream key-sorted (key, ts, value) records straight into ONE new
+        SSTable — no WAL, no memtable, no compaction (badger StreamWriter,
+        the bulk loader's reduce output path). Records must arrive in
+        ascending key order."""
+        with self._mu:
+            self._seq += 1
+            base = self._seq
+            name = f"sst_{base:016x}i.tbl"
+            path = os.path.join(self.dir, name)
+
+            def with_seq():
+                n = 0
+                for key, ts, val in entries:
+                    n += 1
+                    yield key, ts, base + n, val
+                self._seq = base + n
+
+            _SSTable.write(path, with_seq(), self.enc_key)
+            self._tables.insert(0, _SSTable(path, self.enc_key))
+            self._save_manifest()
+
     def sync(self):
         with self._mu:
             if self._wal is not None:
