@@ -1,0 +1,127 @@
+"""One-command TPU benchmark day (VERDICT r2 next #4).
+
+When the axon tunnel is up, this converts it into the full set of
+hardware numbers in one run, each stage a separate subprocess so a
+single stage failing (or the tunnel dropping mid-run) still leaves the
+others' JSON on disk:
+
+  1. bench.py               — headline batched 10v1M intersect + ratio sweep
+  2. pallas_bench.py        — Pallas compare-all sweep vs XLA searchsorted, compiled
+  3. tune_thresholds.py     — host/device crossover for _DEVICE_MIN_TOTAL
+  4. bench_suite.py         — 2-hop engine traversal + vector QPS (brute/IVF)
+  5. scale_suite.py         — 1M-edge corpus, 11 golden queries, device on
+
+Usage:
+  python benchmarks/tpu_day.py [--out TPU_DAY.json] [--scale small|full]
+                               [--edges 1000000] [--skip stage,...]
+
+Emits ONE combined JSON at --out. Designed to run end-to-end on the CPU
+fallback too (stages detect the backend themselves).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_stage(name, argv, timeout_s, out):
+    print(f"=== stage {name}: {' '.join(argv)}", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            argv,
+            cwd=REPO,
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        out[name] = {
+            "rc": p.returncode,
+            "wall_s": round(time.time() - t0, 1),
+        }
+        if p.returncode != 0:
+            out[name]["stderr_tail"] = p.stderr[-2000:]
+        return p
+    except subprocess.TimeoutExpired:
+        out[name] = {"rc": -1, "error": f"timeout after {timeout_s}s"}
+        return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "TPU_DAY.json"))
+    ap.add_argument("--scale", choices=["small", "full"], default="small")
+    ap.add_argument("--edges", type=int, default=1_000_000)
+    ap.add_argument("--skip", default="")
+    args = ap.parse_args()
+    skip = set(filter(None, args.skip.split(",")))
+
+    tmp = tempfile.mkdtemp(prefix="tpu_day_")
+    results = {"started": time.strftime("%Y-%m-%dT%H:%M:%S"), "stages": {}}
+    st = results["stages"]
+    py = sys.executable
+
+    if "bench" not in skip:
+        p = run_stage("bench", [py, "bench.py"], 900, st)
+        if p and p.returncode == 0:
+            try:
+                st["bench"]["result"] = json.loads(p.stdout.strip().splitlines()[-1])
+                st["bench"]["sweep_stderr"] = p.stderr[-1500:]
+            except Exception:
+                st["bench"]["raw"] = p.stdout[-1000:]
+
+    if "pallas" not in skip:
+        j = os.path.join(tmp, "pallas.json")
+        p = run_stage(
+            "pallas", [py, "benchmarks/pallas_bench.py", "--json", j], 1200, st
+        )
+        if os.path.exists(j):
+            st["pallas"]["result"] = json.load(open(j))
+
+    if "thresholds" not in skip:
+        j = os.path.join(tmp, "thr.json")
+        p = run_stage(
+            "thresholds",
+            [py, "benchmarks/tune_thresholds.py", "--json", j],
+            1200,
+            st,
+        )
+        if os.path.exists(j):
+            st["thresholds"]["result"] = json.load(open(j))
+
+    if "suite" not in skip:
+        j = os.path.join(tmp, "suite.json")
+        p = run_stage(
+            "suite",
+            [py, "benchmarks/bench_suite.py", "--scale", args.scale, "--json", j],
+            5400,
+            st,
+        )
+        if os.path.exists(j):
+            st["suite"]["result"] = json.load(open(j))
+
+    if "scale" not in skip:
+        j = os.path.join(tmp, "scale.json")
+        p = run_stage(
+            "scale",
+            [py, "benchmarks/scale_suite.py", "--edges", str(args.edges), "--json", j],
+            7200,
+            st,
+        )
+        if os.path.exists(j):
+            st["scale"]["result"] = json.load(open(j))
+
+    results["finished"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({"out": args.out, "stages": list(st)}, indent=1))
+
+
+if __name__ == "__main__":
+    main()

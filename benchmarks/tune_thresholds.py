@@ -1,0 +1,99 @@
+"""Measure the host-vs-device break-even for the dispatcher thresholds.
+
+_DEVICE_MIN_TOTAL (query/dispatch.py) decides when a batch of set ops is
+worth a device dispatch instead of host numpy/C++. It shipped as a guess
+(32k); this script measures, on the LIVE backend:
+
+  - host path latency (the dispatcher's vectorized searchsorted fallback
+    + native C++ loops) across total-work sizes,
+  - device round-trip latency for the same batches (upload, vmapped
+    kernel, download),
+
+and reports the crossover total. Run with the TPU tunnel up to tune for
+real dispatch latency; the recommended value is printed and can be
+pinned via DGRAPH_TPU_DEVICE_MIN_TOTAL.
+
+Usage: python benchmarks/tune_thresholds.py [--json out]
+"""
+
+import sys as _sys
+
+_sys.path.insert(0, "/root/repo") if "/root/repo" not in _sys.path else None
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    from dgraph_tpu.query.dispatch import SetOpDispatcher
+
+    backend = jax.default_backend()
+    rng = np.random.default_rng(3)
+
+    rows = []
+    crossover = None
+    # batch of 32 rows vs one shared big operand — the dominant query shape
+    for big in [1 << k for k in range(10, 23)]:
+        b = np.sort(
+            rng.choice(np.uint64(1) << np.uint64(33), size=big, replace=False)
+        ).astype(np.uint64)
+        rws = [np.sort(rng.choice(b, size=16)).astype(np.uint64) for _ in range(32)]
+        total = sum(len(r) for r in rws) + len(b)
+
+        d = SetOpDispatcher()
+        # host path: force the threshold above total
+        import dgraph_tpu.query.dispatch as dmod
+
+        old_min, old_force = dmod._DEVICE_MIN_TOTAL, dmod._FORCE_DEVICE
+        try:
+            dmod._DEVICE_MIN_TOTAL, dmod._FORCE_DEVICE = 1 << 62, False
+            d.run_rows_vs_one("intersect", rws, b)  # warm
+            t0 = time.perf_counter()
+            for _ in range(10):
+                d.run_rows_vs_one("intersect", rws, b)
+            t_host = (time.perf_counter() - t0) / 10
+
+            dmod._DEVICE_MIN_TOTAL, dmod._FORCE_DEVICE = 0, True
+            d.run_rows_vs_one("intersect", rws, b)  # warm/compile
+            t0 = time.perf_counter()
+            for _ in range(10):
+                d.run_rows_vs_one("intersect", rws, b)
+            t_dev = (time.perf_counter() - t0) / 10
+        finally:
+            dmod._DEVICE_MIN_TOTAL, dmod._FORCE_DEVICE = old_min, old_force
+
+        row = {
+            "total": total,
+            "big": big,
+            "host_us": round(t_host * 1e6, 1),
+            "device_us": round(t_dev * 1e6, 1),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        if crossover is None and t_dev < t_host:
+            crossover = total
+
+    rec = crossover if crossover is not None else 1 << 62
+    result = {
+        "backend": backend,
+        "rows": rows,
+        "crossover_total": crossover,
+        "recommended_DEVICE_MIN_TOTAL": rec,
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result, indent=1))
+
+
+if __name__ == "__main__":
+    main()

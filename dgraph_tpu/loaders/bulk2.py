@@ -74,17 +74,17 @@ class _Run:
         return _Run(path)
 
     def __iter__(self) -> Iterator[Tuple[bytes, int, bytes]]:
-        with open(self.path, "rb") as f:
-            data = f.read()
-        pos, n = 0, len(data)
-        while pos < n:
-            klen, kind, plen = _REC.unpack_from(data, pos)
-            pos += _REC.size
-            key = data[pos : pos + klen]
-            pos += klen
-            payload = data[pos : pos + plen]
-            pos += plen
-            yield key, kind, payload
+        # buffered incremental read: reduce holds every run open at once,
+        # so per-run memory must stay O(record), not O(file)
+        with open(self.path, "rb", buffering=1 << 20) as f:
+            while True:
+                hdr = f.read(_REC.size)
+                if len(hdr) < _REC.size:
+                    return
+                klen, kind, plen = _REC.unpack(hdr)
+                key = f.read(klen)
+                payload = f.read(plen)
+                yield key, kind, payload
 
 
 class _MapState:
@@ -360,6 +360,7 @@ class ParallelBulkLoader:
         ts = server.zero.next_ts()
         merged = heapq.merge(*runs, key=lambda e: (e[0], e[1], e[2]))
         counts: Dict[Tuple[str, int, int], List[int]] = {}
+        vecs_out: List[Tuple[str, int, np.ndarray]] = []
         stats = getattr(server, "stats", None)
 
         def groups():
@@ -378,14 +379,49 @@ class ParallelBulkLoader:
             if cur_key is not None:
                 yield cur_key, uids, posts
 
+        from dgraph_tpu.types.types import from_binary
+
+        vec_preds = {
+            p
+            for p in server.schema.predicates()
+            if getattr(server.schema.get(p), "vector_specs", None)
+        }
+
         def writes() -> Iterator[Tuple[bytes, int, bytes]]:
             for key, uids, posts in groups():
                 if posts:
+                    pk = keys.parse_key(key)
+                    su = server.schema.get(pk.attr) if pk.is_data else None
                     dedup: Dict[int, Posting] = {}
                     for pb in posts:
                         p: Posting = pickle.loads(pb)
+                        if (
+                            su is not None
+                            and su.value_type not in (TypeID.DEFAULT, p.value_type)
+                        ):
+                            # workers infer undeclared-predicate types on
+                            # their own chunk; the merged schema (chunk-order
+                            # first-wins) is authoritative — re-convert here
+                            # so stored data is chunking-independent, and
+                            # fail loudly on unconvertible values like the
+                            # sequential loader does
+                            v = convert(
+                                from_binary(TypeID(p.value_type), p.value),
+                                su.value_type,
+                            )
+                            p.value = to_binary(v)
+                            p.value_type = v.tid
                         dedup[p.uid] = p  # merge order = run order
                     ordered = [dedup[u] for u in sorted(dedup)]
+                    if pk.is_data and pk.attr in vec_preds:
+                        for p in ordered:
+                            vecs_out.append(
+                                (
+                                    pk.attr,
+                                    pk.uid,
+                                    np.frombuffer(p.value, np.float32),
+                                )
+                            )
                     pack = uidpack.serialize_uids(
                         np.unique(np.asarray(uids, np.uint64))
                         if uids
@@ -421,6 +457,11 @@ class ParallelBulkLoader:
                 )
             cw.sort(key=lambda w: w[0])
             self._ingest(iter(cw), ts)
+        # vector predicates feed the similarity engine directly (the old
+        # in-memory loader's server.vector_indexes path — review finding)
+        for attr, subj, vec in vecs_out:
+            server._ensure_vector_index(server.schema.get(attr))
+            server.vector_indexes[attr].insert(subj, vec)
         return ts
 
     def _ingest(self, stream: Iterator[Tuple[bytes, int, bytes]], ts: int):

@@ -304,3 +304,48 @@ def test_parallel_bulk_loader_spill_and_ingest(tmp_path, monkeypatch):
     out = s.query('{ q(func: eq(name, "from-xid")) { name } }')
     assert out["data"]["q"][0]["name"] == "from-xid"
     s.kv.close()
+
+
+def test_parallel_bulk_loader_vectors(tmp_path):
+    """Bulk-loaded float32vector predicates must land in the similarity
+    engine without a restart (parity with loaders.bulk's vector path)."""
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.loaders.bulk2 import ParallelBulkLoader
+
+    s = Server()
+    s.alter(
+        'emb: float32vector @index(hnsw(metric:"euclidean")) .\n'
+        "name: string @index(exact) ."
+    )
+    rdf = []
+    for i in range(8):
+        vec = f"[{float(i)}, {float(i)}]"
+        rdf.append(f'<0x{i+1:x}> <emb> "{vec}"^^<xs:float32vector> .')
+        rdf.append(f'<0x{i+1:x}> <name> "v{i}" .')
+    ld = ParallelBulkLoader(s, workdir=str(tmp_path / "w"), workers=1)
+    ld.load_text("\n".join(rdf))
+    out = s.query(
+        '{ q(func: similar_to(emb, 2, "[3.1, 3.1]")) { name } }'
+    )
+    names = [r["name"] for r in out["data"]["q"]]
+    assert names == ["v3", "v4"]
+
+
+def test_parallel_bulk_loader_type_inference_chunk_independent(tmp_path):
+    """Undeclared-predicate types are decided by first occurrence in input
+    order regardless of worker chunking; later conflicting values convert
+    to the decided type at reduce (review finding: per-worker inference)."""
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.loaders.bulk2 import ParallelBulkLoader
+
+    lines = ['<0x1> <age> "25"^^<xs:int> .']
+    lines += [f'<0x{i:x}> <age> "{i}"^^<xs:int> .' for i in range(2, 40)]
+    s = Server()
+    ld = ParallelBulkLoader(s, workdir=str(tmp_path / "w"), workers=2)
+    ld.load_text("\n".join(lines))
+    su = s.schema.get("age")
+    from dgraph_tpu.types.types import TypeID
+
+    assert su is not None and su.value_type == TypeID.INT
+    out = s.query('{ q(func: eq(age, 25)) { age } }')
+    assert out["data"]["q"][0]["age"] == 25
