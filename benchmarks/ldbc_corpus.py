@@ -1,0 +1,334 @@
+"""Deterministic LDBC-SNB-shaped corpus generator.
+
+The reference's LDBC oracle (systest/ldbc/ldbc_test.go) bulk-loads the
+real SNB dataset (fetched by CI from TEST_DATA_DIRECTORY — not present in
+the tree) and asserts golden answers from test_cases.yaml. With no
+network egress the dataset itself cannot be used here, so this module
+mirrors its SHAPE instead: persons with a knows-graph (creationDate
+facets), places, messages (posts + comments) with hasCreator/replyOf,
+forums with containerOf/hasModerator — the exact entity/edge layout the
+IS01..IS07 interactive-short-read queries exercise
+(/root/reference/systest/ldbc/test_cases.yaml:1-90).
+
+Like movie_corpus.py, the generator returns BOTH the RDF stream and a
+plain-Python model, so conformance goldens are derived independently of
+the engine under test.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+SCHEMA = """
+fqid: string @index(exact) @upsert .
+id: int @index(int) .
+firstName: string @index(exact, term) .
+lastName: string @index(exact, term) .
+gender: string .
+birthday: datetime .
+creationDate: datetime @index(hour) .
+locationIP: string .
+browserUsed: string .
+content: string @index(fulltext) .
+imageFile: string .
+title: string @index(term) .
+name: string @index(exact) .
+dgraph.type: [string] @index(exact) .
+knows: [uid] @reverse .
+isLocatedIn: [uid] @reverse .
+hasCreator: [uid] @reverse .
+replyOf: [uid] @reverse .
+containerOf: [uid] @reverse .
+hasModerator: [uid] @reverse .
+likes: [uid] @reverse .
+"""
+
+_FIRST = ["Mahinda", "Karl", "Jose", "Rudolf", "Chutima", "Farhad",
+          "Abhishek", "Ouwo", "Abdou", "Jan", "Aisha", "Wei", "Maria",
+          "Ivan", "Lena", "Noor"]
+_LAST = ["Perera", "Wagner", "Costa", "Engel", "Wattansin", "Qaderi",
+         "Roy", "Maazou", "Dia", "Hus", "Khan", "Chen", "Silva",
+         "Petrov", "Meyer", "Ali"]
+_PLACES = ["Thanjavur", "Leipzig", "Porto", "Vienna", "Bangkok",
+           "Kabul", "Kolkata", "Niamey", "Dakar", "Prague"]
+_BROWSERS = ["Internet Explorer", "Firefox", "Chrome", "Safari", "Opera"]
+
+
+def _dt(ms_epoch: int) -> str:
+    """RFC3339 with millis, the SNB creationDate shape."""
+    import datetime
+
+    d = datetime.datetime.fromtimestamp(
+        ms_epoch / 1000.0, datetime.timezone.utc
+    )
+    return d.strftime("%Y-%m-%dT%H:%M:%S.") + f"{ms_epoch % 1000:03d}Z"
+
+
+@dataclass
+class Person:
+    uid: int
+    sid: int  # SNB id
+    first: str
+    last: str
+    gender: str
+    birthday: str
+    creation: int  # ms epoch
+    ip: str
+    browser: str
+    place: int  # place uid
+
+
+@dataclass
+class Message:
+    uid: int
+    sid: int
+    kind: str  # "post" | "comment"
+    content: str
+    image: str
+    creation: int
+    creator: int  # person uid
+    reply_of: Optional[int] = None  # message uid (comments)
+
+
+@dataclass
+class Forum:
+    uid: int
+    sid: int
+    title: str
+    moderator: int
+    posts: List[int] = field(default_factory=list)
+
+
+@dataclass
+class Corpus:
+    persons: Dict[int, Person] = field(default_factory=dict)
+    messages: Dict[int, Message] = field(default_factory=dict)
+    forums: Dict[int, Forum] = field(default_factory=dict)
+    places: Dict[int, str] = field(default_factory=dict)  # uid -> name
+    place_ids: Dict[int, int] = field(default_factory=dict)  # uid -> id
+    # knows edges with creationDate facet (ms): (a, b) -> ms, a < b
+    knows: Dict[Tuple[int, int], int] = field(default_factory=dict)
+    by_fqid: Dict[str, int] = field(default_factory=dict)
+    n_edges: int = 0
+
+    # -- derived goldens ----------------------------------------------------
+
+    def knows_of(self, uid: int) -> List[Tuple[int, int]]:
+        """[(friend uid, facet ms)] for one person."""
+        out = []
+        for (a, b), ms in self.knows.items():
+            if a == uid:
+                out.append((b, ms))
+            elif b == uid:
+                out.append((a, ms))
+        return out
+
+    def friends_of_friends(self, uid: int) -> List[int]:
+        """2-hop friends (excluding self and direct friends) — the
+        north-star traversal (BASELINE.json LDBC 2-hop)."""
+        direct = {f for f, _ in self.knows_of(uid)}
+        out = set()
+        for f in direct:
+            for g, _ in self.knows_of(f):
+                if g != uid and g not in direct:
+                    out.add(g)
+        return sorted(out)
+
+    def messages_by(self, person_uid: int) -> List[int]:
+        return sorted(
+            m.uid for m in self.messages.values() if m.creator == person_uid
+        )
+
+    def replies_to(self, msg_uid: int) -> List[int]:
+        return sorted(
+            m.uid for m in self.messages.values() if m.reply_of == msg_uid
+        )
+
+    def forum_of_post(self, post_uid: int) -> Optional[int]:
+        for f in self.forums.values():
+            if post_uid in f.posts:
+                return f.uid
+        return None
+
+
+def generate(
+    n_persons: int = 200,
+    n_posts: int = 600,
+    n_comments: int = 900,
+    seed: int = 7,
+) -> Tuple[Corpus, List[str]]:
+    rng = np.random.default_rng(seed)
+    c = Corpus()
+    rdf: List[str] = []
+    uid = 0x10000
+
+    def nu() -> int:
+        nonlocal uid
+        uid += 1
+        return uid
+
+    def emit(s, p, o, facet=None):
+        c.n_edges += 1
+        rdf.append(
+            f"<0x{s:x}> <{p}> {o} "
+            + (f"({facet}) ." if facet else ".")
+        )
+
+    def lit(v: str) -> str:
+        e = v.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{e}"'
+
+    # places
+    for i, name in enumerate(_PLACES):
+        pu = nu()
+        c.places[pu] = name
+        c.place_ids[pu] = 200 + i
+        emit(pu, "name", lit(name))
+        emit(pu, "id", f'"{200+i}"^^<xs:int>')
+        emit(pu, "dgraph.type", lit("place"))
+
+    place_uids = list(c.places)
+
+    # persons
+    base_ms = 1275850000000  # ~2010-06
+    for i in range(n_persons):
+        pu = nu()
+        sid = 933 + i * 7
+        p = Person(
+            uid=pu,
+            sid=sid,
+            first=_FIRST[int(rng.integers(len(_FIRST)))],
+            last=_LAST[int(rng.integers(len(_LAST)))],
+            gender="male" if rng.integers(2) else "female",
+            birthday=f"19{60 + int(rng.integers(40)):02d}-0{1 + int(rng.integers(9))}-0{1 + int(rng.integers(9))}T00:00:00Z",
+            creation=base_ms + int(rng.integers(0, 60_000_000_000)),
+            ip=f"27.54.{int(rng.integers(256))}.{int(rng.integers(256))}",
+            browser=_BROWSERS[int(rng.integers(len(_BROWSERS)))],
+            place=place_uids[int(rng.integers(len(place_uids)))],
+        )
+        c.persons[pu] = p
+        fq = f"person_{sid}"
+        c.by_fqid[fq] = pu
+        emit(pu, "fqid", lit(fq))
+        emit(pu, "id", f'"{sid}"^^<xs:int>')
+        emit(pu, "firstName", lit(p.first))
+        emit(pu, "lastName", lit(p.last))
+        emit(pu, "gender", lit(p.gender))
+        emit(pu, "birthday", f'"{p.birthday}"^^<xs:dateTime>')
+        emit(pu, "creationDate", f'"{_dt(p.creation)}"^^<xs:dateTime>')
+        emit(pu, "locationIP", lit(p.ip))
+        emit(pu, "browserUsed", lit(p.browser))
+        emit(pu, "dgraph.type", lit("person"))
+        emit(pu, "isLocatedIn", f"<0x{p.place:x}>")
+
+    person_uids = list(c.persons)
+
+    # knows graph: preferential-ish — everyone gets 3-10 friends
+    for pu in person_uids:
+        deg = 3 + int(rng.integers(8))
+        for _ in range(deg):
+            q = person_uids[int(rng.integers(len(person_uids)))]
+            if q == pu:
+                continue
+            a, b = min(pu, q), max(pu, q)
+            if (a, b) in c.knows:
+                continue
+            ms = base_ms + int(rng.integers(0, 60_000_000_000))
+            c.knows[(a, b)] = ms
+            facet = f'creationDate="{_dt(ms)}"^^<xs:dateTime>'
+            emit(a, "knows", f"<0x{b:x}>", facet)
+            emit(b, "knows", f"<0x{a:x}>", facet)
+
+    # posts
+    post_uids: List[int] = []
+    for i in range(n_posts):
+        mu = nu()
+        sid = 3 + i * 11
+        creator = person_uids[int(rng.integers(len(person_uids)))]
+        m = Message(
+            uid=mu,
+            sid=sid,
+            kind="post",
+            content=(
+                f"About topic {int(rng.integers(500))}, opinion {i}"
+                if rng.integers(4)
+                else ""
+            ),
+            image=f"photo{sid}.jpg" if not rng.integers(3) else "",
+            creation=base_ms + int(rng.integers(0, 70_000_000_000)),
+            creator=creator,
+        )
+        c.messages[mu] = m
+        post_uids.append(mu)
+        fq = f"post_{sid}"
+        c.by_fqid[fq] = mu
+        emit(mu, "fqid", lit(fq))
+        emit(mu, "id", f'"{sid}"^^<xs:int>')
+        if m.content:
+            emit(mu, "content", lit(m.content))
+        if m.image:
+            emit(mu, "imageFile", lit(m.image))
+        emit(mu, "creationDate", f'"{_dt(m.creation)}"^^<xs:dateTime>')
+        emit(mu, "dgraph.type", lit("post"))
+        emit(mu, "hasCreator", f"<0x{creator:x}>")
+
+    # comments (reply to posts or earlier comments)
+    all_msgs = list(post_uids)
+    for i in range(n_comments):
+        mu = nu()
+        sid = 1099511627777 + i * 3
+        creator = person_uids[int(rng.integers(len(person_uids)))]
+        target = all_msgs[int(rng.integers(len(all_msgs)))]
+        m = Message(
+            uid=mu,
+            sid=sid,
+            kind="comment",
+            content=f"reply {i} about {int(rng.integers(100))}",
+            image="",
+            creation=c.messages[target].creation
+            + 1000 + int(rng.integers(0, 5_000_000_000)),
+            creator=creator,
+            reply_of=target,
+        )
+        c.messages[mu] = m
+        all_msgs.append(mu)
+        fq = f"comment_{sid}"
+        c.by_fqid[fq] = mu
+        emit(mu, "fqid", lit(fq))
+        emit(mu, "id", f'"{sid}"^^<xs:int>')
+        emit(mu, "content", lit(m.content))
+        emit(mu, "creationDate", f'"{_dt(m.creation)}"^^<xs:dateTime>')
+        emit(mu, "dgraph.type", lit("comment"))
+        emit(mu, "hasCreator", f"<0x{creator:x}>")
+        emit(mu, "replyOf", f"<0x{target:x}>")
+
+    # forums: each wraps a slice of posts
+    nf = max(1, n_persons // 10)
+    for i in range(nf):
+        fu = nu()
+        sid = i
+        mod = person_uids[int(rng.integers(len(person_uids)))]
+        f = Forum(
+            uid=fu,
+            sid=sid,
+            title=f"Wall of {c.persons[mod].first} {c.persons[mod].last}",
+            moderator=mod,
+        )
+        c.forums[fu] = f
+        fq = f"forum_{sid}"
+        c.by_fqid[fq] = fu
+        emit(fu, "fqid", lit(fq))
+        emit(fu, "id", f'"{sid}"^^<xs:int>')
+        emit(fu, "title", lit(f.title))
+        emit(fu, "dgraph.type", lit("forum"))
+        emit(fu, "hasModerator", f"<0x{mod:x}>")
+    forum_uids = list(c.forums)
+    for j, mu in enumerate(post_uids):
+        fu = forum_uids[j % len(forum_uids)]
+        c.forums[fu].posts.append(mu)
+        emit(fu, "containerOf", f"<0x{mu:x}>")
+
+    return c, rdf

@@ -90,10 +90,12 @@ def main():
 
         t_pallas = None
         if small <= 128:
-            def pl_one(a, la, b, lb):
-                return pallas_setops.intersect(a, la, b, lb, interpret=interpret)
+            def pl_batch(A_, LA_, B_, LB_):
+                return pallas_setops.intersect_batch(
+                    A_, LA_, B_, LB_, interpret=interpret
+                )
 
-            pl_fn = jax.jit(jax.vmap(pl_one))
+            pl_fn = jax.jit(pl_batch)
             try:
                 t_pallas = _bench(pl_fn, (Ad, LAd, Bd, LBd))
             except Exception as e:  # pragma: no cover - hardware-specific

@@ -189,12 +189,39 @@ def _map_chunk(args) -> dict:
             st.add(
                 keys.DataKey(attr, subj, ns), _K_UID, struct.pack("<Q", obj)
             )
+            if nq.facets:
+                # uid-edge facets ride as a value-less Posting next to the
+                # pack (posting/pl.py rollup keeps them alongside)
+                fb = {k: to_binary(v) for k, v in nq.facets.items()}
+                ft = {k: v.tid for k, v in nq.facets.items()}
+                st.add(
+                    keys.DataKey(attr, subj, ns),
+                    _K_VAL,
+                    pickle.dumps(
+                        Posting(
+                            uid=obj, op=OP_SET, facets=fb, facet_types=ft
+                        ),
+                        protocol=4,
+                    ),
+                )
             if su.directive_reverse:
                 st.add(
                     keys.ReverseKey(attr, obj, ns),
                     _K_UID,
                     struct.pack("<Q", subj),
                 )
+                if nq.facets:
+                    st.add(
+                        keys.ReverseKey(attr, obj, ns),
+                        _K_VAL,
+                        pickle.dumps(
+                            Posting(
+                                uid=subj, op=OP_SET, facets=fb,
+                                facet_types=ft,
+                            ),
+                            protocol=4,
+                        ),
+                    )
             continue
 
         stored = (
@@ -396,7 +423,8 @@ class ParallelBulkLoader:
                     for pb in posts:
                         p: Posting = pickle.loads(pb)
                         if (
-                            su is not None
+                            p.is_value
+                            and su is not None
                             and su.value_type not in (TypeID.DEFAULT, p.value_type)
                         ):
                             # workers infer undeclared-predicate types on
@@ -415,19 +443,26 @@ class ParallelBulkLoader:
                     ordered = [dedup[u] for u in sorted(dedup)]
                     if pk.is_data and pk.attr in vec_preds:
                         for p in ordered:
-                            vecs_out.append(
-                                (
-                                    pk.attr,
-                                    pk.uid,
-                                    np.frombuffer(p.value, np.float32),
+                            if p.is_value:
+                                vecs_out.append(
+                                    (
+                                        pk.attr,
+                                        pk.uid,
+                                        np.frombuffer(p.value, np.float32),
+                                    )
                                 )
-                            )
-                    pack = uidpack.serialize_uids(
+                    u = (
                         np.unique(np.asarray(uids, np.uint64))
                         if uids
                         else np.zeros((0,), np.uint64)
                     )
-                    yield key, ts, encode_rollup(pack, ordered)
+                    if len(u) and pk.is_data and su is not None and su.count:
+                        counts.setdefault(
+                            (pk.attr, len(u), pk.ns), []
+                        ).append(pk.uid)
+                    yield key, ts, encode_rollup(
+                        uidpack.serialize_uids(u), ordered
+                    )
                     continue
                 u = np.unique(np.asarray(uids, np.uint64))
                 pk = keys.parse_key(key)

@@ -225,25 +225,64 @@ def sst_seek(buf: np.ndarray, end: int, off: int, key: bytes) -> int:
     )
 
 
-def sst_versions(buf: np.ndarray, end: int, off: int, key: bytes, cap: int = 64):
-    """(tss, seqs, val_offs, val_lens) arrays for entries == key."""
-    kb = np.frombuffer(key, dtype=np.uint8)
+def buf_ptr(arr: np.ndarray):
+    """Stable uint8 pointer for a long-lived buffer (an SSTable mmap) —
+    callers cache it so per-probe calls skip the numpy/ctypes marshaling
+    that dominated the point-get profile."""
+    return _ptr(arr, ctypes.c_uint8)
+
+
+class _VerScratch(__import__("threading").local):
+    """Reusable output arrays + cached pointers for sst_versions."""
+
+    def __init__(self):
+        self.cap = 0
+
+    def ensure(self, cap: int):
+        if cap <= self.cap:
+            return
+        self.cap = cap
+        self.tss = np.empty(cap, np.uint64)
+        self.seqs = np.empty(cap, np.uint64)
+        self.voffs = np.empty(cap, np.int64)
+        self.vlens = np.empty(cap, np.int64)
+        self.ptrs = (
+            _ptr(self.tss, ctypes.c_uint64),
+            _ptr(self.seqs, ctypes.c_uint64),
+            _ptr(self.voffs, ctypes.c_int64),
+            _ptr(self.vlens, ctypes.c_int64),
+        )
+
+
+_VSCRATCH = _VerScratch()
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def sst_versions(
+    buf: np.ndarray,
+    end: int,
+    off: int,
+    key: bytes,
+    cap: int = 64,
+    bptr=None,
+):
+    """(tss, seqs, val_offs, val_lens) arrays for entries == key.
+    Returned arrays are views into thread-local scratch — consume before
+    the next call on this thread."""
+    if bptr is None:
+        bptr = _ptr(buf, ctypes.c_uint8)
+    kp = ctypes.cast(ctypes.c_char_p(key), _U8P)
+    s = _VSCRATCH
     while True:
-        tss = np.empty(cap, np.uint64)
-        seqs = np.empty(cap, np.uint64)
-        voffs = np.empty(cap, np.int64)
-        vlens = np.empty(cap, np.int64)
+        s.ensure(cap)
         n = int(
             _LIB.sst_versions(
-                _ptr(buf, ctypes.c_uint8), end, off,
-                _ptr(kb, ctypes.c_uint8), len(key), cap,
-                _ptr(tss, ctypes.c_uint64), _ptr(seqs, ctypes.c_uint64),
-                _ptr(voffs, ctypes.c_int64), _ptr(vlens, ctypes.c_int64),
+                bptr, end, off, kp, len(key), s.cap, *s.ptrs
             )
         )
-        if n < cap:
-            return tss[:n], seqs[:n], voffs[:n], vlens[:n]
-        cap *= 4
+        if n < s.cap:
+            return s.tss[:n], s.seqs[:n], s.voffs[:n], s.vlens[:n]
+        cap = s.cap * 4
 
 
 def sst_scan(buf: np.ndarray, end: int, off: int, prefix: bytes, batch: int = 1024):

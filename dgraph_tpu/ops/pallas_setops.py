@@ -10,22 +10,25 @@ vs huge posting list, the reference's IntersectWith ratio>32 regime,
 algo/uidlist.go:156) the sweep is bandwidth-bound at HBM speed, which is
 the roofline for this op.
 
-Grid: one step per b-tile; the hit-mask accumulates across steps via
-output revisiting (out block index is constant). Early-block skipping by
-base comparison is left to the caller's block structure (codec blocks are
-range-partitioned, so the engine only feeds tiles overlapping [a_min,
-a_max]).
+The kernel is written BATCH-AWARE (grid = (batch, b_tiles), block specs
+indexed by batch) rather than as a vmapped single example: Pallas TPU
+lowering rejects the Squeezed SMEM blocks that jax.vmap produces for the
+scalar length operand (found the first time the kernel ran compiled on a
+real v5e — interpret mode accepts them).
+
+Grid: for each batch row, one step per b-tile; the hit-mask accumulates
+across steps via output revisiting (out block index is constant in the
+tile dimension). TPU grids iterate the last axis fastest, so the
+`step == 0` init runs before that row's accumulation.
 
 Correctness is validated in interpret mode on CPU (tests). The dispatcher
 uses this path for intersect buckets with <=128-element small sides when
-DGRAPH_TPU_PALLAS=1 (query/dispatch.py); default remains the XLA
-searchsorted path until the sweep is profiled on real hardware.
+DGRAPH_TPU_PALLAS=1 (query/dispatch.py).
 """
 
 from __future__ import annotations
 
 import functools
-import os
 
 import numpy as np
 import jax
@@ -37,6 +40,7 @@ LANE = 128
 SUBLANE = 8
 TILE = LANE * SUBLANE  # 1024 u32 per b-tile
 
+
 def _default_interpret() -> bool:
     """Pallas TPU kernels only run compiled on real TPUs; everywhere else
     use interpret mode. Resolved from the live backend (the env var can
@@ -46,74 +50,101 @@ def _default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-
 def _member_kernel(lb_ref, a_ref, b_ref, out_ref):
-    """One grid step: OR membership hits of a (1,128) against b tile (8,128).
+    """One grid step: OR membership hits of batch row i's queries (1,128)
+    against its b tile (8,128).
 
     b-lane validity is computed from the global flat index vs lb (no
     sentinel collisions possible — 0xFFFFFFFF stays a legal uid)."""
-    step = pl.program_id(0)
+    i = pl.program_id(0)
+    step = pl.program_id(1)
 
     @pl.when(step == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    a = a_ref[:]  # (1, LANE)
-    b = b_ref[:]  # (SUBLANE, LANE)
+    a = a_ref[0, 0]  # (LANE,)
+    b = b_ref[0]  # (SUBLANE, LANE)
     base = step * TILE
     flat = (
         base
         + jax.lax.broadcasted_iota(jnp.int32, (SUBLANE, LANE), 0) * LANE
         + jax.lax.broadcasted_iota(jnp.int32, (SUBLANE, LANE), 1)
     )
-    valid = flat < lb_ref[0]
+    # validity folded in as an i32 multiply: Mosaic cannot insert a minor
+    # dim on 1-bit vectors (valid[:, :, None] fails to compile), and the
+    # accumulator is i32 for the same reason
+    vmask = (flat < lb_ref[i]).astype(jnp.int32)
     # compare-all: (SUBLANE, LANE, 1) vs (1, 1, LANE) -> any over b axes
-    eq = (b[:, :, None] == a[0][None, None, :]) & valid[:, :, None]
-    hits = eq.any(axis=(0, 1))
-    out_ref[:] = out_ref[:] | hits[None, :]
+    eq = (b[:, :, None] == a[None, None, :]).astype(jnp.int32)
+    hits = (eq * vmask[:, :, None]).max(axis=(0, 1))
+    out_ref[:] = jnp.maximum(out_ref[:], hits[None, None, :])
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def membership_small(a128, b_padded, lb, interpret: bool = False):
-    """mask over a128 (shape (128,) uint32) against b_padded (shape (N,)
-    uint32, N a multiple of 1024); b validity = index < lb."""
-    nb = b_padded.shape[0] // TILE
-    a2 = a128.reshape(1, LANE)
-    b2 = b_padded.reshape(nb * SUBLANE, LANE)
+def _membership_padded(LB, A128, Bp, interpret: bool = False):
+    """A128: (n, LANE) u32; Bp: (n, nb*SUBLANE, LANE) u32 row-major tiles;
+    LB: (n,) i32 valid lengths. Returns (n, LANE) bool hit masks."""
+    n, nbs, _ = Bp.shape
+    nb = nbs // SUBLANE
+    # (1, 1, LANE) blocks: TPU lowering requires the last two block dims
+    # divisible by (8, 128) OR equal to the array dims — a leading
+    # singleton axis makes the (1, LANE) row block legal
     out = pl.pallas_call(
         _member_kernel,
-        grid=(nb,),
+        grid=(n, nb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, LANE), lambda i: (0, 0)),
-            pl.BlockSpec((SUBLANE, LANE), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, LANE), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, SUBLANE, LANE), lambda i, j: (i, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, LANE), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((1, LANE), jnp.bool_),
+        out_specs=pl.BlockSpec((1, 1, LANE), lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, 1, LANE), jnp.int32),
         interpret=interpret,
-    )(jnp.asarray([lb], jnp.int32), a2, b2)
-    return out[0]
+    )(jnp.asarray(LB, jnp.int32), A128[:, None, :], Bp)
+    return out[:, 0, :] != 0
+
+
+def membership_batch(A, LA, B, LB, interpret=None):
+    """Batched membership masks: A (n, pa<=128) u32 sorted rows (padded
+    with UINT32_MAX), B (n, pb) u32 sorted rows, lengths LA/LB. Returns
+    (n, pa) bool — True where A[i,j] occurs in B[i, :LB[i]]."""
+    if interpret is None:
+        interpret = _default_interpret()
+    n, pa = A.shape
+    if pa > LANE:
+        raise ValueError(f"pallas membership path is for <=128 queries, got {pa}")
+    pb = B.shape[1]
+    if pb == 0:
+        return jnp.zeros((n, pa), jnp.bool_)
+    A_l = jnp.pad(A, ((0, 0), (0, LANE - pa)))
+    Bp = jnp.pad(B, ((0, 0), (0, (-pb) % TILE)))
+    Bp = Bp.reshape(n, -1, LANE)
+    hits = _membership_padded(LB, A_l, Bp, interpret=interpret)
+    la_mask = (
+        jax.lax.broadcasted_iota(jnp.int32, (n, pa), 1)
+        < jnp.asarray(LA, jnp.int32)[:, None]
+    )
+    return hits[:, :pa] & la_mask
+
+
+def intersect_batch(A, LA, B, LB, interpret=None):
+    """Batched pallas intersect with the same (out, cnt) contract as
+    jax.vmap(setops.intersect) — the dispatcher's bucket entry point."""
+    from dgraph_tpu.ops import setops
+
+    keep = membership_batch(A, LA, B, LB, interpret=interpret)
+    return jax.vmap(setops.compact)(A, keep)
 
 
 def membership(a, la, b, lb, interpret=None):
-    """Drop-in replacement for setops.membership when len(a) <= 128.
-
-    Handles the sentinel-collision case (0xFFFFFFFF is a legal uid) by
-    masking on explicit lengths like the XLA path.
-    """
-    if interpret is None:
-        interpret = _default_interpret()
-    n = a.shape[0]
-    if n > LANE:
-        raise ValueError(f"pallas membership path is for <=128 queries, got {n}")
-    if b.shape[0] == 0:
-        # zero grid steps would leave the output uninitialized
-        return jnp.zeros((n,), jnp.bool_)
-    a_l = jnp.pad(a, (0, LANE - n))
-    m = b.shape[0]
-    b_p = jnp.pad(b, (0, (-m) % TILE))
-    hits = membership_small(a_l, b_p, lb, interpret=interpret)
-    return hits[:n] & (jnp.arange(n) < la)
+    """Single-example membership (<=128 queries) — test/compat shim over
+    the batched kernel."""
+    mask = membership_batch(
+        a[None, :], jnp.asarray([la]), b[None, :], jnp.asarray([lb]),
+        interpret=interpret,
+    )
+    return mask[0]
 
 
 def intersect(a, la, b, lb, interpret=None):

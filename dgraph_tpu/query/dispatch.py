@@ -506,8 +506,12 @@ class SetOpDispatcher:
             if _USE_PALLAS and op == "intersect" and pa <= 128:
                 from dgraph_tpu.ops import pallas_setops
 
-                base = pallas_setops.intersect
-            fn = jax.jit(jax.vmap(base))
+                # batch-aware pallas entry point — do NOT vmap a
+                # single-example pallas kernel (TPU lowering rejects the
+                # Squeezed SMEM blocks vmap produces)
+                fn = jax.jit(pallas_setops.intersect_batch)
+            else:
+                fn = jax.jit(jax.vmap(base))
             self._jit_cache[key] = fn
         return fn
 
