@@ -1,0 +1,117 @@
+"""North-star metric: LDBC SNB 2-hop friends-of-friends edges/sec.
+
+BASELINE.json's headline config — "systest/ldbc SNB interactive short
+reads" / "friends-of-friends 2-hop traversal (batched UID intersect)",
+target >=5x CPU on TPU. The real SNB dataset is CI-fetched and not
+available here; benchmarks/ldbc_corpus.py generates the same shape at a
+configurable scale.
+
+Measures, through the FULL engine (parse -> plan -> dispatch -> merge):
+  - 2-hop FoF queries from a batch of person roots (var block + uid()
+    expansion + NOT-filters, the IS-style traversal),
+  - edges traversed per second (knows edges touched at both hops),
+  - per-query latency.
+
+Usage: python benchmarks/ldbc_bench.py [--persons 20000] [--roots 64]
+                                       [--json out]
+"""
+
+import sys as _sys
+
+_sys.path.insert(0, "/root/repo") if "/root/repo" not in _sys.path else None
+from dgraph_tpu.devsetup import maybe_force_cpu
+
+maybe_force_cpu()
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--persons", type=int, default=20_000)
+    ap.add_argument("--roots", type=int, default=64)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    from benchmarks.ldbc_corpus import generate, SCHEMA
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.loaders.bulk2 import ParallelBulkLoader
+
+    rng = np.random.default_rng(11)
+    t0 = time.time()
+    corpus, rdf = generate(
+        n_persons=args.persons,
+        n_posts=args.persons // 4,
+        n_comments=args.persons // 4,
+    )
+    gen_s = time.time() - t0
+
+    s = Server()
+    s.alter(SCHEMA)
+    t0 = time.time()
+    ParallelBulkLoader(s).load_text("\n".join(rdf))
+    load_s = time.time() - t0
+
+    person_uids = list(corpus.persons)
+    roots = [
+        person_uids[int(rng.integers(len(person_uids)))]
+        for _ in range(args.roots)
+    ]
+
+    def fof_query(pu):
+        sid = corpus.persons[pu].sid
+        return (
+            f'{{ me as var(func: eq(fqid, "person_{sid}")) {{ f as knows }} '
+            "q(func: uid(f)) { fof as knows @filter(NOT uid(me) AND NOT uid(f)) } "
+            "res(func: uid(fof)) { count(uid) } }"
+        )
+
+    # warm (compiles)
+    s.query(fof_query(roots[0]))
+
+    edges = 0
+    t0 = time.time()
+    for pu in roots:
+        out = s.query(fof_query(pu))
+        assert "errors" not in out, out
+        # edges touched: deg(root) at hop 1 + sum deg(friend) at hop 2
+        direct = {f for f, _ in corpus.knows_of(pu)}
+        edges += len(direct) + sum(
+            len(corpus.knows_of(f)) for f in direct
+        )
+    wall = time.time() - t0
+
+    # correctness spot-check vs the model
+    pu = roots[0]
+    out = s.query(fof_query(pu).replace("count(uid)", "id"))
+    got = sorted(r["id"] for r in out["data"]["res"])
+    want = sorted(corpus.persons[u].sid for u in corpus.friends_of_friends(pu))
+    ok = got == want
+
+    result = {
+        "persons": args.persons,
+        "knows_edges": 2 * len(corpus.knows),
+        "gen_seconds": round(gen_s, 1),
+        "load_seconds": round(load_s, 1),
+        "load_edges_per_sec": round(corpus.n_edges / load_s),
+        "roots": args.roots,
+        "fof_edges_per_sec": round(edges / wall),
+        "latency_ms_per_query": round(wall / args.roots * 1e3, 2),
+        "conformant": ok,
+        "device": str(jax.devices()[0]),
+    }
+    text = json.dumps(result, indent=1)
+    print(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text)
+
+
+if __name__ == "__main__":
+    main()

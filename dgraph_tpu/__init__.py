@@ -26,3 +26,21 @@ Layer map (mirrors SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# Persistent XLA compile cache, engine-wide (tests/conftest.py sets the
+# same for tests). Query shapes are pow2-bucketed, so a warm cache turns
+# every recurring bucket's compile (seconds on this 1-core host; 60-115s
+# through the remote-TPU compile service) into a disk hit. Env vars are
+# read at first backend init, which is always after package import.
+import os as _os
+import tempfile as _tempfile
+
+_os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    _os.path.join(
+        _tempfile.gettempdir(), f"dgraph_tpu_jax_cache-{_os.getuid()}"
+    ),
+)
+_os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+_os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+del _os, _tempfile

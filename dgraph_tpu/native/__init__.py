@@ -78,6 +78,11 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         u8p, i64, i64, u8p, i64, i64, u64p, u64p, i64p, i64p
     ]
     lib.sst_versions.restype = i64
+    lib.sst_versions_multi.argtypes = [
+        u8p, i64, i64, u8p, i64p, i64p, i64p, i64,
+        i64p, u64p, u64p, i64p, i64p,
+    ]
+    lib.sst_versions_multi.restype = i64
     lib.sst_scan.argtypes = [
         u8p, i64, i64, u8p, i64, i64,
         i64p, i64p, u64p, u64p, i64p, i64p, i64p,
@@ -283,6 +288,41 @@ def sst_versions(
         if n < s.cap:
             return s.tss[:n], s.seqs[:n], s.voffs[:n], s.vlens[:n]
         cap = s.cap * 4
+
+
+def sst_versions_multi(
+    bptr, end: int, keys: list, starts: np.ndarray, cap: int
+):
+    """Batched version probe over SORTED distinct keys in one native call.
+    Returns (counts, tss, seqs, voffs, vlens) flattened per key order."""
+    nk = len(keys)
+    blob = b"".join(keys)
+    key_lens = np.fromiter((len(k) for k in keys), np.int64, nk)
+    key_offs = np.zeros(nk, np.int64)
+    np.cumsum(key_lens[:-1], out=key_offs[1:])
+    kb = np.frombuffer(blob, np.uint8)
+    while True:
+        counts = np.zeros(nk, np.int64)
+        tss = np.empty(cap, np.uint64)
+        seqs = np.empty(cap, np.uint64)
+        voffs = np.empty(cap, np.int64)
+        vlens = np.empty(cap, np.int64)
+        got = int(
+            _LIB.sst_versions_multi(
+                bptr, end, nk,
+                _ptr(kb, ctypes.c_uint8),
+                _ptr(key_offs, ctypes.c_int64),
+                _ptr(key_lens, ctypes.c_int64),
+                _ptr(np.ascontiguousarray(starts, np.int64), ctypes.c_int64),
+                cap,
+                _ptr(counts, ctypes.c_int64),
+                _ptr(tss, ctypes.c_uint64), _ptr(seqs, ctypes.c_uint64),
+                _ptr(voffs, ctypes.c_int64), _ptr(vlens, ctypes.c_int64),
+            )
+        )
+        if got >= 0:
+            return counts, tss[:got], seqs[:got], voffs[:got], vlens[:got]
+        cap = max(cap * 2, -got + 1024)
 
 
 def sst_scan(buf: np.ndarray, end: int, off: int, prefix: bytes, batch: int = 1024):

@@ -205,6 +205,45 @@ int64_t sst_versions(const uint8_t* buf, int64_t end, int64_t off,
 // Entry headers from `off` while keys start with `prefix` (or all when
 // prefix_len == 0): writes (key_off, key_len, ts, seq, val_off, val_len);
 // returns count (callers loop with growing max_out).
+// Versions of MANY sorted distinct keys in one pass. `starts[i]` is a
+// seek hint at/before key i's first possible entry (sparse-index stride
+// head); since keys ascend, the walk position is monotone — the scan for
+// key i begins at max(current pos, starts[i]). Outputs are flattened:
+// counts[i] versions for key i, written sequentially into tss/seqs/
+// voffs/vlens. Returns total versions written, or -(needed) if max_out
+// was too small (caller re-runs with a bigger buffer).
+int64_t sst_versions_multi(const uint8_t* buf, int64_t end, int64_t nkeys,
+                           const uint8_t* keys_blob, const int64_t* key_offs,
+                           const int64_t* key_lens, const int64_t* starts,
+                           int64_t max_out, int64_t* counts, uint64_t* tss,
+                           uint64_t* seqs, int64_t* voffs, int64_t* vlens) {
+    int64_t pos = 0;
+    int64_t out = 0;
+    for (int64_t i = 0; i < nkeys; i++) {
+        const uint8_t* key = keys_blob + key_offs[i];
+        int64_t klen = key_lens[i];
+        if (starts[i] > pos) pos = starts[i];
+        int64_t p = sst_seek(buf, end, pos, key, klen);
+        int64_t n = 0;
+        while (p + 24 <= end) {
+            uint32_t kl, vl; uint64_t ts, seq;
+            int64_t body = ent_read(buf, p, &kl, &ts, &seq, &vl);
+            if (keycmp(buf + body, kl, key, klen) != 0) break;
+            if (out + n >= max_out) return -(out + n + 1);
+            tss[out + n] = ts;
+            seqs[out + n] = seq;
+            voffs[out + n] = body + kl;
+            vlens[out + n] = vl;
+            n++;
+            p = body + kl + vl;
+        }
+        counts[i] = n;
+        out += n;
+        pos = p;
+    }
+    return out;
+}
+
 int64_t sst_scan(const uint8_t* buf, int64_t end, int64_t off,
                  const uint8_t* prefix, int64_t prefix_len, int64_t max_out,
                  int64_t* key_offs, int64_t* key_lens, uint64_t* tss,

@@ -49,6 +49,20 @@ class LocalCache:
             self._plists[key] = pl
         return pl
 
+    def prefetch(self, keys_list) -> None:
+        """Batch-read many posting lists ahead of a per-key loop (level-
+        batched fan-out, uid_in probes). On the LSM backend this becomes
+        one monotone multi-key probe per table instead of a seek per key
+        (ref badger iterator prefetch / MultiGet)."""
+        if self.mem is None:
+            return
+        missing = [k for k in keys_list if k not in self._plists]
+        if len(missing) < 16:
+            return
+        self._plists.update(
+            self.mem.read_many(self.kv, missing, self.read_ts)
+        )
+
     # -- reads (uncommitted deltas visible to this txn) ----------------------
 
     def uids(self, key: bytes) -> np.ndarray:

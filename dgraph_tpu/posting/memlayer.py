@@ -58,6 +58,43 @@ class MemoryLayer:
                 self._cache.popitem(last=False)
         return pl
 
+    def read_many(self, kv, keys, read_ts: int) -> dict:
+        """Batched read-through: one kv.versions_batch for every key (the
+        LSM backend probes each table monotonically instead of per-key).
+        Returns {key: PostingList}. Falls back to per-key read when the
+        backend has no batch API."""
+        keys = list(dict.fromkeys(keys))  # dedupe: decode each key once
+        vb = getattr(kv, "versions_batch", None)
+        if vb is None:
+            return {k: self.read(kv, k, read_ts) for k in keys}
+        got = vb(keys, read_ts)
+        out = {}
+        to_store = []
+        with self._lock:
+            for k in keys:
+                versions = got.get(k, [])
+                newest_ts = versions[0][0] if versions else 0
+                ent = self._cache.get(k)
+                if ent is not None and ent[0] == newest_ts:
+                    self._cache.move_to_end(k)
+                    self.hits += 1
+                    out[k] = ent[1]
+                else:
+                    out[k] = None  # decode outside the lock
+                    to_store.append((k, newest_ts, versions))
+        for k, newest_ts, versions in to_store:
+            self.misses += 1
+            pl = PostingList.from_versions(
+                k, versions, kv=kv, read_ts=read_ts
+            )
+            out[k] = pl
+            with self._lock:
+                self._cache[k] = (newest_ts, pl)
+                self._cache.move_to_end(k)
+                while len(self._cache) > self.max_entries:
+                    self._cache.popitem(last=False)
+        return out
+
     def invalidate(self, keys: Iterable[bytes]):
         keys = list(keys)
         with self._lock:

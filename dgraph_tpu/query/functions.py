@@ -408,10 +408,9 @@ class FuncRunner:
         if su.directive_reverse:
             from dgraph_tpu.query.dispatch import DISPATCHER
 
-            rows = [
-                self.cache.uids(keys.ReverseKey(fn.attr, int(t), self.ns))
-                for t in tarr
-            ]
+            rkeys = [keys.ReverseKey(fn.attr, int(t), self.ns) for t in tarr]
+            self.cache.prefetch(rkeys)
+            rows = [self.cache.uids(k) for k in rkeys]
             hit = DISPATCHER.run_chain("union", rows) if rows else EMPTY
             if src is None:
                 return hit.astype(np.uint64)
@@ -423,10 +422,12 @@ class FuncRunner:
             return EMPTY
         from dgraph_tpu.query.dispatch import DISPATCHER
 
+        ckeys = [keys.DataKey(fn.attr, int(u), self.ns) for u in cands]
+        self.cache.prefetch(ckeys)
         rows = []
         toks = []
-        for u in cands:
-            r, tk = self.cache.uids_tok(keys.DataKey(fn.attr, int(u), self.ns))
+        for k in ckeys:
+            r, tk = self.cache.uids_tok(k)
             rows.append(r)
             toks.append(tk)
         inter = DISPATCHER.run_rows_vs_one(

@@ -254,10 +254,14 @@ class Executor:
             seed = self._selective_seed(gq.filter)
             if seed is not None:
                 attr = gq.func.attr
+                skeys = [
+                    keys.DataKey(attr, int(u), self.ns) for u in seed
+                ]
+                self.cache.prefetch(skeys)
                 root = _as_uids(
                     int(u)
-                    for u in seed
-                    if self.cache.has(keys.DataKey(attr, int(u), self.ns))
+                    for u, k in zip(seed, skeys)
+                    if self.cache.has(k)
                 )
                 return self.eval_filter(gq.filter, root)
         root = runner.run_root(gq.func)
@@ -524,14 +528,16 @@ class Executor:
             if reverse and not su.directive_reverse:
                 raise QueryError(f"predicate {attr[1:]!r} has no @reverse index")
             cnode.is_uid_pred = True
+            level_keys = [
+                keys.ReverseKey(attr[1:], int(u), self.ns)
+                if reverse
+                else keys.DataKey(attr, int(u), self.ns)
+                for u in parent.dest_uids
+            ]
+            self.cache.prefetch(level_keys)
             rows = []
             row_toks = []
-            for u in parent.dest_uids:
-                key = (
-                    keys.ReverseKey(attr[1:], int(u), self.ns)
-                    if reverse
-                    else keys.DataKey(attr, int(u), self.ns)
-                )
+            for key in level_keys:
                 r, tok = self.cache.uids_tok(key)
                 rows.append(r)
                 row_toks.append(tok)

@@ -129,6 +129,19 @@ def _marker_visible(drops, delbelow, key: bytes, ts: int, seq: int) -> bool:
     return True
 
 
+def _resolve_versions(per_ts: Dict[int, Tuple[int, bytes]], key, versions,
+                      visible) -> None:
+    """Fold (ts, seq, val) records for ONE key into per_ts with the MVCC
+    resolution rule — markers applied, newest seq wins per ts. The single
+    authority shared by the point-read and batched-read paths (they must
+    never diverge: the MemoryLayer caches whichever answered first)."""
+    for ts, seq, val in versions:
+        if visible(key, ts, seq):
+            got = per_ts.get(ts)
+            if got is None or seq > got[0]:
+                per_ts[ts] = (seq, val)
+
+
 def _newest_wins(stream, visible):
     """Collapse an ascending (key, ts, seq, val) stream to the highest-seq
     record per (key, ts), dropping marker-hidden records — the shared
@@ -357,6 +370,51 @@ class _SSTable:
         if i >= 0:
             return self._index[i][1]
         return self._index[0][1] if self._index else 0
+
+    def versions_of_many(self, keys_sorted: List[bytes]):
+        """Batched versions_of over SORTED distinct keys: ONE native call
+        walks the table monotonically (badger MultiGet shape). Returns
+        {key: [(ts, seq, val)]} for present keys only. Falls back to
+        per-key probes without the native library."""
+        if not self._native:
+            out = {}
+            for k in keys_sorted:
+                got = self.versions_of(k)
+                if got:
+                    out[k] = got
+            return out
+        import numpy as _np
+
+        from dgraph_tpu import native as _native
+
+        bloom = self.bloom
+        cands = [
+            k
+            for k in keys_sorted
+            if self.min_key <= k <= self._max_key()
+            and (bloom is None or bloom.may_contain(k))
+        ]
+        if not cands:
+            return {}
+        starts = _np.fromiter(
+            (self._index_start(k) for k in cands), _np.int64, len(cands)
+        )
+        counts, tss, seqs, voffs, vlens = _native.sst_versions_multi(
+            self._buf_ptr, self._data_end, cands, starts,
+            max(1024, 4 * len(cands)),
+        )
+        out = {}
+        off = 0
+        mm = self._mm
+        for k, n in zip(cands, counts):
+            if n:
+                out[k] = [
+                    (int(tss[off + j]), int(seqs[off + j]),
+                     mm[voffs[off + j] : voffs[off + j] + vlens[off + j]])
+                    for j in range(n)
+                ]
+            off += n
+        return out
 
     def scan(self, prefix: bytes = b""):
         """Yield (key, ts, seq, val) ascending from the first prefixed key."""
@@ -698,16 +756,10 @@ class LsmKV(KV):
         tables, seq is the authority)."""
         per_ts: Dict[int, Tuple[int, bytes]] = {}
         for t in self._tables:
-            for ts, seq, val in t.versions_of(key):
-                if self._visible(key, ts, seq):
-                    got = per_ts.get(ts)
-                    if got is None or seq > got[0]:
-                        per_ts[ts] = (seq, val)
-        for ts, seq, val in self._mem.get(key, []):
-            if self._visible(key, ts, seq):
-                got = per_ts.get(ts)
-                if got is None or seq > got[0]:
-                    per_ts[ts] = (seq, val)
+            _resolve_versions(per_ts, key, t.versions_of(key), self._visible)
+        _resolve_versions(
+            per_ts, key, self._mem.get(key, []), self._visible
+        )
         return [(ts, *per_ts[ts]) for ts in sorted(per_ts)]
 
     def get(self, key: bytes, read_ts: int) -> Optional[Tuple[int, bytes]]:
@@ -726,6 +778,36 @@ class LsmKV(KV):
                 for ts, _, val in reversed(self._all_versions(key))
                 if ts <= read_ts
             ]
+
+    def versions_batch(
+        self, keys_in: List[bytes], read_ts: int
+    ) -> Dict[bytes, List[Tuple[int, bytes]]]:
+        """versions() for many keys with one monotone probe pass per table
+        — the read path for level-batched query fan-out (badger MultiGet
+        analog; kills the per-key re-seek that dominated 2-hop queries on
+        this backend)."""
+        ks = sorted(set(keys_in))
+        with self._mu:
+            per_key: Dict[bytes, Dict[int, Tuple[int, bytes]]] = {}
+            for t in self._tables:
+                for k, vers in t.versions_of_many(ks).items():
+                    _resolve_versions(
+                        per_key.setdefault(k, {}), k, vers, self._visible
+                    )
+            for k in ks:
+                vs = self._mem.get(k)
+                if vs:
+                    _resolve_versions(
+                        per_key.setdefault(k, {}), k, vs, self._visible
+                    )
+            out: Dict[bytes, List[Tuple[int, bytes]]] = {}
+            for k, d in per_key.items():
+                out[k] = [
+                    (ts, d[ts][1])
+                    for ts in sorted(d, reverse=True)
+                    if ts <= read_ts
+                ]
+            return out
 
     def _merged_keys(self, prefix: bytes) -> Iterator[bytes]:
         import heapq
