@@ -62,6 +62,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         path = urlparse(self.path).path
+        if path == "/graphql":
+            from dgraph_tpu.api import ws
+
+            if ws.is_upgrade(self.headers):
+                # GraphQL subscriptions over websocket (ref
+                # graphql/subscription/poller.go transport)
+                if ws.handshake(self):
+                    ws.serve_graphql_ws(self, self.engine)
+                self.close_connection = True
+                return
         if path == "/health":
             self._reply(
                 [

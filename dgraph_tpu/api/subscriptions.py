@@ -52,6 +52,65 @@ class Subscriptions:
         callback(result)
         return sid
 
+    def subscribe_graphql(
+        self,
+        query: str,
+        callback: Callable[[dict], None],
+        variables: Optional[dict] = None,
+    ) -> int:
+        """GraphQL subscription: `subscription { queryT ... }` runs through
+        the engine's GraphQL layer and re-fires on commits touching the
+        selected types' predicates (ref graphql/subscription/poller.go,
+        commit-driven instead of timed polling)."""
+        import re as _re
+
+        gql = getattr(self.server, "graphql", None)
+        if gql is None:
+            raise ValueError("no GraphQL schema configured")
+        # a subscription op is evaluated like a query op
+        body = _re.sub(r"^\s*subscription\b", "query", query, count=1)
+
+        # predicates: every field predicate of every type the selection
+        # tree touches (nested object selections included — a commit on a
+        # child type must re-fire too)
+        from dgraph_tpu.graphql.parser import parse_operation
+
+        preds = set()
+
+        def walk(t, sels):
+            preds.update(f"{t.name}.{f}" for f in t.fields)
+            preds.add("dgraph.type")
+            for s in sels:
+                f = t.fields.get(s.name)
+                if f is not None and not f.is_scalar:
+                    ct = gql.types.get(f.type_name)
+                    if ct is not None:
+                        walk(ct, s.selections)
+
+        op = parse_operation(body, variables)
+        for sel in op.selections:
+            m = _re.match(r"(?:get|query|aggregate)(\w+)", sel.name)
+            t = gql.types.get(m.group(1)) if m else None
+            if t is not None:
+                walk(t, sel.selections)
+
+        def evaluate():
+            return gql.execute(body, variables)
+
+        result = evaluate()
+        with self._lock:
+            self._next_id += 1
+            sid = self._next_id
+            self._subs[sid] = {
+                "preds": preds,
+                "callback": callback,
+                "jwt": None,
+                "evaluate": evaluate,
+                "last": json.dumps(result, sort_keys=True, default=str),
+            }
+        callback(result)
+        return sid
+
     def unsubscribe(self, sid: int):
         with self._lock:
             self._subs.pop(sid, None)
@@ -71,7 +130,12 @@ class Subscriptions:
                 continue
             # never let a subscriber error fail the commit that triggered it
             try:
-                result = self.server.query(sub["query"], access_jwt=sub["jwt"])
+                ev = sub.get("evaluate")
+                result = (
+                    ev()
+                    if ev is not None
+                    else self.server.query(sub["query"], access_jwt=sub["jwt"])
+                )
                 blob = json.dumps(result, sort_keys=True, default=str)
                 if blob != sub["last"]:
                     sub["last"] = blob

@@ -147,6 +147,13 @@ def split_segments(uids: np.ndarray) -> Dict[int, np.ndarray]:
     out: Dict[int, np.ndarray] = {}
     if uids.size == 0:
         return out
+    # sorted input: equal first/last hi-words means ONE segment — the
+    # overwhelmingly common case (uids cluster far below 2^32), and this
+    # function runs once per row of every level-batched dispatch
+    hi0 = int(uids[0] >> np.uint64(32))
+    if int(uids[-1] >> np.uint64(32)) == hi0:
+        out[hi0] = (uids & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        return out
     hi = (uids >> np.uint64(32)).astype(np.uint64)
     starts = np.flatnonzero(np.concatenate([[True], hi[1:] != hi[:-1]]))
     bounds = list(starts) + [uids.size]

@@ -49,7 +49,8 @@ class GraphQLError(Exception):
 
 
 class GraphQLServer:
-    def __init__(self, engine, sdl: str):
+    def __init__(self, engine, sdl: str, lambda_url: Optional[str] = None):
+        import os
         import threading
 
         from dgraph_tpu.graphql.auth import parse_authorization
@@ -58,6 +59,13 @@ class GraphQLServer:
         self.types: Dict[str, GqlType] = parse_sdl(sdl)
         self.sdl = sdl
         self.auth_config = parse_authorization(sdl)
+        # --graphql lambda-url analog (ref x.LambdaUrl): explicit arg >
+        # engine attr (set by the alpha CLI superflag) > env
+        self.lambda_url = (
+            lambda_url
+            or getattr(engine, "graphql_lambda_url", None)
+            or os.environ.get("DGRAPH_TPU_LAMBDA_URL", "")
+        )
         self._tls = threading.local()  # per-request JWT claims
         engine.alter(to_dql_schema(self.types))
 
@@ -137,6 +145,8 @@ class GraphQLServer:
             f = qt.fields.get(name)
             if f is not None and f.custom is not None:
                 return self._resolve_custom(f, sel)
+            if f is not None and f.is_lambda:
+                return self._resolve_lambda_root("Query", f, sel)
         if name.startswith("get"):
             t = self._type_for(name, ["get"])
             return self._get(t, sel)
@@ -202,6 +212,124 @@ class GraphQLServer:
             return _project(payload, sel.selections)
         return payload
 
+    # ------------------------------------------------------------------
+    # @lambda (ref wrappers.go buildCustomDirectiveForLambda,
+    # custom_http.go GetBodyForLambda)
+    # ------------------------------------------------------------------
+
+    def _lambda_post(self, body: dict):
+        import json as _json
+        import urllib.request
+
+        if not self.lambda_url:
+            raise GraphQLError(
+                "@lambda field used but no lambda-url configured "
+                "(--graphql lambda-url / DGRAPH_TPU_LAMBDA_URL)"
+            )
+        req = urllib.request.Request(
+            self.lambda_url,
+            data=_json.dumps(body).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return _json.loads(r.read() or b"null")
+
+    def _resolve_lambda_root(self, parent: str, f: GqlField, sel: Selection):
+        """Query./Mutation.-level @lambda: POST {resolver, args} and return
+        the lambda server's value, projected over the selection."""
+        from dgraph_tpu.graphql.introspection import _project
+
+        try:
+            payload = self._lambda_post(
+                {
+                    "resolver": f"{parent}.{f.name}",
+                    "args": sel.args,
+                    "parents": None,
+                    "authHeader": self._lambda_auth_header(),
+                }
+            )
+        except GraphQLError:
+            raise
+        except Exception as e:
+            raise GraphQLError(f"@lambda call failed: {e}") from e
+        if sel.selections and isinstance(payload, (dict, list)):
+            return _project(payload, sel.selections)
+        return payload
+
+    def _lambda_auth_header(self):
+        cfg = self.auth_config
+        if not cfg:
+            return None
+        return {"key": getattr(cfg, "header", None), "value": None}
+
+    def _enrich_lambda_fields(
+        self, t: GqlType, sels: List[Selection], rows: List[dict]
+    ) -> None:
+        """BATCH-mode @lambda on type fields: one POST per (type, field)
+        with every row's scalar fields as `parents`; the response array
+        aligns with parents (ref wrappers.go BATCH mode). Recurses into
+        object-valued children; hidden __lp_ scalars are stripped."""
+        if not rows:
+            return
+        lam = [
+            s
+            for s in sels
+            if t.fields.get(s.name) is not None and t.fields[s.name].is_lambda
+        ]
+        for s in sels:  # recurse into nested objects first
+            f = t.fields.get(s.name)
+            if f is None or f.is_scalar or f.is_lambda:
+                continue
+            ct = self.types.get(f.type_name)
+            if ct is None:
+                continue
+            for row in rows:
+                v = row.get(s.key)
+                if isinstance(v, list):
+                    self._enrich_lambda_fields(ct, s.selections, v)
+                elif isinstance(v, dict):
+                    self._enrich_lambda_fields(ct, s.selections, [v])
+        if lam:
+            parents = []
+            for row in rows:
+                p = {}
+                for fn, fdef in t.fields.items():
+                    if not fdef.is_scalar or fdef.is_lambda or fdef.custom:
+                        continue
+                    if fn in row:
+                        p[fn] = row[fn]
+                    elif f"__lp_{fn}" in row:
+                        p[fn] = row[f"__lp_{fn}"]
+                parents.append(p)
+            for s in lam:
+                try:
+                    got = self._lambda_post(
+                        {
+                            "resolver": f"{t.name}.{s.name}",
+                            "parents": parents,
+                            "authHeader": self._lambda_auth_header(),
+                        }
+                    )
+                except GraphQLError:
+                    raise
+                except Exception as e:
+                    raise GraphQLError(f"@lambda call failed: {e}") from e
+                if isinstance(got, list):
+                    if len(got) != len(rows):
+                        raise GraphQLError(
+                            f"@lambda {t.name}.{s.name}: BATCH response has "
+                            f"{len(got)} values for {len(rows)} parents"
+                        )
+                    vals = got
+                else:
+                    vals = [got] * len(rows)
+                for row, v in zip(rows, vals):
+                    row[s.key] = v
+        for row in rows:
+            for k in [k for k in row if k.startswith("__lp_")]:
+                del row[k]
+
     def _run_block(self, gq: GraphQuery) -> List[dict]:
         cache = LocalCache(
             self.engine.kv,
@@ -219,15 +347,21 @@ class GraphQLServer:
         self, t: GqlType, sels: List[Selection]
     ) -> List[GraphQuery]:
         out = []
+        has_lambda = False
+        selected = set()
         for s in sels:
             f = t.fields.get(s.name)
             if s.name == "__typename":
                 continue  # injected post-encode (_add_typename)
+            if f is not None and f.is_lambda:
+                has_lambda = True  # resolved post-query via the lambda URL
+                continue
             if s.name == "id" or (f and f.type_name == "ID"):
                 out.append(GraphQuery(attr="uid", is_uid=True, alias=s.key))
                 continue
             if f is None:
                 raise GraphQLError(f"no field {s.name!r} on type {t.name}")
+            selected.add(s.name)
             child = GraphQuery(attr=f"{t.name}.{f.name}", alias=s.key)
             if not f.is_scalar:
                 ct = self.types.get(f.type_name)
@@ -235,6 +369,22 @@ class GraphQLServer:
                     raise GraphQLError(f"unknown type {f.type_name}")
                 child.children = self._selection_children(ct, s.selections)
             out.append(child)
+        if has_lambda:
+            # lambda parents carry ALL scalar fields of the type
+            # (wrappers.go body template); fetch unselected ones hidden
+            for fn, fdef in t.fields.items():
+                if (
+                    fdef.is_scalar
+                    and not fdef.is_lambda
+                    and not fdef.custom
+                    and fdef.type_name != "ID"
+                    and fn not in selected
+                ):
+                    out.append(
+                        GraphQuery(
+                            attr=f"{t.name}.{fn}", alias=f"__lp_{fn}"
+                        )
+                    )
         return out
 
     def _filter_tree(self, t: GqlType, fobj: dict) -> Optional[FilterTree]:
@@ -319,7 +469,9 @@ class GraphQLServer:
         gq.first = sel.args.get("first")
         gq.offset = sel.args.get("offset")
         gq.children = self._selection_children(t, sel.selections)
-        return self._add_typename(self._run_block(gq), t, sel.selections)
+        rows = self._run_block(gq)
+        self._enrich_lambda_fields(t, sel.selections, rows)
+        return self._add_typename(rows, t, sel.selections)
 
     def _get(self, t: GqlType, sel: Selection) -> Optional[dict]:
         gq = GraphQuery(attr="q")
@@ -347,6 +499,7 @@ class GraphQLServer:
             )
         gq.children = self._selection_children(t, sel.selections)
         res = self._run_block(gq)
+        self._enrich_lambda_fields(t, sel.selections, res)
         return res[0] if res else None
 
     def _aggregate(self, t: GqlType, sel: Selection) -> dict:
@@ -416,11 +569,50 @@ class GraphQLServer:
             args=[topk, _json.dumps(vec)],
         )
         gq.children = self._selection_children(t, sel.selections)
-        return self._run_block(gq)
+        rows = self._run_block(gq)
+        self._enrich_lambda_fields(t, sel.selections, rows)
+        return rows
 
     # ------------------------------------------------------------------
     # Mutations (ref resolve/mutation_rewriter.go)
     # ------------------------------------------------------------------
+
+    def _fire_webhook(self, t: GqlType, op: str, uids: List[int], sel: Selection):
+        """@lambdaOnMutate fire-and-forget webhook (ref resolve/webhook.go
+        sendWebhookEvent; payload shape webhookPayload/eventPayload)."""
+        if not t.lambda_on_mutate.get(op) or not self.lambda_url:
+            return
+        event: Dict[str, Any] = {
+            "__typename": t.name,
+            "operation": op,
+            "commitTs": 0,
+        }
+        root_uids = [f"0x{u:x}" for u in uids]
+        if op == "add":
+            event["add"] = {
+                "rootUIDs": root_uids,
+                "input": _as_list(sel.args.get("input", [])),
+            }
+        elif op == "update":
+            inp = sel.args.get("input", {}) or {}
+            event["update"] = {
+                "rootUIDs": root_uids,
+                "setPatch": inp.get("set"),
+                "removePatch": inp.get("remove"),
+            }
+        else:
+            event["delete"] = {"rootUIDs": root_uids}
+        body = {"resolver": "$webhook", "event": event}
+
+        import threading
+
+        def post():
+            try:
+                self._lambda_post(body)
+            except Exception:
+                pass  # at-most-once, errors only logged by the reference too
+
+        threading.Thread(target=post, daemon=True).start()
 
     def _resolve_mutation(self, sel: Selection):
         if getattr(self.engine, "draining", False):
@@ -431,6 +623,8 @@ class GraphQLServer:
             f = mt.fields.get(name)
             if f is not None and f.custom is not None:
                 return self._resolve_custom(f, sel)
+            if f is not None and f.is_lambda:
+                return self._resolve_lambda_root("Mutation", f, sel)
         if name.startswith("add"):
             return self._add(self._type_for(name, ["add"]), sel)
         if name.startswith("update"):
@@ -450,7 +644,9 @@ class GraphQLServer:
                 gq = GraphQuery(attr="q")
                 gq.func = FuncSpec(name="uid", args=uids)
                 gq.children = self._selection_children(t, s.selections)
-                out[s.key] = self._run_block(gq)
+                rows = self._run_block(gq)
+                self._enrich_lambda_fields(t, s.selections, rows)
+                out[s.key] = rows
         return out
 
     def _set_field(self, txn, t: GqlType, uid: int, f: GqlField, value, op=OP_SET):
@@ -557,6 +753,7 @@ class GraphQLServer:
                 txn.discard()
                 raise GraphQLError(f"unauthorized to add {t.name}")
         txn.commit()
+        self._fire_webhook(t, "add", uids, sel)
         return self._payload(t, sel, uids, len(created))
 
     def _match_filter_uids(self, t: GqlType, fobj) -> List[int]:
@@ -585,6 +782,7 @@ class GraphQLServer:
                     raise GraphQLError(f"no field {k!r}")
                 self._set_field(txn.txn, t, uid, f, v, op=OP_DEL)
         txn.commit()
+        self._fire_webhook(t, "update", uids, sel)
         return self._payload(t, sel, uids, len(uids))
 
     def _delete(self, t: GqlType, sel: Selection):
@@ -606,6 +804,7 @@ class GraphQLServer:
                 )
             delete_entity_attr(txn.txn, self.engine.schema, uid, "dgraph.type")
         txn.commit()
+        self._fire_webhook(t, "delete", uids, sel)
         return self._payload(t, sel, uids, len(uids))
 
 

@@ -47,6 +47,7 @@ class GqlField:
     is_embedding: bool = False
     is_scalar: bool = True
     custom: Optional[dict] = None  # @custom(http: {...}) config
+    is_lambda: bool = False  # @lambda: resolved by the lambda server
 
     @property
     def dql_type(self) -> str:
@@ -60,6 +61,9 @@ class GqlType:
     name: str
     fields: Dict[str, GqlField] = field(default_factory=dict)
     auth: object = None  # graphql.auth.TypeAuth when @auth present
+    # @lambdaOnMutate(add/update/delete) webhook switches
+    # (ref gqlschema.go:292, resolve/webhook.go)
+    lambda_on_mutate: Dict[str, bool] = field(default_factory=dict)
 
     def id_field(self) -> Optional[GqlField]:
         for f in self.fields.values():
@@ -201,9 +205,20 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
     sdl, auth_blobs = _extract_type_auth(sdl)
     sdl = re.sub(r'"""[\s\S]*?"""', "", sdl)  # strip descriptions
     sdl = re.sub(r"#[^\n]*", "", sdl)
+    # type-header @lambdaOnMutate switches (ref gqlschema.go:292)
+    lom: Dict[str, Dict[str, bool]] = {}
+    for m in re.finditer(r"\btype\s+(\w+)([^{]*)\{", sdl):
+        dm = re.search(r"@lambdaOnMutate\s*\(([^)]*)\)", m.group(2))
+        if dm:
+            lom[m.group(1)] = {
+                k: v.strip().lower() == "true"
+                for k, v in re.findall(r"(\w+)\s*:\s*(\w+)", dm.group(1))
+            }
+    sdl = re.sub(r"@lambdaOnMutate\s*\([^)]*\)", "", sdl)
     types: Dict[str, GqlType] = {}
     for tname, body in _scan_bodies(sdl):
         t = GqlType(name=tname)
+        t.lambda_on_mutate = lom.get(tname, {})
         if tname in auth_blobs:
             from dgraph_tpu.graphql.auth import parse_auth_blob
 
@@ -246,6 +261,11 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
                     from dgraph_tpu.graphql.auth import _parse_gql_object
 
                     f.custom = _parse_gql_object("{" + dargs + "}")
+                elif dname == "lambda":
+                    # internally @lambda is @custom against the configured
+                    # lambda server (ref wrappers.go:699 comment); we keep
+                    # the flag and build the POST in resolve.py
+                    f.is_lambda = True
             t.fields[f.name] = f
         types[t.name] = t
     return types
@@ -261,7 +281,7 @@ def to_dql_schema(types: Dict[str, GqlType]) -> str:
         for f in t.fields.values():
             if f.type_name == "ID":
                 continue  # internal uid, no predicate
-            if f.custom is not None:
+            if f.custom is not None or f.is_lambda:
                 continue  # resolved remotely, never stored
             pred = f"{t.name}.{f.name}"
             tfields.append(pred)

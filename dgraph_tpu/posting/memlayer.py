@@ -23,7 +23,16 @@ from dgraph_tpu.posting.pl import PostingList
 
 
 class MemoryLayer:
-    def __init__(self, max_entries: int = 100_000):
+    def __init__(self, max_entries: Optional[int] = None):
+        import os
+
+        if max_entries is None:
+            # must exceed the touched-key count of one large traversal
+            # level or the LRU thrashes (a 5M-edge 2-hop touches ~140k
+            # lists); decoded entries are small, ~300B typical
+            max_entries = int(
+                os.environ.get("DGRAPH_TPU_MEMLAYER_ENTRIES", 400_000)
+            )
         self.max_entries = max_entries
         self._lock = threading.Lock()
         # key -> (newest_version_ts, PostingList); LRU by insertion order
@@ -33,6 +42,33 @@ class MemoryLayer:
         self.hits = 0
         self.misses = 0
 
+    @staticmethod
+    def _fast_state(kv, read_ts: int):
+        """(seq, complete) for the no-revalidation fast path. An entry is
+        reusable WITHOUT a per-key probe by a reader at R2 iff:
+          - the KV's global mutation counter hasn't moved since the entry
+            was built (store content identical), AND
+          - the entry was a COMPLETE view when built — its creation
+            read_ts covered every version in the store
+            (max_write_ts <= creation read_ts), AND
+          - R2 >= the entry's creation read_ts.
+        The completeness condition closes the race where a query holding
+        an older read_ts caches a partial view after a newer commit."""
+        fn = getattr(kv, "mut_seq", None)
+        if fn is None:
+            return None, False
+        mx = getattr(kv, "max_write_ts", None)
+        return fn(), (mx is not None and mx() <= read_ts)
+
+    @staticmethod
+    def _fast_hit(ent, seq, read_ts: int) -> bool:
+        return (
+            seq is not None
+            and ent[2] == seq
+            and ent[4]
+            and read_ts >= ent[3]
+        )
+
     def read(self, kv, key: bytes, read_ts: int) -> PostingList:
         """Read-through: returns a PostingList valid at read_ts.
 
@@ -40,19 +76,28 @@ class MemoryLayer:
         reader at an older ts never sees future versions. The version list
         is fetched ONCE and the cache key derives from it — deriving it
         from a separate earlier kv.get would race concurrent commits and
-        cache future versions under an old ts."""
+        cache future versions under an old ts. Complete entries skip the
+        probe while the store is unchanged (_fast_state)."""
+        seq, complete = self._fast_state(kv, read_ts)
+        with self._lock:
+            got = self._cache.get(key)
+            if got is not None and self._fast_hit(got, seq, read_ts):
+                self._cache.move_to_end(key)
+                self.hits += 1
+                return got[1]
         versions = kv.versions(key, read_ts)
         newest_ts = versions[0][0] if versions else 0
         with self._lock:
             got = self._cache.get(key)
             if got is not None and got[0] == newest_ts:
+                self._cache[key] = (newest_ts, got[1], seq, read_ts, complete)
                 self._cache.move_to_end(key)
                 self.hits += 1
                 return got[1]
         self.misses += 1
         pl = PostingList.from_versions(key, versions, kv=kv, read_ts=read_ts)
         with self._lock:
-            self._cache[key] = (newest_ts, pl)
+            self._cache[key] = (newest_ts, pl, seq, read_ts, complete)
             self._cache.move_to_end(key)
             while len(self._cache) > self.max_entries:
                 self._cache.popitem(last=False)
@@ -67,15 +112,29 @@ class MemoryLayer:
         vb = getattr(kv, "versions_batch", None)
         if vb is None:
             return {k: self.read(kv, k, read_ts) for k in keys}
-        got = vb(keys, read_ts)
+        seq, complete = self._fast_state(kv, read_ts)
         out = {}
-        to_store = []
+        need = []
         with self._lock:
             for k in keys:
+                ent = self._cache.get(k)
+                if ent is not None and self._fast_hit(ent, seq, read_ts):
+                    self._cache.move_to_end(k)
+                    self.hits += 1
+                    out[k] = ent[1]
+                else:
+                    need.append(k)
+        if not need:
+            return out
+        got = vb(need, read_ts)
+        to_store = []
+        with self._lock:
+            for k in need:
                 versions = got.get(k, [])
                 newest_ts = versions[0][0] if versions else 0
                 ent = self._cache.get(k)
                 if ent is not None and ent[0] == newest_ts:
+                    self._cache[k] = (newest_ts, ent[1], seq, read_ts, complete)
                     self._cache.move_to_end(k)
                     self.hits += 1
                     out[k] = ent[1]
@@ -89,7 +148,7 @@ class MemoryLayer:
             )
             out[k] = pl
             with self._lock:
-                self._cache[k] = (newest_ts, pl)
+                self._cache[k] = (newest_ts, pl, seq, read_ts, complete)
                 self._cache.move_to_end(k)
                 while len(self._cache) > self.max_entries:
                     self._cache.popitem(last=False)

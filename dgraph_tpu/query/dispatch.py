@@ -318,6 +318,11 @@ class SetOpDispatcher:
         if op == "intersect" and any(len(p) == 0 for p in parts):
             return np.zeros((0,), np.uint64)
         total = sum(len(p) for p in parts)
+        if op == "union" and len(parts) > 256:
+            # k-way union of MANY small rows: one host unique beats both
+            # the pairwise loop and a device merge whose padding is mostly
+            # air (the uid_in reverse fan-out shape at 5M+ scale)
+            return np.unique(np.concatenate(parts))
         if (
             not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL
         ) or not self._device_ready():

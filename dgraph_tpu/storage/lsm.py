@@ -387,13 +387,7 @@ class _SSTable:
 
         from dgraph_tpu import native as _native
 
-        bloom = self.bloom
-        cands = [
-            k
-            for k in keys_sorted
-            if self.min_key <= k <= self._max_key()
-            and (bloom is None or bloom.may_contain(k))
-        ]
+        cands = [k for k in keys_sorted if self.may_contain(k)]
         if not cands:
             return {}
         starts = _np.fromiter(
@@ -484,6 +478,7 @@ class LsmKV(KV):
         self._mem: Dict[bytes, List[Tuple[int, int, bytes]]] = {}
         self._mem_size = 0
         self._seq = 0
+        self._max_ts = 0  # highest version ts ever written (manifest-kept)
         # markers: ("drop", prefix, seq) | ("delbelow", key, ts, seq)
         self._markers: List[tuple] = []
         self._tables: List[_SSTable] = []  # newest first
@@ -500,6 +495,7 @@ class LsmKV(KV):
             with open(self._manifest_path) as f:
                 man = json.load(f)
             self._seq = man.get("seq", 0)
+            self._max_ts = man.get("max_ts", 0)
             self._markers = [tuple(m) for m in man.get("markers", [])]
             names = man.get("tables", [])
         # markers persisted as lists; key/prefix fields are latin-1 strings
@@ -519,6 +515,7 @@ class LsmKV(KV):
     def _save_manifest(self):
         man = {
             "seq": self._seq,
+            "max_ts": self._max_ts,
             "tables": [os.path.basename(t.path) for t in self._tables],
             "markers": [
                 (m[0], m[1].decode("latin-1"), *m[2:]) for m in self._markers
@@ -594,6 +591,8 @@ class LsmKV(KV):
         self._wal.flush()
 
     def _mem_put(self, key, ts, seq, val):
+        if ts > self._max_ts:
+            self._max_ts = ts
         vers = self._mem.get(key)
         if vers is None:
             vers = self._mem[key] = []
@@ -957,12 +956,27 @@ class LsmKV(KV):
                 n = 0
                 for key, ts, val in entries:
                     n += 1
+                    if ts > self._max_ts:
+                        self._max_ts = ts
                     yield key, ts, base + n, val
                 self._seq = base + n
 
             _SSTable.write(path, with_seq(), self.enc_key)
             self._tables.insert(0, _SSTable(path, self.enc_key))
             self._save_manifest()
+
+    def mut_seq(self) -> int:
+        """Global mutation counter: bumps on every write (put/markers/
+        ingest/load). Readers use it to skip per-key cache revalidation
+        when the store hasn't changed at all (posting/memlayer.py)."""
+        return self._seq
+
+    def max_write_ts(self) -> int:
+        """Highest version ts ever written. A cache entry built at
+        read_ts >= max_write_ts is a complete view for EVERY later
+        read_ts as long as mut_seq hasn't moved (posting/memlayer.py
+        fast path)."""
+        return self._max_ts
 
     def sync(self):
         with self._mu:

@@ -1,0 +1,218 @@
+"""GraphQL @lambda / @lambdaOnMutate / websocket subscriptions
+(ref graphql/schema/gqlschema.go:291-292 directives, resolve/webhook.go
+payload shape, graphql/subscription/poller.go transport).
+"""
+
+import base64
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dgraph_tpu.api.server import Server
+from dgraph_tpu.graphql.resolve import GraphQLServer
+
+RECEIVED = []
+
+
+class _Lambda(BaseHTTPRequestHandler):
+    """Stub lambda server: resolves by `resolver` key like dgraph-lambda."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        RECEIVED.append(body)
+        res = body.get("resolver")
+        if res == "Query.greet":
+            out = f"hello {body['args']['name']}"
+        elif res == "Person.fullName":
+            out = [
+                f"{p.get('firstName','')} {p.get('lastName','')}"
+                for p in body["parents"]
+            ]
+        elif res == "$webhook":
+            out = None
+        else:
+            out = None
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+@pytest.fixture(scope="module")
+def lambda_port():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Lambda)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+SDL = """
+type Person @lambdaOnMutate(add: true, delete: true) {
+  id: ID!
+  firstName: String @search(by: [exact])
+  lastName: String
+  fullName: String @lambda
+}
+type Query {
+  greet(name: String!): String @lambda
+}
+"""
+
+
+@pytest.fixture()
+def gql(lambda_port):
+    RECEIVED.clear()
+    return GraphQLServer(
+        Server(), SDL, lambda_url=f"http://127.0.0.1:{lambda_port}/graphql-worker"
+    )
+
+
+def test_lambda_query_root(gql):
+    out = gql.execute('{ greet(name: "ada") }')
+    assert out["data"]["greet"] == "hello ada"
+    assert RECEIVED[-1]["resolver"] == "Query.greet"
+    assert RECEIVED[-1]["args"] == {"name": "ada"}
+
+
+def test_lambda_field_batch(gql):
+    gql.execute(
+        'mutation { addPerson(input: [{firstName: "Ada", lastName: "L"}, '
+        '{firstName: "Alan", lastName: "T"}]) { numUids } }'
+    )
+    out = gql.execute(
+        '{ queryPerson(order: {asc: firstName}) { firstName fullName } }'
+    )
+    rows = out["data"]["queryPerson"]
+    assert [r["fullName"] for r in rows] == ["Ada L", "Alan T"]
+    # BATCH shape: one POST with all parents incl. unselected scalars
+    batch = [r for r in RECEIVED if r.get("resolver") == "Person.fullName"][-1]
+    assert [p["lastName"] for p in batch["parents"]] == ["L", "T"]
+    # hidden parent-only scalars never leak into the response
+    assert all(not k.startswith("__lp_") for r in rows for k in r)
+
+
+def test_lambda_on_mutate_webhook(gql):
+    gql.execute('mutation { addPerson(input: [{firstName: "Eve"}]) { numUids } }')
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        hooks = [r for r in RECEIVED if r.get("resolver") == "$webhook"]
+        if hooks:
+            break
+        time.sleep(0.05)
+    assert hooks, "webhook never fired"
+    ev = hooks[-1]["event"]
+    assert ev["__typename"] == "Person"
+    assert ev["operation"] == "add"
+    assert ev["add"]["input"][0]["firstName"] == "Eve"
+    # update not enabled -> no webhook
+    before = len([r for r in RECEIVED if r.get("resolver") == "$webhook"])
+    gql.execute(
+        'mutation { updatePerson(input: {filter: {firstName: {eq: "Eve"}}, '
+        'set: {lastName: "X"}}) { numUids } }'
+    )
+    time.sleep(0.3)
+    after = len([r for r in RECEIVED if r.get("resolver") == "$webhook"])
+    assert after == before
+
+
+# -- websocket subscriptions -------------------------------------------------
+
+
+def _ws_send(sock, obj):
+    payload = json.dumps(obj).encode()
+    mask = b"\x01\x02\x03\x04"
+    n = len(payload)
+    if n < 126:
+        hdr = bytes([0x81, 0x80 | n])
+    else:
+        hdr = bytes([0x81, 0x80 | 126]) + struct.pack(">H", n)
+    masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+    sock.sendall(hdr + mask + masked)
+
+
+def _ws_recv(sock, timeout=10.0):
+    sock.settimeout(timeout)
+
+    def rd(n):
+        buf = b""
+        while len(buf) < n:
+            got = sock.recv(n - len(buf))
+            if not got:
+                raise ConnectionError("closed")
+            buf += got
+        return buf
+
+    b1, b2 = rd(2)
+    ln = b2 & 0x7F
+    if ln == 126:
+        (ln,) = struct.unpack(">H", rd(2))
+    elif ln == 127:
+        (ln,) = struct.unpack(">Q", rd(8))
+    return json.loads(rd(ln).decode())
+
+
+def test_websocket_subscription(tmp_path):
+    from dgraph_tpu.api.http_server import HTTPServer
+    from dgraph_tpu.api.subscriptions import Subscriptions
+
+    engine = Server()
+    engine.graphql = GraphQLServer(engine, SDL, lambda_url="")
+    Subscriptions(engine)
+    srv = HTTPServer(engine, port=0).start()
+    port = srv.port
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        key = base64.b64encode(os.urandom(16)).decode()
+        s.sendall(
+            (
+                f"GET /graphql HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n"
+                "Sec-WebSocket-Protocol: graphql-transport-ws\r\n\r\n"
+            ).encode()
+        )
+        # read the 101 response headers
+        hdr = b""
+        while b"\r\n\r\n" not in hdr:
+            hdr += s.recv(1024)
+        assert b"101" in hdr.split(b"\r\n", 1)[0]
+
+        _ws_send(s, {"type": "connection_init"})
+        assert _ws_recv(s)["type"] == "connection_ack"
+        _ws_send(
+            s,
+            {
+                "id": "1",
+                "type": "subscribe",
+                "payload": {
+                    "query": "subscription { queryPerson { firstName } }"
+                },
+            },
+        )
+        first = _ws_recv(s)
+        assert first["type"] == "next"
+        assert first["payload"]["data"]["queryPerson"] == []
+
+        # a mutation through the engine pushes an update frame
+        engine.graphql.execute(
+            'mutation { addPerson(input: [{firstName: "Zed"}]) { numUids } }'
+        )
+        nxt = _ws_recv(s)
+        assert nxt["type"] == "next"
+        assert nxt["payload"]["data"]["queryPerson"] == [{"firstName": "Zed"}]
+
+        _ws_send(s, {"id": "1", "type": "complete"})
+        s.close()
+    finally:
+        srv.stop()
