@@ -75,10 +75,34 @@ def _jit_brute_batch(metric: str, npool: int):
     return jax.jit(run)
 
 
-@functools.lru_cache(maxsize=64)
-def _jit_ivf(metric: str, m_slabs: int, npool: int):
-    """One-dispatch IVF probe: centroid scores -> top-M slabs -> gather ->
-    distances -> top-k. All shapes static."""
+def _dedup_first(rows: np.ndarray) -> np.ndarray:
+    """Indices of the first occurrence of each row id, in original order.
+    Probe results ascend by distance, so the first occurrence of a
+    multi-assigned row is its best distance. Input must be filtered to
+    valid (>=0) rows."""
+    _, first = np.unique(rows, return_index=True)
+    return np.sort(first)
+
+
+def _probe_plan(ivf: dict, pool: int):
+    """Widen the static slab probe in pow2 factors until it covers the
+    requested candidate pool (bounded jit signatures); npool carries 2x
+    slack for multi-assignment duplicates."""
+    base_pool = 64
+    factor = 1
+    while factor * base_pool < pool and ivf["m_slabs"] * factor < ivf[
+        "n_slabs"
+    ]:
+        factor *= 2
+    m = int(min(ivf["n_slabs"], ivf["m_slabs"] * factor))
+    npool = int(min(max(pool, 1) * 2, m * _SLAB))
+    return m, npool
+
+
+def _ivf_probe(metric: str, m_slabs: int, npool: int):
+    """The IVF probe body shared by the single-query and batched jits:
+    centroid scores -> top-M slabs -> gather -> distances -> top-k.
+    All shapes static."""
     import jax
     import jax.numpy as jnp
 
@@ -97,6 +121,31 @@ def _jit_ivf(metric: str, m_slabs: int, npool: int):
         dd = jax.lax.optimization_barrier(dd)
         neg, idx = jax.lax.top_k(-dd, npool)
         return -neg, rows[idx]
+
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_ivf(metric: str, m_slabs: int, npool: int):
+    import jax
+
+    return jax.jit(_ivf_probe(metric, m_slabs, npool))
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_ivf_batch(metric: str, m_slabs: int, npool: int):
+    """Batched IVF probe: the _ivf_probe pipeline vmapped over queries, so
+    a whole query batch is ONE device dispatch + ONE host fetch. Through a
+    remote-device tunnel this amortizes the per-dispatch round trip the
+    same way the query engine's whole-level batching does."""
+    import jax
+
+    one = _ivf_probe(metric, m_slabs, npool)
+
+    def run(cents, csq, slab_cell, flat_vecs, flat_sq, flat_rows, Q):
+        return jax.vmap(
+            one, in_axes=(None, None, None, None, None, None, 0)
+        )(cents, csq, slab_cell, flat_vecs, flat_sq, flat_rows, Q)
 
     return jax.jit(run)
 
@@ -313,8 +362,13 @@ class VectorIndex:
             pool = min(pool * 4, self._n)
 
     def search_batch(self, Q, k: int) -> np.ndarray:
-        """Exact brute top-k for a batch of queries in one dispatch.
-        Returns (len(Q), min(k, len(index))) uids, closest-first."""
+        """Top-k for a batch of queries in one device dispatch. Returns
+        (len(Q), min(k, len(index))) uids, closest-first.
+
+        Brute tier: exact. IVF tier: approximate (same probe the
+        single-query path uses, pool 4x k); a row with fewer than k unique
+        survivors pads trailing slots with uid 0 — callers must treat 0 as
+        absent, as with any uid list."""
         if self._n == 0:
             return np.zeros((len(Q), 0), np.uint64)
         self._sync_device()
@@ -326,6 +380,8 @@ class VectorIndex:
 
         Q = np.asarray(Q, np.float32)
         kk = min(max(k, 1), self._n)
+        if self._ivf is not None:
+            return self._ivf_search_batch(Q, kk)
         fn = _jit_brute_batch(self.metric, int(kk))
         dd, idx = fn(
             self._device["vecs"],
@@ -356,7 +412,13 @@ class VectorIndex:
         rng = np.random.default_rng(0)
         cents = mat[rng.choice(n, nlist, replace=False)].copy()
 
-        X = jnp.asarray(mat)
+        # Lloyd trains on a bounded subsample: the assignment matrix is
+        # n_train x nlist on device, so a 1Mx768 corpus (nlist 2000 ->
+        # 8GB if trained on everything) stays within a v5e's HBM next to
+        # the brute-tier arrays. FAISS-style sampling: ~64 pts per cell.
+        n_train = int(min(n, max(64 * nlist, 100_000)))
+        Xtr = mat if n_train >= n else mat[rng.choice(n, n_train, replace=False)]
+        X = jnp.asarray(Xtr)
         xsq = (X * X).sum(axis=1)
 
         @jax.jit
@@ -366,30 +428,46 @@ class VectorIndex:
             assign = jnp.argmin(d2, axis=1)
             sums = jax.ops.segment_sum(X, assign, num_segments=nlist)
             cnts = jax.ops.segment_sum(
-                jnp.ones((n,), jnp.float32), assign, num_segments=nlist
+                jnp.ones((n_train,), jnp.float32), assign, num_segments=nlist
             )
             newc = jnp.where(
                 cnts[:, None] > 0, sums / jnp.maximum(cnts, 1.0)[:, None], c
             )
-            return newc, assign
+            return newc
 
         c = jnp.asarray(cents)
         for _ in range(iters):
-            c, assign = step(c)
+            c = step(c)
+        # step's jit closure captured X/xsq as embedded constants; drop the
+        # executable too or the training sample stays resident in HBM
+        del step, X, xsq
 
         # multi-assignment: each vector lands in its 2 nearest cells —
         # big recall win for weakly-clustered data at 2x cell memory
-        # (the reference's HNSW achieves the same via graph redundancy)
+        # (the reference's HNSW achieves the same via graph redundancy).
+        # The full corpus is assigned in fixed-size chunks so the chunk
+        # distance matrix stays small regardless of n.
+        CH = 1 << 17
+
         @jax.jit
-        def top2(c):
+        def top2_chunk(c, xc):
             csq = (c * c).sum(axis=1)
-            d2 = xsq[:, None] - 2.0 * (X @ c.T) + csq[None, :]
+            d2 = (xc * xc).sum(axis=1)[:, None] - 2.0 * (xc @ c.T) + csq[None, :]
             d2 = jax.lax.optimization_barrier(d2)
             _, t2 = jax.lax.top_k(-d2, 2)
             return t2
 
         c_np = np.asarray(c)
-        t2 = np.asarray(top2(c))
+        parts = []
+        for off in range(0, n, CH):
+            chunk = mat[off : off + CH]
+            if len(chunk) < CH and n > CH:
+                padc = np.zeros((CH, d), np.float32)
+                padc[: len(chunk)] = chunk
+                parts.append(np.asarray(top2_chunk(c, jnp.asarray(padc)))[: len(chunk)])
+            else:
+                parts.append(np.asarray(top2_chunk(c, jnp.asarray(chunk))))
+        t2 = np.concatenate(parts, axis=0)
         rows_rep = np.repeat(np.arange(n), 2)
         cells_rep = t2.reshape(-1)
 
@@ -455,14 +533,7 @@ class VectorIndex:
         import jax.numpy as jnp
 
         ivf = self._ivf
-        base_pool = 64
-        factor = 1
-        while factor * base_pool < pool and ivf["m_slabs"] * factor < ivf[
-            "n_slabs"
-        ]:
-            factor *= 2
-        m = int(min(ivf["n_slabs"], ivf["m_slabs"] * factor))
-        npool = int(min(max(pool, 1) * 2, m * _SLAB))  # 2x for dup slack
+        m, npool = _probe_plan(ivf, pool)
         fn = _jit_ivf(self.metric, int(m), npool)
         dev = ivf["dev"]
         dd, rows = fn(
@@ -478,18 +549,51 @@ class VectorIndex:
         dd = np.asarray(dd)
         ok = rows >= 0
         rows, dd = rows[ok], dd[ok]
-        # drop multi-assignment duplicates — results ascend by distance, so
-        # the first occurrence of a row is its best distance
-        first = np.zeros(len(rows), bool)
-        seen = set()
-        for i, r in enumerate(rows):
-            if r not in seen:
-                seen.add(r)
-                first[i] = True
+        first = _dedup_first(rows)
         rows, dd = rows[first], dd[first]
         k = min(pool, rows.size)
         uids = self._uids_np[rows[:k]]
         return uids, dd[:k]
+
+    def _ivf_search_batch(self, Q: np.ndarray, k: int) -> np.ndarray:
+        """Batched IVF (see _jit_ivf_batch). Candidate pool is 4x k (the
+        same slack search() applies for filtered pools); rows that end up
+        with fewer than k unique survivors pad with uid 0.
+
+        The vmapped probe gathers (m_slabs * _SLAB, d) candidates PER
+        QUERY, so the query batch is chunked to keep that intermediate
+        under a fixed device budget (at 1Mx768 one query's gather is
+        ~190MB — an unchunked 64-batch would alone exceed a v5e's HBM)."""
+        import jax.numpy as jnp
+
+        ivf = self._ivf
+        m, npool = _probe_plan(ivf, 4 * k)
+        d = int(ivf["dev"]["flat_vecs"].shape[2])
+        per_q = m * _SLAB * d * 4  # gather bytes per query
+        chunk = max(1, min(len(Q), int(2e9 // max(per_q, 1))))
+        fn = _jit_ivf_batch(self.metric, int(m), npool)
+        dev = ivf["dev"]
+        out = np.zeros((len(Q), k), np.uint64)
+        for off in range(0, len(Q), chunk):
+            qc = np.asarray(Q[off : off + chunk], np.float32)
+            if len(qc) < chunk:  # pad to the compiled batch shape
+                qc = np.vstack([qc, np.zeros((chunk - len(qc), qc.shape[1]), np.float32)])
+            _, rows = fn(
+                dev["cents"],
+                dev["csq"],
+                dev["slab_cell"],
+                dev["flat_vecs"],
+                dev["flat_sq"],
+                dev["flat_rows"],
+                jnp.asarray(qc),
+            )
+            rows = np.asarray(rows)
+            for i in range(min(chunk, len(Q) - off)):
+                r = rows[i]
+                r = r[r >= 0]
+                r = r[_dedup_first(r)][:k]
+                out[off + i, : len(r)] = self._uids_np[r]
+        return out
 
 
 def _distances(V, sqnorm, q, metric):
