@@ -163,10 +163,13 @@ class GraphQuery:
     # facets
     facets: bool = False
     facet_names: List[str] = field(default_factory=list)
+    facet_aliases: Dict[str, str] = field(default_factory=dict)  # facet->alias
     facet_vars: Dict[str, str] = field(default_factory=dict)  # var -> facet
     facet_filter: Optional["FuncSpec"] = None
     facet_order: str = ""
     facet_order_desc: bool = False
+    # multi-key facet ordering, listing order: [(facet, desc), ...]
+    facet_orders: List[Any] = field(default_factory=list)
     # lang tag on predicate: name@en
     lang: str = ""
     # checkpwd(pred, "pw") selection field
@@ -644,6 +647,10 @@ def _parse_directives(p: _P, gq: GraphQuery):
             p.expect(")")
         elif d == "facets":
             if p.accept("("):
+                if p.accept(")"):
+                    # @facets() with empty parens fetches NOTHING
+                    # (ref TestFetchingNoFacets), unlike bare @facets
+                    return _parse_directives(p, gq)
                 is_filter = p.peek().text.upper() == "NOT" or (
                     p.peek().kind == "name"
                     and p.toks[p.i + 1].text == "("
@@ -660,9 +667,16 @@ def _parse_directives(p: _P, gq: GraphQuery):
                 while p.peek().text != ")":
                     t = p.next()
                     if t.text in ("orderasc", "orderdesc"):
+                        # ordering facets also project (ref TestOrderFacets:
+                        # orderasc:since emits friend|since)
                         p.expect(":")
-                        gq.facet_order = p.next().text
-                        gq.facet_order_desc = t.text == "orderdesc"
+                        fname = p.next().text
+                        gq.facet_orders.append((fname, t.text == "orderdesc"))
+                        if not gq.facet_order:
+                            gq.facet_order = fname
+                            gq.facet_order_desc = t.text == "orderdesc"
+                        if fname not in gq.facet_names:
+                            gq.facet_names.append(fname)
                     elif p.peek().text == "as":
                         # `w as weight`: bind the facet into a value var
                         # (ref query facet var bindings)
@@ -670,6 +684,13 @@ def _parse_directives(p: _P, gq: GraphQuery):
                         fname = p.next().text
                         gq.facet_vars[t.text] = fname
                         gq.facet_names.append(fname)
+                    elif p.peek().text == ":":
+                        # `o: origin` — facet alias; output key is the bare
+                        # alias (ref TestFacetsAlias golden)
+                        p.next()  # :
+                        fname = p.next().text
+                        gq.facet_names.append(fname)
+                        gq.facet_aliases[fname] = t.text
                     else:
                         gq.facet_names.append(t.text)
                     p.accept(",")

@@ -85,7 +85,7 @@ class BulkLoader:
         )
         vbytes = to_binary(stored)
         puid = (
-            value_uid(vbytes)
+            value_uid(stored)
             if su.is_list
             else lang_uid(nq.lang if su.lang else "")
         )

@@ -231,7 +231,7 @@ def _map_chunk(args) -> dict:
         )
         vbytes = to_binary(stored)
         puid = (
-            value_uid(vbytes)
+            value_uid(stored)
             if su.is_list
             else lang_uid(nq.lang if su.lang else "")
         )

@@ -131,7 +131,7 @@ def _apply_value_edge(txn: Txn, su: SchemaUpdate, edge: DirectedEdge, data_key):
     vbytes = to_binary(stored)
 
     if su.is_list:
-        puid = value_uid(vbytes)
+        puid = value_uid(stored)
     else:
         puid = lang_uid(edge.lang if su.lang else "")
 

@@ -31,6 +31,10 @@ import numpy as np
 
 from dgraph_tpu.codec import uidpack
 from dgraph_tpu.types.types import TypeID, Val, from_binary, to_binary
+from dgraph_tpu.utils.farmhash import (
+    fingerprint64 as _farm_fp,
+    go_value_binary,
+)
 
 OP_SET = 1
 OP_DEL = 2
@@ -58,13 +62,21 @@ def fingerprint64(data: bytes) -> int:
 
 
 def lang_uid(lang: str) -> int:
+    """Posting uid for a language-tagged value: farm.Fingerprint64 of the
+    bare lang tag (ref posting/list.go:826) — the reference accepts the
+    lang-vs-value collision this implies, so we must too: posting order
+    (= JSON list order) is fingerprint order."""
     if not lang:
         return VALUE_UID
-    return fingerprint64(b"lang:" + lang.encode("utf-8"))
+    return _farm_fp(lang.encode("utf-8"))
 
 
-def value_uid(value_bytes: bytes) -> int:
-    return fingerprint64(b"val:" + value_bytes)
+def value_uid(stored: "Val") -> int:
+    """Posting uid for a list-predicate value: farm.Fingerprint64 of the
+    value's GO-marshaled bytes (ref posting/list.go:831 + the conversion
+    in types/conversion.go Marshal). Matching the reference's hash over
+    the reference's bytes makes list-value JSON ordering bit-exact."""
+    return _farm_fp(go_value_binary(stored.tid, stored.value))
 
 
 @dataclass
@@ -438,7 +450,10 @@ class PostingList:
         return None
 
     def get_all_values(self, extra_deltas=None) -> List[Posting]:
-        """All live value postings (list predicates / lang variants)."""
+        """All live value postings (list predicates / lang variants),
+        posting-uid ascending — with farm-fingerprint uids this reproduces
+        the reference's list-value JSON ordering exactly (posting lists
+        iterate uid order, ref list.go Iterate)."""
         merged = self._merged_postings(extra_deltas)
         return [
             merged[uid]

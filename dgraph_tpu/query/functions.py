@@ -944,9 +944,20 @@ def _required_trigrams(pattern: str, flags: str = "") -> List[str]:
     verify) whenever the literal-run argument is unsound: alternation makes
     no single run required, and case-insensitive patterns don't match the
     case-sensitive index tokens."""
-    if "|" in pattern or "i" in flags:
+    if "|" in pattern or "i" in flags or "(?i" in pattern:
         return []
-    lit = max(re.split(r"[\.\*\+\?\[\]\(\)\\\^\$\{\}]", pattern), key=len, default="")
+    # a character class matches many strings — nothing inside it is a
+    # required literal (ref TestFilterRegex1 /^[Glen Rh]+$/)
+    pat = re.sub(r"\[(?:\\.|[^\]])*\]", ".", pattern)
+    # group punctuation is not literal text; lookaround and optional
+    # group contents are not required; neither is anything quantified
+    # by {m,n} or ?/* (conservative: blank them all to a splitter)
+    pat = pat.replace("(?:", "(")
+    pat = re.sub(r"\(\?[=!<][^)]*\)", ".", pat)
+    pat = re.sub(r"\((?:[^()])*\)[*?]", ".", pat)
+    pat = re.sub(r"(\\.|[^\\])\{[^}]*\}", ".", pat)
+    pat = re.sub(r"(\\.|[^\\.*+?{}()^$])[*?]", ".", pat)
+    lit = max(re.split(r"[\.\*\+\?\[\]\(\)\\\^\$\{\}]", pat), key=len, default="")
     if len(lit) < 3:
         return []
     return [lit[i : i + 3] for i in range(len(lit) - 2)]

@@ -252,12 +252,19 @@ class JsonEncoder:
                         else {}
                     )
                     if not c.children:
-                        kid = {} if sub_norm else {"uid": encode_uid(int(v))}
-                    if fmaps is not None and row < len(fmaps):
+                        # a uid predicate with no selection block emits
+                        # nothing (ref TestUidWithoutDebug: `friend` with
+                        # no braces contributes no key; TestFacetsAlias2)
+                        kid = {}
+                    # facets ride along only on children that carry real
+                    # fields; facet-only objects are pruned
+                    # (ref TestFetchingFewFacets: nameless friend omitted)
+                    if kid and fmaps is not None and row < len(fmaps):
                         for fk, fv in fmaps[row].get(int(v), {}).items():
                             if gq.facet_names and fk not in gq.facet_names:
                                 continue
-                            kid[f"{name}|{fk}"] = _json_val(fv)
+                            fkey = gq.facet_aliases.get(fk) or f"{name}|{fk}"
+                            kid[fkey] = _json_val(fv)
                     if kid:
                         kids.append(kid)
                 # `friend { count(uid) }`: the row count appends as one
@@ -318,12 +325,36 @@ class JsonEncoder:
                     # list-vs-scalar shape follows the schema, not the
                     # value count (ref outputnode list handling)
                     su = self.schema.get(c.attr) if self.schema else None
+                    if su is not None and su.value_type == TypeID.PASSWORD:
+                        # password values never serialize; only checkpwd()
+                        # reads them (ref TestCheckPasswordQuery1 golden)
+                        continue
                     as_list = (
                         su.is_list if su is not None else len(posts) > 1
                     )
                     vals = [_json_val(p.val()) for p in posts]
                     obj[name] = vals if as_list else vals[0]
-                    if gq.facets:
+                    if gq.facets and as_list:
+                        # list-predicate facets key by the value's index in
+                        # the output array: alt_name|origin: {"0": ...}
+                        # (ref TestFacetValueListPredicate golden)
+                        by_facet: Dict[str, Dict[str, Any]] = {}
+                        for i, p in enumerate(posts):
+                            for fk, fv in p.get_facets().items():
+                                if (
+                                    c.gq.facet_names
+                                    and fk not in c.gq.facet_names
+                                ):
+                                    continue
+                                by_facet.setdefault(fk, {})[str(i)] = (
+                                    _json_val(fv)
+                                )
+                        for fk, m in by_facet.items():
+                            fkey = (
+                                gq.facet_aliases.get(fk) or f"{name}|{fk}"
+                            )
+                            obj[fkey] = m
+                    elif gq.facets:
                         for p in posts:
                             for fk, fv in p.get_facets().items():
                                 if (
@@ -331,7 +362,11 @@ class JsonEncoder:
                                     and fk not in c.gq.facet_names
                                 ):
                                     continue
-                                obj[f"{name}|{fk}"] = _json_val(fv)
+                                fkey = (
+                                    gq.facet_aliases.get(fk)
+                                    or f"{name}|{fk}"
+                                )
+                                obj[fkey] = _json_val(fv)
         return obj
 
 

@@ -343,22 +343,53 @@ class Executor:
         if o.desc:
             bucket_keys.reverse()
         out: List[int] = []
+        tail: List[int] = []  # in a bucket but no untagged sort value
         emitted: set = set()
         for bk in bucket_keys:
             if need is not None and len(out) >= need:
                 break
             sel = self.cache.uids(bk)
-            sel = np.array(
-                [u for u in sel if int(u) not in emitted], dtype=np.uint64
-            )
-            if not len(sel):
+            sel = [int(u) for u in sel if int(u) not in emitted]
+            if not sel:
                 continue
-            emitted.update(int(u) for u in sel)
-            if tk.is_lossy and len(sel) > 1:
+            emitted.update(sel)
+            if su.lang:
+                # sorting reads the UNTAGGED value (ref worker/sort.go):
+                # - lang-tagged-only nodes sort after every valued one;
+                # - a node whose tagged value landed it in THIS bucket
+                #   but whose untagged value tokenizes elsewhere emits
+                #   from its own bucket, not here.
+                # Without @lang every posting is untagged and always
+                # matches its own bucket — skip the per-uid reads.
+                from dgraph_tpu.posting.mutation import build_tokens
+
+                term = keys.parse_key(bk).term
+                dkeys = [keys.DataKey(o.attr, u, self.ns) for u in sel]
+                self.cache.prefetch(dkeys)
+                valued = []
+                for u, dk in zip(sel, dkeys):
+                    posts = self.cache.values(dk)
+                    untagged = [p for p in posts if p.lang == ""]
+                    if not untagged:
+                        tail.append(u)
+                        continue
+                    toks = build_tokens(untagged[0].val(), [tk])
+                    if term not in toks:
+                        emitted.discard(u)  # emits from its own bucket
+                        continue
+                    valued.append(u)
+                if not valued:
+                    continue
+            else:
+                valued = sel
+            sel_np = np.array(valued, dtype=np.uint64)
+            if tk.is_lossy and len(sel_np) > 1:
                 sub = GraphQuery(attr=gq.attr)
                 sub.order = [Order(attr=o.attr, desc=o.desc)]
-                sel = self._order_uids_generic(sub, sel)
-            out.extend(int(u) for u in sel)
+                sel_np = self._order_uids_generic(sub, sel_np)
+            out.extend(int(u) for u in sel_np)
+        if need is None or len(out) < need:
+            out.extend(tail)
         return np.array(out, dtype=np.uint64)
 
     def _finish_block(
@@ -594,6 +625,17 @@ class Executor:
                     # untagged read on an @lang predicate returns only the
                     # untagged value (ref lang semantics)
                     posts = [p for p in posts if p.lang == ""]
+                if cgq.facet_filter is not None:
+                    # @facets(eq(...)) on a VALUE edge keeps only values
+                    # whose facets match; a node left with none drops the
+                    # field (ref TestFacetsFilterAtValueBasic)
+                    posts = [
+                        p
+                        for p in posts
+                        if _facet_tree_match(
+                            cgq.facet_filter, p.get_facets()
+                        )
+                    ]
                 if posts:
                     cnode.values[int(u)] = posts
             if cgq.is_count:
@@ -963,19 +1005,35 @@ class Executor:
                     )
                 ]
                 row = np.array(keep, dtype=np.uint64)
-            if cgq.facet_order:
-                with_v = [
-                    (fmap.get(int(u), {}).get(cgq.facet_order), int(u))
-                    for u in row
-                ]
-                present = sorted(
-                    [(v.value, u) for v, u in with_v if v is not None],
-                    reverse=cgq.facet_order_desc,
-                )
-                missing = [u for v, u in with_v if v is None]
-                row = np.array(
-                    [u for _, u in present] + missing, dtype=np.uint64
-                )
+            orders = cgq.facet_orders or (
+                [(cgq.facet_order, cgq.facet_order_desc)]
+                if cgq.facet_order
+                else []
+            )
+            if orders:
+                # multi-key sort: stable passes applied last key first;
+                # edges missing a key sort after present ones per pass
+                # (ref TestFacetsMultipleOrderbyMissingFacets)
+                ulist = [int(u) for u in row]
+                for fname, desc in reversed(orders):
+                    vals = {
+                        u: fmap.get(u, {}).get(fname) for u in ulist
+                    }
+                    present = [u for u in ulist if vals[u] is not None]
+                    missing = [u for u in ulist if vals[u] is None]
+                    try:
+                        # sorted() on a copy: a TypeError mid-sort must
+                        # not leave `present` partially permuted
+                        present = sorted(
+                            present, key=lambda u: vals[u].value,
+                            reverse=desc,
+                        )
+                    except TypeError:
+                        # mixed facet types are not sortable — keep the
+                        # edge order for this key (ref nonsortable facet)
+                        pass
+                    ulist = present + missing
+                row = np.array(ulist, dtype=np.uint64)
             cnode.uid_matrix[i] = row
         # (dest_uids is recomputed by the caller after order/pagination)
         if cgq.facets:
@@ -1402,9 +1460,11 @@ class Executor:
             )
 
         # multi-key ordering: stable sorts applied in reverse key order
-        # (ref query.go multiSort). Sorted queries EXCLUDE nodes missing
-        # the primary sort value (ref worker/sort.go); secondary-key
-        # missing values sink within their group.
+        # (ref query.go multiSort). Sorting by a PREDICATE keeps nodes
+        # missing the value, after every valued one (ref TestNegativeOffset
+        # golden); sorting by a val(..) var EXCLUDES uids outside the var
+        # map (ref the QueryVarValAgg* goldens) — the var map IS the
+        # candidate set there.
         ordered = [int(u) for u in uids]
         try:
             for ki, o in enumerate(reversed(gq.order)):
@@ -1415,7 +1475,10 @@ class Executor:
                     key=lambda u: _sort_key_of(vals[u]), reverse=o.desc
                 )
                 is_primary = ki == len(gq.order) - 1
-                ordered = present if is_primary else present + missing
+                if is_primary and o.val_var:
+                    ordered = present
+                else:
+                    ordered = present + missing
         except TypeError:
             names = ", ".join(o.attr or o.val_var for o in gq.order)
             raise QueryError(f"unorderable values for {names}") from None

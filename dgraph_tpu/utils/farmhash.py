@@ -1,0 +1,223 @@
+"""FarmHash Fingerprint64 (farmhashna::Hash64), pure Python.
+
+The reference keys value/lang postings by farm.Fingerprint64 of the
+value's marshaled bytes (/root/reference/posting/list.go:814
+fingerprintEdge), and posting lists iterate uid-ascending — so the JSON
+order of list-predicate values IS farmhash order of the Go-marshaled
+value. To match those orderings bit-for-bit we need the same hash over
+the same bytes; `go_binary()` mirrors the Go side's storage marshaling
+(/root/reference/types/conversion.go Marshal: raw UTF-8 strings, LE
+int64/float64, time.MarshalBinary datetimes).
+
+The algorithm below is written from the public FarmHash spec (Google,
+MIT-licensed; farmhashna variant). The golden query suites double as
+test vectors: list orderings like [1935, 1933] only come out right if
+every path is exact.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from dgraph_tpu.types.types import TypeID
+
+M64 = (1 << 64) - 1
+
+K0 = 0xC3A5C85C97CB3127
+K1 = 0xB492B66FBE98F273
+K2 = 0x9AE16A3B2F90404F
+
+
+def _rot(v: int, s: int) -> int:
+    if s == 0:
+        return v
+    return ((v >> s) | (v << (64 - s))) & M64
+
+
+def _shift_mix(v: int) -> int:
+    return (v ^ (v >> 47)) & M64
+
+
+def _f64(s: bytes, i: int = 0) -> int:
+    return struct.unpack_from("<Q", s, i)[0]
+
+
+def _f32(s: bytes, i: int = 0) -> int:
+    return struct.unpack_from("<I", s, i)[0]
+
+
+def _hash16(u: int, v: int, mul: int) -> int:
+    a = ((u ^ v) * mul) & M64
+    a ^= a >> 47
+    b = ((v ^ a) * mul) & M64
+    b ^= b >> 47
+    return (b * mul) & M64
+
+
+def _len0to16(s: bytes) -> int:
+    n = len(s)
+    if n >= 8:
+        mul = (K2 + n * 2) & M64
+        a = (_f64(s) + K2) & M64
+        b = _f64(s, n - 8)
+        c = (_rot(b, 37) * mul + a) & M64
+        d = ((_rot(a, 25) + b) * mul) & M64
+        return _hash16(c, d, mul)
+    if n >= 4:
+        mul = (K2 + n * 2) & M64
+        a = _f32(s)
+        return _hash16((n + (a << 3)) & M64, _f32(s, n - 4), mul)
+    if n > 0:
+        a, b, c = s[0], s[n >> 1], s[n - 1]
+        y = (a + (b << 8)) & M64
+        z = (n + (c << 2)) & M64
+        return (_shift_mix((y * K2 ^ z * K0) & M64) * K2) & M64
+    return K2
+
+
+def _len17to32(s: bytes) -> int:
+    n = len(s)
+    mul = (K2 + n * 2) & M64
+    a = (_f64(s) * K1) & M64
+    b = _f64(s, 8)
+    c = (_f64(s, n - 8) * mul) & M64
+    d = (_f64(s, n - 16) * K2) & M64
+    return _hash16(
+        (_rot((a + b) & M64, 43) + _rot(c, 30) + d) & M64,
+        (a + _rot((b + K2) & M64, 18) + c) & M64,
+        mul,
+    )
+
+
+def _len33to64(s: bytes) -> int:
+    n = len(s)
+    mul = (K2 + n * 2) & M64
+    a = (_f64(s) * K2) & M64
+    b = _f64(s, 8)
+    c = (_f64(s, n - 8) * mul) & M64
+    d = (_f64(s, n - 16) * K2) & M64
+    y = (_rot((a + b) & M64, 43) + _rot(c, 30) + d) & M64
+    z = _hash16(y, (a + _rot((b + K2) & M64, 18) + c) & M64, mul)
+    e = (_f64(s, 16) * mul) & M64
+    f = _f64(s, 24)
+    g = ((y + _f64(s, n - 32)) * mul) & M64
+    h = ((z + _f64(s, n - 24)) * mul) & M64
+    return _hash16(
+        (_rot((e + f) & M64, 43) + _rot(g, 30) + h) & M64,
+        (e + _rot((f + a) & M64, 18) + g) & M64,
+        mul,
+    )
+
+
+def _weak32(s: bytes, i: int, a: int, b: int):
+    w = _f64(s, i)
+    x = _f64(s, i + 8)
+    y = _f64(s, i + 16)
+    z = _f64(s, i + 24)
+    a = (a + w) & M64
+    b = _rot((b + a + z) & M64, 21)
+    c = a
+    a = (a + x + y) & M64
+    b = (b + _rot(a, 44)) & M64
+    return (a + z) & M64, (b + c) & M64
+
+
+def fingerprint64(s: bytes) -> int:
+    n = len(s)
+    if n <= 16:
+        return _len0to16(s)
+    if n <= 32:
+        return _len17to32(s)
+    if n <= 64:
+        return _len33to64(s)
+
+    seed = 81
+    x = seed
+    y = (seed * K1 + 113) & M64
+    z = (_shift_mix((y * K2 + 113) & M64) * K2) & M64
+    v1 = v2 = w1 = w2 = 0
+    x = (x * K2 + _f64(s)) & M64
+
+    end = ((n - 1) // 64) * 64
+    last64 = n - 64
+    i = 0
+    while i < end:
+        x = (_rot((x + y + v1 + _f64(s, i + 8)) & M64, 37) * K1) & M64
+        y = (_rot((y + v2 + _f64(s, i + 48)) & M64, 42) * K1) & M64
+        x ^= w2
+        y = (y + v1 + _f64(s, i + 40)) & M64
+        z = (_rot((z + w1) & M64, 33) * K1) & M64
+        v1, v2 = _weak32(s, i, (v2 * K1) & M64, (x + w1) & M64)
+        w1, w2 = _weak32(s, i + 32, (z + w2) & M64, (y + _f64(s, i + 16)) & M64)
+        z, x = x, z
+        i += 64
+
+    mul = (K1 + ((z & 0xFF) << 1)) & M64
+    i = last64
+    w1 = (w1 + ((n - 1) & 63)) & M64
+    v1 = (v1 + w1) & M64
+    w1 = (w1 + v1) & M64
+    x = (_rot((x + y + v1 + _f64(s, i + 8)) & M64, 37) * mul) & M64
+    y = (_rot((y + v2 + _f64(s, i + 48)) & M64, 42) * mul) & M64
+    x ^= (w2 * 9) & M64
+    y = (y + v1 * 9 + _f64(s, i + 40)) & M64
+    z = (_rot((z + w1) & M64, 33) * mul) & M64
+    v1, v2 = _weak32(s, i, (v2 * mul) & M64, (x + w1) & M64)
+    w1, w2 = _weak32(s, i + 32, (z + w2) & M64, (y + _f64(s, i + 16)) & M64)
+    z, x = x, z
+    return _hash16(
+        (_hash16(v1, w1, mul) + _shift_mix(y) * K0 + z) & M64,
+        (_hash16(v2, w2, mul) + x) & M64,
+        mul,
+    )
+
+
+# -- Go-side value marshaling (types/conversion.go Marshal -> []byte) --------
+
+_UNIX_TO_INTERNAL = (1969 * 365 + 1969 // 4 - 1969 // 100 + 1969 // 400) * 86400
+
+
+def go_time_binary(dt) -> bytes:
+    """Go time.Time.MarshalBinary, version 1 (whole-minute zone offsets):
+    version byte, 8B big-endian seconds since year 1, 4B nanoseconds,
+    2B zone offset minutes (-1 == UTC)."""
+    import datetime as _dt
+
+    if dt.tzinfo is None:
+        off_min = -1
+        epoch = _dt.datetime(1970, 1, 1)
+        delta = dt - epoch
+    else:
+        off = dt.utcoffset() or _dt.timedelta(0)
+        off_min = int(off.total_seconds() // 60)
+        if off_min == 0:
+            off_min = -1  # UTC marshals as -1
+        epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+        delta = dt - epoch
+    unix = int(delta.total_seconds())
+    # delta.total_seconds loses sub-us precision; rebuild exactly
+    unix = delta.days * 86400 + delta.seconds
+    nsec = delta.microseconds * 1000
+    sec = unix + _UNIX_TO_INTERNAL
+    return (
+        b"\x01"
+        + struct.pack(">q", sec)
+        + struct.pack(">i", nsec)
+        + struct.pack(">h", off_min)
+    )
+
+
+def go_value_binary(tid, value) -> bytes:
+    """The bytes the reference hashes for a value posting's uid: its
+    storage-type marshaled form (types/conversion.go Marshal)."""
+    if tid == TypeID.DATETIME:
+        return go_time_binary(value)
+    if tid == TypeID.INT:
+        return struct.pack("<q", int(value))
+    if tid == TypeID.FLOAT:
+        return struct.pack("<d", float(value))
+    if tid == TypeID.BOOL:
+        return b"\x01" if value else b"\x00"
+    if isinstance(value, bytes):
+        return value
+    return str(value).encode("utf-8")
