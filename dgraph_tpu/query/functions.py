@@ -43,7 +43,7 @@ class FuncRunner:
 
     def __init__(self, cache: LocalCache, st: State, ns: int = keys.GALAXY_NS,
                  vector_indexes=None, uid_vars=None, val_vars=None,
-                 stats=None):
+                 stats=None, ordered_uid_vars=None):
         self.cache = cache
         self.st = st
         self.ns = ns
@@ -51,6 +51,8 @@ class FuncRunner:
         self.uid_vars = uid_vars or {}
         self.val_vars = val_vars or {}
         self.stats = stats  # StatsHolder: selectivity-ordered index scans
+        # vars whose array order is meaningful (shortest-path vars)
+        self.ordered_uid_vars = ordered_uid_vars or set()
 
     # -- helpers -------------------------------------------------------------
 
@@ -135,7 +137,17 @@ class FuncRunner:
             return self._count_func(fn, name, src)
         if name == "uid":
             uids = list(fn.args)
-            for v in fn.uid_var.split(",") if fn.uid_var else ():
+            uvars = fn.uid_var.split(",") if fn.uid_var else []
+            if (
+                not uids
+                and len(uvars) == 1
+                and uvars[0] in self.ordered_uid_vars
+                and src is None
+            ):
+                # uid(A) where A is a shortest-path var: PATH order
+                # (ref TestShortestPathRev golden)
+                return np.asarray(self.uid_vars[uvars[0]], np.uint64)
+            for v in uvars:
                 if v in self.uid_vars:
                     uids.extend(int(u) for u in self.uid_vars[v])
                 elif v in self.val_vars:
@@ -663,14 +675,28 @@ class FuncRunner:
             out = np.intersect1d(out, src, assume_unique=True)
         if su.lang:
             # lang-aware re-check: the index matched tokens from any
-            # language; re-tokenize the value in the requested lang
+            # language; re-tokenize the value in the requested lang.
+            # `name@.` matches in ANY language (ref TestLangDotInFunction)
             want = set(toks)
+            any_lang = fn.lang and "." in fn.lang.split(":")
             verified = []
             for u in out:
-                got = self._value_of(fn.attr, int(u), fn.lang)
-                if got is None:
-                    continue
-                have = set(build_tokens(got, [tok], lang=fn.lang or ""))
+                if any_lang:
+                    have = set()
+                    for p in self.cache.values(
+                        keys.DataKey(fn.attr, int(u), self.ns)
+                    ):
+                        if p.is_value:
+                            have |= set(
+                                build_tokens(p.val(), [tok], lang=p.lang)
+                            )
+                else:
+                    got = self._value_of(fn.attr, int(u), fn.lang)
+                    if got is None:
+                        continue
+                    have = set(
+                        build_tokens(got, [tok], lang=fn.lang or "")
+                    )
                 hit = want <= have if require_all else bool(want & have)
                 if hit:
                     verified.append(int(u))
@@ -949,14 +975,23 @@ def _required_trigrams(pattern: str, flags: str = "") -> List[str]:
     # a character class matches many strings — nothing inside it is a
     # required literal (ref TestFilterRegex1 /^[Glen Rh]+$/)
     pat = re.sub(r"\[(?:\\.|[^\]])*\]", ".", pattern)
-    # group punctuation is not literal text; lookaround and optional
-    # group contents are not required; neither is anything quantified
-    # by {m,n} or ?/* (conservative: blank them all to a splitter)
-    pat = pat.replace("(?:", "(")
+    # lookaround contents are not required
     pat = re.sub(r"\(\?[=!<][^)]*\)", ".", pat)
-    pat = re.sub(r"\((?:[^()])*\)[*?]", ".", pat)
+    # groups, innermost-first to a fixpoint: a quantified group's body is
+    # optional/repeated (blank it); an unquantified group's body is
+    # required exactly once (splice it into the surrounding run)
+    prev = None
+    while prev != pat:
+        prev = pat
+        pat = re.sub(
+            r"\((?:\?:)?(?:\\.|[^()\\])*\)(?:[*?+]|\{[^}]*\})", ".", pat
+        )
+        pat = re.sub(r"\((?:\?:)?((?:\\.|[^()\\])*)\)", r"\1", pat)
+    if "(" in pat or ")" in pat:
+        return []  # unbalanced/exotic nesting: no safe prefilter
+    # anything quantified by {m,n} or ?/* is not required either
     pat = re.sub(r"(\\.|[^\\])\{[^}]*\}", ".", pat)
-    pat = re.sub(r"(\\.|[^\\.*+?{}()^$])[*?]", ".", pat)
+    pat = re.sub(r"(\\.|[^\\.*+?{}^$])[*?]", ".", pat)
     lit = max(re.split(r"[\.\*\+\?\[\]\(\)\\\^\$\{\}]", pat), key=len, default="")
     if len(lit) < 3:
         return []

@@ -221,6 +221,12 @@ class JsonEncoder:
             elif gq.is_count:
                 if gq.attr == "uid":
                     continue
+                if self.schema is not None and (
+                    self.schema.get(c.attr.lstrip("~")) is None
+                ):
+                    # count() of a predicate with no schema entry emits
+                    # nothing (ref TestCountEmptyData3: "me": [])
+                    continue
                 if banned is not None and c.is_uid_pred:
                     r = c.uid_matrix[row] if row < len(c.uid_matrix) else []
                     obj[name] = int(
@@ -243,6 +249,9 @@ class JsonEncoder:
                 for v in r:
                     if banned is not None and int(v) in banned:
                         continue  # @ignorereflex: path back-edge
+                    # a uid predicate with no selection block emits
+                    # nothing (ref TestUidWithoutDebug: `friend` with no
+                    # braces contributes no key; TestFacetsAlias2)
                     kid = (
                         self.encode_entity(
                             c, int(v), dest_idx.get(int(v), 0),
@@ -251,11 +260,6 @@ class JsonEncoder:
                         if c.children
                         else {}
                     )
-                    if not c.children:
-                        # a uid predicate with no selection block emits
-                        # nothing (ref TestUidWithoutDebug: `friend` with
-                        # no braces contributes no key; TestFacetsAlias2)
-                        kid = {}
                     # facets ride along only on children that carry real
                     # fields; facet-only objects are pruned
                     # (ref TestFetchingFewFacets: nameless friend omitted)
@@ -275,13 +279,19 @@ class JsonEncoder:
                     if banned is None
                     else sum(1 for v in r if int(v) not in banned)
                 )
-                for cc in c.children:
-                    if (
-                        cc.gq.is_count
-                        and cc.gq.attr == "uid"
-                        and not cc.gq.var_name
-                    ):
-                        kids.append({cc.gq.alias or "count": int(n_live)})
+                # an EMPTY edge list emits no count row — and thus no key
+                # at all (ref TestCountUIDNested: parents without friends
+                # have no "friend" entry)
+                if n_live:
+                    for cc in c.children:
+                        if (
+                            cc.gq.is_count
+                            and cc.gq.attr == "uid"
+                            and not cc.gq.var_name
+                        ):
+                            kids.append(
+                                {cc.gq.alias or "count": int(n_live)}
+                            )
                 if gq.normalize:
                     # subquery-level @normalize: flatten each target's
                     # subtree into aliased-leaf rows, concatenated
@@ -319,6 +329,18 @@ class JsonEncoder:
                 for p in posts or []:
                     key = f"{base}@{p.lang}" if p.lang else base
                     obj[key] = _json_val(p.val())
+                    if gq.facets:
+                        for fk, fv in p.get_facets().items():
+                            if (
+                                gq.facet_names
+                                and fk not in gq.facet_names
+                            ):
+                                continue
+                            fkey = (
+                                gq.facet_aliases.get(fk)
+                                or f"{key}|{fk}"
+                            )
+                            obj[fkey] = _json_val(fv)
             else:
                 posts = c.values.get(uid)
                 if posts:

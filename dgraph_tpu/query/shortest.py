@@ -30,20 +30,32 @@ from dgraph_tpu.x import keys
 class _Edges:
     """Neighbor + per-edge-cost reader over the path predicates."""
 
-    def __init__(self, cache, st, preds, weight_facets, ns, node_filter=None):
+    def __init__(
+        self,
+        cache,
+        st,
+        preds,
+        weight_facets,
+        ns,
+        node_filter=None,
+        node_filters=None,
+    ):
         self.cache = cache
         self.ns = ns
         # node_filter(uids ndarray) -> surviving uids; applied to every
-        # expansion frontier (ref shortest.go applying the block @filter
-        # to intermediate nodes)
+        # expansion frontier. node_filters is the PER-PREDICATE form —
+        # each path predicate's own @filter prunes only edges traversed
+        # via that predicate (ref shortest.go per-subgraph filters,
+        # TestShortestPath_filter2). node_filter applies to all.
         self.node_filter = node_filter
-        self.upreds: List[Tuple[str, Optional[str]]] = []
+        self.upreds: List[Tuple[str, Optional[str], object]] = []
         for i, p in enumerate(preds):
             su = st.get(p.lstrip("~"))
             if su is not None and su.value_type == TypeID.UID:
                 wf = weight_facets[i] if weight_facets else None
-                self.upreds.append((p, wf))
-        self.weighted = any(wf for _, wf in self.upreds)
+                pf = node_filters[i] if node_filters else None
+                self.upreds.append((p, wf, pf))
+        self.weighted = any(wf for _, wf, _pf in self.upreds)
 
     def _key(self, pred: str, u: int):
         return (
@@ -55,7 +67,7 @@ class _Edges:
     def neighbors(self, u: int) -> Dict[int, float]:
         """target uid -> edge cost (min across predicates)."""
         out: Dict[int, float] = {}
-        for pred, wf in self.upreds:
+        for pred, wf, pf in self.upreds:
             key = self._key(pred, u)
             vs = self.cache.uids(key)
             if not len(vs):
@@ -64,25 +76,36 @@ class _Edges:
                 vs = self.node_filter(vs)
                 if not len(vs):
                     continue
+            if pf is not None:
+                vs = pf(vs)
+                if not len(vs):
+                    continue
             fmap = self.cache.edge_facets(key) if wf else {}
             for v in vs:
                 v = int(v)
                 cost = 1.0
                 if wf:
                     fv = fmap.get(v, {}).get(wf)
-                    if fv is not None:
-                        try:
-                            cost = float(fv.value)
-                        except (TypeError, ValueError):
-                            cost = 1.0
+                    if fv is None:
+                        # @facets(weight) requested but this edge has no
+                        # such facet: the edge is NOT traversable (ref
+                        # TestKShortestPathWeighted: the facet-less
+                        # 1003->1001 edge yields no route)
+                        continue
+                    try:
+                        cost = float(fv.value)
+                    except (TypeError, ValueError):
+                        continue
                 if v not in out or cost < out[v]:
                     out[v] = cost
         return out
 
     def neighbor_uids(self, u: int) -> np.ndarray:
         outs = []
-        for pred, _ in self.upreds:
+        for pred, _wf, pf in self.upreds:
             o = self.cache.uids(self._key(pred, u))
+            if len(o) and pf is not None:
+                o = pf(o)
             if len(o):
                 outs.append(o)
         if not outs:
@@ -106,13 +129,18 @@ def k_shortest_paths(
     min_weight: Optional[float] = None,
     max_weight: Optional[float] = None,
     node_filter=None,
+    node_filters=None,
 ) -> List[Tuple[List[int], float]]:
     """Returns up to num_paths (uid-path, total_cost) pairs, cheapest first.
 
     weight_facets[i] names the facet carrying pred[i]'s edge cost (None =
-    unit cost, matching the reference's default). node_filter prunes
-    intermediate nodes (the block @filter)."""
-    edges = _Edges(cache, st, preds, weight_facets, ns, node_filter=node_filter)
+    unit cost, matching the reference's default; a named facet makes
+    facet-less edges untraversable). node_filter prunes intermediate
+    nodes globally; node_filters[i] prunes only pred[i]'s edges."""
+    edges = _Edges(
+        cache, st, preds, weight_facets, ns,
+        node_filter=node_filter, node_filters=node_filters,
+    )
     if not edges.upreds:
         return []
     if src == dst:
@@ -144,7 +172,10 @@ def k_shortest_paths(
             if in_bounds(cost):
                 results.append((path, cost))
             continue
-        if len(path) > max_depth:
+        if len(path) - 1 > max_depth:
+            # depth bounds INTERMEDIATE nodes: a route may use depth+1
+            # edges (ref TestKShortestPathTwoPaths: depth:2 admits a
+            # 3-edge path)
             continue
         if max_weight is not None and cost > max_weight:
             continue  # costs are non-negative: no route can come back down
@@ -171,7 +202,10 @@ def annotate_hops(
     hops: List[Tuple[str, Optional[float]]] = []
     for u, v in zip(path, path[1:]):
         found = (preds[0] if preds else "", None)
-        for pred, wf in edges.upreds:
+        # when several query predicates carry the same edge, the LAST one
+        # labels the hop (ref shortest.go adjacency overwrite order,
+        # TestShortestPath4: follow wins over path)
+        for pred, wf, _pf in edges.upreds:
             key = edges._key(pred, int(u))
             vs = edges.cache.uids(key)
             if int(v) in {int(x) for x in vs}:
@@ -184,7 +218,6 @@ def annotate_hops(
                         except (TypeError, ValueError):
                             cost = None
                 found = (pred, cost)
-                break
         hops.append(found)
     return hops
 
@@ -195,7 +228,9 @@ def _bfs_single(edges: _Edges, src: int, dst: int, max_depth: int):
     frontier = {src}
     found = False
     depth = 0
-    while frontier and depth < max_depth and not found:
+    # depth bounds INTERMEDIATE nodes (max_depth+1 edges) — keep in sync
+    # with the k-paths branch's `len(path) - 1 > max_depth` check
+    while frontier and depth < max_depth + 1 and not found:
         depth += 1
         nxt: Dict[int, set] = {}
         for u in frontier:

@@ -58,6 +58,8 @@ class ExecNode:
     own_vars: set = field(default_factory=set)
     level_vars: Dict[str, Dict[int, Val]] = field(default_factory=dict)
     parent_node: Optional["ExecNode"] = None
+    # inside a @cascade subtree: pagination defers until after pruning
+    under_cascade: bool = False
 
 
 class Executor:
@@ -83,6 +85,9 @@ class Executor:
         # ACL-readable predicates (ref expand filtering in edgraph auth)
         self.allowed_preds = allowed_preds
         self.uid_vars: Dict[str, np.ndarray] = {}
+        # vars whose stored order is MEANINGFUL (shortest-path vars hold
+        # path order; uid(var) roots preserve it — ref TestShortestPathRev)
+        self.ordered_uid_vars: set = set()
         # value vars; scalar (block-wide) vars broadcast via key MAXUID
         # (ref query.go:1593 count-var stored at math.MaxUint64)
         self.val_vars: Dict[str, Dict[int, Val]] = {}
@@ -98,6 +103,7 @@ class Executor:
             uid_vars=self.uid_vars,
             val_vars=self.val_vars,
             stats=self.stats,
+            ordered_uid_vars=self.ordered_uid_vars,
         )
 
     # ------------------------------------------------------------------
@@ -400,7 +406,9 @@ class Executor:
         if not skip_order:
             if gq.cascade:
                 if gq.order:
-                    node.dest_uids = self._order_uids(gq, node.dest_uids)
+                    node.dest_uids = self._order_uids(
+                        gq, node.dest_uids, full=True
+                    )
             else:
                 node.dest_uids = self._order_and_paginate(gq, node.dest_uids)
 
@@ -438,6 +446,8 @@ class Executor:
 
         if gq.cascade:
             self._apply_cascade(node)
+        else:
+            self._apply_child_cascades(node)
         return node
 
     # ------------------------------------------------------------------
@@ -555,6 +565,9 @@ class Executor:
         su = self.st.get(attr[1:] if reverse else attr)
         cnode = ExecNode(gq=cgq, attr=attr, src_uids=parent.dest_uids)
         cnode.parent_node = parent
+        cnode.under_cascade = (
+            parent.under_cascade or parent.gq.cascade or cgq.cascade
+        )
         if su is not None and (su.value_type == TypeID.UID or reverse):
             if reverse and not su.directive_reverse:
                 raise QueryError(f"predicate {attr[1:]!r} has no @reverse index")
@@ -581,12 +594,22 @@ class Executor:
                 )
             if cgq.facet_filter is not None or cgq.facet_order or cgq.facets:
                 self._apply_edge_facets(cnode, cgq, parent, reverse)
-            # per-row order & pagination (ref query.go:2493,2511)
+            # per-row order & pagination (ref query.go:2493,2511);
+            # under @cascade, order fully — bounded top-k would truncate
+            # to offset+first BEFORE pruning restores the window
             if cgq.order:
                 cnode.uid_matrix = [
-                    self._order_uids(cgq, r) for r in cnode.uid_matrix
+                    self._order_uids(cgq, r, full=cnode.under_cascade)
+                    for r in cnode.uid_matrix
                 ]
-            if cgq.first is not None or cgq.offset is not None or cgq.after is not None:
+            if (
+                cgq.first is not None
+                or cgq.offset is not None
+                or cgq.after is not None
+            ) and not cnode.under_cascade:
+                # any block inside a @cascade subtree defers pagination
+                # until after pruning (_apply_deferred_pagination; ref
+                # TestCascadeWithPaginationDeep)
                 cnode.uid_matrix = [
                     _paginate(r, cgq.first, cgq.offset, cgq.after)
                     for r in cnode.uid_matrix
@@ -1098,10 +1121,24 @@ class Executor:
                 ):
                     continue  # silently drop unreadable preds (ref behavior)
                 seen.add(pname)
+                su = self.st.get(pname)
+                if g.filter is not None and not (
+                    su is not None and su.value_type == TypeID.UID
+                ):
+                    # expand(...) @filter(...) filters NODES — scalar
+                    # expanded predicates drop entirely
+                    # (ref TestTypeFilterAtExpand: only `owner` survives)
+                    continue
                 child = GraphQuery(attr=pname)
                 child.children = list(g.children)
                 # expand(...) @filter(...) applies to every expanded edge
                 child.filter = g.filter
+                # expanded fields surface every language variant and all
+                # facets (ref TestTypeExpandLang model@jp,
+                # TestTypeExpandFacets model|type)
+                if su is not None and su.lang:
+                    child.lang = "*"
+                child.facets = True
                 out.append(child)
         return out
 
@@ -1218,92 +1255,145 @@ class Executor:
     # @cascade: prune uids missing any child (ref query.go cascade)
     # ------------------------------------------------------------------
 
-    def _apply_cascade(self, node: ExecNode):
-        """@cascade prunes RECURSIVELY: an entity at ANY level survives
-        only if every queried field at its level is present — including
-        uid-pred children whose own subtrees survived (ref query.go
-        applyCascade bottom-up pruning)."""
-        valids: Dict[int, set] = {}
-
-        def compute(n: ExecNode) -> set:
+    def _cascade_compute(self, n: ExecNode, valids: Dict[int, set]) -> set:
+        """Bottom-up valid sets: an entity survives only if every queried
+        field at its level is present — including uid-pred children whose
+        own subtrees survived (ref query.go applyCascade)."""
+        for c in n.children:
+            if c.is_uid_pred and c.children:
+                self._cascade_compute(c, valids)
+        valid = set()
+        for i, u in enumerate(n.dest_uids):
+            ok = True
             for c in n.children:
-                if c.is_uid_pred and c.children:
-                    compute(c)
-            valid = set()
-            for i, u in enumerate(n.dest_uids):
-                ok = True
-                for c in n.children:
-                    gq = c.gq
-                    if (
-                        gq.is_uid
-                        or gq.is_count
-                        or gq.aggregator
-                        or gq.val_var
-                        or gq.math_expr is not None
-                        or gq.checkpwd_val is not None
+                gq = c.gq
+                if (
+                    gq.is_uid
+                    or gq.is_count
+                    or gq.aggregator
+                    or gq.val_var
+                    or gq.math_expr is not None
+                    or gq.checkpwd_val is not None
+                ):
+                    continue
+                if c.is_uid_pred:
+                    row = (
+                        c.uid_matrix[i]
+                        if i < len(c.uid_matrix)
+                        else ()
+                    )
+                    cv = valids.get(id(c))
+                    if not any(
+                        cv is None or int(v) in cv for v in row
                     ):
-                        continue
-                    if c.is_uid_pred:
-                        row = (
-                            c.uid_matrix[i]
-                            if i < len(c.uid_matrix)
-                            else ()
-                        )
-                        cv = valids.get(id(c))
-                        if not any(
-                            cv is None or int(v) in cv for v in row
-                        ):
-                            ok = False
-                            break
-                    elif int(u) not in c.values:
                         ok = False
                         break
-                if ok:
-                    valid.add(int(u))
-            valids[id(n)] = valid
-            return valid
+                elif int(u) not in c.values:
+                    ok = False
+                    break
+            if ok:
+                valid.add(int(u))
+        valids[id(n)] = valid
+        return valid
 
-        root_valid = compute(node)
+    def _cascade_prune(
+        self, n: ExecNode, n_valid: set, valids: Dict[int, set]
+    ):
+        """Prune matrix CONTENTS by the valid sets (row alignment with
+        each parent's dest list is preserved; dest stays a superset, which
+        the encoder tolerates — it walks rows, not dest)."""
+        for c in n.children:
+            if not c.is_uid_pred:
+                continue
+            cv = valids.get(id(c))
+            rows = []
+            for i, row in enumerate(c.uid_matrix):
+                pu = (
+                    int(n.dest_uids[i])
+                    if i < len(n.dest_uids)
+                    else None
+                )
+                if pu is not None and pu not in n_valid:
+                    rows.append(EMPTY)  # parent itself was pruned
+                elif cv is not None:
+                    rows.append(
+                        _as_uids(v for v in row if int(v) in cv)
+                    )
+                else:
+                    rows.append(row)
+            c.uid_matrix = rows
+            # uid vars bound in a cascaded subtree see the PRUNED set
+            # (ref TestUseVarsMultiCascade golden)
+            if c.gq.var_name and not c.gq.is_count:
+                self.uid_vars[c.gq.var_name] = _merge_rows(
+                    c.uid_matrix
+                )
+            if c.children:
+                self._cascade_prune(
+                    c,
+                    cv
+                    if cv is not None
+                    else {int(x) for x in c.dest_uids},
+                    valids,
+                )
 
-        # prune matrix CONTENTS by the valid sets (row alignment with each
-        # parent's dest list is preserved; dest stays a superset, which the
-        # encoder tolerates — it walks rows, not dest)
-        def prune_contents(n: ExecNode, n_valid: set):
-            for c in n.children:
-                if not c.is_uid_pred:
-                    continue
-                cv = valids.get(id(c))
-                rows = []
-                for i, row in enumerate(c.uid_matrix):
-                    pu = (
-                        int(n.dest_uids[i])
-                        if i < len(n.dest_uids)
-                        else None
-                    )
-                    if pu is not None and pu not in n_valid:
-                        rows.append(EMPTY)  # parent itself was pruned
-                    elif cv is not None:
-                        rows.append(
-                            _as_uids(v for v in row if int(v) in cv)
-                        )
-                    else:
-                        rows.append(row)
-                c.uid_matrix = rows
-                # uid vars bound in a cascaded subtree see the PRUNED set
-                # (ref TestUseVarsMultiCascade golden)
-                if c.gq.var_name and not c.gq.is_count:
-                    self.uid_vars[c.gq.var_name] = _merge_rows(
-                        c.uid_matrix
-                    )
-                if c.children:
-                    prune_contents(
-                        c,
-                        cv
-                        if cv is not None
-                        else {int(x) for x in c.dest_uids},
-                    )
+    def _apply_deferred_pagination(self, node: ExecNode):
+        """Pagination for blocks inside a @cascade subtree, applied AFTER
+        pruning (ref TestCascadeWithPaginationDeep: first/offset count
+        only surviving entities)."""
+        for c in node.children:
+            if not c.is_uid_pred:
+                continue
+            gq = c.gq
+            if c.under_cascade and (
+                gq.first is not None
+                or gq.offset is not None
+                or gq.after is not None
+            ):
+                c.uid_matrix = [
+                    _paginate(r, gq.first, gq.offset, gq.after)
+                    for r in c.uid_matrix
+                ]
+                c.dest_uids = _merge_rows(c.uid_matrix)
+            self._apply_deferred_pagination(c)
 
-        prune_contents(node, root_valid)
+    def _apply_child_cascades(self, node: ExecNode):
+        """`friend @cascade { ... }` on a SUBQUERY: prune that subtree the
+        same way a root @cascade does, then apply the subtree's deferred
+        pagination (ref TestCascadeSubQuery*)."""
+        for c in node.children:
+            if not c.is_uid_pred:
+                continue
+            if c.gq.cascade and c.children:
+                valids: Dict[int, set] = {}
+                valid = self._cascade_compute(c, valids)
+                c.uid_matrix = [
+                    _as_uids(v for v in row if int(v) in valid)
+                    for row in c.uid_matrix
+                ]
+                self._cascade_prune(c, valid, valids)
+                gq = c.gq
+                if (
+                    gq.first is not None
+                    or gq.offset is not None
+                    or gq.after is not None
+                ):
+                    c.uid_matrix = [
+                        _paginate(r, gq.first, gq.offset, gq.after)
+                        for r in c.uid_matrix
+                    ]
+                c.dest_uids = _merge_rows(c.uid_matrix)
+                if gq.var_name and not gq.is_count:
+                    self.uid_vars[gq.var_name] = c.dest_uids
+                self._apply_deferred_pagination(c)
+            else:
+                self._apply_child_cascades(c)
+
+    def _apply_cascade(self, node: ExecNode):
+        """Root @cascade (ref query.go applyCascade bottom-up pruning)."""
+        valids: Dict[int, set] = {}
+        root_valid = self._cascade_compute(node, valids)
+        self._cascade_prune(node, root_valid, valids)
 
         # root pagination was deferred for cascade blocks: apply it now,
         # preserving any ordering already applied to dest_uids
@@ -1322,6 +1412,7 @@ class Executor:
         if gq.var_name:
             # the block's own uid var must see the pruned set too
             self.uid_vars[gq.var_name] = kept
+        self._apply_deferred_pagination(node)
 
     # ------------------------------------------------------------------
     # Ordering / pagination
@@ -1435,10 +1526,19 @@ class Executor:
             return np.concatenate([top, rest])
         return top
 
-    def _order_uids(self, gq: GraphQuery, uids: np.ndarray) -> np.ndarray:
+    def _order_uids(
+        self, gq: GraphQuery, uids: np.ndarray, full: bool = False
+    ) -> np.ndarray:
+        """full=True keeps EVERY uid ordered (no first/offset-bounded
+        top-k / index early stop) — required when pruning happens after
+        ordering, e.g. @cascade (ref TestCascadeWithSort)."""
         if not len(uids) or not gq.order:
             return uids
-        if len(gq.order) == 1:
+        if any(o.lang and o.lang != "." for o in gq.order):
+            # lang-tagged sorts need collation — only the generic path
+            # applies it (index walks are byte-ordered)
+            return self._order_uids_generic(gq, uids)
+        if len(gq.order) == 1 and not full:
             o = gq.order[0]
             got = self._order_uids_topk(gq, o, uids)
             if got is not None:
@@ -1459,28 +1559,69 @@ class Executor:
                 keys.DataKey(o.attr, int(u), self.ns), o.lang
             )
 
-        # multi-key ordering: stable sorts applied in reverse key order
-        # (ref query.go multiSort). Sorting by a PREDICATE keeps nodes
-        # missing the value, after every valued one (ref TestNegativeOffset
-        # golden); sorting by a val(..) var EXCLUDES uids outside the var
-        # map (ref the QueryVarValAgg* goldens) — the var map IS the
-        # candidate set there.
+        # multi-key ordering: ONE composite comparator (ref query.go
+        # multiSort/sortWithValues semantics, pinned by the goldens):
+        # - a node missing a key's value sorts after every valued one,
+        #   in asc AND desc (ref TestNegativeOffset);
+        # - sorting by a val(..) var EXCLUDES uids outside the var map
+        #   (ref QueryVarValAgg*) — the var map IS the candidate set;
+        # - full ties break by uid, in the LAST key's direction
+        #   (ref TestMultiSort5: Bob/Elizabeth pairs order uid-desc
+        #   under orderasc:name, orderdesc:salary);
+        # - lang-tagged string keys use that language's collation
+        #   (ref LanguageOrderIndexed goldens).
+        import functools
+
+        from dgraph_tpu.tok.collate import collate_key
+
+        orders = gq.order
         ordered = [int(u) for u in uids]
+        vals_per_key = [
+            {u: key_of(o, u) for u in ordered} for o in orders
+        ]
+        if orders[0].val_var:
+            ordered = [
+                u for u in ordered if vals_per_key[0][u] is not None
+            ]
+
+        def skey(o, v):
+            if (
+                o.lang
+                and o.lang != "."
+                and isinstance(v.value, str)
+            ):
+                return collate_key(v.value, o.lang)
+            return _sort_key_of(v)
+
+        def cmp(a, b):
+            for o, vals in zip(orders, vals_per_key):
+                va, vb = vals[a], vals[b]
+                if va is None and vb is None:
+                    continue
+                if va is None:
+                    return 1  # missing always last
+                if vb is None:
+                    return -1
+                ka, kb = skey(o, va), skey(o, vb)
+                if ka == kb:
+                    continue
+                lt = -1 if ka < kb else 1
+                return -lt if o.desc else lt
+            if a == b:
+                return 0
+            # uid tiebreak: val(..) sorts are stable over uid-asc input
+            # (ref TestQueryVarValAggMul equal-value runs); predicate
+            # sorts break ties in the LAST key's direction
+            # (ref TestMultiSort5 Bob/Elizabeth pairs)
+            lt = -1 if a < b else 1
+            if orders[-1].val_var:
+                return lt
+            return -lt if orders[-1].desc else lt
+
         try:
-            for ki, o in enumerate(reversed(gq.order)):
-                vals = {u: key_of(o, u) for u in ordered}
-                present = [u for u in ordered if vals[u] is not None]
-                missing = [u for u in ordered if vals[u] is None]
-                present.sort(
-                    key=lambda u: _sort_key_of(vals[u]), reverse=o.desc
-                )
-                is_primary = ki == len(gq.order) - 1
-                if is_primary and o.val_var:
-                    ordered = present
-                else:
-                    ordered = present + missing
+            ordered.sort(key=functools.cmp_to_key(cmp))
         except TypeError:
-            names = ", ".join(o.attr or o.val_var for o in gq.order)
+            names = ", ".join(o.attr or o.val_var for o in orders)
             raise QueryError(f"unorderable values for {names}") from None
         return np.array(ordered, dtype=np.uint64)
 
@@ -1507,14 +1648,11 @@ class Executor:
         wfacets = [
             (c.facet_names[0] if c.facet_names else None) for c in gq.children
         ]
-        # the first child filter prunes intermediate nodes (except the
-        # destination, which always completes a path — ref shortest.go)
-        nf = None
-        child_filters = [c.filter for c in gq.children if c.filter is not None]
-        if child_filters:
-            ftree = child_filters[0]
-
-            def nf(uids, _f=ftree, _dst=dst):
+        # each path predicate's own @filter prunes the nodes reached VIA
+        # that predicate (except the destination, which always completes
+        # a path — ref shortest.go per-subgraph filters, filter2 golden)
+        def mk_nf(ftree, _dst=dst):
+            def nf(uids, _f=ftree):
                 kept = self.eval_filter(_f, uids)
                 if _dst in uids and _dst not in kept:
                     kept = np.sort(
@@ -1522,6 +1660,12 @@ class Executor:
                     ).astype(np.uint64)
                 return kept
 
+            return nf
+
+        nfs = [
+            mk_nf(c.filter) if c.filter is not None else None
+            for c in gq.children
+        ]
         routes = k_shortest_paths(
             self.cache,
             self.st,
@@ -1534,7 +1678,7 @@ class Executor:
             weight_facets=wfacets,
             min_weight=gq.min_weight,
             max_weight=gq.max_weight,
-            node_filter=nf,
+            node_filters=nfs,
         )
         node = ExecNode(gq=gq, attr="_path_")
         node.dest_uids = _as_uids(routes[0][0]) if routes else EMPTY
@@ -1553,8 +1697,11 @@ class Executor:
             for c in gq.children
         }
         if gq.var_name:
-            # path var holds the uids on the best path (ref shortest.go)
-            self.uid_vars[gq.var_name] = node.dest_uids
+            # path var holds the BEST path's uids in PATH order (ref
+            # shortest.go; TestShortestPathRev + TestTwoShortestPath)
+            best = [int(u) for u in routes[0][0]] if routes else []
+            self.uid_vars[gq.var_name] = np.array(best, dtype=np.uint64)
+            self.ordered_uid_vars.add(gq.var_name)
         return node
 
     def _resolve_endpoint(self, ep) -> Optional[int]:

@@ -194,8 +194,7 @@ def go_time_binary(dt) -> bytes:
             off_min = -1  # UTC marshals as -1
         epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
         delta = dt - epoch
-    unix = int(delta.total_seconds())
-    # delta.total_seconds loses sub-us precision; rebuild exactly
+    # not total_seconds(): float conversion loses sub-us precision
     unix = delta.days * 86400 + delta.seconds
     nsec = delta.microseconds * 1000
     sec = unix + _UNIX_TO_INTERNAL
