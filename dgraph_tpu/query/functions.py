@@ -623,19 +623,34 @@ class FuncRunner:
         return np.unique(np.concatenate(interior)).astype(np.uint64)
 
     def _cmp_ok(self, attr, uid, op, val) -> bool:
-        got = self._value_of(attr, uid)
-        if got is None:
-            return False
-        try:
-            c = compare_vals(convert(got, val.tid), val)
-        except ValueError:
-            return False
-        return (
-            (op == "le" and c <= 0)
-            or (op == "lt" and c < 0)
-            or (op == "ge" and c >= 0)
-            or (op == "gt" and c > 0)
-        )
+        su = self.st.get(attr)
+        if su is not None and su.is_list:
+            # list predicates match when ANY value satisfies the range
+            # (ref TestMultipleValueFilter2: le(graduation, 1933) keeps
+            # the [1935, 1933] node)
+            cands = [
+                p.val()
+                for p in self.cache.values(
+                    keys.DataKey(attr, int(uid), self.ns)
+                )
+                if p.is_value
+            ]
+        else:
+            got = self._value_of(attr, uid)
+            cands = [] if got is None else [got]
+        for got in cands:
+            try:
+                c = compare_vals(convert(got, val.tid), val)
+            except ValueError:
+                continue
+            if (
+                (op == "le" and c <= 0)
+                or (op == "lt" and c < 0)
+                or (op == "ge" and c >= 0)
+                or (op == "gt" and c > 0)
+            ):
+                return True
+        return False
 
     def _between(self, fn: FuncSpec, src) -> np.ndarray:
         lo = FuncSpec(name="ge", attr=fn.attr, args=[fn.args[0]], lang=fn.lang)
@@ -1045,6 +1060,16 @@ def _polys_intersect(ring_a, ring_b) -> bool:
     )
 
 
+def _on_segment(x, y, x1, y1, x2, y2, eps: float = 1e-12) -> bool:
+    """Point (x, y) lies on the segment (x1,y1)-(x2,y2)."""
+    cross = (x2 - x1) * (y - y1) - (y2 - y1) * (x - x1)
+    if abs(cross) > eps:
+        return False
+    return min(x1, x2) - eps <= x <= max(x1, x2) + eps and (
+        min(y1, y2) - eps <= y <= max(y1, y2) + eps
+    )
+
+
 def _point_in_poly(x: float, y: float, ring) -> bool:
     """Ray casting point-in-polygon, boundary-inclusive (ref S2 contains
     semantics: a point on the edge or a vertex counts as inside)."""
@@ -1054,12 +1079,8 @@ def _point_in_poly(x: float, y: float, ring) -> bool:
     for i in range(n):
         xi, yi = float(ring[i][0]), float(ring[i][1])
         xj, yj = float(ring[j][0]), float(ring[j][1])
-        # boundary check: point on segment (i,j)
-        cross = (xj - xi) * (y - yi) - (yj - yi) * (x - xi)
-        if abs(cross) < 1e-12:
-            if min(xi, xj) - 1e-12 <= x <= max(xi, xj) + 1e-12 and \
-                    min(yi, yj) - 1e-12 <= y <= max(yi, yj) + 1e-12:
-                return True
+        if _on_segment(x, y, xi, yi, xj, yj):
+            return True
         if (yi > y) != (yj > y) and x < (xj - xi) * (y - yi) / (yj - yi) + xi:
             inside = not inside
         j = i
@@ -1099,11 +1120,29 @@ def _geom_within(geom: dict, qring) -> bool:
         return False
     if t == "point":
         return _point_in_poly(float(c[0]), float(c[1]), qring)
+    # polygons must be STRICTLY inside: a stored ring identical to the
+    # query ring (vertices on the boundary) is NOT within it, matching
+    # the reference's nested-loop semantics (ref TestWithinPolygon:
+    # Mountain View == the query polygon and is excluded)
     rings = _geo_rings(geom)
     return bool(rings) and all(
         _point_in_poly(float(p[0]), float(p[1]), qring)
+        and not _on_ring(float(p[0]), float(p[1]), qring)
         for ring in rings
         for p in ring
+    )
+
+
+def _on_ring(x: float, y: float, ring) -> bool:
+    """True when (x, y) lies on one of the ring's edges."""
+    n = len(ring)
+    return any(
+        _on_segment(
+            x, y,
+            float(ring[i][0]), float(ring[i][1]),
+            float(ring[(i + 1) % n][0]), float(ring[(i + 1) % n][1]),
+        )
+        for i in range(n)
     )
 
 

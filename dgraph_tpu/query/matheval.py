@@ -14,6 +14,15 @@ class MathError(Exception):
     pass
 
 
+def _both_int(args) -> bool:
+    return (
+        isinstance(args[0], int)
+        and isinstance(args[1], int)
+        and not isinstance(args[0], bool)
+        and not isinstance(args[1], bool)
+    )
+
+
 def eval_math(node: MathNode, env: Dict[str, Any]):
     op = node.op
     if op == "const":
@@ -44,9 +53,23 @@ def eval_math(node: MathNode, env: Dict[str, Any]):
     if op == "/":
         if args[1] == 0:
             raise MathError("division by zero")
+        if _both_int(args):
+            # int / int stays int, truncating toward zero like Go —
+            # exact integer math, no float round-trip (lossy >= 2^53)
+            # (ref TestFloatConverstion: ceil(66/5) == ceil(13) == 13)
+            q = abs(args[0]) // abs(args[1])
+            return -q if (args[0] < 0) != (args[1] < 0) else q
         return args[0] / args[1]
     if op == "%":
-        return args[0] % args[1]
+        if args[1] == 0:
+            raise MathError("division by zero")
+        if _both_int(args):
+            # Go's % truncates: the result takes the dividend's sign
+            r = abs(args[0]) % abs(args[1])
+            return -r if args[0] < 0 else r
+        import math as _math
+
+        return _math.fmod(args[0], args[1])
     if op == "neg":
         return -args[0]
     if op == "min":

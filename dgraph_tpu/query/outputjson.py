@@ -113,6 +113,12 @@ class JsonEncoder:
                         {_display_name(c): None if v is None else _json_val(v)}
                     )
                 continue  # per-parent aggregates emit inside entities
+            elif c.gq.math_expr is not None and not len(node.dest_uids):
+                # aggregate-root math (`me() { Sum: math(a + b) }`) emits
+                # its own row (ref TestAggregateRoot4 "Sum": 53)
+                v = c.math_vals.get(MAXUID)
+                if v is not None:
+                    out.append({_display_name(c): _json_val(v)})
             elif c.gq.is_count and c.gq.attr == "uid":
                 out.append({_display_name(c): int(len(node.dest_uids))})
 
@@ -214,8 +220,11 @@ class JsonEncoder:
                     obj[name] = _json_val(c.math_vals[uid])
                 continue  # scalar aggregates emit at list level
             elif gq.val_var and not gq.aggregator:
+                # display reads the PER-UID map only: a MAXUID-broadcast
+                # count var participates in math but does not print
+                # (ref TestCountUIDToVar2: no val(s) rows)
                 vals = self.val_vars.get(gq.val_var, {})
-                v = vals.get(uid, vals.get(MAXUID))
+                v = vals.get(uid)
                 if v is not None:
                     obj[name] = _json_val(v)
             elif gq.is_count:
@@ -283,12 +292,10 @@ class JsonEncoder:
                 # at all (ref TestCountUIDNested: parents without friends
                 # have no "friend" entry)
                 if n_live:
+                    # var-bound `s as count(uid)` still emits its row
+                    # (ref TestCountUIDToVar2 q block {"count": 5})
                     for cc in c.children:
-                        if (
-                            cc.gq.is_count
-                            and cc.gq.attr == "uid"
-                            and not cc.gq.var_name
-                        ):
+                        if cc.gq.is_count and cc.gq.attr == "uid":
                             kids.append(
                                 {cc.gq.alias or "count": int(n_live)}
                             )
@@ -320,7 +327,12 @@ class JsonEncoder:
                         # (ref outputnode: best_friend {} not [])
                         obj[name] = kids[0]
                     else:
-                        obj[name] = kids
+                        # `friend @groupby(..)` + plain `friend` share one
+                        # output list (ref TestGroupBy_RepeatAttr)
+                        prev = obj.get(name)
+                        obj[name] = (
+                            (prev + kids) if isinstance(prev, list) else kids
+                        )
             elif gq.lang == "*":
                 # name@* fans out one field per language; untagged value
                 # keeps the bare name (ref outputnode langs handling)
@@ -414,7 +426,12 @@ def _normalize_flatten(obj: Dict[str, Any]) -> List[Dict[str, Any]]:
     scalars = {}
     lists: List[tuple[str, List[Dict[str, Any]]]] = []
     for k, v in obj.items():
-        if isinstance(v, list) and v and isinstance(v[0], dict):
+        if "|" in k:
+            # facet payloads ("alt_name|origin": {"0": ...}) are leaf
+            # values, not nested entities — never flattened
+            # (ref TestFacetValuePredicateWithNormalize)
+            scalars[k] = v
+        elif isinstance(v, list) and v and isinstance(v[0], dict):
             lists.append((k, v))
         elif isinstance(v, dict):
             lists.append((k, [v]))

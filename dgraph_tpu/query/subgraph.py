@@ -119,28 +119,63 @@ class Executor:
 
     def process(self, blocks: List[GraphQuery]) -> List[ExecNode]:
         pending = list(blocks)
-        done: List[Tuple[GraphQuery, ExecNode]] = []
         executed: List[ExecNode] = [None] * len(blocks)  # type: ignore
         idx = {id(b): i for i, b in enumerate(blocks)}
-        progress = True
-        while pending and progress:
-            progress = False
-            still = []
+        while pending:
+            progress = True
+            while pending and progress:
+                progress = False
+                still = []
+                for b in pending:
+                    self._check_deadline()
+                    if self._deps_ready(b):
+                        node = self.execute_block(b)
+                        executed[idx[id(b)]] = node
+                        progress = True
+                    else:
+                        still.append(b)
+                pending = still
+            if not pending:
+                break
+            # a var declared in an EXECUTED block but never bound (its
+            # defining predicate matched nothing / isn't in the schema)
+            # resolves to the empty set, like the reference's nil
+            # DestUIDs (ref TestGroupBy_FixPanicForNilDestUIDs). Vars
+            # declared only in still-pending blocks stay unresolved — a
+            # dependency cycle must error, not silently empty out.
+            ran = [b for b in blocks if b not in pending]
+            declared = self._declared_vars(ran)
+            fixable = set()
             for b in pending:
-                self._check_deadline()
-                if self._deps_ready(b):
-                    node = self.execute_block(b)
-                    executed[idx[id(b)]] = node
-                    progress = True
-                else:
-                    still.append(b)
-            pending = still
-        if pending:
-            raise QueryError(
-                f"unresolved query variables in blocks: "
-                f"{[b.attr for b in pending]}"
-            )
+                for d in self._block_deps(b):
+                    if (
+                        d not in self.uid_vars
+                        and d not in self.val_vars
+                        and d in declared
+                    ):
+                        fixable.add(d)
+            if not fixable:
+                raise QueryError(
+                    f"unresolved query variables in blocks: "
+                    f"{[b.attr for b in pending]}"
+                )
+            for d in fixable:
+                self.uid_vars[d] = EMPTY
         return executed
+
+    def _declared_vars(self, blocks: List[GraphQuery]) -> set:
+        out: set = set()
+
+        def walk(g):
+            if g.var_name:
+                out.add(g.var_name)
+            out.update(g.facet_vars.keys())
+            for c in g.children:
+                walk(c)
+
+        for b in blocks:
+            walk(b)
+        return out
 
     def _block_deps(self, gq: GraphQuery) -> set:
         deps = set()
@@ -177,6 +212,8 @@ class Executor:
                 deps.add(g.shortest_from[1])
             if isinstance(g.shortest_to, tuple):
                 deps.add(g.shortest_to[1])
+            if g.expand.startswith("val:"):
+                deps.add(g.expand[4:])
             if g.var_name:
                 defined.add(g.var_name)
             defined.update(g.facet_vars.keys())
@@ -782,9 +819,28 @@ class Executor:
         cnode = ExecNode(gq=cgq, attr="math", src_uids=parent.dest_uids)
         needed = math_vars(cgq.math_expr)
         out: Dict[int, Val] = {}
+        if not len(parent.dest_uids) and needed:
+            # aggregate-root math over block-wide scalar vars
+            # (`me() { Sum: math(minVal + maxVal) }`, ref TestAggregateRoot4)
+            env = {}
+            present = 0
+            for v in needed:
+                val = self.val_vars.get(v, {}).get(MAXUID)
+                if val is None:
+                    env[v] = Val(TypeID.INT, 0)
+                else:
+                    present += 1
+                    env[v] = val
+            if present:
+                try:
+                    out[MAXUID] = to_val(eval_math(cgq.math_expr, env))
+                except (MathError, KeyError, ValueError, OverflowError,
+                        ZeroDivisionError, TypeError):
+                    pass
         for u in parent.dest_uids:
             env = {}
             present = 0
+            bcast = 0
             for v in needed:
                 vmap = self.val_vars.get(v, {})
                 # ancestor-level vars use the PROPAGATED (path-summed)
@@ -794,17 +850,28 @@ class Executor:
                 val = parent.level_vars.get(v, {}).get(int(u))
                 if val is None:
                     val = vmap.get(int(u))
-                if val is None:
-                    val = vmap.get(MAXUID)
-                if val is None:
+                if val is not None:
+                    present += 1
+                    env[v] = val
+                    continue
+                val = vmap.get(MAXUID)
+                if val is not None:
+                    bcast += 1
+                else:
                     # a uid with AT LEAST one bound var evaluates with the
                     # rest defaulting to 0 (ref math.go zero-fill); a uid
                     # with none stays out of the result entirely
-                    env[v] = Val(TypeID.INT, 0)
-                else:
-                    present += 1
-                    env[v] = val
-            ok = present > 0 or not needed
+                    val = Val(TypeID.INT, 0)
+                env[v] = val
+            # eligible when some var binds THIS uid, or when every needed
+            # var is a block-wide broadcast (`score: math(f)` — ref
+            # TestCountUidToVar); a uid missing from a per-uid map stays
+            # out (ref TestCountUIDToVar2: valueless friend, no val(mul))
+            ok = (
+                present > 0
+                or not needed
+                or (bcast == len(needed) and bool(needed))
+            )
             if not ok:
                 continue
             try:
@@ -929,9 +996,16 @@ class Executor:
             for c in cgq.children
             if c.aggregator and c.attr and not c.val_var
         ]
+        # "count" appears only when count(uid) was requested in the
+        # groupby body (ref TestGroupByAgg: max(name) alone emits no count)
+        wants_count = any(
+            c.is_count and c.attr == "uid" for c in cgq.children
+        )
         sizes = {k: len(b["__members__"]) for k, b in buckets.items()}
         for b in buckets.values():
             members = b.pop("__members__")
+            if not wants_count:
+                b.pop("count", None)
             for agg in aggs:
                 vals = []
                 for cu in members:
@@ -985,7 +1059,17 @@ class Executor:
                         ck = c.alias or "count"
                         for k, b in buckets.items():
                             if k[0] is not None and ck in b:
-                                vals[int(k[0])] = Val(TypeID.INT, b[ck])
+                                # counts SUM across parents' groupings
+                                # (ref TestGroupByFriendsMultipleParentsVar)
+                                prev = vals.get(int(k[0]))
+                                base = (
+                                    int(prev.value)
+                                    if prev is not None
+                                    else 0
+                                )
+                                vals[int(k[0])] = Val(
+                                    TypeID.INT, base + b[ck]
+                                )
                     elif c.var_name and c.aggregator and c.attr:
                         # `a as max(name)` in @groupby(uidpred): bind the
                         # per-group aggregate keyed by the group target
@@ -1044,6 +1128,12 @@ class Executor:
                     }
                     present = [u for u in ulist if vals[u] is not None]
                     missing = [u for u in ulist if vals[u] is None]
+                    if any(
+                        isinstance(vals[u].value, bool) for u in present
+                    ):
+                        # bool facets are not sortable — the key is
+                        # skipped entirely (ref NonsortableFacet golden)
+                        continue
                     try:
                         # sorted() on a copy: a TypeError mid-sort must
                         # not leave `present` partially permuted
@@ -1106,6 +1196,14 @@ class Executor:
                         tu = self.st.get_type(str(p.val().value))
                         if tu:
                             preds.extend(tu.fields)
+            elif g.expand.startswith("val:"):
+                # expand(val(x)): predicates named by the var's STRING
+                # values (ref TestExpandVal)
+                vmap = self.val_vars.get(g.expand[4:], {})
+                preds.extend(
+                    str(v.value) for v in vmap.values()
+                    if isinstance(v.value, str)
+                )
             else:
                 for tname in g.expand.split(","):  # expand(Type1, Type2)
                     tu = self.st.get_type(tname)
@@ -1152,7 +1250,13 @@ class Executor:
         — ALL uid predicates continue, not just the first). A shared seen
         set (loop: false) prunes revisits across the whole traversal."""
         depth = node.gq.recurse_depth or 5
-        preds = [c for c in node.gq.children if not (c.is_uid or c.val_var)]
+        # bare `uid` rides along (emitted at every level); `a as uid`
+        # only binds the visited set (handled below)
+        preds = [
+            c
+            for c in node.gq.children
+            if not (c.val_var or (c.is_uid and c.var_name))
+        ]
         seen = [node.dest_uids.copy()]  # single-element holder (shared state)
         self._recurse_level(node, preds, seen, depth, node.gq.recurse_loop)
         # `a as uid` under @recurse binds every VISITED node (root + all
@@ -1191,6 +1295,16 @@ class Executor:
             else {int(x) for x in frontier}
         )
         for cgq in preds:
+            if cgq.is_uid:
+                # bare `uid` emits at every recursion level
+                # (ref TestRecurseQueryLimitDepth2 golden)
+                frontier_node.children.append(
+                    ExecNode(
+                        gq=cgq, attr="uid",
+                        src_uids=frontier_node.dest_uids,
+                    )
+                )
+                continue
             c2 = GraphQuery(
                 attr=cgq.attr,
                 alias=cgq.alias,
@@ -1199,6 +1313,13 @@ class Executor:
                 first=cgq.first,
                 offset=cgq.offset,
                 var_name=cgq.var_name,
+                facets=cgq.facets,
+                facet_names=list(cgq.facet_names),
+                facet_aliases=dict(cgq.facet_aliases),
+                facet_orders=list(cgq.facet_orders),
+                facet_order=cgq.facet_order,
+                facet_order_desc=cgq.facet_order_desc,
+                facet_filter=cgq.facet_filter,
             )
             prev_vals = (
                 dict(self.val_vars.get(cgq.var_name, {}))
@@ -1479,8 +1600,16 @@ class Executor:
                 sub.order = [Order(attr=o.attr, desc=o.desc, lang=o.lang)]
                 sel = self._order_uids_generic(sub, sel)
             out.extend(int(u) for u in sel)
-        # uids with no indexed value are DROPPED: sorted queries exclude
-        # nodes missing the sort predicate (ref worker/sort.go semantics)
+        # uids with no indexed value sort AFTER every valued one, uid
+        # order matching the key's direction — same tail the generic
+        # comparator produces (ref TestNegativeOffset)
+        if need is None or len(out) < need:
+            out.extend(
+                sorted(
+                    (int(u) for u in uids if int(u) not in emitted),
+                    reverse=o.desc,
+                )
+            )
         return np.array(out, dtype=np.uint64)
 
     def _order_uids_topk(

@@ -42,10 +42,10 @@ def test_orderasc_int_index_walk(server):
     out = server.query('{ q(func: has(name), orderasc: age) { name age } }')
     ages = _ages(out)
     assert ages == sorted(ages) and len(ages) == 60
-    # sorted queries EXCLUDE nodes missing the sort value (ref
-    # worker/sort.go semantics; golden TestQueryVarValAggMinMax)
-    assert all(o["name"] != "ageless" for o in out["data"]["q"])
-    assert len(out["data"]["q"]) == 60
+    # nodes missing the sort value sort AFTER every valued one (golden
+    # TestNegativeOffset pins keep-missing-last for predicate sorts)
+    assert out["data"]["q"][-1]["name"] == "ageless"
+    assert len(out["data"]["q"]) == 61
 
 
 def test_orderdesc_with_first_early_stop(server):
