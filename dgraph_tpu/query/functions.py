@@ -1070,9 +1070,8 @@ def _on_segment(x, y, x1, y1, x2, y2, eps: float = 1e-12) -> bool:
     )
 
 
-def _point_in_poly(x: float, y: float, ring) -> bool:
-    """Ray casting point-in-polygon, boundary-inclusive (ref S2 contains
-    semantics: a point on the edge or a vertex counts as inside)."""
+def _poly_side(x: float, y: float, ring) -> str:
+    """Ray-cast classification: 'in', 'edge', or 'out'."""
     n = len(ring)
     j = n - 1
     inside = False
@@ -1080,11 +1079,17 @@ def _point_in_poly(x: float, y: float, ring) -> bool:
         xi, yi = float(ring[i][0]), float(ring[i][1])
         xj, yj = float(ring[j][0]), float(ring[j][1])
         if _on_segment(x, y, xi, yi, xj, yj):
-            return True
+            return "edge"
         if (yi > y) != (yj > y) and x < (xj - xi) * (y - yi) / (yj - yi) + xi:
             inside = not inside
         j = i
-    return inside
+    return "in" if inside else "out"
+
+
+def _point_in_poly(x: float, y: float, ring) -> bool:
+    """Boundary-inclusive point-in-polygon (ref S2 contains semantics:
+    a point on the edge or a vertex counts as inside)."""
+    return _poly_side(x, y, ring) != "out"
 
 
 def _geo_distance_m(geom: dict, lon: float, lat: float) -> Optional[float]:
@@ -1126,23 +1131,9 @@ def _geom_within(geom: dict, qring) -> bool:
     # Mountain View == the query polygon and is excluded)
     rings = _geo_rings(geom)
     return bool(rings) and all(
-        _point_in_poly(float(p[0]), float(p[1]), qring)
-        and not _on_ring(float(p[0]), float(p[1]), qring)
+        _poly_side(float(p[0]), float(p[1]), qring) == "in"
         for ring in rings
         for p in ring
-    )
-
-
-def _on_ring(x: float, y: float, ring) -> bool:
-    """True when (x, y) lies on one of the ring's edges."""
-    n = len(ring)
-    return any(
-        _on_segment(
-            x, y,
-            float(ring[i][0]), float(ring[i][1]),
-            float(ring[(i + 1) % n][0]), float(ring[(i + 1) % n][1]),
-        )
-        for i in range(n)
     )
 
 

@@ -228,6 +228,12 @@ class RaftNode:
             self._persist_append(e)
             self._persist_flush()
             self.match_index[self.id] = self.last_index()
+            if self._voting_size() == 1:
+                # a single-voter group commits on its own match alone —
+                # there are no append responses to drive _advance_commit
+                # (multi-voter groups advance on responses; scanning the
+                # uncommitted backlog per propose would be O(n^2) there)
+                self._advance_commit()
             return True
 
     def is_leader(self) -> bool:

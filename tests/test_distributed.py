@@ -144,3 +144,18 @@ def test_zero_state(cluster):
     st = cluster.zero.state()
     assert len(st["members"]) == 6
     assert st["maxTxnTs"] >= 0
+
+
+def test_single_replica_groups_commit():
+    """replicas=1: a one-voter raft group commits on its own match alone
+    (no append responses ever arrive to advance the commit index)."""
+    from dgraph_tpu.worker.facade import ClusterFacade
+    from dgraph_tpu.worker.groups import DistributedCluster
+
+    c = DistributedCluster(n_groups=2, replicas=1)
+    f = ClusterFacade(c)
+    c.alter("name: string @index(exact) .")
+    t = f.new_txn()
+    t.mutate_rdf(set_rdf='<0x1> <name> "solo" .', commit_now=True)
+    got = f.query('{ q(func: eq(name, "solo")) { name } }')["data"]
+    assert got == {"q": [{"name": "solo"}]}
