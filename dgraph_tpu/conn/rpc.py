@@ -3,11 +3,15 @@
 The reference maintains one gRPC ClientConn per peer inside a Pool with
 health checks (conn/pool.go:52 Pool, :233 MonitorHealth, :292
 IsHealthy). This is the socket equivalent for dgraph-tpu's cross-process
-cluster: length-prefixed JSON frames (bytes base64-tagged, reusing the
-raft transport's codec), persistent pooled connections with reconnect,
-periodic heartbeat pings, and per-peer health state.
+cluster: length-prefixed frames (conn/frame.py codec), persistent
+pooled connections with reconnect, periodic heartbeat pings, and
+per-peer health state.
 
-Framing: 4-byte big-endian length + JSON body
+Framing: 4-byte big-endian length + body, where body is either plain
+JSON or conn/frame.py's binary multipart (JSON header + raw blobs,
+zlib-compressed — the snappy-stream analog, ref conn/snappy.go): bulk
+payloads (raft snapshots, predicate-move streams, pack transfer) ride
+as raw bytes instead of base64.
   request:  {"id": n, "m": method, "a": args}
   response: {"id": n, "r": result} | {"id": n, "e": error_string}
 
@@ -16,7 +20,6 @@ JSON (not pickle) on purpose: the wire should never execute code.
 
 from __future__ import annotations
 
-import json
 import socket
 import socketserver
 import struct
@@ -24,7 +27,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from dgraph_tpu.raft.tcp import _jsonize, _unjsonize
+from dgraph_tpu.conn.frame import pack_body, unpack_body
 
 _LEN = struct.Struct(">I")
 
@@ -34,7 +37,7 @@ class RpcError(RuntimeError):
 
 
 def _send_frame(sock: socket.socket, obj: dict):
-    body = json.dumps(obj).encode()
+    body = pack_body(obj)
     sock.sendall(_LEN.pack(len(body)) + body)
 
 
@@ -46,7 +49,7 @@ def _recv_frame(rfile) -> Optional[dict]:
     body = rfile.read(n)
     if len(body) < n:
         return None
-    return json.loads(body)
+    return unpack_body(body)
 
 
 class RpcServer:
@@ -62,7 +65,7 @@ class RpcServer:
                 while True:
                     try:
                         req = _recv_frame(self.rfile)
-                    except (OSError, json.JSONDecodeError):
+                    except (OSError, ValueError, struct.error):
                         return
                     if req is None:
                         return
@@ -71,8 +74,8 @@ class RpcServer:
                     try:
                         if fn is None:
                             raise RpcError(f"no such method {req.get('m')!r}")
-                        result = fn(_unjsonize(req.get("a") or {}))
-                        resp = {"id": rid, "r": _jsonize(result)}
+                        result = fn(req.get("a") or {})
+                        resp = {"id": rid, "r": result}
                     except Exception as e:  # surface to caller, keep serving
                         resp = {"id": rid, "e": f"{type(e).__name__}: {e}"}
                     try:
@@ -134,14 +137,14 @@ class RpcClient:
                         self._sock.settimeout(timeout)
                     _send_frame(
                         self._sock,
-                        {"id": rid, "m": method, "a": _jsonize(args or {})},
+                        {"id": rid, "m": method, "a": args or {}},
                     )
                     resp = _recv_frame(self._rfile)
                     if resp is None:
                         raise OSError("connection closed")
                     if resp.get("e"):
                         raise RpcError(resp["e"])
-                    return _unjsonize(resp.get("r"))
+                    return resp.get("r")
                 except (OSError, socket.timeout) as e:
                     last_err = e
                     self.close_conn()

@@ -3,42 +3,26 @@
 The reference's conn/node.go batches raft messages onto long-lived gRPC
 streams between peers (BatchAndSendMessages:338, streamMessages:398). This
 is the socket equivalent for dgraph-tpu: one listener per node, persistent
-outbound connections per peer with automatic reconnect, newline-delimited
-JSON frames (snappy/proto framing is a drop-in upgrade later). Implements
-the same send/drain interface as InProcNetwork, so RaftNode is unchanged.
+outbound connections per peer with automatic reconnect, length-prefixed
+frames via conn/frame.py — small control messages stay JSON; bulk
+payloads (snapshot installs, big append batches) ride as raw
+zlib-compressed blobs (the snappy framing of conn/snappy.go +
+worker/snapshot.go:177). Implements the same send/drain interface as
+InProcNetwork, so RaftNode is unchanged.
 """
 
 from __future__ import annotations
 
-import base64
-import json
 import socket
 import socketserver
+import struct
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from dgraph_tpu.conn.frame import pack_body, unpack_body
 from dgraph_tpu.raft.raft import Message
 
-
-def _jsonize(obj):
-    """Payloads carry bytes (delta keys/records); JSON needs b64 tagging."""
-    if isinstance(obj, bytes):
-        return {"__b64__": base64.b64encode(obj).decode()}
-    if isinstance(obj, (list, tuple)):
-        return [_jsonize(x) for x in obj]
-    if isinstance(obj, dict):
-        return {k: _jsonize(v) for k, v in obj.items()}
-    return obj
-
-
-def _unjsonize(obj):
-    if isinstance(obj, dict):
-        if set(obj.keys()) == {"__b64__"}:
-            return base64.b64decode(obj["__b64__"])
-        return {k: _unjsonize(v) for k, v in obj.items()}
-    if isinstance(obj, list):
-        return [_unjsonize(x) for x in obj]
-    return obj
+_LEN = struct.Struct(">I")
 
 
 class TcpNetwork:
@@ -67,14 +51,21 @@ class TcpNetwork:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                for line in self.rfile:
+                while True:
+                    hdr = self.rfile.read(_LEN.size)
+                    if len(hdr) < _LEN.size:
+                        return
+                    (n,) = _LEN.unpack(hdr)
+                    body = self.rfile.read(n)
+                    if len(body) < n:
+                        return
                     try:
-                        d = json.loads(line)
+                        d = unpack_body(body)
                         msg = Message(
                             kind=d["k"], frm=d["f"], to=d["t"],
-                            term=d["m"], payload=_unjsonize(d["p"]),
+                            term=d["m"], payload=d["p"],
                         )
-                    except (json.JSONDecodeError, KeyError):
+                    except (ValueError, KeyError, TypeError):
                         continue
                     with net.lock:
                         if msg.to in net.inboxes:
@@ -114,13 +105,11 @@ class TcpNetwork:
                 self.inboxes[msg.to].append(msg)
             return
         try:
-            frame = (
-                json.dumps(
-                    {"k": msg.kind, "f": msg.frm, "t": msg.to,
-                     "m": msg.term, "p": _jsonize(msg.payload)}
-                )
-                + "\n"
-            ).encode()
+            body = pack_body(
+                {"k": msg.kind, "f": msg.frm, "t": msg.to,
+                 "m": msg.term, "p": msg.payload}
+            )
+            frame = _LEN.pack(len(body)) + body
         except (TypeError, ValueError):
             # an unserializable payload must never kill the tick thread —
             # but a silent drop would retry forever, so log once per type
