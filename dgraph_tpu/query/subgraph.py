@@ -143,7 +143,8 @@ class Executor:
             # DestUIDs (ref TestGroupBy_FixPanicForNilDestUIDs). Vars
             # declared only in still-pending blocks stay unresolved — a
             # dependency cycle must error, not silently empty out.
-            ran = [b for b in blocks if b not in pending]
+            pending_ids = {id(b) for b in pending}
+            ran = [b for b in blocks if id(b) not in pending_ids]
             declared = self._declared_vars(ran)
             fixable = set()
             for b in pending:

@@ -189,9 +189,28 @@ def go_time_binary(dt) -> bytes:
         delta = dt - epoch
     else:
         off = dt.utcoffset() or _dt.timedelta(0)
-        off_min = int(off.total_seconds() // 60)
+        off_sec = off.days * 86400 + off.seconds
+        if off_sec % 60 or off.microseconds:
+            # Go's MarshalBinary errors on fractional-minute offsets
+            # ("zone offset has fractional minute"); flooring silently
+            # would desync posting uids from the reference
+            raise ValueError(
+                f"zone offset {off_sec}s has fractional minute"
+            )
+        off_min = off_sec // 60
+        # Only the UTC location itself marshals as -1; Go writes 0 for
+        # a non-UTC zone at zero offset (e.g. FixedZone("GMT", 0)).
+        # Go's LoadLocation("UTC") IS time.UTC, so any zone *named* UTC
+        # counts (covers ZoneInfo("UTC")/pytz.utc, not just the
+        # stdlib timezone.utc singleton). RFC3339 "+00:00" parses to
+        # the UTC singleton in both languages, so that stays aligned.
         if off_min == 0:
-            off_min = -1  # UTC marshals as -1
+            try:
+                name = dt.tzname()
+            except NotImplementedError:
+                name = None
+            if dt.tzinfo is _dt.timezone.utc or name == "UTC":
+                off_min = -1
         epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
         delta = dt - epoch
     # not total_seconds(): float conversion loses sub-us precision

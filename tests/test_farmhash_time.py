@@ -1,0 +1,75 @@
+"""go_time_binary edge cases (ADVICE r3: UTC vs zero-offset-non-UTC,
+fractional-minute offsets). Mirrors Go time.Time.MarshalBinary v1."""
+
+import datetime as dt
+import struct
+
+import pytest
+
+from dgraph_tpu.utils.farmhash import go_time_binary
+
+
+def _off_min(b: bytes) -> int:
+    return struct.unpack(">h", b[-2:])[0]
+
+
+def test_utc_marshals_minus_one():
+    t = dt.datetime(2020, 5, 1, 12, 0, 0, tzinfo=dt.timezone.utc)
+    assert _off_min(go_time_binary(t)) == -1
+
+
+def test_plus_zero_offset_is_utc_singleton():
+    # RFC3339 "+00:00" parses to the UTC singleton in python like Go
+    t = dt.datetime.fromisoformat("2020-05-01T12:00:00+00:00")
+    assert t.tzinfo is dt.timezone.utc
+    assert _off_min(go_time_binary(t)) == -1
+
+
+def test_non_utc_zero_offset_zone_writes_zero():
+    class ZeroZone(dt.tzinfo):
+        def utcoffset(self, _):
+            return dt.timedelta(0)
+
+        def dst(self, _):
+            return dt.timedelta(0)
+
+    t = dt.datetime(2020, 5, 1, 12, 0, 0, tzinfo=ZeroZone())
+    assert _off_min(go_time_binary(t)) == 0
+
+
+def test_positive_offset_minutes():
+    t = dt.datetime(
+        2020, 5, 1, 12, 0, 0, tzinfo=dt.timezone(dt.timedelta(hours=5, minutes=30))
+    )
+    assert _off_min(go_time_binary(t)) == 330
+
+
+def test_fractional_minute_offset_raises():
+    tz = dt.timezone(dt.timedelta(seconds=90))
+    t = dt.datetime(2020, 5, 1, tzinfo=tz)
+    with pytest.raises(ValueError):
+        go_time_binary(t)
+
+
+def test_zoneinfo_utc_marshals_minus_one():
+    from zoneinfo import ZoneInfo
+
+    t = dt.datetime(2020, 5, 1, 12, tzinfo=ZoneInfo("UTC"))
+    assert _off_min(go_time_binary(t)) == -1
+
+
+def test_named_gmt_zero_offset_writes_zero():
+    t = dt.datetime(2020, 5, 1, tzinfo=dt.timezone(dt.timedelta(0), "GMT"))
+    assert _off_min(go_time_binary(t)) == 0
+
+
+def test_subsecond_offset_raises():
+    class SubSec(dt.tzinfo):
+        def utcoffset(self, _):
+            return dt.timedelta(microseconds=500000)
+
+        def dst(self, _):
+            return dt.timedelta(0)
+
+    with pytest.raises(ValueError):
+        go_time_binary(dt.datetime(2020, 5, 1, tzinfo=SubSec()))

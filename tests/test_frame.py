@@ -83,3 +83,157 @@ def test_rpc_roundtrip_with_bulk_payload():
         c.close_conn()
     finally:
         srv.close()
+
+
+def test_sentinel_collision_dicts_roundtrip():
+    # user payloads that look exactly like codec sentinels must survive
+    big = b"x" * 1000
+    obj = {
+        "a": {"__blob__": 3},
+        "b": {"__b64__": "not base64!"},
+        "c": {"__esc__": {"__blob__": 0}},
+        "d": {"__blob__": big},  # value itself is blob-sized bytes
+        "e": big,  # a real blob alongside, indices must not collide
+    }
+    got = unpack_body(pack_body(obj))
+    assert got == obj
+
+
+def test_sentinel_collision_without_blobs_stays_consistent():
+    obj = {"only": {"__b64__": 42}}
+    assert unpack_body(pack_body(obj)) == obj
+
+
+def test_decompression_bomb_rejected():
+    import struct
+    import zlib
+
+    from dgraph_tpu.conn.frame import FrameError
+
+    # hand-build a frame whose blob declares 100 bytes but inflates to 10MB
+    bomb = zlib.compress(b"\x00" * (10 << 20), 1)
+    payload = struct.pack(">I", 100) + bomb
+    jb = json.dumps({"d": {"__blob__": 0}}).encode()
+    body = (
+        bytes([MAGIC])
+        + struct.pack(">I", len(jb))
+        + jb
+        + struct.pack(">I", len(payload))
+        + b"\x02"
+        + payload
+    )
+    with pytest.raises(FrameError):
+        unpack_body(body)
+
+
+def test_compressed_roundtrip_with_rawlen_header(monkeypatch):
+    from dgraph_tpu.conn import frame
+
+    monkeypatch.setattr(frame, "_COMPRESS", True)
+    big = b"pattern!" * 100_000
+    body = pack_body({"d": big, "meta": {"__blob__": "user-key"}})
+    got = unpack_body(body)
+    assert got == {"d": big, "meta": {"__blob__": "user-key"}}
+
+
+def test_declared_huge_rawlen_rejected():
+    import struct
+    import zlib
+
+    from dgraph_tpu.conn import frame
+    from dgraph_tpu.conn.frame import FrameError
+
+    # blob declares 1GB (over the 256MB cap) — rejected before inflating
+    comp = zlib.compress(b"\x00" * 1024, 1)
+    payload = struct.pack(">I", 1 << 30) + comp
+    jb = json.dumps({"d": {"__blob__": 0}}).encode()
+    body = (
+        bytes([MAGIC])
+        + struct.pack(">I", len(jb))
+        + jb
+        + struct.pack(">I", len(payload))
+        + b"\x02"
+        + payload
+    )
+    with pytest.raises(FrameError):
+        unpack_body(body)
+    assert frame._MAX_INFLATE == 256 << 20
+
+
+def test_truncated_zlib_trailer_rejected():
+    import struct
+    import zlib
+
+    from dgraph_tpu.conn.frame import FrameError
+
+    raw = b"checksum-me" * 100
+    comp = zlib.compress(raw, 1)[:-4]  # cut the adler32 trailer
+    payload = struct.pack(">I", len(raw)) + comp
+    jb = json.dumps({"d": {"__blob__": 0}}).encode()
+    body = (
+        bytes([MAGIC])
+        + struct.pack(">I", len(jb))
+        + jb
+        + struct.pack(">I", len(payload))
+        + b"\x02"
+        + payload
+    )
+    with pytest.raises(FrameError):
+        unpack_body(body)
+
+
+def test_malformed_esc_payload_raises_frameerror():
+    from dgraph_tpu.conn.frame import FrameError
+
+    with pytest.raises(FrameError):
+        unpack_body(json.dumps({"x": {"__esc__": 5}}).encode())
+
+
+def test_aggregate_inflation_budget_enforced():
+    import struct
+    import zlib
+
+    from dgraph_tpu.conn import frame
+    from dgraph_tpu.conn.frame import FrameError
+
+    # three blobs each declaring 100MB (each under the 256MB cap, but
+    # 300MB aggregate) — the frame budget must reject the third
+    comp = zlib.compress(b"\x00" * (100 << 20), 1)
+    payload = struct.pack(">I", 100 << 20) + comp
+    jb = json.dumps({"d": [{"__blob__": i} for i in range(3)]}).encode()
+    body = bytes([MAGIC]) + struct.pack(">I", len(jb)) + jb
+    for _ in range(3):
+        body += struct.pack(">I", len(payload)) + b"\x02" + payload
+    with pytest.raises(FrameError):
+        unpack_body(body)
+
+
+def test_legacy_flag1_blob_still_decodes():
+    import struct
+    import zlib
+
+    raw = b"legacy-data" * 1000
+    comp = zlib.compress(raw, 1)
+    jb = json.dumps({"d": {"__blob__": 0}}).encode()
+    body = (
+        bytes([MAGIC])
+        + struct.pack(">I", len(jb))
+        + jb
+        + struct.pack(">I", len(comp))
+        + b"\x01"
+        + comp
+    )
+    assert unpack_body(body) == {"d": raw}
+
+
+def test_bad_blob_ref_types_raise_frameerror():
+    from dgraph_tpu.conn.frame import FrameError
+
+    for payload in (
+        {"x": {"__blob__": "0"}},  # string index
+        {"x": {"__blob__": 0}},  # dangling (no blobs in plain JSON)
+        {"x": {"__blob__": True}},  # bool index
+        {"x": {"__b64__": 7}},  # non-string b64
+    ):
+        with pytest.raises(FrameError):
+            unpack_body(json.dumps(payload).encode())
