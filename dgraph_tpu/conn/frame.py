@@ -161,6 +161,9 @@ def _check_stream_end(d, raw_len) -> None:
         raise FrameError(
             f"compressed blob inflates past declared {raw_len} bytes"
         )
+    if d.unused_data:
+        # bytes after the stream's end marker: junk or a covert channel
+        raise FrameError("trailing bytes after compressed stream")
     if not d.eof:
         # stream truncated before its adler32 trailer: the checksum was
         # never verified, so the bytes cannot be trusted

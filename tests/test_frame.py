@@ -237,3 +237,25 @@ def test_bad_blob_ref_types_raise_frameerror():
     ):
         with pytest.raises(FrameError):
             unpack_body(json.dumps(payload).encode())
+
+
+def test_trailing_bytes_after_stream_rejected():
+    import struct
+    import zlib
+
+    from dgraph_tpu.conn.frame import FrameError
+
+    raw = b"payload" * 100
+    comp = zlib.compress(raw, 1) + b"JUNKJUNK"
+    payload = struct.pack(">I", len(raw)) + comp
+    jb = json.dumps({"d": {"__blob__": 0}}).encode()
+    body = (
+        bytes([MAGIC])
+        + struct.pack(">I", len(jb))
+        + jb
+        + struct.pack(">I", len(payload))
+        + b"\x02"
+        + payload
+    )
+    with pytest.raises(FrameError):
+        unpack_body(body)
