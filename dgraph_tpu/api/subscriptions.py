@@ -78,9 +78,18 @@ class Subscriptions:
         preds = set()
 
         def walk(t, sels):
-            preds.update(f"{t.name}.{f}" for f in t.fields)
+            # owner-qualified: inherited fields live under the
+            # interface's predicate (Character.name, not Human.name)
+            preds.update(t.pred(f) for f in t.fields)
             preds.add("dgraph.type")
             for s in sels:
+                if s.name == "...":
+                    ft = (
+                        t if not s.frag_on else gql.types.get(s.frag_on)
+                    )
+                    if ft is not None:
+                        walk(ft, s.selections)
+                    continue
                 f = t.fields.get(s.name)
                 if f is not None and not f.is_scalar:
                     ct = gql.types.get(f.type_name)

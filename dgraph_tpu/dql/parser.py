@@ -151,6 +151,9 @@ class GraphQuery:
     expand: str = ""  # expand(_all_) / expand(TypeName)
     # directives
     cascade: bool = False
+    # @cascade(pred1, pred2): only these preds are required; empty =
+    # all queried fields (ref dql/parser.go parseCascade)
+    cascade_fields: list = field(default_factory=list)
     recurse: bool = False
     recurse_depth: int = 0
     recurse_loop: bool = False
@@ -627,6 +630,12 @@ def _parse_directives(p: _P, gq: GraphQuery):
             gq.filter = parse_filter(p)
         elif d == "cascade":
             gq.cascade = True
+            if p.accept("("):
+                while p.peek().text != ")":
+                    tok = p.next().text
+                    if tok != ",":
+                        gq.cascade_fields.append(tok)
+                p.expect(")")
         elif d == "normalize":
             gq.normalize = True
         elif d == "ignorereflex":
@@ -901,7 +910,7 @@ def parse_query_block(p: _P) -> GraphQuery:
     return gq
 
 
-_VAR_TYPES = ("string", "int", "float", "bool", "uid", "default")
+_VAR_TYPES = ("string", "int", "float", "bool", "uid", "default", "float32vector")
 
 
 def _coerce_var(value, type_name: str):

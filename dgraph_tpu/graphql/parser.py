@@ -48,6 +48,13 @@ class Selection:
     alias: str = ""
     args: Dict[str, Any] = field(default_factory=dict)
     selections: List["Selection"] = field(default_factory=list)
+    # inline fragment: name == "..." and frag_on holds the type
+    # condition; its selections apply only to nodes of that type
+    frag_on: str = ""
+    # field directives other than @skip/@include (those are evaluated
+    # at parse time since variables are already substituted): e.g.
+    # ("cascade", {"fields": [...]})
+    directives: List = field(default_factory=list)
 
     @property
     def key(self) -> str:
@@ -149,22 +156,89 @@ def _parse_args(p: _P, variables):
     return args
 
 
-def _parse_selection_set(p: _P, variables) -> List[Selection]:
+def _parse_directives(p: _P, variables):
+    """Returns (keep, directives): @skip/@include evaluate immediately
+    (variables are already substituted); the rest are returned."""
+    keep = True
+    out = []
+    while p.accept("@"):
+        dname = p.next()[1]
+        dargs = _parse_args(p, variables)
+        if dname == "skip":
+            keep = keep and not dargs.get("if", False)
+        elif dname == "include":
+            keep = keep and bool(dargs.get("if", True))
+        else:
+            out.append((dname, dargs))
+    return keep, out
+
+
+def _parse_selection_set(p: _P, variables, fragments) -> List[Selection]:
     p.expect("{")
     out = []
     while not p.accept("}"):
+        if p.accept("..."):
+            nxt = p.peek()[1]
+            if nxt == "on" or nxt == "{" or nxt == "@":
+                # inline fragment; a missing type condition ('... { x }'
+                # / '... @include(...) { x }') means "same type"
+                cond = ""
+                if nxt == "on":
+                    p.next()
+                    cond = p.next()[1]
+                keep, dirs = _parse_directives(p, variables)
+                sels = _parse_selection_set(p, variables, fragments)
+                if keep:
+                    sel = Selection(name="...", frag_on=cond)
+                    sel.selections = sels
+                    sel.directives = dirs
+                    out.append(sel)
+            else:  # named fragment spread — expanded after definitions
+                fname = p.next()[1]
+                keep, dirs = _parse_directives(p, variables)
+                if keep:
+                    sel = Selection(name="...", frag_on="")
+                    sel.alias = f"__spread_{fname}"
+                    sel.directives = dirs
+                    out.append(sel)
+            continue
         name = p.next()[1]
         sel = Selection(name=name)
         if p.accept(":"):
             sel.alias = name
             sel.name = p.next()[1]
         sel.args = _parse_args(p, variables)
-        while p.accept("@"):  # skip field directives
-            p.next()
-            _parse_args(p, variables)
+        keep, sel.directives = _parse_directives(p, variables)
         if p.peek()[1] == "{":
-            sel.selections = _parse_selection_set(p, variables)
-        out.append(sel)
+            sel.selections = _parse_selection_set(p, variables, fragments)
+        if keep:
+            out.append(sel)
+    return out
+
+
+def _expand_spreads(
+    sels: List[Selection], fragments, _stack=()
+) -> List[Selection]:
+    out = []
+    for s in sels:
+        if s.name == "..." and s.alias.startswith("__spread_"):
+            fname = s.alias[len("__spread_") :]
+            if fname in _stack:
+                # the GraphQL spec rejects fragment cycles outright
+                raise GqlParseError(f"fragment cycle through {fname!r}")
+            frag = fragments.get(fname)
+            if frag is None:
+                raise GqlParseError(f"undefined fragment {fname!r}")
+            cond, fsels = frag
+            inline = Selection(name="...", frag_on=cond)
+            inline.directives = s.directives
+            inline.selections = _expand_spreads(
+                fsels, fragments, _stack + (fname,)
+            )
+            out.append(inline)
+        else:
+            s.selections = _expand_spreads(s.selections, fragments, _stack)
+            out.append(s)
     return out
 
 
@@ -172,9 +246,31 @@ def parse_operation(
     text: str, variables: Optional[Dict[str, Any]] = None
 ) -> Operation:
     variables = dict(variables or {})
-    p = _P(_tokenize(text))
+    toks = _tokenize(text)
+    p = _P(toks)
     kind = "query"
     name = ""
+    fragments: Dict[str, tuple] = {}
+    # Fragment definitions may precede the operation, but their bodies
+    # can reference operation variables (incl. defaults declared in the
+    # operation prologue) — so skip their token spans now and parse
+    # them AFTER the variable definitions are known.
+    leading: list = []  # (header_index,) spans to revisit
+    while p.peek()[1] == "fragment":
+        start = p.i
+        p.next()
+        p.next()  # name
+        p.expect("on")
+        p.next()  # type condition
+        p.expect("{")
+        depth = 1
+        while depth:
+            tkn = p.next()[1]
+            if tkn == "{":
+                depth += 1
+            elif tkn == "}":
+                depth -= 1
+        leading.append(start)
     t = p.peek()
     if t[1] in ("query", "mutation"):
         kind = p.next()[1]
@@ -195,8 +291,28 @@ def parse_operation(
                 if vname not in variables:
                     variables[vname] = None
             p.expect(")")
+    # now parse the leading fragments with full variable knowledge
+    for start in leading:
+        fp = _P(toks)
+        fp.i = start
+        fp.next()
+        fname = fp.next()[1]
+        fp.expect("on")
+        cond = fp.next()[1]
+        fragments[fname] = (
+            cond,
+            _parse_selection_set(fp, variables, fragments),
+        )
     op = Operation(kind=kind, name=name)
-    op.selections = _parse_selection_set(p, variables)
+    op.selections = _parse_selection_set(p, variables, fragments)
+    # fragment definitions may follow the operation
+    while p.peek()[1] == "fragment":
+        p.next()
+        fname = p.next()[1]
+        p.expect("on")
+        cond = p.next()[1]
+        fragments[fname] = (cond, _parse_selection_set(p, variables, fragments))
     if p.peek()[0] != "eof":
         raise GqlParseError(f"trailing input at {p.peek()[2]}")
+    op.selections = _expand_spreads(op.selections, fragments)
     return op

@@ -41,7 +41,67 @@ _FILTER_OPS = {
     "alloftext": "alloftext",
     "regexp": "regexp",
     "near": "near",
+    "within": "within",
+    "contains": "contains",
+    "intersects": "intersects",
 }
+
+
+def _gql_polygon_coords(p: dict) -> list:
+    """GraphQL PolygonRef {coordinates: [{points: [{latitude,longitude}]}]}
+    -> geojson-style [[[lon,lat], ...], ...] ring list."""
+    return [
+        [[pt["longitude"], pt["latitude"]] for pt in ring["points"]]
+        for ring in p.get("coordinates", [])
+    ]
+
+
+def _gql_geo_to_geojson(v: dict) -> dict:
+    if "longitude" in v:
+        return {
+            "type": "Point",
+            "coordinates": [v["longitude"], v["latitude"]],
+        }
+    if "polygons" in v:
+        return {
+            "type": "MultiPolygon",
+            "coordinates": [
+                _gql_polygon_coords(p) for p in v["polygons"]
+            ],
+        }
+    if "coordinates" in v:
+        return {"type": "Polygon", "coordinates": _gql_polygon_coords(v)}
+    return v
+
+
+def _geojson_to_gql(g):
+    """Stored geojson -> the GraphQL Point/Polygon/MultiPolygon shape
+    (ref graphql/resolve completeGeoObject)."""
+    if not isinstance(g, dict):
+        return g
+    t = g.get("type")
+    c = g.get("coordinates")
+    if t == "Point":
+        return {"longitude": c[0], "latitude": c[1]}
+    if t == "Polygon":
+        return {
+            "coordinates": [
+                {
+                    "points": [
+                        {"longitude": p[0], "latitude": p[1]} for p in ring
+                    ]
+                }
+                for ring in c
+            ]
+        }
+    if t == "MultiPolygon":
+        return {
+            "polygons": [
+                _geojson_to_gql({"type": "Polygon", "coordinates": pc})
+                for pc in c
+            ]
+        }
+    return g
 
 
 class GraphQLError(Exception):
@@ -164,16 +224,93 @@ class GraphQLServer:
             return self._aggregate(t, sel)
         raise GraphQLError(f"unknown query {name!r}")
 
-    @staticmethod
-    def _add_typename(results, t: GqlType, sels: List[Selection]):
-        """Inject __typename literals the encoder doesn't know about."""
-        keys_ = [s.key for s in sels if s.name == "__typename"]
-        if not keys_:
-            return results
+    def _concrete(self, row_types, fallback: str) -> str:
+        """The concrete (non-interface) type among a row's dgraph.type
+        values — what __typename must report for interface/union
+        results (ref outputnode_graphql.go)."""
+        for n in row_types or []:
+            tt = self.types.get(n)
+            if tt is not None and tt.kind == "type":
+                return n
+        return fallback
+
+    def _add_typename(self, results, t: GqlType, sels: List[Selection]):
+        """Post-encode shaping: prune inline-fragment fields that don't
+        apply to a row's concrete type, inject __typename (concrete via
+        the hidden __dgt fetch), drop __dgt."""
         for r in results:
-            for k in keys_:
-                r[k] = t.name
+            if isinstance(r, dict):
+                self._shape_row(r, t, sels)
         return results
+
+    def _shape_row(self, row: dict, t: GqlType, sels: List[Selection]):
+        row_types = row.pop("__dgt", None)
+        if isinstance(row_types, str):
+            row_types = [row_types]
+        keep: Dict[str, tuple] = {}
+
+        def collect(tt: GqlType, ss: List[Selection]):
+            for s in ss:
+                if s.name == "...":
+                    ft = (
+                        tt if not s.frag_on else self.types.get(s.frag_on)
+                    )
+                    if ft is None:
+                        continue
+                    # with no __dgt fetched (object-type parent) every
+                    # fragment matched statically; otherwise the row's
+                    # dgraph.type list (which includes interfaces)
+                    # decides
+                    if (
+                        not s.frag_on
+                        or row_types is None
+                        or s.frag_on in row_types
+                    ):
+                        collect(ft, s.selections)
+                elif s.name == "__typename":
+                    row[s.key] = self._concrete(row_types, tt.name)
+                    keep.setdefault(s.key, (tt, s))
+                elif (
+                    s.name.endswith("Aggregate")
+                    and s.name[: -len("Aggregate")] in tt.fields
+                ):
+                    if s.key in keep:
+                        continue  # already computed (fragment overlap)
+                    items = row.pop(f"__agg_{s.key}", None) or []
+                    if not isinstance(items, list):
+                        items = [items]
+                    row[s.key] = _compute_child_agg(s, items)
+                    keep.setdefault(s.key, (tt, s))
+                else:
+                    keep.setdefault(s.key, (tt, s))
+
+        collect(t, sels)
+        for k in list(row.keys()):
+            if k not in keep and not k.startswith("__lp_"):
+                row.pop(k)
+        for k, (tt, s) in keep.items():
+            v = row.get(k)
+            f = tt.fields.get(s.name)
+            if v is None or f is None:
+                continue
+            if f.type_name in ("Point", "Polygon", "MultiPolygon"):
+                row[k] = (
+                    [_geojson_to_gql(x) for x in v]
+                    if isinstance(v, list)
+                    else _geojson_to_gql(v)
+                )
+                continue
+            if f.is_scalar:
+                continue
+            ct = self.types.get(f.type_name)
+            if ct is None:
+                continue
+            if isinstance(v, list):
+                for item in v:
+                    if isinstance(item, dict):
+                        self._shape_row(item, ct, s.selections)
+            elif isinstance(v, dict):
+                self._shape_row(v, ct, s.selections)
 
     def _resolve_custom(self, f: GqlField, sel: Selection):
         """@custom(http: {...}) resolver (ref graphql/schema/remote.go +
@@ -349,10 +486,64 @@ class GraphQLServer:
         out = []
         has_lambda = False
         selected = set()
+        need_dgt = t.kind in ("interface", "union") and any(
+            s.name in ("...", "__typename") for s in sels
+        )
+        if need_dgt:
+            # concrete-type dispatch for fragments/__typename: fetch
+            # dgraph.type hidden; _shape_rows prunes with it
+            out.append(GraphQuery(attr="dgraph.type", alias="__dgt"))
         for s in sels:
+            if s.name == "...":
+                # no type condition ('... { x }') means the enclosing type
+                ft = t if not s.frag_on else self.types.get(s.frag_on)
+                if ft is None or ft.kind not in ("type", "interface"):
+                    raise GraphQLError(
+                        f"fragment on unknown type {s.frag_on!r}"
+                    )
+                for c in self._selection_children(ft, s.selections):
+                    if not any(
+                        o.alias == c.alias and o.attr == c.attr
+                        for o in out
+                    ):
+                        out.append(c)
+                continue
+            if (
+                s.name.endswith("Aggregate")
+                and s.name[: -len("Aggregate")] in t.fields
+            ):
+                # child-level aggregate field (ref gqlschema.go: every
+                # object field f gets fAggregate(filter): visible as a
+                # nested {count, <g>Min, ...} object). Fetch the child
+                # edge hidden; _shape_row computes the aggregate.
+                base = s.name[: -len("Aggregate")]
+                bf = t.fields[base]
+                ct = self.types.get(bf.type_name)
+                hidden = GraphQuery(
+                    attr=t.pred(base), alias=f"__agg_{s.key}"
+                )
+                if s.args.get("filter") and ct is not None:
+                    hidden.filter = self._filter_tree(ct, s.args["filter"])
+                need = set()
+                for a in s.selections:
+                    for suffix in ("Min", "Max", "Sum", "Avg"):
+                        if a.name.endswith(suffix):
+                            need.add(a.name[: -len(suffix)])
+                            break
+                for fn in sorted(need):
+                    if ct is not None and fn in ct.fields:
+                        hidden.children.append(
+                            GraphQuery(attr=ct.pred(fn), alias=fn)
+                        )
+                if not hidden.children:
+                    hidden.children.append(
+                        GraphQuery(attr="uid", is_uid=True, alias="uid")
+                    )
+                out.append(hidden)
+                continue
             f = t.fields.get(s.name)
             if s.name == "__typename":
-                continue  # injected post-encode (_add_typename)
+                continue  # injected post-encode (_shape_rows)
             if f is not None and f.is_lambda:
                 has_lambda = True  # resolved post-query via the lambda URL
                 continue
@@ -362,12 +553,23 @@ class GraphQLServer:
             if f is None:
                 raise GraphQLError(f"no field {s.name!r} on type {t.name}")
             selected.add(s.name)
-            child = GraphQuery(attr=f"{t.name}.{f.name}", alias=s.key)
+            child = GraphQuery(attr=t.pred(f.name), alias=s.key)
             if not f.is_scalar:
                 ct = self.types.get(f.type_name)
                 if ct is None:
                     raise GraphQLError(f"unknown type {f.type_name}")
                 child.children = self._selection_children(ct, s.selections)
+                # per-field args (ref query_rewriter.go addArgumentsToField):
+                # filter/order/first/offset apply to the edge expansion
+                if s.args.get("filter"):
+                    child.filter = self._filter_tree(ct, s.args["filter"])
+                order = s.args.get("order") or {}
+                self._apply_order(ct, child, order)
+                if s.args.get("first") is not None:
+                    child.first = s.args["first"]
+                if s.args.get("offset") is not None:
+                    child.offset = s.args["offset"]
+                self._apply_cascade_dir(ct, s, child)
             out.append(child)
         if has_lambda:
             # lambda parents carry ALL scalar fields of the type
@@ -382,26 +584,46 @@ class GraphQLServer:
                 ):
                     out.append(
                         GraphQuery(
-                            attr=f"{t.name}.{fn}", alias=f"__lp_{fn}"
+                            attr=t.pred(fn), alias=f"__lp_{fn}"
                         )
                     )
-        return out
+        # one fetch per (alias, attr): a field selected both plainly and
+        # inside a matching fragment must not be fetched twice
+        seen = set()
+        dedup = []
+        for c in out:
+            key = (c.alias, c.attr)
+            if key in seen:
+                continue
+            seen.add(key)
+            dedup.append(c)
+        return dedup
 
     def _filter_tree(self, t: GqlType, fobj: dict) -> Optional[FilterTree]:
+        """ref resolve/query_rewriter.go compileFilter: within one
+        filter object the field comparisons and `and`/`not` clauses
+        conjoin; an `or` clause disjoins with THAT conjunction —
+        {f: X, or: {g: Y}} means (f=X) OR (g=Y), not AND."""
         parts: List[FilterTree] = []
+        ors: List[FilterTree] = []
         for k, v in (fobj or {}).items():
             if k == "and":
                 subs = [self._filter_tree(t, x) for x in _as_list(v)]
                 parts.append(FilterTree(op="and", children=[s for s in subs if s]))
             elif k == "or":
                 subs = [self._filter_tree(t, x) for x in _as_list(v)]
-                parts.append(FilterTree(op="or", children=[s for s in subs if s]))
+                ors.extend(s for s in subs if s)
             elif k == "not":
                 sub = self._filter_tree(t, v)
                 if sub:
                     parts.append(FilterTree(op="not", children=[sub]))
             elif k == "id":
-                uids = [int(x, 16) for x in _as_list(v)]
+                # ref query_rewriter.go convertIDs: unparseable or
+                # out-of-range ids are silently dropped from the list
+                uids = [
+                    u for u in (_parse_uid(x) for x in _as_list(v))
+                    if u is not None
+                ]
                 parts.append(
                     FilterTree(func=FuncSpec(name="uid", args=uids))
                 )
@@ -412,17 +634,35 @@ class GraphQLServer:
                         raise GraphQLError(f"no field {fname!r}")
                     parts.append(
                         FilterTree(
-                            func=FuncSpec(name="has", attr=f"{t.name}.{fname}")
+                            func=FuncSpec(name="has", attr=t.pred(fname))
                         )
                     )
             else:
                 f = t.fields.get(k)
                 if f is None:
                     raise GraphQLError(f"no field {k!r} on {t.name}")
-                attr = f"{t.name}.{k}"
+                attr = t.pred(k)
                 if not isinstance(v, dict):
                     v = {"eq": v}
                 for opname, arg in v.items():
+                    if arg is None:
+                        # ref query_rewriter.go: {eq: null} matches
+                        # nodes WITHOUT the predicate (NOT has); any
+                        # other null-valued comparison is dropped
+                        if opname == "eq":
+                            parts.append(
+                                FilterTree(
+                                    op="not",
+                                    children=[
+                                        FilterTree(
+                                            func=FuncSpec(
+                                                name="has", attr=attr
+                                            )
+                                        )
+                                    ],
+                                )
+                            )
+                        continue
                     fn = _FILTER_OPS.get(opname)
                     if fn is None:
                         raise GraphQLError(f"bad filter op {opname!r}")
@@ -436,6 +676,30 @@ class GraphQLServer:
                             [c.get("longitude"), c.get("latitude")],
                             arg.get("distance"),
                         ]
+                    elif opname in ("within", "intersects"):
+                        if "multiPolygon" in arg:
+                            args = [
+                                [
+                                    _gql_polygon_coords(p)
+                                    for p in arg["multiPolygon"].get(
+                                        "polygons", []
+                                    )
+                                ]
+                            ]
+                        else:
+                            args = [
+                                _gql_polygon_coords(arg.get("polygon", {}))
+                            ]
+                    elif opname == "contains":
+                        if "point" in arg:
+                            pt = arg["point"]
+                            args = [
+                                [pt.get("longitude"), pt.get("latitude")]
+                            ]
+                        else:
+                            args = [
+                                _gql_polygon_coords(arg.get("polygon", {}))
+                            ]
                     elif opname == "regexp":
                         pat = str(arg)
                         if pat.startswith("/"):
@@ -448,11 +712,45 @@ class GraphQLServer:
                     parts.append(
                         FilterTree(func=FuncSpec(name=fn, attr=attr, args=args))
                     )
+        base: Optional[FilterTree]
         if not parts:
-            return None
-        if len(parts) == 1:
-            return parts[0]
-        return FilterTree(op="and", children=parts)
+            base = None
+        elif len(parts) == 1:
+            base = parts[0]
+        else:
+            base = FilterTree(op="and", children=parts)
+        for o in ors:
+            base = (
+                o
+                if base is None
+                else FilterTree(op="or", children=[base, o])
+            )
+        return base
+
+    def _apply_cascade_dir(self, t: GqlType, sel: Selection, gq):
+        """@cascade / @cascade(fields: [...]) on a field (ref
+        query_rewriter.go addCascadeDirective)."""
+        for dname, dargs in sel.directives:
+            if dname != "cascade":
+                continue
+            gq.cascade = True
+            for fn in _as_list(dargs.get("fields") or []):
+                if fn == "id":
+                    continue  # uid always present
+                f = t.fields.get(fn)
+                gq.cascade_fields.append(
+                    t.pred(fn) if f is not None else fn
+                )
+
+    def _apply_order(self, t: GqlType, gq, order: dict):
+        """order: {asc|desc: field, then: {...}} — nested `then` chains
+        secondary sort keys (ref gqlschema.go order input synthesis)."""
+        while order:
+            if "asc" in order:
+                gq.order.append(Order(attr=t.pred(order["asc"])))
+            if "desc" in order:
+                gq.order.append(Order(attr=t.pred(order["desc"]), desc=True))
+            order = order.get("then") or {}
 
     def _query_list(self, t: GqlType, sel: Selection) -> List[dict]:
         fobj, allowed = self._with_auth_filter(t, sel.args.get("filter"))
@@ -461,11 +759,8 @@ class GraphQLServer:
         gq = GraphQuery(attr="q")
         gq.func = FuncSpec(name="type", attr=t.name)
         gq.filter = self._filter_tree(t, fobj)
-        order = sel.args.get("order") or {}
-        if "asc" in order:
-            gq.order.append(Order(attr=f"{t.name}.{order['asc']}"))
-        if "desc" in order:
-            gq.order.append(Order(attr=f"{t.name}.{order['desc']}", desc=True))
+        self._apply_cascade_dir(t, sel, gq)
+        self._apply_order(t, gq, sel.args.get("order") or {})
         gq.first = sel.args.get("first")
         gq.offset = sel.args.get("offset")
         gq.children = self._selection_children(t, sel.selections)
@@ -475,8 +770,14 @@ class GraphQLServer:
 
     def _get(self, t: GqlType, sel: Selection) -> Optional[dict]:
         gq = GraphQuery(attr="q")
-        if "id" in sel.args:
-            gq.func = FuncSpec(name="uid", args=[int(sel.args["id"], 16)])
+        idf = t.id_field()
+        id_key = idf.name if idf is not None else "id"
+        id_arg = sel.args.get(id_key, sel.args.get("id"))
+        if id_arg is not None:
+            u = _parse_uid(id_arg)
+            if u is None:
+                return None
+            gq.func = FuncSpec(name="uid", args=[u])
             gq.filter = FilterTree(func=FuncSpec(name="type", attr=t.name))
         else:
             xf = t.xid_field()
@@ -484,7 +785,7 @@ class GraphQLServer:
                 raise GraphQLError(f"get{t.name} requires id or @id field")
             gq.func = FuncSpec(
                 name="eq",
-                attr=f"{t.name}.{xf.name}",
+                attr=t.pred(xf.name),
                 args=[sel.args[xf.name]],
             )
         auth = self._auth(t, "query")
@@ -497,9 +798,11 @@ class GraphQLServer:
                 if gq.filter is None
                 else FilterTree(op="and", children=[gq.filter, extra])
             )
+        self._apply_cascade_dir(t, sel, gq)
         gq.children = self._selection_children(t, sel.selections)
         res = self._run_block(gq)
         self._enrich_lambda_fields(t, sel.selections, res)
+        self._add_typename(res, t, sel.selections)
         return res[0] if res else None
 
     def _aggregate(self, t: GqlType, sel: Selection) -> dict:
@@ -514,9 +817,8 @@ class GraphQLServer:
         gq = GraphQuery(attr="q")
         gq.func = FuncSpec(name="type", attr=t.name)
         gq.filter = self._filter_tree(t, fobj)
-        count_key = next(
-            (s.key for s in sel.selections if s.name == "count"), "count"
-        )
+        count_keys = [s.key for s in sel.selections if s.name == "count"]
+        count_key = count_keys[0] if count_keys else "count"
         gq.children = [GraphQuery(attr="uid", is_count=True, alias=count_key)]
 
         # map selections like ageMin/ageMax/ageSum/ageAvg to aggregators
@@ -539,7 +841,7 @@ class GraphQLServer:
                 var_of[fname] = f"v{i}"
                 gq.children.append(
                     GraphQuery(
-                        attr=f"{t.name}.{fname}", var_name=var_of[fname]
+                        attr=t.pred(fname), var_name=var_of[fname]
                     )
                 )
         for key, fname, op in aggs:
@@ -550,6 +852,8 @@ class GraphQLServer:
         out = {count_key: 0}
         for obj in res:
             out.update(obj)
+        for k in count_keys[1:]:  # repeated count under other aliases
+            out[k] = out.get(count_key, 0)
         wanted = {s.key for s in sel.selections}
         out = {k: v for k, v in out.items() if k in wanted}
         for s in sel.selections:  # absent aggregates -> null
@@ -565,12 +869,13 @@ class GraphQLServer:
 
         gq.func = FuncSpec(
             name="similar_to",
-            attr=f"{t.name}.{by}",
+            attr=t.pred(by),
             args=[topk, _json.dumps(vec)],
         )
         gq.children = self._selection_children(t, sel.selections)
         rows = self._run_block(gq)
         self._enrich_lambda_fields(t, sel.selections, rows)
+        self._add_typename(rows, t, sel.selections)
         return rows
 
     # ------------------------------------------------------------------
@@ -646,11 +951,12 @@ class GraphQLServer:
                 gq.children = self._selection_children(t, s.selections)
                 rows = self._run_block(gq)
                 self._enrich_lambda_fields(t, s.selections, rows)
+                self._add_typename(rows, t, s.selections)
                 out[s.key] = rows
         return out
 
     def _set_field(self, txn, t: GqlType, uid: int, f: GqlField, value, op=OP_SET):
-        attr = f"{t.name}.{f.name}"
+        attr = t.pred(f.name)
         if f.is_embedding:
             edge = DirectedEdge(
                 uid, attr, value=Val(TypeID.VFLOAT, np.asarray(value, np.float32)),
@@ -673,7 +979,7 @@ class GraphQLServer:
                         self.engine.schema,
                         DirectedEdge(
                             child_uid,
-                            f"{ct.name}.{f.has_inverse}",
+                            ct.pred(f.has_inverse),
                             value_id=uid,
                             op=op,
                         ),
@@ -691,14 +997,17 @@ class GraphQLServer:
         """Create or reference an object: {id: "0x1"} references, otherwise
         create a new node (with @id dedup)."""
         if set(obj.keys()) == {"id"}:
-            return int(obj["id"], 16)
+            u = _parse_uid(obj["id"])
+            if u is None:
+                raise GraphQLError(f"invalid id {obj['id']!r}")
+            return u
         xf = t.xid_field()
         if xf and xf.name in obj:
             # look up existing by xid
             ex = Executor(txn.cache, self.engine.schema)
             found = ex._runner().run_root(
                 FuncSpec(
-                    name="eq", attr=f"{t.name}.{xf.name}", args=[obj[xf.name]]
+                    name="eq", attr=t.pred(xf.name), args=[obj[xf.name]]
                 )
             )
             if len(found):
@@ -711,11 +1020,17 @@ class GraphQLServer:
         uid = self.engine.zero.assign_uids(1)
         if created is not None:
             created.append(uid)
-        apply_edge(
-            txn,
-            self.engine.schema,
-            DirectedEdge(uid, "dgraph.type", value=Val(TypeID.STRING, t.name)),
-        )
+        # a node is a member of its type AND every interface it
+        # implements (ref mutation_rewriter.go — dgraph.type gets both,
+        # so queryCharacter(func: type(Character)) finds Humans)
+        for tyname in [t.name, *t.interfaces]:
+            apply_edge(
+                txn,
+                self.engine.schema,
+                DirectedEdge(
+                    uid, "dgraph.type", value=Val(TypeID.STRING, tyname)
+                ),
+            )
         for k, v in obj.items():
             if k == "id":
                 continue
@@ -800,12 +1115,58 @@ class GraphQLServer:
                 if f.type_name == "ID":
                     continue
                 delete_entity_attr(
-                    txn.txn, self.engine.schema, uid, f"{t.name}.{f.name}"
+                    txn.txn, self.engine.schema, uid, t.pred(f.name)
                 )
             delete_entity_attr(txn.txn, self.engine.schema, uid, "dgraph.type")
         txn.commit()
         self._fire_webhook(t, "delete", uids, sel)
         return self._payload(t, sel, uids, len(uids))
+
+
+def _compute_child_agg(sel: Selection, items: list) -> dict:
+    """{count, <f>Min/Max/Sum/Avg} over a fetched child edge (the
+    child-level aggregate fields of ref gqlschema.go)."""
+    out = {}
+    for a in sel.selections:
+        if a.name == "count":
+            out[a.key] = len(items)
+            continue
+        for suffix, op in (
+            ("Min", "min"),
+            ("Max", "max"),
+            ("Sum", "sum"),
+            ("Avg", "avg"),
+        ):
+            if a.name.endswith(suffix):
+                fname = a.name[: -len(suffix)]
+                vals = [
+                    it[fname]
+                    for it in items
+                    if isinstance(it, dict) and it.get(fname) is not None
+                ]
+                if not vals:
+                    out[a.key] = None
+                elif op == "min":
+                    out[a.key] = min(vals)
+                elif op == "max":
+                    out[a.key] = max(vals)
+                elif op == "sum":
+                    out[a.key] = sum(vals)
+                else:
+                    out[a.key] = sum(vals) / len(vals)
+                break
+    return out
+
+
+def _parse_uid(x):
+    """uid within u64 range, else None (dropped). Base-0 semantics like
+    the reference (query_rewriter.go convertIDs → strconv.ParseUint
+    base 0): "17" is decimal, "0x11" is hex."""
+    try:
+        u = int(str(x), 0)
+    except (ValueError, TypeError):
+        return None
+    return u if 0 < u < (1 << 64) else None
 
 
 def _as_list(x):
@@ -827,10 +1188,7 @@ def _to_val(v, f: GqlField) -> Val:
 
         return Val(TypeID.DATETIME, parse_datetime(str(v)))
     if dtype == "geo":
-        if isinstance(v, dict) and "longitude" in v:
-            v = {
-                "type": "Point",
-                "coordinates": [v["longitude"], v["latitude"]],
-            }
+        if isinstance(v, dict):
+            v = _gql_geo_to_geojson(v)
         return Val(TypeID.GEO, v)
     return Val(TypeID.STRING, str(v))

@@ -23,6 +23,8 @@ _SCALARS = {
     "DateTime": "datetime",
     "ID": "uid",
     "Point": "geo",
+    "Polygon": "geo",
+    "MultiPolygon": "geo",
 }
 
 _SEARCH_DEFAULT = {
@@ -48,11 +50,19 @@ class GqlField:
     is_scalar: bool = True
     custom: Optional[dict] = None  # @custom(http: {...}) config
     is_lambda: bool = False  # @lambda: resolved by the lambda server
+    # declaring type: a field inherited from an interface keeps the
+    # interface's predicate (ref gqlschema.go — Human implements
+    # Character stores Character.name, not Human.name)
+    owner: str = ""
+    is_enum: bool = False  # enum-typed: stored as string
+    is_union: bool = False  # union-typed: uid edge, fragment-dispatched
 
     @property
     def dql_type(self) -> str:
         if self.is_embedding:
             return "float32vector"
+        if self.is_enum:
+            return "string"
         return _SCALARS.get(self.type_name, "uid")
 
 
@@ -64,6 +74,18 @@ class GqlType:
     # @lambdaOnMutate(add/update/delete) webhook switches
     # (ref gqlschema.go:292, resolve/webhook.go)
     lambda_on_mutate: Dict[str, bool] = field(default_factory=dict)
+    kind: str = "type"  # type | interface | input | enum | union
+    interfaces: List[str] = field(default_factory=list)  # implemented
+    implementers: List[str] = field(default_factory=list)  # for interfaces
+    enum_values: List[str] = field(default_factory=list)  # for enums
+    members: List[str] = field(default_factory=list)  # for unions
+
+    def pred(self, fname: str) -> str:
+        """DQL predicate for a field: owner-qualified so interface
+        fields share one predicate across implementing types."""
+        f = self.fields.get(fname)
+        owner = (f.owner or self.name) if f else self.name
+        return f"{owner}.{fname}"
 
     def id_field(self) -> Optional[GqlField]:
         for f in self.fields.values():
@@ -85,10 +107,10 @@ _TYPE_RE = re.compile(
 _FIELD_RE = re.compile(
     r"""(?P<name>\w+)\s*(?P<args>\((?:[^()]|\([^()]*\))*\))?\s*:\s*
     (?P<list>\[)?\s*(?P<type>\w+)\s*(?P<inner_nn>!)?\s*\]?\s*(?P<nn>!)?\s*
-    (?P<directives>(?:@\w+(?:\((?:[^()]|\([^()]*\))*\))?\s*)*)""",
+    (?P<directives>(?:@\w+(?:[ \t]*\((?:[^()]|\([^()]*\))*\))?\s*)*)""",
     re.VERBOSE,
 )
-_DIR_RE = re.compile(r"@(\w+)(?:\(((?:[^()]|\([^()]*\))*)\))?")
+_DIR_RE = re.compile(r"@(\w+)(?:[ \t]*\(((?:[^()]|\([^()]*\))*)\))?")
 
 
 class SDLError(Exception):
@@ -167,12 +189,15 @@ def _extract_type_auth(sdl: str):
 
 
 def _scan_bodies(sdl: str):
-    """Extract (type_name, body_text) with quote- and brace-aware scanning
-    — directive args may contain braces (@custom http configs, @auth
-    rules), which a `[^}]*` regex body would truncate."""
+    """Extract (kind, type_name, header, body_text) with quote- and
+    brace-aware scanning — directive args may contain braces (@custom
+    http configs, @auth rules), which a `[^}]*` regex body would
+    truncate."""
     out = []
-    for m in re.finditer(r"\btype\s+(\w+)[^{]*\{", sdl):
-        name = m.group(1)
+    for m in re.finditer(
+        r"\b(type|interface|input)\s+(\w+)([^{]*)\{", sdl
+    ):
+        kind, name, header = m.group(1), m.group(2), m.group(3)
         i = m.end()
         depth = 1
         in_str = None
@@ -197,7 +222,7 @@ def _scan_bodies(sdl: str):
             elif ch == "}":
                 depth -= 1
             i += 1
-        out.append((name, sdl[start : i - 1]))
+        out.append((kind, name, header, sdl[start : i - 1]))
     return out
 
 
@@ -216,8 +241,30 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
             }
     sdl = re.sub(r"@lambdaOnMutate\s*\([^)]*\)", "", sdl)
     types: Dict[str, GqlType] = {}
-    for tname, body in _scan_bodies(sdl):
-        t = GqlType(name=tname)
+    # enum E { A B C } — values become string storage with hash search
+    for m in re.finditer(r"\benum\s+(\w+)\s*\{([^}]*)\}", sdl):
+        types[m.group(1)] = GqlType(
+            name=m.group(1),
+            kind="enum",
+            enum_values=re.findall(r"\w+", m.group(2)),
+        )
+    # union U = A | B | C — a uid edge dispatched by inline fragments
+    # members may span lines in leading-pipe style: after the first
+    # member, every further member needs its '|', so the scan can't
+    # swallow the next definition
+    for m in re.finditer(
+        r"\bunion\s+(\w+)\s*=\s*\|?\s*(\w+(?:\s*\|\s*\w+)*)", sdl
+    ):
+        types[m.group(1)] = GqlType(
+            name=m.group(1),
+            kind="union",
+            members=re.findall(r"\w+", m.group(2)),
+        )
+    for kind, tname, header, body in _scan_bodies(sdl):
+        t = GqlType(name=tname, kind=kind)
+        im = re.search(r"\bimplements\s+([\w&\s]+)", header)
+        if im:
+            t.interfaces = re.findall(r"\w+", im.group(1))
         t.lambda_on_mutate = lom.get(tname, {})
         if tname in auth_blobs:
             from dgraph_tpu.graphql.auth import parse_auth_blob
@@ -268,23 +315,57 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
                     f.is_lambda = True
             t.fields[f.name] = f
         types[t.name] = t
+    # second pass: enum/union field marking, interface inheritance
+    for t in types.values():
+        for f in t.fields.values():
+            ft = types.get(f.type_name)
+            if ft is not None and ft.kind == "enum":
+                f.is_enum = True
+                f.is_scalar = True
+            elif ft is not None and ft.kind == "union":
+                f.is_union = True
+                f.is_scalar = False
+    for t in types.values():
+        if t.kind != "type":
+            continue
+        for iname in t.interfaces:
+            it = types.get(iname)
+            if it is None or it.kind != "interface":
+                raise SDLError(
+                    f"type {t.name} implements unknown interface {iname}"
+                )
+            it.implementers.append(t.name)
+            # inherited fields keep the interface's predicate; the
+            # interface's declaration is authoritative even when the
+            # implementing type redeclares the field (ref gqlschema.go)
+            for f in it.fields.values():
+                g = GqlField(**{**f.__dict__, "search": list(f.search)})
+                g.owner = iname
+                t.fields[f.name] = g
     return types
 
 
 def to_dql_schema(types: Dict[str, GqlType]) -> str:
-    """Generate the internal schema text (ref schemagen.go)."""
+    """Generate the internal schema text (ref schemagen.go). Interfaces
+    emit their own predicates; implementing types list the inherited
+    (interface-owned) predicates in their type definition but do not
+    re-emit them."""
     lines: List[str] = []
     for t in types.values():
         if t.name in ("Query", "Mutation"):
             continue  # virtual roots hold @custom resolvers, not data
+        if t.kind in ("enum", "union", "input"):
+            continue  # no storage of their own
         tfields = []
         for f in t.fields.values():
             if f.type_name == "ID":
                 continue  # internal uid, no predicate
             if f.custom is not None or f.is_lambda:
                 continue  # resolved remotely, never stored
-            pred = f"{t.name}.{f.name}"
+            pred = t.pred(f.name)
             tfields.append(pred)
+            if f.owner and f.owner != t.name:
+                continue  # inherited: the interface emits the predicate
             dtype = f.dql_type
             type_str = f"[{dtype}]" if (f.is_list and not f.is_embedding) else dtype
             directives = []
@@ -302,7 +383,11 @@ def to_dql_schema(types: Dict[str, GqlType]) -> str:
                 toks = []
                 for s in f.search:
                     if s == "__default__":
-                        toks.extend(_SEARCH_DEFAULT.get(dtype, ["term"]))
+                        if f.is_enum:
+                            # ref gqlschema.go defaultSearches: enum=hash
+                            toks.append("hash")
+                        else:
+                            toks.extend(_SEARCH_DEFAULT.get(dtype, ["term"]))
                     elif s == "regexp":
                         toks.append("trigram")
                     else:

@@ -1377,18 +1377,28 @@ class Executor:
     # @cascade: prune uids missing any child (ref query.go cascade)
     # ------------------------------------------------------------------
 
-    def _cascade_compute(self, n: ExecNode, valids: Dict[int, set]) -> set:
+    def _cascade_compute(
+        self, n: ExecNode, valids: Dict[int, set], fields=None
+    ) -> set:
         """Bottom-up valid sets: an entity survives only if every queried
         field at its level is present — including uid-pred children whose
-        own subtrees survived (ref query.go applyCascade)."""
+        own subtrees survived (ref query.go applyCascade). A parameterized
+        @cascade(f1, f2) requires only the listed predicates; the list
+        propagates to child levels unless a child declares its own
+        (ref query.go Params.Cascade)."""
+        fields = n.gq.cascade_fields or fields or []
         for c in n.children:
             if c.is_uid_pred and c.children:
-                self._cascade_compute(c, valids)
+                self._cascade_compute(c, valids, fields)
         valid = set()
         for i, u in enumerate(n.dest_uids):
             ok = True
             for c in n.children:
                 gq = c.gq
+                if fields and not (
+                    gq.attr in fields or (gq.alias and gq.alias in fields)
+                ):
+                    continue
                 if (
                     gq.is_uid
                     or gq.is_count

@@ -134,6 +134,59 @@ class FulltextTokenizer(Tokenizer):
         return self._wrap(sorted(toks))
 
 
+IDENT_NGRAM = 0xF
+
+
+class NGramTokenizer(Tokenizer):
+    """Word-shingle n-grams over the fulltext pipeline (ref tok.go:522
+    NGramTokenizer). Index time emits 1..4-gram shingles per position;
+    query time emits a sliding min(3, n)-gram window. Shingles >= 30
+    chars are replaced by their blake2b-256 digest (tok.go:475)."""
+
+    name = "ngram"
+    type_id = TypeID.STRING
+    identifier = IDENT_NGRAM
+
+    @staticmethod
+    def _analyze(v: Val, lang: str = "") -> List[str]:
+        from dgraph_tpu.tok.stemmers import REGISTRY, lang_base
+
+        words = _word_re.findall(_normalize(str(v.value)))
+        base = lang_base(lang)
+        if base and base != "en" and base in REGISTRY:
+            stem, stop = REGISTRY[base]
+            return [stem(w) for w in words if w not in stop]
+        return [_porter_stem(w) for w in words if w not in _STOPWORDS]
+
+    @staticmethod
+    def _shingle(tok: str) -> bytes:
+        if len(tok) < 30:
+            return tok.encode("utf-8")
+        import hashlib
+
+        return hashlib.blake2b(tok.encode("utf-8"), digest_size=32).digest()
+
+    def tokens(self, v: Val, lang: str = "") -> List[bytes]:
+        ws = self._analyze(v, lang)
+        out = set()
+        for i in range(len(ws)):
+            for g in (1, 2, 3, 4):
+                if i + g <= len(ws):
+                    out.add(self._shingle(" ".join(ws[i : i + g])))
+        return self._wrap(sorted(out))
+
+    def query_tokens(self, v: Val, lang: str = "") -> List[bytes]:
+        ws = self._analyze(v, lang)
+        if not ws:
+            return []
+        g = min(3, len(ws))
+        out = {
+            self._shingle(" ".join(ws[i : i + g]))
+            for i in range(len(ws) - g + 1)
+        }
+        return self._wrap(sorted(out))
+
+
 def _enc_int_sortable(x: int) -> bytes:
     # flip sign bit so lexicographic byte order == numeric order
     return struct.pack(">Q", (x + (1 << 63)) & ((1 << 64) - 1))
@@ -353,6 +406,7 @@ for _t in (
     Sha256Tokenizer(),
     TrigramTokenizer(),
     GeoTokenizer(),
+    NGramTokenizer(),
 ):
     register(_t)
 

@@ -1,0 +1,352 @@
+"""Extract golden (GraphQL query, variables, expected-JSON) cases from
+the reference's GraphQL e2e suites.
+
+The reference runs ~200 e2e assertions over the normal/directives
+fixture (schema.graphql + test_data.json loaded once per suite,
+/root/reference/graphql/e2e/common/common.go RunAll) in two mechanical
+shapes:
+
+    params := &GraphQLParams{Query: `...`, Variables: map[...]{...}}
+    gqlResponse := params.ExecuteAsPost(t, GraphqlURL)
+    expected := `...`
+    require.JSONEq(t, expected, string(gqlResponse.Data))
+      (or testutil.CompareJSON — array-order-insensitive)
+
+and table-driven:
+
+    tcases := []struct{...}{{name: ..., query: `...`, respData: `...`}}
+
+This script extracts every statically-resolvable case from functions
+that do NOT mutate cluster state (helpers like addAuthor/deleteCountry
+make a function's goldens depend on in-test data, not the fixture).
+Queries needing Go-side Sprintf/concatenation or non-literal variables
+are skipped.
+
+Run from the repo root:  python tests/ref_golden_graphql/extract_goldens.py
+cases.json is checked in so the conformance suite is self-contained.
+"""
+
+import json
+import os
+import re
+
+REF = "/root/reference/graphql/e2e/common/query.go"
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cases.json")
+
+# any of these in a function body => the function mutates shared state
+# (or depends on data added in-test) and its goldens are not
+# fixture-derived
+MUTATORS = (
+    "add",  # addCountry/addAuthor/addStarship/addMultipleAuthorFromRef…
+    "delete",
+    "update",
+    "cleanup",
+    "DeleteGql",
+    "mutation",
+    "Mutation",
+    "dgo.",
+    "RunQuery(",  # direct dgo side-channel
+)
+
+
+def has_mutator(body: str) -> bool:
+    for mu in MUTATORS:
+        if mu in body:
+            return True
+    return False
+
+
+def split_functions(src):
+    """Yield (name, body) for each top-level func taking *testing.T."""
+    for m in re.finditer(r"func (\w+)\(t \*testing\.T[^)]*\) \{", src):
+        start = m.end()
+        depth = 1
+        i = start
+        in_raw = in_str = False
+        while i < len(src) and depth:
+            c = src[i]
+            if in_raw:
+                if c == "`":
+                    in_raw = False
+            elif in_str:
+                if c == "\\":
+                    i += 1
+                elif c == '"':
+                    in_str = False
+            elif c == "`":
+                in_raw = True
+            elif c == '"':
+                in_str = True
+            elif c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+            i += 1
+        yield m.group(1), src[start : i - 1]
+
+
+def read_raw(src, i):
+    """src[i] == '`' — (content, index after closing tick)."""
+    j = src.index("`", i + 1)
+    return src[i + 1 : j], j + 1
+
+
+# ---------------------------------------------------------------------------
+# Go literal sub-parser (Variables maps). Returns (value, end) or raises.
+# ---------------------------------------------------------------------------
+
+
+class Unextractable(Exception):
+    pass
+
+
+WS = re.compile(r"[\s,]+")
+
+
+def _skip_ws(s, i):
+    m = WS.match(s, i)
+    return m.end() if m else i
+
+
+def parse_go_value(s, i):
+    i = _skip_ws(s, i)
+    if s.startswith("map[string]interface{}{", i):
+        return parse_go_map(s, i + len("map[string]interface{}{"))
+    if s.startswith("[]interface{}{", i):
+        return parse_go_list(s, i + len("[]interface{}{"))
+    m = re.match(r"\[\]string\{", s[i:])
+    if m:
+        return parse_go_list(s, i + m.end())
+    if s[i] == '"':
+        m = re.match(r'"((?:[^"\\]|\\.)*)"', s[i:])
+        if not m:
+            raise Unextractable("bad string")
+        return json.loads('"' + m.group(1) + '"'), i + m.end()
+    if s[i] == "`":
+        v, j = read_raw(s, i)
+        return v, j
+    m = re.match(r"(true|false)\b", s[i:])
+    if m:
+        return m.group(1) == "true", i + m.end()
+    m = re.match(r"-?\d+\.\d+", s[i:])
+    if m:
+        return float(m.group(0)), i + m.end()
+    m = re.match(r"-?\d+", s[i:])
+    if m:
+        return int(m.group(0)), i + m.end()
+    raise Unextractable(f"unsupported Go literal at {s[i:i+40]!r}")
+
+
+def parse_go_map(s, i):
+    out = {}
+    while True:
+        i = _skip_ws(s, i)
+        if s[i] == "}":
+            return out, i + 1
+        m = re.match(r'"((?:[^"\\]|\\.)*)"\s*:', s[i:])
+        if not m:
+            raise Unextractable(f"bad map key at {s[i:i+40]!r}")
+        key = json.loads('"' + m.group(1) + '"')
+        v, i = parse_go_value(s, i + m.end())
+        out[key] = v
+
+
+def parse_go_list(s, i):
+    out = []
+    while True:
+        i = _skip_ws(s, i)
+        if s[i] == "}":
+            return out, i + 1
+        v, i = parse_go_value(s, i)
+        out.append(v)
+
+
+# ---------------------------------------------------------------------------
+# Case extraction
+# ---------------------------------------------------------------------------
+
+RE_QUERY = re.compile(r"Query:\s*`")
+RE_VARS = re.compile(r"Variables:\s*")
+RE_EXPECT_ASSIGN = re.compile(r"(\w+)\s*:?=\s*`")
+RE_COMPARE = re.compile(
+    r"(require\.JSONEq|testutil\.CompareJSON|JSONEqGraphQL)\(t,\s*"
+)
+RE_TCASE_FIELD = re.compile(r"\b(name|query|variables|respData)\s*:\s*")
+
+
+def balanced_query(q: str) -> bool:
+    stripped = re.sub(r"#[^\n]*", "", q)
+    return stripped.count("{") == stripped.count("}") and "%s" not in q
+
+
+def extract_simple(name, body, fname):
+    """Sequential scan: remember the last Query/Variables literal; a
+    JSONEq/CompareJSON with a literal (or raw-string var) expected
+    emits a case."""
+    cases = []
+    svars = {}
+    cur_q = None
+    cur_vars = None
+    i, k = 0, 0
+    n = len(body)
+    while i < n:
+        hits = []
+        for kind, rx in (
+            ("q", RE_QUERY),
+            ("v", RE_VARS),
+            ("a", RE_EXPECT_ASSIGN),
+            ("c", RE_COMPARE),
+        ):
+            m = rx.search(body, i)
+            if m:
+                hits.append((m.start(), kind, m))
+        if not hits:
+            break
+        hits.sort(key=lambda h: h[0])
+        _, kind, m = hits[0]
+        if kind == "q":
+            cur_q, i = read_raw(body, body.index("`", m.start()))
+            cur_vars = None
+        elif kind == "v":
+            try:
+                cur_vars, i = parse_go_value(body, m.end())
+                if not isinstance(cur_vars, dict):
+                    cur_vars = None
+            except (Unextractable, IndexError):
+                cur_vars, i = "UNEXTRACTABLE", m.end()
+        elif kind == "a":
+            raw, i = read_raw(body, body.index("`", m.start()))
+            svars[m.group(1)] = raw
+        else:  # compare
+            j = m.end()
+            unordered = "CompareJSON" in m.group(1)
+            if body[j] == "`":
+                expected, j = read_raw(body, j)
+            elif body[j] == '"':
+                mm = re.match(r'"((?:[^"\\]|\\.)*)"', body[j:])
+                if not mm:
+                    i = j
+                    continue
+                expected = json.loads('"' + mm.group(1) + '"')
+                j += mm.end()
+            else:
+                mm = re.match(r"(\w+)", body[j:])
+                expected = svars.get(mm.group(1)) if mm else None
+                j += mm.end() if mm else 0
+            i = j
+            if expected is None or cur_q is None:
+                continue
+            if cur_vars == "UNEXTRACTABLE" or not balanced_query(cur_q):
+                cur_q = None
+                continue
+            try:
+                json.loads(expected)
+            except ValueError:
+                continue
+            cases.append(
+                {
+                    "id": f"{name}/{k}",
+                    "file": fname,
+                    "query": cur_q,
+                    "variables": cur_vars,
+                    "expected": expected,
+                    "unordered": unordered,
+                }
+            )
+            k += 1
+            cur_q = None
+    return cases
+
+
+def extract_tables(name, body, fname):
+    """Table-driven: {name: "...", query: `...`, [variables: ...,]
+    respData: `...`} entries, compared via tcase.respData."""
+    if "tcase.respData" not in body and "tcase.expected" not in body:
+        return []
+    unordered = "CompareJSON" in body
+    cases = []
+    i, k = 0, 0
+    cur = {}
+    while True:
+        m = RE_TCASE_FIELD.search(body, i)
+        if not m:
+            break
+        field = m.group(1)
+        j = m.end()
+        try:
+            if body[j] == "`":
+                val, j = read_raw(body, j)
+            elif body[j] == '"':
+                mm = re.match(r'"((?:[^"\\]|\\.)*)"', body[j:])
+                if not mm:
+                    i = j
+                    continue
+                val = json.loads('"' + mm.group(1) + '"')
+                j += mm.end()
+            elif field == "variables":
+                val, j = parse_go_value(body, j)
+            else:
+                i = j
+                continue
+        except (Unextractable, IndexError, ValueError):
+            i = j
+            cur = {}
+            continue
+        i = j
+        if field == "name":
+            cur = {"name": val}
+        else:
+            cur[field] = val
+        if "query" in cur and "respData" in cur:
+            q = cur["query"]
+            exp = cur["respData"]
+            ok = balanced_query(q)
+            try:
+                json.loads(exp)
+            except ValueError:
+                ok = False
+            v = cur.get("variables")
+            if isinstance(v, str):
+                try:
+                    v = json.loads(v) if v.strip() else None
+                except ValueError:
+                    ok = False
+            if ok:
+                cases.append(
+                    {
+                        "id": f"{name}/t{k}",
+                        "file": fname,
+                        "case": cur.get("name", ""),
+                        "query": q,
+                        "variables": v,
+                        "expected": exp,
+                        "unordered": unordered,
+                    }
+                )
+                k += 1
+            cur = {}
+    return cases
+
+
+def main():
+    src = open(REF, encoding="utf-8").read()
+    fname = os.path.basename(REF)
+    all_cases = []
+    skipped = 0
+    for name, body in split_functions(src):
+        if has_mutator(body):
+            skipped += 1
+            continue
+        if "tcases" in body or "tcase." in body:
+            all_cases.extend(extract_tables(name, body, fname))
+        else:
+            all_cases.extend(extract_simple(name, body, fname))
+    with open(OUT, "w", encoding="utf-8") as f:
+        json.dump(all_cases, f, indent=1)
+    print(
+        f"{len(all_cases)} cases extracted; {skipped} mutating funcs skipped"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -231,3 +231,64 @@ def test_aggregate_aliased_count(gql):
     gql.execute('mutation { addAuthor(input: [{name: "AC"}]) { numUids } }')
     res = gql.execute("query { aggregateAuthor { c: count } }")
     assert res["data"]["aggregateAuthor"]["c"] >= 1
+
+
+def test_fragment_cycle_rejected(gql):
+    res = gql.execute(
+        "query { queryAuthor { ...A } } "
+        "fragment A on Author { ...B } fragment B on Author { ...A }"
+    )
+    assert res.get("errors") and "cycle" in res["errors"][0]["message"]
+
+
+def test_inline_fragment_without_type_condition(gql):
+    gql.execute('mutation { addAuthor(input: [{name: "Zed", age: 9}]) { numUids } }')
+    res = gql.execute("query { queryAuthor { ... { name age } } }")
+    assert not res.get("errors"), res
+    assert any(a["name"] == "Zed" and a["age"] == 9 for a in res["data"]["queryAuthor"])
+
+
+def test_decimal_and_hex_ids():
+    from dgraph_tpu.graphql.resolve import _parse_uid
+
+    assert _parse_uid("17") == 17
+    assert _parse_uid("0x11") == 17
+    assert _parse_uid("alice") is None
+    assert _parse_uid("0") is None
+    assert _parse_uid(str(1 << 65)) is None
+
+
+def test_mutation_payload_shapes_typename_and_aggregates(gql):
+    res = gql.execute(
+        """mutation {
+          addAuthor(input: [{name: "Shape", posts: [{title: "a"}, {title: "b"}]}]) {
+            author { __typename name postsAggregate { count } }
+          }
+        }"""
+    )
+    assert not res.get("errors"), res
+    a = [x for x in res["data"]["addAuthor"]["author"] if x["name"] == "Shape"][0]
+    assert a["__typename"] == "Author"
+    assert a["postsAggregate"] == {"count": 2}
+
+
+def test_leading_fragment_with_operation_variables(gql):
+    gql.execute('mutation { addAuthor(input: [{name: "Lead", age: 3}]) { numUids } }')
+    res = gql.execute(
+        "fragment F on Author { name age @include(if: $v) } "
+        'query Q($v: Boolean = true) { queryAuthor(filter: {name: {eq: "Lead"}}) { ...F } }'
+    )
+    assert not res.get("errors"), res
+    assert res["data"]["queryAuthor"][0] == {"name": "Lead", "age": 3}
+
+
+def test_aggregate_not_clobbered_by_fragment_overlap(gql):
+    gql.execute(
+        'mutation { addAuthor(input: [{name: "Aggy", posts: [{title: "x"}, {title: "y"}]}]) { numUids } }'
+    )
+    res = gql.execute(
+        'query { queryAuthor(filter: {name: {eq: "Aggy"}}) '
+        "{ postsAggregate { count } ... { postsAggregate { count } } } }"
+    )
+    assert not res.get("errors"), res
+    assert res["data"]["queryAuthor"][0]["postsAggregate"] == {"count": 2}
