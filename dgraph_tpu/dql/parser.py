@@ -473,7 +473,7 @@ def _math_addsub(p: _P) -> MathNode:
 
 def _math_term(p: _P) -> MathNode:
     left = _math_unary(p)
-    while p.peek().text in ("*", "/", "%"):
+    while p.peek().text in ("*", "/", "%", "dot"):
         op = p.next().text
         right = _math_unary(p)
         left = MathNode(op=op, children=[left, right])
@@ -498,6 +498,16 @@ def _math_atom(p: _P) -> MathNode:
         v = int(t.text, 16) if t.text.startswith("0x") else (
             float(t.text) if "." in t.text else int(t.text)
         )
+        return MathNode(op="const", const=v)
+    if t.kind == "name" and t.text.startswith("$"):
+        p.next()
+        if t.text not in p.vars:
+            raise ParseError(f"undefined variable {t.text} in math")
+        v = p.vars[t.text]
+        if isinstance(v, str) and v.lstrip().startswith("["):
+            import json as _json
+
+            v = _json.loads(v)
         return MathNode(op="const", const=v)
     if t.kind == "name":
         p.next()

@@ -173,7 +173,7 @@ def _parse_directives(p: _P, variables):
     return keep, out
 
 
-def _parse_selection_set(p: _P, variables, fragments) -> List[Selection]:
+def _parse_selection_set(p: _P, variables) -> List[Selection]:
     p.expect("{")
     out = []
     while not p.accept("}"):
@@ -187,7 +187,7 @@ def _parse_selection_set(p: _P, variables, fragments) -> List[Selection]:
                     p.next()
                     cond = p.next()[1]
                 keep, dirs = _parse_directives(p, variables)
-                sels = _parse_selection_set(p, variables, fragments)
+                sels = _parse_selection_set(p, variables)
                 if keep:
                     sel = Selection(name="...", frag_on=cond)
                     sel.selections = sels
@@ -210,7 +210,7 @@ def _parse_selection_set(p: _P, variables, fragments) -> List[Selection]:
         sel.args = _parse_args(p, variables)
         keep, sel.directives = _parse_directives(p, variables)
         if p.peek()[1] == "{":
-            sel.selections = _parse_selection_set(p, variables, fragments)
+            sel.selections = _parse_selection_set(p, variables)
         if keep:
             out.append(sel)
     return out
@@ -282,8 +282,11 @@ def parse_operation(
                 p.expect("$")
                 vname = p.next()[1]
                 p.expect(":")
+                # type: [ ]* Name with ! anywhere ([String!]! etc.)
+                while p.peek()[1] in ("[",):
+                    p.next()
                 p.next()  # type name
-                while p.peek()[1] in ("!", "[", "]"):
+                while p.peek()[1] in ("!", "]"):
                     p.next()
                 if p.accept("="):
                     default = _parse_value(p, variables)
@@ -301,17 +304,17 @@ def parse_operation(
         cond = fp.next()[1]
         fragments[fname] = (
             cond,
-            _parse_selection_set(fp, variables, fragments),
+            _parse_selection_set(fp, variables),
         )
     op = Operation(kind=kind, name=name)
-    op.selections = _parse_selection_set(p, variables, fragments)
+    op.selections = _parse_selection_set(p, variables)
     # fragment definitions may follow the operation
     while p.peek()[1] == "fragment":
         p.next()
         fname = p.next()[1]
         p.expect("on")
         cond = p.next()[1]
-        fragments[fname] = (cond, _parse_selection_set(p, variables, fragments))
+        fragments[fname] = (cond, _parse_selection_set(p, variables))
     if p.peek()[0] != "eof":
         raise GqlParseError(f"trailing input at {p.peek()[2]}")
     op.selections = _expand_spreads(op.selections, fragments)

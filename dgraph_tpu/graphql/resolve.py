@@ -207,6 +207,16 @@ class GraphQLServer:
                 return self._resolve_custom(f, sel)
             if f is not None and f.is_lambda:
                 return self._resolve_lambda_root("Query", f, sel)
+        if name == "_entities":
+            return self._entities(sel)
+        if name == "_service":
+            return {
+                s.key: self.sdl for s in sel.selections if s.name == "sdl"
+            }
+        if name.startswith("check") and name.endswith("Password"):
+            t = self.types.get(name[len("check") : -len("Password")])
+            if t is not None:
+                return self._check_password(t, sel)
         if name.startswith("get"):
             t = self._type_for(name, ["get"])
             return self._get(t, sel)
@@ -216,6 +226,12 @@ class GraphQLServer:
             if not t:
                 raise GraphQLError(f"unknown type {tname}")
             return self._similar(t, sel)
+        if name.startswith("querySimilar") and name.endswith("ById"):
+            tname = name[len("querySimilar") : -len("ById")]
+            t = self.types.get(tname)
+            if not t:
+                raise GraphQLError(f"unknown type {tname}")
+            return self._similar(t, sel, by_id=True)
         if name.startswith("query"):
             t = self._type_for(name, ["query"])
             return self._query_list(t, sel)
@@ -244,6 +260,7 @@ class GraphQLServer:
         return results
 
     def _shape_row(self, row: dict, t: GqlType, sels: List[Selection]):
+        row.pop("__uid", None)
         row_types = row.pop("__dgt", None)
         if isinstance(row_types, str):
             row_types = [row_types]
@@ -279,7 +296,10 @@ class GraphQLServer:
                     items = row.pop(f"__agg_{s.key}", None) or []
                     if not isinstance(items, list):
                         items = [items]
-                    row[s.key] = _compute_child_agg(s, items)
+                    base_f = tt.fields[s.name[: -len("Aggregate")]]
+                    row[s.key] = _compute_child_agg(
+                        s, items, base_f.type_name
+                    )
                     keep.setdefault(s.key, (tt, s))
                 else:
                     keep.setdefault(s.key, (tt, s))
@@ -409,6 +429,15 @@ class GraphQLServer:
         object-valued children; hidden __lp_ scalars are stripped."""
         if not rows:
             return
+        # inline-fragment selections contribute their fields too: the
+        # over-approximation (a fragment on a sibling type) is harmless
+        # because _shape_row prunes non-applicable keys per row after
+        sels = list(sels)
+        for s in list(sels):
+            if s.name == "...":
+                ft = t if not s.frag_on else self.types.get(s.frag_on)
+                if ft is not None:
+                    sels.extend(s.selections)
         lam = [
             s
             for s in sels
@@ -559,10 +588,27 @@ class GraphQLServer:
                 if ct is None:
                     raise GraphQLError(f"unknown type {f.type_name}")
                 child.children = self._selection_children(ct, s.selections)
+                # every object level carries uid (ref query_rewriter.go
+                # injects dgraph.uid), so an entity whose requested
+                # scalars are all absent still materializes as a row —
+                # GraphQL returns it with null fields, DQL would omit it
+                if not any(
+                    c.alias == "__uid" for c in child.children
+                ):
+                    child.children.append(
+                        GraphQuery(attr="uid", is_uid=True, alias="__uid")
+                    )
                 # per-field args (ref query_rewriter.go addArgumentsToField):
                 # filter/order/first/offset apply to the edge expansion
                 if s.args.get("filter"):
-                    child.filter = self._filter_tree(ct, s.args["filter"])
+                    if ct.kind == "union":
+                        child.filter = self._union_filter(
+                            ct, s.args["filter"]
+                        )
+                    else:
+                        child.filter = self._filter_tree(
+                            ct, s.args["filter"]
+                        )
                 order = s.args.get("order") or {}
                 self._apply_order(ct, child, order)
                 if s.args.get("first") is not None:
@@ -598,6 +644,32 @@ class GraphQLServer:
             seen.add(key)
             dedup.append(c)
         return dedup
+
+    def _union_filter(self, ut: GqlType, fobj: dict) -> Optional[FilterTree]:
+        """Union member filter (ref query_rewriter.go buildUnionFilter):
+        {memberTypes: [Dog, Parrot], dogFilter: {...}} -> OR over the
+        named member types, each AND'd with its member filter when one
+        is given. No memberTypes = all members."""
+        members = _as_list(fobj.get("memberTypes") or ut.members)
+        parts = []
+        for mname in members:
+            if mname not in ut.members:
+                raise GraphQLError(
+                    f"{mname} is not a member of union {ut.name}"
+                )
+            mt = self.types.get(mname)
+            tf = FilterTree(func=FuncSpec(name="type", attr=mname))
+            sub = fobj.get(mname[0].lower() + mname[1:] + "Filter")
+            if sub and mt is not None:
+                inner = self._filter_tree(mt, sub)
+                if inner is not None:
+                    tf = FilterTree(op="and", children=[tf, inner])
+            parts.append(tf)
+        if not parts:
+            return None
+        if len(parts) == 1:
+            return parts[0]
+        return FilterTree(op="or", children=parts)
 
     def _filter_tree(self, t: GqlType, fobj: dict) -> Optional[FilterTree]:
         """ref resolve/query_rewriter.go compileFilter: within one
@@ -641,6 +713,18 @@ class GraphQLServer:
                 f = t.fields.get(k)
                 if f is None:
                     raise GraphQLError(f"no field {k!r} on {t.name}")
+                if f.type_name == "ID":
+                    # an ID-named field (postID etc.) filters by uid,
+                    # same as the generic "id" key
+                    uids = [
+                        u
+                        for u in (_parse_uid(x) for x in _as_list(v))
+                        if u is not None
+                    ]
+                    parts.append(
+                        FilterTree(func=FuncSpec(name="uid", args=uids))
+                    )
+                    continue
                 attr = t.pred(k)
                 if not isinstance(v, dict):
                     v = {"eq": v}
@@ -768,6 +852,92 @@ class GraphQLServer:
         self._enrich_lambda_fields(t, sel.selections, rows)
         return self._add_typename(rows, t, sel.selections)
 
+    def _entities(self, sel: Selection) -> List[dict]:
+        """Apollo federation _entities(representations: [...]) (ref
+        graphql/resolve entitiesQuery rewrite): group representations by
+        __typename, fetch each batch by its @key field ordered asc."""
+        reps = _as_list(sel.args.get("representations") or [])
+        by_type: Dict[str, List[Any]] = {}
+        for r in reps:
+            tn = r.get("__typename")
+            t = self.types.get(tn)
+            if t is None or not t.key_field:
+                raise GraphQLError(
+                    f"unknown or keyless type in representation: {tn!r}"
+                )
+            by_type.setdefault(tn, []).append(r.get(t.key_field))
+        # resolve each type batch, then reorder to match the
+        # representations argument positionally — Apollo merges results
+        # by index (ref resolve/resolver.go entitiesQueryCompletion);
+        # duplicate keys duplicate rows, missing keys yield null
+        rows_by_key: Dict[tuple, dict] = {}
+        for tn, keyvals in by_type.items():
+            t = self.types[tn]
+            gq = GraphQuery(attr="q")
+            gq.func = FuncSpec(
+                name="eq", attr=t.pred(t.key_field), args=keyvals
+            )
+            gq.filter = FilterTree(func=FuncSpec(name="type", attr=tn))
+            frags = [
+                s
+                for s in sel.selections
+                if s.name == "..." and s.frag_on in (tn, "")
+            ]
+            sels = [x for s in frags for x in s.selections]
+            gq.children = self._selection_children(t, sels)
+            gq.children.append(
+                GraphQuery(attr=t.pred(t.key_field), alias="__key")
+            )
+            rows = self._run_block(gq)
+            keys_ = [r.pop("__key", None) for r in rows]
+            self._add_typename(rows, t, sels)
+            for k, r in zip(keys_, rows):
+                rows_by_key[(tn, k)] = r
+        out: List[Optional[dict]] = []
+        for r in reps:
+            tn = r.get("__typename")
+            k = r.get(self.types[tn].key_field)
+            out.append(rows_by_key.get((tn, k)))
+        return out
+
+    def _check_password(self, t: GqlType, sel: Selection) -> Optional[dict]:
+        """checkTPassword(xid/id, <secretField>) -> T | null (ref
+        query_rewriter.go passwordQuery: eq-root + checkPwd filter)."""
+        sf = next(
+            (f for f in t.fields.values() if f.is_secret), None
+        )
+        if sf is None:
+            raise GraphQLError(f"{t.name} has no @secret field")
+        pwd = sel.args.get(sf.name)
+        gq = GraphQuery(attr="q")
+        xf = t.xid_field()
+        if xf is not None and xf.name in sel.args:
+            gq.func = FuncSpec(
+                name="eq", attr=t.pred(xf.name), args=[sel.args[xf.name]]
+            )
+        else:
+            u = _parse_uid(sel.args.get("id"))
+            if u is None:
+                return None
+            gq.func = FuncSpec(name="uid", args=[u])
+        gq.filter = FilterTree(
+            op="and",
+            children=[
+                FilterTree(func=FuncSpec(name="type", attr=t.name)),
+                FilterTree(
+                    func=FuncSpec(
+                        name="checkpwd",
+                        attr=t.pred(sf.name),
+                        args=[pwd],
+                    )
+                ),
+            ],
+        )
+        gq.children = self._selection_children(t, sel.selections)
+        res = self._run_block(gq)
+        self._add_typename(res, t, sel.selections)
+        return res[0] if res else None
+
     def _get(self, t: GqlType, sel: Selection) -> Optional[dict]:
         gq = GraphQuery(attr="q")
         idf = t.id_field()
@@ -782,7 +952,8 @@ class GraphQLServer:
         else:
             xf = t.xid_field()
             if xf is None or xf.name not in sel.args:
-                raise GraphQLError(f"get{t.name} requires id or @id field")
+                # ref rewrites an argless get to uid(0x0) — null result
+                return None
             gq.func = FuncSpec(
                 name="eq",
                 attr=t.pred(xf.name),
@@ -856,26 +1027,89 @@ class GraphQLServer:
             out[k] = out.get(count_key, 0)
         wanted = {s.key for s in sel.selections}
         out = {k: v for k, v in out.items() if k in wanted}
-        for s in sel.selections:  # absent aggregates -> null
-            out.setdefault(s.key, None)
+        for s in sel.selections:
+            if s.name == "__typename":
+                # ref gqlschema.go names the result type TAggregateResult
+                out[s.key] = f"{t.name}AggregateResult"
+            else:  # absent aggregates -> null
+                out.setdefault(s.key, None)
         return out
 
-    def _similar(self, t: GqlType, sel: Selection) -> List[dict]:
+    def _similar(
+        self, t: GqlType, sel: Selection, by_id: bool = False
+    ) -> List[dict]:
         by = sel.args.get("by")
         topk = int(sel.args.get("topK", 10))
-        vec = sel.args.get("vector")
-        gq = GraphQuery(attr="q")
         import json as _json
 
+        if by_id:
+            # querySimilarTById: the query vector is the given node's
+            # own embedding (ref query_rewriter.go rewriteVectorSearch
+            # uid->vec var chain); results include the node itself
+            u = _parse_uid(sel.args.get("id"))
+            if u is None:
+                return []
+            probe = GraphQuery(attr="q")
+            probe.func = FuncSpec(name="uid", args=[u])
+            probe.children = [
+                GraphQuery(attr=t.pred(by), alias="__v")
+            ]
+            got = self._run_block(probe)
+            if not got or got[0].get("__v") is None:
+                return []
+            vec = got[0]["__v"]
+        else:
+            vec = sel.args.get("vector")
+        gq = GraphQuery(attr="q")
         gq.func = FuncSpec(
             name="similar_to",
             attr=t.pred(by),
-            args=[topk, _json.dumps(vec)],
+            args=[topk, _json.dumps(_as_list(vec))],
         )
-        gq.children = self._selection_children(t, sel.selections)
+        dist_sels = [
+            s for s in sel.selections if s.name == "vector_distance"
+        ]
+        plain = [s for s in sel.selections if s.name != "vector_distance"]
+        gq.children = self._selection_children(t, plain)
+        if dist_sels:
+            # fetch each hit's embedding hidden; distance computed here
+            # (ref query_rewriter.go appends val(distance) the same way)
+            gq.children.append(
+                GraphQuery(attr=t.pred(by), alias="__simv")
+            )
         rows = self._run_block(gq)
-        self._enrich_lambda_fields(t, sel.selections, rows)
-        self._add_typename(rows, t, sel.selections)
+        dists = []
+        if dist_sels:
+            # the embedding's search metric picks the distance formula
+            # (ref query_rewriter.go:669 distanceFormula)
+            metric = "euclidean"
+            bf = t.fields.get(by)
+            for tok in bf.search if bf is not None else []:
+                if tok in ("cosine", "dotproduct"):
+                    metric = tok
+            qv = np.asarray(_as_list(vec), np.float64)
+            for r in rows:
+                v = np.asarray(_as_list(r.pop("__simv", []) or []), np.float64)
+                if v.size != qv.size or not v.size:
+                    dists.append(None)
+                elif metric == "cosine":
+                    denom = float(
+                        np.linalg.norm(v) * np.linalg.norm(qv)
+                    )
+                    dists.append(
+                        1.0 - float(np.dot(v, qv)) / denom
+                        if denom
+                        else None
+                    )
+                elif metric == "dotproduct":
+                    dists.append(1.0 - float(np.dot(v, qv)))
+                else:
+                    dists.append(float(np.sqrt(((v - qv) ** 2).sum())))
+        self._enrich_lambda_fields(t, plain, rows)
+        self._add_typename(rows, t, plain)
+        for i, r in enumerate(rows):  # after shaping, it must survive
+            for s in dist_sels:
+                r[s.key] = dists[i]
         return rows
 
     # ------------------------------------------------------------------
@@ -967,6 +1201,35 @@ class GraphQLServer:
         if not f.is_scalar:
             ct = self.types[f.type_name]
             for obj in _as_list(value):
+                if ct.kind == "union":
+                    # union ref input: {dogRef: {...}} names the member
+                    # (ref gqlschema.go union ref input synthesis)
+                    if len(obj) != 1:
+                        raise GraphQLError(
+                            f"union {ct.name} ref must name exactly one "
+                            f"member, got {sorted(obj)}"
+                        )
+                    refk, obj = next(iter(obj.items()))
+                    if not refk.endswith("Ref") or len(refk) <= 3:
+                        raise GraphQLError(
+                            f"bad union ref {refk!r} for {ct.name}"
+                        )
+                    mname = refk[:-3]
+                    mname = mname[0].upper() + mname[1:]
+                    if mname not in ct.members:
+                        raise GraphQLError(
+                            f"bad union ref {refk!r} for {ct.name}"
+                        )
+                    mt = self.types[mname]
+                    child_uid = self._upsert_object(
+                        txn, mt, obj, getattr(txn, "_created", None)
+                    )
+                    apply_edge(
+                        txn,
+                        self.engine.schema,
+                        DirectedEdge(uid, attr, value_id=child_uid, op=op),
+                    )
+                    continue
                 child_uid = self._upsert_object(txn, ct, obj, getattr(txn, '_created', None))
                 apply_edge(
                     txn,
@@ -996,7 +1259,11 @@ class GraphQLServer:
     def _upsert_object(self, txn, t: GqlType, obj: dict, created=None) -> int:
         """Create or reference an object: {id: "0x1"} references, otherwise
         create a new node (with @id dedup)."""
-        if set(obj.keys()) == {"id"}:
+        xf0 = t.xid_field()
+        if set(obj.keys()) == {"id"} and (xf0 is None or xf0.name != "id"):
+            # bare {id} is a uid reference — unless 'id' is this type's
+            # stored @id key (extended federation types), which the xid
+            # path below handles
             u = _parse_uid(obj["id"])
             if u is None:
                 raise GraphQLError(f"invalid id {obj['id']!r}")
@@ -1032,8 +1299,9 @@ class GraphQLServer:
                 ),
             )
         for k, v in obj.items():
-            if k == "id":
-                continue
+            if k == "id" and (xf0 is None or xf0.name != "id"):
+                continue  # virtual uid, no predicate — but a stored
+                # @id key named 'id' (extended federation types) writes
             f = t.fields.get(k)
             if f is None:
                 raise GraphQLError(f"no field {k!r} on {t.name}")
@@ -1123,13 +1391,18 @@ class GraphQLServer:
         return self._payload(t, sel, uids, len(uids))
 
 
-def _compute_child_agg(sel: Selection, items: list) -> dict:
+def _compute_child_agg(
+    sel: Selection, items: list, type_name: str = ""
+) -> dict:
     """{count, <f>Min/Max/Sum/Avg} over a fetched child edge (the
     child-level aggregate fields of ref gqlschema.go)."""
     out = {}
     for a in sel.selections:
         if a.name == "count":
             out[a.key] = len(items)
+            continue
+        if a.name == "__typename":
+            out[a.key] = f"{type_name}AggregateResult"
             continue
         for suffix, op in (
             ("Min", "min"),
@@ -1191,4 +1464,8 @@ def _to_val(v, f: GqlField) -> Val:
         if isinstance(v, dict):
             v = _gql_geo_to_geojson(v)
         return Val(TypeID.GEO, v)
+    if dtype == "password":
+        from dgraph_tpu.types.types import convert
+
+        return convert(Val(TypeID.STRING, str(v)), TypeID.PASSWORD)
     return Val(TypeID.STRING, str(v))

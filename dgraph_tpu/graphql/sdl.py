@@ -56,11 +56,17 @@ class GqlField:
     owner: str = ""
     is_enum: bool = False  # enum-typed: stored as string
     is_union: bool = False  # union-typed: uid edge, fragment-dispatched
+    is_secret: bool = False  # @secret password field (never returned)
+    # @dgraph(pred: "...") explicit predicate mapping; "~x" maps the
+    # field onto x's reverse edge (ref gqlschema.go dgraph directive)
+    dql_pred: str = ""
 
     @property
     def dql_type(self) -> str:
         if self.is_embedding:
             return "float32vector"
+        if self.is_secret:
+            return "password"
         if self.is_enum:
             return "string"
         return _SCALARS.get(self.type_name, "uid")
@@ -79,11 +85,18 @@ class GqlType:
     implementers: List[str] = field(default_factory=list)  # for interfaces
     enum_values: List[str] = field(default_factory=list)  # for enums
     members: List[str] = field(default_factory=list)  # for unions
+    # Apollo federation: @key(fields: "x") + @extends (ref
+    # graphql/schema apollo support; _entities resolver)
+    key_field: str = ""
+    is_extended: bool = False
 
     def pred(self, fname: str) -> str:
         """DQL predicate for a field: owner-qualified so interface
-        fields share one predicate across implementing types."""
+        fields share one predicate across implementing types;
+        @dgraph(pred:) overrides entirely."""
         f = self.fields.get(fname)
+        if f is not None and f.dql_pred:
+            return f.dql_pred
         owner = (f.owner or self.name) if f else self.name
         return f"{owner}.{fname}"
 
@@ -266,6 +279,20 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
         if im:
             t.interfaces = re.findall(r"\w+", im.group(1))
         t.lambda_on_mutate = lom.get(tname, {})
+        km = re.search(r'@key\s*\(\s*fields:\s*"(\w+)"', header)
+        if km:
+            t.key_field = km.group(1)
+        if re.search(r"@extends\b", header):
+            t.is_extended = True
+        sm = re.search(r'@secret\s*\(\s*field:\s*"(\w+)"', header)
+        if sm:
+            # type T @secret(field: "pwd") stores a hashed password
+            # predicate and generates checkTPassword (ref
+            # gqlschema.go:280 secret directive)
+            f = GqlField(
+                name=sm.group(1), type_name="String", is_secret=True
+            )
+            t.fields[f.name] = f
         if tname in auth_blobs:
             from dgraph_tpu.graphql.auth import parse_auth_blob
 
@@ -313,8 +340,24 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
                     # lambda server (ref wrappers.go:699 comment); we keep
                     # the flag and build the POST in resolve.py
                     f.is_lambda = True
+                elif dname == "dgraph":
+                    pm = re.search(r'pred\s*:\s*"([^"]+)"', dargs)
+                    if pm:
+                        f.dql_pred = pm.group(1).strip("<>").replace(
+                            "~<", "~"
+                        )
             t.fields[f.name] = f
         types[t.name] = t
+    # an extended type's @external ID key comes from another federation
+    # service: it is STORED as an indexed string predicate, not a uid
+    # (ref schemagen apollo handling — eq(Astronaut.id, ...) queries)
+    for t in types.values():
+        if t.is_extended and t.key_field:
+            f = t.fields.get(t.key_field)
+            if f is not None and f.type_name == "ID":
+                f.type_name = "String"
+                f.is_scalar = True
+                f.is_id = True
     # second pass: enum/union field marking, interface inheritance
     for t in types.values():
         for f in t.fields.values():
@@ -351,6 +394,15 @@ def to_dql_schema(types: Dict[str, GqlType]) -> str:
     (interface-owned) predicates in their type definition but do not
     re-emit them."""
     lines: List[str] = []
+    # predicates referenced through "~x" reverse mappings need @reverse
+    # on their forward declaration
+    need_reverse = {
+        f.dql_pred[1:]
+        for t in types.values()
+        for f in t.fields.values()
+        if f.dql_pred.startswith("~")
+    }
+    emitted = set()
     for t in types.values():
         if t.name in ("Query", "Mutation"):
             continue  # virtual roots hold @custom resolvers, not data
@@ -363,9 +415,14 @@ def to_dql_schema(types: Dict[str, GqlType]) -> str:
             if f.custom is not None or f.is_lambda:
                 continue  # resolved remotely, never stored
             pred = t.pred(f.name)
+            if pred.startswith("~"):
+                continue  # rides the forward predicate's @reverse
             tfields.append(pred)
             if f.owner and f.owner != t.name:
                 continue  # inherited: the interface emits the predicate
+            if pred in emitted:
+                continue  # @dgraph(pred) shared across types
+            emitted.add(pred)
             dtype = f.dql_type
             type_str = f"[{dtype}]" if (f.is_list and not f.is_embedding) else dtype
             directives = []
@@ -394,7 +451,7 @@ def to_dql_schema(types: Dict[str, GqlType]) -> str:
                         toks.append(s)
                 directives.append(f"@index({', '.join(dict.fromkeys(toks))})")
             if not f.is_scalar:
-                if f.has_inverse:
+                if f.has_inverse or pred in need_reverse:
                     directives.append("@reverse")
             d = (" " + " ".join(directives)) if directives else ""
             lines.append(f"<{pred}>: {type_str}{d} .")

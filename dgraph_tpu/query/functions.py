@@ -258,12 +258,39 @@ class FuncRunner:
     def _geo_intersects(self, fn: FuncSpec, src) -> np.ndarray:
         """intersects(loc, polygon): stored geometries intersecting the
         query polygon (ref QueryTypeIntersects)."""
-        ring = fn.args[0] if fn.args else None
-        if isinstance(ring, list) and ring and isinstance(ring[0], list) and ring[0] and isinstance(ring[0][0], list):
-            ring = ring[0]
-        if not isinstance(ring, list) or len(ring) < 3:
+        arg = fn.args[0] if fn.args else None
+
+        def _depth(x):
+            d = 0
+            while isinstance(x, list) and x:
+                x = x[0]
+                d += 1
+            return d
+
+        d = _depth(arg)
+        if d == 4:  # multipolygon: [[ring...]...] per polygon
+            outer_rings = [poly[0] for poly in arg if poly]
+        elif d == 3:  # polygon: [ring, holes...]
+            outer_rings = [arg[0]]
+        elif d == 2:  # bare ring
+            outer_rings = [arg]
+        else:
+            outer_rings = []
+        outer_rings = [r for r in outer_rings if len(r) >= 3]
+        if not outer_rings:
             raise QueryError("intersects() needs a polygon of >=3 points")
-        qring = [(float(p[0]), float(p[1])) for p in ring]
+        if len(outer_rings) > 1:
+            # a geometry intersects a multipolygon iff it intersects any
+            # member polygon (ref QueryTypeIntersects over loops)
+            parts = [
+                self._geo_intersects(
+                    FuncSpec(name=fn.name, attr=fn.attr, args=[[r]]),
+                    src,
+                )
+                for r in outer_rings
+            ]
+            return _as_uids(sorted(set().union(*[set(map(int, p)) for p in parts])))
+        qring = [(float(p[0]), float(p[1])) for p in outer_rings[0]]
         # candidates: cover cells of the query polygon bbox across levels
         from dgraph_tpu.tok.tok import GeoTokenizer
 

@@ -6,6 +6,8 @@ import datetime as _dt
 import math
 from typing import Any, Dict
 
+import numpy as np
+
 from dgraph_tpu.dql.parser import MathNode
 from dgraph_tpu.types.types import TypeID, Val
 
@@ -44,6 +46,19 @@ def eval_math(node: MathNode, env: Dict[str, Any]):
             "==": a == b, "!=": a != b, "<": a < b,
             ">": a > b, "<=": a <= b, ">=": a >= b,
         }[op]
+    if op in ("+", "-", "*", "dot") and any(
+        isinstance(a, (list, np.ndarray)) for a in args
+    ):
+        # vector math (ref query/math.go vector ops): elementwise
+        # +/-/* and dot-product reduction over float32vector values
+        va = [np.asarray(a, np.float64) for a in args]
+        if op == "+":
+            return va[0] + va[1]
+        if op == "-":
+            return va[0] - va[1]
+        if op == "*":
+            return va[0] * va[1]
+        return float(np.dot(va[0], va[1]))
     if op == "+":
         return args[0] + args[1]
     if op == "-":

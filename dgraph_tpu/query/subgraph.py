@@ -733,6 +733,10 @@ class Executor:
                 except ValueError:
                     ok = False
             cnode.math_vals[int(u)] = Val(TypeID.BOOL, ok)
+        if cgq.var_name:
+            # `pwd as checkpwd(...)` binds a per-uid bool value var (the
+            # reference's password-query rewrite filters on eq(val(pwd),1))
+            self.val_vars[cgq.var_name] = dict(cnode.math_vals)
         return cnode
 
     def _make_agg_child(self, parent: ExecNode, cgq: GraphQuery) -> ExecNode:
