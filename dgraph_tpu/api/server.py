@@ -154,6 +154,69 @@ class TxnHandle:
             self.commit()
         return out
 
+    def upsert_json(
+        self,
+        query: str,
+        mutations: List[dict],
+        commit_now: bool = True,
+        access_jwt: Optional[str] = None,
+    ) -> Dict[str, str]:
+        """Multi-mutation JSON upsert: one query block binding uid vars,
+        then a list of {"set": obj, "delete": obj, "cond": "@if(...)"}
+        mutations applied against those bindings (ref edgraph/server.go
+        doQuery with req.Mutations[] — the shape the GraphQL rewriters
+        emit, graphql/resolve/mutation_rewriter.go UpsertMutation)."""
+        blocks = dql.parse(query) if query.strip() else []
+        ns = keys.GALAXY_NS
+        if self.server.acl is not None:
+            from dgraph_tpu.acl.acl import READ, WRITE, AclError
+
+            if access_jwt is None:
+                raise AclError("no access token (ACL enabled)")
+            claims = self.server.acl.claims(access_jwt)
+            ns = int(claims.get("namespace", 0))
+            self.server.acl.authorize_preds(
+                access_jwt, _query_preds(blocks), READ, claims=claims
+            )
+            mpreds = sorted(
+                {
+                    p
+                    for m in mutations
+                    for p in (
+                        _json_preds(m.get("set"))
+                        | _json_preds(m.get("delete"))
+                    )
+                }
+            )
+            self.server.acl.authorize_preds(
+                access_jwt, mpreds, WRITE, claims=claims
+            )
+        uid_vars: Dict[str, List[int]] = {}
+        if blocks:
+            ex = Executor(
+                self.txn.cache,
+                self.server.schema,
+                ns=ns,
+                vector_indexes=self.server.vector_indexes,
+            )
+            ex.process(blocks)
+            uid_vars = {
+                k: [int(u) for u in v] for k, v in ex.uid_vars.items()
+            }
+        blanks: Dict[str, int] = {}  # blank-node map SHARED across the
+        # request's mutations (ref: one AssignUids per request)
+        for m in mutations:
+            cond = m.get("cond")
+            if cond and not _eval_cond(cond, uid_vars):
+                continue
+            self.server._apply_json_with_vars(
+                self.txn, m.get("set"), m.get("delete"), uid_vars,
+                ns=ns, blank=blanks,
+            )
+        if commit_now:
+            self.commit()
+        return {k[2:]: hex(v) for k, v in blanks.items()}
+
     def commit(self) -> int:
         if self.finished:
             raise RuntimeError("transaction already finished")
@@ -574,89 +637,141 @@ class Server:
     def _apply_json(
         self, txn: Txn, set_obj, del_obj, ns: int = keys.GALAXY_NS
     ) -> Dict[str, str]:
-        """JSON mutation format (ref chunker/json_parser.go): nested objects
-        with "uid" refs; blank nodes via "_:name"."""
-        blank: Dict[str, int] = {}
+        """JSON mutation format (ref chunker/json_parser.go): nested
+        objects with "uid" refs; blank nodes via "_:name". Delegates to
+        the var-aware walker (no vars bound) so set/delete semantics —
+        schema-typed conversion, bare-uid node deletes, null-predicate
+        deletes — stay in one place."""
+        return self._apply_json_with_vars(txn, set_obj, del_obj, {}, ns=ns)
 
-        def resolve(ref) -> int:
+    def _node_type_preds(self, txn: Txn, uid: int, ns=keys.GALAXY_NS):
+        """Predicates expanded from the node's dgraph.type definitions
+        (ref worker/mutation.go expandEdges for S * * deletes)."""
+        tkey = keys.DataKey("dgraph.type", uid, ns)
+        preds = []
+        for p in txn.cache.values(tkey):
+            tu = self.schema.get_type(str(p.val().value))
+            if tu is not None:
+                preds.extend(tu.fields)
+        return preds
+
+    def _apply_json_with_vars(
+        self, txn: Txn, set_obj, del_obj, uid_vars,
+        ns: int = keys.GALAXY_NS, blank: Optional[Dict[str, int]] = None,
+    ) -> Dict[str, str]:
+        """JSON mutations whose uid refs may be upsert vars — the format
+        the reference's GraphQL mutation rewriters emit (setjson /
+        deletejson with "uid(x)" refs and @if conds, ref
+        graphql/resolve/mutation_rewriter.go + edgraph doMutate var
+        expansion). Values convert by schema type (geo dicts, datetimes),
+        a bare {"uid": U} in delete drops the whole node (S * *), and a
+        null field value in delete drops the predicate (S P *)."""
+        blank = blank if blank is not None else {}
+
+        def resolve_many(ref) -> List[int]:
             if isinstance(ref, int):
-                return ref
+                return [ref]
+            if ref.startswith("uid("):
+                return list(uid_vars.get(ref[4:-1], []))
             if ref.startswith("_:"):
                 if ref not in blank:
                     blank[ref] = self.zero.assign_uids(1)
-                return blank[ref]
-            return int(ref, 16) if ref.startswith("0x") else int(ref)
+                return [blank[ref]]
+            return [int(ref, 16) if ref.startswith("0x") else int(ref)]
 
-        def walk(obj, op) -> int:
-            uid = resolve(obj.get("uid", f"_:auto{id(obj)}"))
-            for k, v in obj.items():
-                if k == "uid":
-                    continue
-                if k == "dgraph.type":
-                    vs = v if isinstance(v, list) else [v]
-                    for t in vs:
-                        apply_edge(
-                            txn,
-                            self.schema,
-                            DirectedEdge(
-                                uid, "dgraph.type",
-                                value=Val(TypeID.STRING, t), op=op, ns=ns,
-                            ),
-                        )
-                    continue
-                lang = ""
-                pred = k
-                if "@" in k:
-                    pred, lang = k.split("@", 1)
-                su = self.schema.get(pred)
-                if (
-                    su is not None
-                    and su.value_type == TypeID.VFLOAT
-                    and isinstance(v, list)
-                    and v
-                    and isinstance(v[0], (int, float))
-                ):
-                    # a numeric list on a vector predicate is ONE value
-                    # (ref chunker json: vector literals), not a list pred
-                    apply_edge(
-                        txn,
-                        self.schema,
-                        DirectedEdge(
-                            uid,
-                            pred,
-                            value=Val(
-                                TypeID.VFLOAT,
-                                np.asarray(v, dtype=np.float32),
-                            ),
-                            op=op,
-                            ns=ns,
-                        ),
+        def to_val(pred: str, v) -> Val:
+            # (geo dicts never reach here — walk() routes them through
+            # is_geo_literal directly)
+            su = self.schema.get(pred)
+            tid = su.value_type if su is not None else None
+            if tid == TypeID.DATETIME:
+                from dgraph_tpu.types.types import parse_datetime
+
+                return Val(TypeID.DATETIME, parse_datetime(str(v)))
+            if tid == TypeID.PASSWORD:
+                from dgraph_tpu.types.types import convert
+
+                return convert(Val(TypeID.STRING, str(v)), TypeID.PASSWORD)
+            if tid == TypeID.VFLOAT and isinstance(v, list):
+                return Val(TypeID.VFLOAT, np.asarray(v, dtype=np.float32))
+            return _json_to_val(v)
+
+        def is_geo_literal(v) -> bool:
+            return (
+                isinstance(v, dict)
+                and "coordinates" in v
+                and v.get("type")
+                in ("Point", "Polygon", "MultiPolygon", "MultiPoint")
+            )
+
+        def edge(subj, pred, op, value=None, value_id=None, lang=""):
+            apply_edge(
+                txn,
+                self.schema,
+                DirectedEdge(
+                    subj, pred, value=value, value_id=value_id,
+                    lang=lang, op=op, ns=ns,
+                ),
+            )
+
+        def walk(obj, op, top=False) -> List[int]:
+            subjects = resolve_many(obj.get("uid", f"_:auto{id(obj)}"))
+            rest = [(k, v) for k, v in obj.items() if k != "uid"]
+            if op == OP_DEL and not rest and top:
+                # bare top-level {"uid": U}: delete the node outright
+                # (nested bare refs are edge targets, not node deletes)
+                for subj in subjects:
+                    for pred in self._node_type_preds(txn, subj, ns):
+                        delete_entity_attr(txn, self.schema, subj, pred, ns)
+                    delete_entity_attr(
+                        txn, self.schema, subj, "dgraph.type", ns
                     )
-                    continue
-                vs = v if isinstance(v, list) else [v]
-                for item in vs:
-                    if isinstance(item, dict):
-                        child = walk(item, op)
-                        apply_edge(
-                            txn,
-                            self.schema,
-                            DirectedEdge(uid, pred, value_id=child, op=op, ns=ns),
-                        )
-                    else:
-                        val = _json_to_val(item)
-                        apply_edge(
-                            txn,
-                            self.schema,
-                            DirectedEdge(
-                                uid, pred, value=val, lang=lang, op=op, ns=ns
-                            ),
-                        )
-            return uid
+                return subjects
+            for subj in subjects:
+                for k, v in rest:
+                    if k == "dgraph.type":
+                        for t in _as_list(v):
+                            edge(
+                                subj, "dgraph.type", op,
+                                value=Val(TypeID.STRING, t),
+                            )
+                        continue
+                    pred, lang = (
+                        k.split("@", 1) if "@" in k else (k, "")
+                    )
+                    if v is None:
+                        if op == OP_DEL:
+                            delete_entity_attr(
+                                txn, self.schema, subj, pred, ns
+                            )
+                        continue
+                    su = self.schema.get(pred)
+                    if (
+                        su is not None
+                        and su.value_type == TypeID.VFLOAT
+                        and isinstance(v, list)
+                        and v
+                        and isinstance(v[0], (int, float))
+                    ):
+                        edge(subj, pred, op, value=to_val(pred, v))
+                        continue
+                    for item in _as_list(v):
+                        if is_geo_literal(item):
+                            edge(subj, pred, op, value=Val(TypeID.GEO, item))
+                        elif isinstance(item, dict):
+                            for child in walk(item, op):
+                                edge(subj, pred, op, value_id=child)
+                        else:
+                            edge(
+                                subj, pred, op,
+                                value=to_val(pred, item), lang=lang,
+                            )
+            return subjects
 
         for obj in _as_list(set_obj):
-            walk(obj, OP_SET)
+            walk(obj, OP_SET, top=True)
         for obj in _as_list(del_obj):
-            walk(obj, OP_DEL)
+            walk(obj, OP_DEL, top=True)
         return {k[2:]: hex(v) for k, v in blank.items()}
 
     # -- queries ----------------------------------------------------------------

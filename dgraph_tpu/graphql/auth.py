@@ -48,6 +48,7 @@ class AuthConfig:
     header: str = "X-Dgraph-AuthToken"
     namespace: str = ""
     algo: str = "HS256"
+    closed_by_default: bool = False  # every request needs a JWT
 
 
 _AUTH_LINE = re.compile(r"#\s*Dgraph\.Authorization\s+(\{.*\})")
@@ -68,6 +69,7 @@ def parse_authorization(sdl: str) -> Optional[AuthConfig]:
         header=obj.get("Header", "X-Dgraph-AuthToken"),
         namespace=obj.get("Namespace", ""),
         algo=obj.get("Algo", "HS256"),
+        closed_by_default=bool(obj.get("ClosedByDefault", False)),
     )
 
 
@@ -118,9 +120,41 @@ def _untriple(s: str) -> str:
     return _TRIPLE.sub(lambda m: json.dumps(m.group(1)), s)
 
 
+def _strip_comments(s: str) -> str:
+    """Drop `# …` line comments outside string literals."""
+    out = []
+    in_str = False
+    i = 0
+    while i < len(s):
+        ch = s[i]
+        if in_str:
+            if ch == '"':
+                # closing quote unless preceded by an ODD number of
+                # backslashes ("...\\" ends the string)
+                bs = 0
+                j = i - 1
+                while j >= 0 and s[j] == "\\":
+                    bs += 1
+                    j -= 1
+                if bs % 2 == 0:
+                    in_str = False
+            out.append(ch)
+        elif ch == '"':
+            in_str = True
+            out.append(ch)
+        elif ch == "#":
+            while i < len(s) and s[i] != "\n":
+                i += 1
+            continue
+        else:
+            out.append(ch)
+        i += 1
+    return "".join(out)
+
+
 def parse_auth_blob(blob: str) -> TypeAuth:
     """blob: the argument text inside @auth( ... )."""
-    obj = _parse_gql_object("{" + _untriple(blob) + "}")
+    obj = _parse_gql_object("{" + _strip_comments(_untriple(blob)) + "}")
     ta = TypeAuth()
     for op in ("query", "add", "update", "delete"):
         if op in obj:
@@ -151,34 +185,79 @@ def _rule_node(obj: dict) -> AuthNode:
         if not isinstance(cond, dict) or len(cond) != 1:
             raise AuthError(f"RBAC rule needs one op: {rule!r}")
         op, val = next(iter(cond.items()))
-        if op not in ("eq", "in"):
-            raise AuthError(f"RBAC op must be eq/in: {rule!r}")
+        if op not in ("eq", "in", "regexp"):
+            raise AuthError(f"RBAC op must be eq/in/regexp: {rule!r}")
         return AuthNode(kind="rbac", claim=claim[1:], op=op, value=val)
-    # graph rule: query (...) { queryT(filter: {...}) { ... } }
-    m = re.search(r"filter\s*:", rule)
-    if not m:
-        # a rule query with no filter gates nothing beyond type access
-        return AuthNode(kind="filter", filt={})
-    filt_src = _balanced_object(rule, rule.index("{", m.end()))
-    return AuthNode(kind="filter", filt=_parse_gql_object(filt_src))
+    # graph rule: query (...) { queryT(filter: {...}) { ... } }.
+    # A root-only filter with a trivial body lifts straight into the
+    # operation filter; anything deeper (nested filters / cascade-
+    # significant selections) is kept as an executable rule query the
+    # resolver runs with @cascade semantics (ref auth_query_rewriting's
+    # uid-var + @cascade chains).
+    if _is_root_only_rule(rule):
+        m = re.search(r"filter\s*:", rule)
+        if not m:
+            return AuthNode(kind="filter", filt={})
+        filt_src = _balanced_object(rule, rule.index("{", m.end()))
+        return AuthNode(kind="filter", filt=_parse_gql_object(filt_src))
+    return AuthNode(kind="gqlrule", value=rule)
 
 
-def evaluate(node: Optional[AuthNode], claims: Dict[str, Any]):
+def _is_root_only_rule(rule: str) -> bool:
+    """True when the rule query's only structure is a root filter with a
+    trivial (__typename/uid-only) body — the common fast path."""
+    try:
+        from dgraph_tpu.graphql.parser import parse_operation
+
+        # probe-parse with every $var bound to a placeholder
+        names = set(re.findall(r"\$(\w+)", rule))
+        op = parse_operation(rule, variables={n: "0" for n in names})
+    except Exception:
+        return False
+    if len(op.selections) != 1:
+        return False
+    root = op.selections[0]
+    for s in root.selections:
+        if s.selections or s.args or s.name not in ("__typename", "id", "uid"):
+            return False
+    return True
+
+
+def evaluate(node: Optional[AuthNode], claims: Dict[str, Any], rule_runner=None):
     """Returns True (allow all), False (deny all), or a filter object to
-    AND into the operation (the reference's auth-query injection)."""
+    AND into the operation (the reference's auth-query injection).
+    rule_runner(rule_text, claims) executes a deep rule query and
+    returns the allowed uids (hex strings)."""
     if node is None:
         return True
     if node.kind == "rbac":
         got = claims.get(node.claim)
         if node.op == "eq":
             return got == node.value
+        if node.op == "regexp":
+            pat = str(node.value).strip("/")
+            return bool(got is not None and re.search(pat, str(got)))
         vals = node.value if isinstance(node.value, list) else [node.value]
         return got in vals
     if node.kind == "filter":
         if not node.filt:
             return True
-        return _substitute(node.filt, claims)
-    parts = [evaluate(c, claims) for c in node.children]
+        try:
+            return _substitute(node.filt, claims)
+        except AuthError:
+            # a rule whose JWT variable is missing simply fails —
+            # deny THIS rule, not the request (ref auth_query_test
+            # "Query with missing jwt variables")
+            return False
+    if node.kind == "gqlrule":
+        if rule_runner is None:
+            return False
+        try:
+            uids = rule_runner(node.value, claims)
+        except Exception:  # noqa: BLE001 — missing claim/var => rule fails
+            return False
+        return {"id": list(uids)}
+    parts = [evaluate(c, claims, rule_runner) for c in node.children]
     if node.kind == "and":
         if any(p is False for p in parts):
             return False

@@ -242,6 +242,24 @@ def _expand_spreads(
     return out
 
 
+def _skip_frag_directives(p) -> None:
+    """Skip '@name(args)' directive tokens between a fragment's type
+    condition and its '{' (legal GraphQL: 'fragment F on T @dir { … }')."""
+    while p.peek()[1] == "@":
+        p.next()
+        p.next()  # directive name
+        if p.peek()[1] == "(":
+            depth = 0
+            while True:
+                tkn = p.next()[1]
+                if tkn == "(":
+                    depth += 1
+                elif tkn == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+
+
 def parse_operation(
     text: str, variables: Optional[Dict[str, Any]] = None
 ) -> Operation:
@@ -262,6 +280,7 @@ def parse_operation(
         p.next()  # name
         p.expect("on")
         p.next()  # type condition
+        _skip_frag_directives(p)
         p.expect("{")
         depth = 1
         while depth:
@@ -302,6 +321,7 @@ def parse_operation(
         fname = fp.next()[1]
         fp.expect("on")
         cond = fp.next()[1]
+        _skip_frag_directives(fp)
         fragments[fname] = (
             cond,
             _parse_selection_set(fp, variables),
@@ -314,6 +334,7 @@ def parse_operation(
         fname = p.next()[1]
         p.expect("on")
         cond = p.next()[1]
+        _skip_frag_directives(p)
         fragments[fname] = (cond, _parse_selection_set(p, variables))
     if p.peek()[0] != "eof":
         raise GqlParseError(f"trailing input at {p.peek()[2]}")

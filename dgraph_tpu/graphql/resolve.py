@@ -108,6 +108,19 @@ class GraphQLError(Exception):
     pass
 
 
+class _MutCtx:
+    """Per-request mutation state (ref mutation_rewriter.go VarGenerator
+    / xidMetadata): upsert flag, uids created, xids claimed by new nodes
+    so in-request duplicates are rejected."""
+
+    def __init__(self, upsert: bool = False):
+        self.upsert = upsert
+        self.upsert_auth = True  # add-rule verdict for upsert pre-checks
+        self.created: List[int] = []
+        # (pred, xid-value) -> (new uid, the claiming input object)
+        self.claimed: Dict[tuple, tuple] = {}
+
+
 class GraphQLServer:
     def __init__(self, engine, sdl: str, lambda_url: Optional[str] = None):
         import os
@@ -119,6 +132,9 @@ class GraphQLServer:
         self.types: Dict[str, GqlType] = parse_sdl(sdl)
         self.sdl = sdl
         self.auth_config = parse_authorization(sdl)
+        self.closed_by_default = bool(
+            self.auth_config and self.auth_config.closed_by_default
+        )
         # --graphql lambda-url analog (ref x.LambdaUrl): explicit arg >
         # engine attr (set by the alpha CLI superflag) > env
         self.lambda_url = (
@@ -146,6 +162,17 @@ class GraphQLServer:
 
                 claims = claims_from_jwt(jwt_token, self.auth_config)
             self._tls.claims = claims or {}
+            self._tls.auth_memo = {}  # fresh verdicts per request
+            if (
+                getattr(self, "closed_by_default", False)
+                and claims is None
+                and not jwt_token
+            ):
+                # Dgraph.Authorization ClosedByDefault: every request
+                # needs a JWT (ref x/config.go + auth closed-mode tests)
+                raise GraphQLError(
+                    "a valid JWT is required but was not provided"
+                )
             op = parse_operation(query, variables)
             data = {}
             for sel in op.selections:
@@ -180,7 +207,55 @@ class GraphQLServer:
 
         if t.auth is None:
             return True
-        return evaluate(getattr(t.auth, op), self._claims())
+        # per-request memo: the same (type, op) verdict is reused at
+        # every nesting site (claims + snapshot are fixed per request)
+        memo = getattr(self._tls, "auth_memo", None)
+        if memo is None:
+            memo = self._tls.auth_memo = {}
+        key = (t.name, op)
+        if key not in memo:
+            memo[key] = evaluate(
+                getattr(t.auth, op), self._claims(),
+                rule_runner=self._run_auth_rule,
+            )
+        return memo[key]
+
+    def _run_auth_rule(self, rule_text: str, claims, cache=None) -> List[str]:
+        """Execute a deep @auth rule query with @cascade semantics and
+        return the allowed root uids (the eager equivalent of the
+        reference's uid-var + @cascade auth chains,
+        auth_query_rewriting). cache pins the snapshot — mutation auth
+        checks run against the uncommitted txn state."""
+        op = parse_operation(rule_text, variables=dict(claims))
+        sel = op.selections[0]
+        t = self._type_for(sel.name, ["query", "get"])
+        gq = GraphQuery(attr="q")
+        gq.func = FuncSpec(name="type", attr=t.name)
+        fobj = sel.args.get("filter")
+        if fobj:
+            gq.filter = self._filter_tree(t, fobj)
+        gq.cascade = True  # root @cascade prunes the whole subtree
+        prev = getattr(self._tls, "in_auth_rule", False)
+        self._tls.in_auth_rule = True
+        try:
+            gq.children = self._selection_children(t, sel.selections)
+        finally:
+            self._tls.in_auth_rule = prev
+        gq.children.append(GraphQuery(attr="uid", is_uid=True))
+        rows = self._run_block(gq, cache=cache)
+        return [r["uid"] for r in rows if isinstance(r, dict) and "uid" in r]
+
+    def _auth_allowed_uids(self, t: GqlType, auth_filter, uids, cache=None):
+        """Subset of uids passing an auth filter dict, evaluated on the
+        given snapshot (txn cache for mutation post-checks)."""
+        if not uids:
+            return set()
+        gq = GraphQuery(attr="q")
+        gq.func = FuncSpec(name="uid", args=list(uids))
+        gq.filter = self._filter_tree(t, auth_filter)
+        gq.children = [GraphQuery(attr="uid", is_uid=True)]
+        rows = self._run_block(gq, cache=cache)
+        return {int(r["uid"], 16) for r in rows}
 
     def _with_auth_filter(self, t: GqlType, fobj, op: str = "query"):
         """Merge the type's auth rule filter into a filter object. Returns
@@ -496,18 +571,41 @@ class GraphQLServer:
             for k in [k for k in row if k.startswith("__lp_")]:
                 del row[k]
 
-    def _run_block(self, gq: GraphQuery) -> List[dict]:
-        cache = LocalCache(
-            self.engine.kv,
-            self.engine.zero.read_ts(),
-            mem=getattr(self.engine, "mem", None),
-        )
+    def _run_block(self, gq: GraphQuery, cache=None) -> List[dict]:
+        if cache is None:
+            cache = LocalCache(
+                self.engine.kv,
+                self.engine.zero.read_ts(),
+                mem=getattr(self.engine, "mem", None),
+            )
         ex = Executor(
             cache, self.engine.schema, vector_indexes=self.engine.vector_indexes
         )
         nodes = ex.process([gq])
         enc = JsonEncoder(val_vars=ex.val_vars, schema=self.engine.schema)
         return enc.encode_blocks(nodes).get(gq.attr, [])
+
+    def _merge_child_auth(self, ct: GqlType, child: GraphQuery):
+        """Nested selections honor the CHILD type's query @auth rules
+        (ref auth_query_rewriting: every traversal level gets its own
+        uid-var auth filter — `Contact.adminTasks @filter(uid(...))`)."""
+        if ct.kind == "union":
+            return
+        if getattr(self._tls, "in_auth_rule", False):
+            return  # auth rule queries are not themselves auth-filtered
+        auth = self._auth(ct, "query")
+        if auth is True:
+            return
+        if auth is False:
+            # matches nothing: uid-in-empty-set filter
+            extra = FilterTree(func=FuncSpec(name="uid", args=[]))
+        else:
+            extra = self._filter_tree(ct, auth)
+        child.filter = (
+            extra
+            if child.filter is None
+            else FilterTree(op="and", children=[child.filter, extra])
+        )
 
     def _selection_children(
         self, t: GqlType, sels: List[Selection]
@@ -553,6 +651,8 @@ class GraphQLServer:
                 )
                 if s.args.get("filter") and ct is not None:
                     hidden.filter = self._filter_tree(ct, s.args["filter"])
+                if ct is not None:
+                    self._merge_child_auth(ct, hidden)
                 need = set()
                 for a in s.selections:
                     for suffix in ("Min", "Max", "Sum", "Avg"):
@@ -616,6 +716,7 @@ class GraphQLServer:
                 if s.args.get("offset") is not None:
                     child.offset = s.args["offset"]
                 self._apply_cascade_dir(ct, s, child)
+                self._merge_child_auth(ct, child)
             out.append(child)
         if has_lambda:
             # lambda parents carry ALL scalar fields of the type
@@ -848,6 +949,12 @@ class GraphQLServer:
         gq.first = sel.args.get("first")
         gq.offset = sel.args.get("offset")
         gq.children = self._selection_children(t, sel.selections)
+        # rows materialize on uid even when every selected scalar is
+        # absent (ref query_rewriter.go injects dgraph.uid at the root)
+        if not any(c.alias == "__uid" for c in gq.children):
+            gq.children.append(
+                GraphQuery(attr="uid", is_uid=True, alias="__uid")
+            )
         rows = self._run_block(gq)
         self._enrich_lambda_fields(t, sel.selections, rows)
         return self._add_typename(rows, t, sel.selections)
@@ -866,17 +973,25 @@ class GraphQLServer:
                     f"unknown or keyless type in representation: {tn!r}"
                 )
             by_type.setdefault(tn, []).append(r.get(t.key_field))
-        # resolve each type batch, then reorder to match the
-        # representations argument positionally — Apollo merges results
-        # by index (ref resolve/resolver.go entitiesQueryCompletion);
-        # duplicate keys duplicate rows, missing keys yield null
+        # resolve each type batch (fetched orderasc by key, matching the
+        # reference dgquery), then reorder to match the representations
+        # argument positionally — Apollo merges results by index (ref
+        # resolve/resolver.go:322 entitiesQueryCompletion). Duplicate keys
+        # duplicate rows; but if ANY unique key resolved to no row the
+        # reference returns the fetched rows as-is, unordered and
+        # un-padded (resolver.go:394 — "This will end into an error at
+        # the Gateway, so no need to order the result here").
         rows_by_key: Dict[tuple, dict] = {}
+        fetched: List[dict] = []
+        n_unique = 0
         for tn, keyvals in by_type.items():
             t = self.types[tn]
+            n_unique += len(set(keyvals))
             gq = GraphQuery(attr="q")
             gq.func = FuncSpec(
                 name="eq", attr=t.pred(t.key_field), args=keyvals
             )
+            gq.order.append(Order(attr=t.pred(t.key_field)))
             gq.filter = FilterTree(func=FuncSpec(name="type", attr=tn))
             frags = [
                 s
@@ -891,8 +1006,11 @@ class GraphQLServer:
             rows = self._run_block(gq)
             keys_ = [r.pop("__key", None) for r in rows]
             self._add_typename(rows, t, sels)
+            fetched.extend(rows)
             for k, r in zip(keys_, rows):
                 rows_by_key[(tn, k)] = r
+        if len(fetched) < n_unique:
+            return fetched
         out: List[Optional[dict]] = []
         for r in reps:
             tn = r.get("__typename")
@@ -981,10 +1099,8 @@ class GraphQLServer:
         (ref gqlschema.go aggregate type synthesis)."""
         fobj, allowed = self._with_auth_filter(t, sel.args.get("filter"))
         if not allowed:
-            return {
-                s.key: (0 if s.name == "count" else None)
-                for s in sel.selections
-            }
+            # denied aggregate resolves to null (ref `aggregateX()`)
+            return None
         gq = GraphQuery(attr="q")
         gq.func = FuncSpec(name="type", attr=t.name)
         gq.filter = self._filter_tree(t, fobj)
@@ -1189,7 +1305,119 @@ class GraphQLServer:
                 out[s.key] = rows
         return out
 
-    def _set_field(self, txn, t: GqlType, uid: int, f: GqlField, value, op=OP_SET):
+    # -- mutation write path (ref graphql/resolve/mutation_rewriter.go) --
+
+    def _edge_targets(self, txn, uid: int, attr: str) -> List[int]:
+        from dgraph_tpu.x import keys as _keys
+
+        return [
+            int(u)
+            for u in txn.cache.uids(_keys.DataKey(attr, uid))
+        ]
+
+    def _node_types(self, txn, uid: int) -> set:
+        from dgraph_tpu.x import keys as _keys
+
+        tkey = _keys.DataKey("dgraph.type", uid)
+        return {str(p.val().value) for p in txn.cache.values(tkey)}
+
+    def _node_is(self, txn, uid: int, t: GqlType) -> bool:
+        tys = self._node_types(txn, uid)
+        if t.name in tys:
+            return True
+        return t.kind == "interface" and any(
+            m in tys for m in t.implementers
+        )
+
+    def _xid_lookup(self, txn, pred: str, value) -> List[int]:
+        ex = Executor(txn.cache, self.engine.schema)
+        found = ex._runner().run_root(
+            FuncSpec(name="eq", attr=pred, args=[value])
+        )
+        return [int(u) for u in found]
+
+    def _write_ref_edge(
+        self, txn, t: GqlType, uid: int, f: GqlField, target: int, op=OP_SET
+    ):
+        """Write uid -[t.f]-> target keeping @hasInverse pairs coherent:
+        the inverse edge is written too, and when either side is
+        single-valued the stale partner edges are removed — exactly the
+        delete set the reference rewriter emits (mutation_rewriter.go
+        addInverseLink + the NOT-uid var cleanup blocks)."""
+        attr = t.pred(f.name)
+        st = self.engine.schema
+        ct = self.types.get(f.type_name)
+        g = (
+            ct.fields.get(f.has_inverse)
+            if (ct is not None and f.has_inverse)
+            else None
+        )
+        if g is not None and op == OP_SET:
+            inv_attr = ct.pred(g.name)
+            if not f.is_list:
+                for old in self._edge_targets(txn, uid, attr):
+                    if old != target:
+                        self._check_additional_delete_auth(txn, ct, old)
+                        apply_edge(
+                            txn, st,
+                            DirectedEdge(old, inv_attr, value_id=uid, op=OP_DEL),
+                        )
+            if not g.is_list:
+                for old_src in self._edge_targets(txn, target, inv_attr):
+                    if old_src != uid:
+                        self._check_additional_delete_auth(txn, t, old_src)
+                        apply_edge(
+                            txn, st,
+                            DirectedEdge(
+                                old_src, attr, value_id=target, op=OP_DEL
+                            ),
+                        )
+            apply_edge(
+                txn, st, DirectedEdge(target, inv_attr, value_id=uid, op=op)
+            )
+        elif g is not None and op == OP_DEL:
+            apply_edge(
+                txn, st,
+                DirectedEdge(target, ct.pred(g.name), value_id=uid, op=OP_DEL),
+            )
+        apply_edge(txn, st, DirectedEdge(uid, attr, value_id=target, op=op))
+
+    def _check_additional_delete_auth(self, txn, ct: GqlType, uid: int):
+        """Re-pointing a reference strips the stale edge from a THIRD
+        node — that node must pass its type's update rule (ref
+        update_rewriter additional-deletes authorization:
+        \"couldn't rewrite query for mutation ... because
+        authorization failed\")."""
+        if ct.auth is None or ct.auth.update is None:
+            return
+        from dgraph_tpu.graphql.auth import evaluate
+
+        # deep rules run on the txn snapshot, like every mutation auth
+        # check (the edge being re-pointed may already be in this txn)
+        auth = evaluate(
+            ct.auth.update,
+            self._claims(),
+            rule_runner=lambda r, c: self._run_auth_rule(
+                r, c, cache=txn.cache
+            ),
+        )
+        if auth is True:
+            return
+        ok = (
+            set()
+            if auth is False
+            else self._auth_allowed_uids(ct, auth, [uid], cache=txn.cache)
+        )
+        if uid not in ok:
+            raise GraphQLError(
+                "couldn't rewrite query for mutation because "
+                "authorization failed"
+            )
+
+    def _set_field(
+        self, txn, t: GqlType, uid: int, f: GqlField, value,
+        op=OP_SET, ctx=None,
+    ):
         attr = t.pred(f.name)
         if f.is_embedding:
             edge = DirectedEdge(
@@ -1200,14 +1428,18 @@ class GraphQLServer:
             return
         if not f.is_scalar:
             ct = self.types[f.type_name]
-            for obj in _as_list(value):
+            for i, obj in enumerate(_as_list(value)):
                 if ct.kind == "union":
                     # union ref input: {dogRef: {...}} names the member
                     # (ref gqlschema.go union ref input synthesis)
                     if len(obj) != 1:
+                        where = (
+                            f"index `{i}`" if isinstance(value, list) else ""
+                        )
                         raise GraphQLError(
-                            f"union {ct.name} ref must name exactly one "
-                            f"member, got {sorted(obj)}"
+                            f"value for field `{f.name}` in type "
+                            f"`{t.name}` {where} must have exactly one "
+                            f"child, found {len(obj)} children"
                         )
                     refk, obj = next(iter(obj.items()))
                     if not refk.endswith("Ref") or len(refk) <= 3:
@@ -1221,72 +1453,222 @@ class GraphQLServer:
                             f"bad union ref {refk!r} for {ct.name}"
                         )
                     mt = self.types[mname]
-                    child_uid = self._upsert_object(
-                        txn, mt, obj, getattr(txn, "_created", None)
+                    child_uid = self._resolve_object(
+                        txn, mt, obj, ctx=ctx, for_delete=(op == OP_DEL)
                     )
+                    if child_uid is None:
+                        continue
                     apply_edge(
                         txn,
                         self.engine.schema,
                         DirectedEdge(uid, attr, value_id=child_uid, op=op),
                     )
                     continue
-                child_uid = self._upsert_object(txn, ct, obj, getattr(txn, '_created', None))
-                apply_edge(
-                    txn,
-                    self.engine.schema,
-                    DirectedEdge(uid, attr, value_id=child_uid, op=op),
+                if op == OP_DEL and not isinstance(obj, dict):
+                    continue
+                child_uid = self._resolve_object(
+                    txn, ct, obj, ctx=ctx, for_delete=(op == OP_DEL),
+                    src_field=f,
                 )
-                if f.has_inverse:
-                    apply_edge(
-                        txn,
-                        self.engine.schema,
-                        DirectedEdge(
-                            child_uid,
-                            ct.pred(f.has_inverse),
-                            value_id=uid,
-                            op=op,
-                        ),
-                    )
+                if child_uid is None:
+                    continue
+                self._write_ref_edge(txn, t, uid, f, child_uid, op=op)
             return
+        # @dgraph(pred: "Person.name@hi") fields write the base predicate
+        # with a language tag (ref gqlschema.go language tag fields)
+        lang = ""
+        if "@" in attr:
+            attr, lang = attr.split("@", 1)
         vals = value if (f.is_list and isinstance(value, list)) else [value]
         for v in vals:
+            if v is None:
+                continue
             apply_edge(
                 txn,
                 self.engine.schema,
-                DirectedEdge(uid, attr, value=_to_val(v, f), op=op),
+                DirectedEdge(
+                    uid, attr, value=_to_val(v, f), lang=lang, op=op
+                ),
             )
 
-    def _upsert_object(self, txn, t: GqlType, obj: dict, created=None) -> int:
-        """Create or reference an object: {id: "0x1"} references, otherwise
-        create a new node (with @id dedup)."""
+    def _resolve_object(
+        self, txn, t: GqlType, obj: dict, ctx=None,
+        is_root=False, for_delete=False, src_field=None,
+    ) -> Optional[int]:
+        """Resolve one input object to a uid with the reference's
+        existence semantics (mutation_rewriter.go RewriteQueries +
+        Rewrite): uid refs must exist with the right type; xid refs
+        link when found (extra fields ignored), error on root add
+        (unless upsert, which updates), create otherwise. src_field is
+        the edge we descended through — its inverse field inside obj is
+        ignored (the parent link wins, ref rewriter inverse handling)."""
+        ctx = ctx if ctx is not None else _MutCtx()
+        # a SINGLE-VALUED inverse of the field we came through is
+        # auto-satisfied by the parent link; user values for it are
+        # dropped (ref add/082 goldens — list inverses still process)
+        inv_name = None
+        if src_field is not None and src_field.has_inverse:
+            invf = t.fields.get(src_field.has_inverse)
+            if invf is not None and not invf.is_list:
+                inv_name = src_field.has_inverse
         xf0 = t.xid_field()
-        if set(obj.keys()) == {"id"} and (xf0 is None or xf0.name != "id"):
-            # bare {id} is a uid reference — unless 'id' is this type's
-            # stored @id key (extended federation types), which the xid
-            # path below handles
-            u = _parse_uid(obj["id"])
+        idf = t.id_field()
+        idname = idf.name if idf is not None else None
+        if (
+            idname
+            and obj.get(idname) is not None
+            and (xf0 is None or xf0.name != idname)
+        ):
+            # uid reference (extras, if any, are ignored — the reference
+            # rewrites {postID: "0x123", ...} to a bare uid link)
+            u = _parse_uid(obj[idname])
             if u is None:
-                raise GraphQLError(f"invalid id {obj['id']!r}")
-            return u
-        xf = t.xid_field()
-        if xf and xf.name in obj:
-            # look up existing by xid
-            ex = Executor(txn.cache, self.engine.schema)
-            found = ex._runner().run_root(
-                FuncSpec(
-                    name="eq", attr=t.pred(xf.name), args=[obj[xf.name]]
+                raise GraphQLError(
+                    f"ID argument ({obj[idname]}) was not able to be parsed"
                 )
-            )
-            if len(found):
-                uid = int(found[0])
+            if not self._node_is(txn, u, t):
+                if for_delete:
+                    return None
+                raise GraphQLError(
+                    f'ID "{obj[idname]}" isn\'t a {t.name}'
+                )
+            return u
+        # xid identity
+        xids = [
+            (f, obj[f.name])
+            for f in t.fields.values()
+            if f.is_id and f.name in obj and obj[f.name] is not None
+            and f.name != inv_name
+        ]
+        for f, v in xids:
+            if v == "":
+                raise GraphQLError(
+                    f"encountered an empty value for @id field "
+                    f"`{t.pred(f.name)}`"
+                )
+        # in-request claimed xids: a repeat either links to the new node
+        # or errors (ref xidMetadata.isDuplicateXid)
+        for f, v in xids:
+            key = (t.pred(f.name), str(v))
+            if key not in ctx.claimed:
+                continue
+            prev_uid, prev_obj = ctx.claimed[key]
+            if is_root:
+                raise GraphQLError(f"duplicate XID found: {v}")
+            if src_field is not None and src_field.has_inverse:
+                ct = self.types.get(src_field.type_name)
+                g = ct.fields.get(src_field.has_inverse) if ct else None
+                if g is not None and not g.is_list:
+                    raise GraphQLError(f"duplicate XID found: {v}")
+            stripped = {k: x for k, x in obj.items() if k != inv_name}
+            if (
+                len(stripped) > 1
+                and len(prev_obj) > 1
+                and stripped != prev_obj
+            ):
+                raise GraphQLError(f"duplicate XID found: {v}")
+            return prev_uid
+        found = None
+        for f, v in xids:
+            hits = self._xid_lookup(txn, t.pred(f.name), v)
+            if not hits:
+                continue
+            same = [h for h in hits if t.name in self._node_types(txn, h)]
+            if len(same) > 1:
+                raise GraphQLError(
+                    "multiple nodes found for given xid values, "
+                    "updation not possible"
+                )
+            if not same:
+                # the value lives only on other types' nodes (shared
+                # interface predicate): a conflict iff @id(interface:true)
+                if f.id_interface:
+                    owner = f.owner or t.name
+                    raise GraphQLError(
+                        f"id {v} already exists for field {f.name} in "
+                        f"some other implementing type of interface "
+                        f"{owner}"
+                    )
+                continue
+            hit = same[0]
+            if found is not None and hit != found:
+                raise GraphQLError(
+                    "multiple nodes found for given xid values, "
+                    "updation not possible"
+                )
+            found, found_f, found_v = hit, f, v
+        if for_delete:
+            if not xids:
+                # a remove reference must carry its identity (ref
+                # rewriter: "field name cannot be empty")
+                if xf0 is not None:
+                    raise GraphQLError(
+                        f"field {xf0.name} cannot be empty"
+                    )
+                raise GraphQLError(
+                    f"id is not provided to remove a {t.name} reference"
+                )
+            return found
+        if found is not None:
+            if is_root and not ctx.upsert:
+                raise GraphQLError(
+                    f"id {found_v} already exists for field "
+                    f"{found_f.name} inside type {t.name}"
+                )
+            if is_root and ctx.upsert:
+                ua = ctx.upsert_auth
+                if ua is False:
+                    return found  # denied upsert: silent no-op
+                if isinstance(ua, dict):
+                    ok = self._auth_allowed_uids(t, ua, [found], cache=txn.cache)
+                    if found not in ok:
+                        return found
+                self._apply_update_defaults(txn, t, found, obj, ctx)
+                # every field is (re)written, xids included — the
+                # reference's upsert setjson carries them all
                 for k, v in obj.items():
-                    if k in ("id", xf.name):
+                    if k == idname or v is None:
                         continue
-                    self._set_field(txn, t, uid, t.fields[k], v)
-                return uid
+                    fld = t.fields.get(k)
+                    if fld is None:
+                        raise GraphQLError(f"no field {k!r} on {t.name}")
+                    self._set_field(txn, t, found, fld, v, ctx=ctx)
+                return found
+            # nested reference: link only, extra fields ignored
+            return found
+        if not xids and not is_root and src_field is not None:
+            has_data = any(
+                k for k in obj if k != inv_name
+            )
+            if xf0 is not None and not has_data:
+                # a reference-shaped object with no identity at all
+                raise GraphQLError(
+                    f"field {xf0.name} cannot be empty"
+                )
+        # brand-new node: required (non-null) scalar fields must be
+        # present (or defaulted) on creation
+        for f in t.fields.values():
+            if (
+                f.non_null
+                and f.is_scalar
+                and not f.is_list
+                and f.type_name != "ID"
+                and not f.is_secret
+                and obj.get(f.name) is None  # absent OR explicit null
+                and f.default_add is None
+                and f.name != inv_name
+            ):
+                raise GraphQLError(
+                    f"type {t.name} requires a value for field "
+                    f"{f.name}, but no value present"
+                )
         uid = self.engine.zero.assign_uids(1)
-        if created is not None:
-            created.append(uid)
+        ctx.created.append(uid)
+        for f, v in xids:
+            ctx.claimed[(t.pred(f.name), str(v))] = (
+                uid,
+                {k: x for k, x in obj.items() if k != inv_name},
+            )
         # a node is a member of its type AND every interface it
         # implements (ref mutation_rewriter.go — dgraph.type gets both,
         # so queryCharacter(func: type(Character)) finds Humans)
@@ -1299,42 +1681,110 @@ class GraphQLServer:
                 ),
             )
         for k, v in obj.items():
-            if k == "id" and (xf0 is None or xf0.name != "id"):
+            if k == idname and (xf0 is None or xf0.name != idname):
                 continue  # virtual uid, no predicate — but a stored
                 # @id key named 'id' (extended federation types) writes
+            if k == inv_name:
+                continue  # parent link wins over explicit inverse value
             f = t.fields.get(k)
             if f is None:
                 raise GraphQLError(f"no field {k!r} on {t.name}")
-            self._set_field(txn, t, uid, f, v)
+            if v is None:
+                continue
+            self._set_field(txn, t, uid, f, v, ctx=ctx)
+        # @default(add:) fills fields the input omitted
+        for f in t.fields.values():
+            if f.default_add is not None and obj.get(f.name) is None:
+                self._set_field(
+                    txn, t, uid, f, self._default_value(f.default_add),
+                    ctx=ctx,
+                )
         return uid
 
+    def _default_value(self, spec: str):
+        if spec == "$now":
+            import datetime as _dt
+
+            override = __import__("os").environ.get("DGRAPH_TPU_FAKE_NOW")
+            if override:
+                return override
+            return (
+                _dt.datetime.now(_dt.timezone.utc)
+                .strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3]
+                + "Z"
+            )
+        return spec
+
+    def _apply_update_defaults(self, txn, t: GqlType, uid: int, obj, ctx):
+        """@default(update:) values auto-set on every update of a node
+        (ref mutation_rewriter.go — update patches gain the defaults
+        for fields the patch doesn't name)."""
+        for f in t.fields.values():
+            if f.default_update is not None and f.name not in obj:
+                self._set_field(
+                    txn, t, uid, f,
+                    self._default_value(f.default_update), ctx=ctx,
+                )
+
     def _add(self, t: GqlType, sel: Selection):
-        auth = self._auth(t, "add")
-        if auth is False:
-            raise GraphQLError(f"unauthorized to add {t.name}")
         inputs = _as_list(sel.args.get("input", []))
         txn = self.engine.new_txn()
-        created: List[int] = []
-        txn.txn._created = created  # nested creates counted in numUids
-        uids = [self._upsert_object(txn.txn, t, obj, created) for obj in inputs]
-        if isinstance(auth, dict):
-            # auth filter must reach every new node (post-mutation check,
-            # ref add-rule semantics: newly added nodes are validated)
-            gq = GraphQuery(attr="q")
-            gq.func = FuncSpec(name="uid", args=list(uids))
-            gq.filter = self._filter_tree(t, auth)
-            gq.children = [GraphQuery(attr="uid", is_uid=True)]
-            cache = txn.txn.cache
-            ex = Executor(
-                cache,
-                self.engine.schema,
-                vector_indexes=self.engine.vector_indexes,
+        try:
+            return self._add_in_txn(t, sel, inputs, txn)
+        except Exception:
+            if not txn.finished:
+                txn.discard()  # release the start_ts (zero conflict GC)
+            raise
+
+    def _add_in_txn(self, t: GqlType, sel: Selection, inputs, txn):
+        from dgraph_tpu.graphql.auth import evaluate
+
+        ctx = _MutCtx(upsert=bool(sel.args.get("upsert")))
+        # upserts pre-check the ADD rule against the existing node
+        # (ref: the rewriter's upsert query carries the auth filter —
+        # a denied upsert is a silent no-op, auth_add_test "Upsert Add
+        # Mutation with RBAC false")
+        ctx.upsert_auth = self._auth(t, "add") if ctx.upsert else True
+        created = ctx.created
+        uids = [
+            self._resolve_object(txn.txn, t, obj, ctx=ctx, is_root=True)
+            for obj in inputs
+        ]
+        # post-insert check: every CREATED node must satisfy its own
+        # type's add rule, evaluated on the txn snapshot (ref
+        # mutation resolver authorizeNewNodes — deep creates validate
+        # against their types' rules too)
+        by_type: Dict[str, List[int]] = {}
+        for u in created:
+            for tn in self._node_types(txn.txn, u):
+                ct = self.types.get(tn)
+                if ct is not None and ct.kind == "type":
+                    by_type.setdefault(tn, []).append(u)
+        for tn, us in by_type.items():
+            ct = self.types[tn]
+            if ct.auth is None or ct.auth.add is None:
+                continue
+            auth = evaluate(
+                ct.auth.add,
+                self._claims(),
+                rule_runner=lambda r, c: self._run_auth_rule(
+                    r, c, cache=txn.txn.cache
+                ),
             )
-            nodes = ex.process([gq])
-            ok = {int(u) for u in nodes[0].dest_uids}
-            if not all(u in ok for u in uids):
+            if auth is True:
+                continue
+            ok = (
+                set()
+                if auth is False
+                else self._auth_allowed_uids(
+                    ct, auth, us, cache=txn.txn.cache
+                )
+            )
+            if not all(u in ok for u in us):
                 txn.discard()
-                raise GraphQLError(f"unauthorized to add {t.name}")
+                raise GraphQLError(
+                    "mutation failed because authorization failed"
+                )
         txn.commit()
         self._fire_webhook(t, "add", uids, sel)
         return self._payload(t, sel, uids, len(created))
@@ -1349,24 +1799,113 @@ class GraphQLServer:
     def _update(self, t: GqlType, sel: Selection):
         inp = sel.args.get("input", {})
         fobj, allowed = self._with_auth_filter(t, inp.get("filter"), "update")
-        if not allowed:
-            raise GraphQLError(f"unauthorized to update {t.name}")
-        uids = self._match_filter_uids(t, fobj)
+        # a denied update matches nothing: empty payload, NOT an error
+        # (ref auth_update_test "top level RBAC false": `x as updateLog()`)
+        denied = not allowed
+        # patch-shape validation happens before matching (the reference
+        # rewriter rejects malformed patches even when the filter is
+        # empty — e.g. a remove reference without its identity)
+        self._validate_remove_patch(t, inp.get("remove"))
+        uids = [] if denied else self._match_filter_uids(t, fobj)
         txn = self.engine.new_txn()
-        for uid in uids:
+        try:
+            return self._update_in_txn(t, sel, inp, uids, txn)
+        except Exception:
+            if not txn.finished:
+                txn.discard()
+            raise
+
+    def _update_in_txn(self, t: GqlType, sel, inp, uids, txn):
+        ctx = _MutCtx()
+        from dgraph_tpu.posting.mutation import delete_entity_attr
+
+        # the reference validates the patch at rewrite time, before it
+        # knows what the filter matches — when nothing matches we still
+        # run one discarded "probe" application so malformed patches
+        # (duplicate xids, taken @id values) error identically
+        probe = not uids
+        for uid in uids or [0]:
+            if inp.get("set") or inp.get("remove"):
+                self._apply_update_defaults(
+                    txn.txn, t, uid, inp.get("set") or {}, ctx
+                )
             for k, v in (inp.get("set") or {}).items():
                 f = t.fields.get(k)
                 if f is None:
                     raise GraphQLError(f"no field {k!r}")
-                self._set_field(txn.txn, t, uid, f, v)
+                if v is None:
+                    continue
+                if f.is_id and not isinstance(v, (dict, list)):
+                    # writing an @id value that lives on ANOTHER node is
+                    # rejected (ref update rewriter existence checks)
+                    hits = self._xid_lookup(txn.txn, t.pred(k), v)
+                    if any(h != uid for h in hits):
+                        raise GraphQLError(
+                            f"id {v} already exists for field {k} "
+                            f"inside type {t.name}"
+                        )
+                self._set_field(txn.txn, t, uid, f, v, ctx=ctx)
             for k, v in (inp.get("remove") or {}).items():
                 f = t.fields.get(k)
                 if f is None:
                     raise GraphQLError(f"no field {k!r}")
-                self._set_field(txn.txn, t, uid, f, v, op=OP_DEL)
+                if v is None:
+                    # remove {field: null}: drop the predicate outright
+                    # (ref update rewriter — deletejson value null);
+                    # language-tagged preds store under the base name
+                    attr = t.pred(f.name).split("@", 1)[0]
+                    for tgt in (
+                        self._edge_targets(txn.txn, uid, attr)
+                        if not f.is_scalar
+                        else []
+                    ):
+                        self._write_ref_edge(
+                            txn.txn, t, uid, f, tgt, op=OP_DEL
+                        )
+                    delete_entity_attr(
+                        txn.txn, self.engine.schema, uid, attr
+                    )
+                    continue
+                self._set_field(txn.txn, t, uid, f, v, op=OP_DEL, ctx=ctx)
+        if probe:
+            txn.discard()
+            return self._payload(t, sel, [], 0)
         txn.commit()
-        self._fire_webhook(t, "update", uids, sel)
+        if uids:
+            self._fire_webhook(t, "update", uids, sel)
         return self._payload(t, sel, uids, len(uids))
+
+    def _validate_remove_patch(self, t: GqlType, patch):
+        """A remove reference must carry id or @id identity (ref update
+        rewriter: 'field name cannot be empty')."""
+        for k, v in (patch or {}).items():
+            f = t.fields.get(k)
+            if f is None:
+                raise GraphQLError(f"no field {k!r}")
+            if f.is_scalar or v is None:
+                continue
+            ct = self.types.get(f.type_name)
+            if ct is None or ct.kind == "union":
+                continue
+            idf = ct.id_field()
+            for obj in _as_list(v):
+                if not isinstance(obj, dict):
+                    continue
+                has_id = idf is not None and idf.name in obj
+                has_xid = any(
+                    g.is_id and obj.get(g.name) is not None
+                    for g in ct.fields.values()
+                )
+                if not has_id and not has_xid:
+                    xf0 = ct.xid_field()
+                    if xf0 is not None:
+                        raise GraphQLError(
+                            f"field {xf0.name} cannot be empty"
+                        )
+                    raise GraphQLError(
+                        f"id is not provided to remove a {ct.name} "
+                        f"reference"
+                    )
 
     def _delete(self, t: GqlType, sel: Selection):
         from dgraph_tpu.posting.mutation import delete_entity_attr
@@ -1374,20 +1913,46 @@ class GraphQLServer:
         fobj, allowed = self._with_auth_filter(
             t, sel.args.get("filter"), "delete"
         )
-        if not allowed:
-            raise GraphQLError(f"unauthorized to delete {t.name}")
-        uids = self._match_filter_uids(t, fobj)
+        # denied delete matches nothing (`x as deleteLog()`): no error
+        uids = [] if not allowed else self._match_filter_uids(t, fobj)
         txn = self.engine.new_txn()
+        try:
+            return self._delete_in_txn(t, sel, uids, txn)
+        except Exception:
+            if not txn.finished:
+                txn.discard()
+            raise
+
+    def _delete_in_txn(self, t: GqlType, sel, uids, txn):
+        from dgraph_tpu.posting.mutation import delete_entity_attr
+
         for uid in uids:
             for f in t.fields.values():
                 if f.type_name == "ID":
                     continue
-                delete_entity_attr(
-                    txn.txn, self.engine.schema, uid, t.pred(f.name)
-                )
+                attr = t.pred(f.name)
+                if not f.is_scalar and f.has_inverse:
+                    # unlink the other side of @hasInverse pairs (ref
+                    # delete rewriter: `Post_2 as Author.posts` +
+                    # deletejson {"uid":"uid(Post_2)","Post.author":…})
+                    ct = self.types.get(f.type_name)
+                    g = ct.fields.get(f.has_inverse) if ct else None
+                    if g is not None:
+                        for tgt in self._edge_targets(txn.txn, uid, attr):
+                            apply_edge(
+                                txn.txn,
+                                self.engine.schema,
+                                DirectedEdge(
+                                    tgt, ct.pred(g.name),
+                                    value_id=uid, op=OP_DEL,
+                                ),
+                            )
+                delete_entity_attr(txn.txn, self.engine.schema, uid, attr)
             delete_entity_attr(txn.txn, self.engine.schema, uid, "dgraph.type")
         txn.commit()
-        self._fire_webhook(t, "delete", uids, sel)
+        if uids:
+            # no phantom events for denied/no-match deletes
+            self._fire_webhook(t, "delete", uids, sel)
         return self._payload(t, sel, uids, len(uids))
 
 
@@ -1439,7 +2004,8 @@ def _parse_uid(x):
         u = int(str(x), 0)
     except (ValueError, TypeError):
         return None
-    return u if 0 < u < (1 << 64) else None
+    # 0 is accepted like ParseUint (uid 0 simply matches no node)
+    return u if 0 <= u < (1 << 64) else None
 
 
 def _as_list(x):
@@ -1455,6 +2021,8 @@ def _to_val(v, f: GqlField) -> Val:
     if dtype == "float":
         return Val(TypeID.FLOAT, float(v))
     if dtype == "bool":
+        if isinstance(v, str):
+            return Val(TypeID.BOOL, v.lower() == "true")
         return Val(TypeID.BOOL, bool(v))
     if dtype == "datetime":
         from dgraph_tpu.types.types import parse_datetime

@@ -60,6 +60,11 @@ class GqlField:
     # @dgraph(pred: "...") explicit predicate mapping; "~x" maps the
     # field onto x's reverse edge (ref gqlschema.go dgraph directive)
     dql_pred: str = ""
+    # @default(add:/update: {value}) literals; "$now" = request time
+    default_add: Optional[str] = None
+    default_update: Optional[str] = None
+    # @id(interface: true): unique interface-wide, not just per type
+    id_interface: bool = False
 
     @property
     def dql_type(self) -> str:
@@ -137,7 +142,7 @@ def _extract_type_auth(sdl: str):
     blobs: Dict[str, str] = {}
     out = []
     pos = 0
-    for m in re.finditer(r"\btype\s+(\w+)", sdl):
+    for m in re.finditer(r"\b(?:type|interface)\s+(\w+)", sdl):
         name = m.group(1)
         i = m.end()
         in_str = None  # None | '"' | '"""'
@@ -321,6 +326,11 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
                 dname, dargs = dm.group(1), dm.group(2) or ""
                 if dname == "id":
                     f.is_id = True
+                    # @id(interface: true): unique across ALL types
+                    # implementing the declaring interface (ref
+                    # gqlschema.go idDirective interface arg)
+                    if re.search(r"interface\s*:\s*true", dargs):
+                        f.id_interface = True
                 elif dname == "search":
                     by = re.findall(r"\w+", dargs.split(":", 1)[1]) if ":" in dargs else []
                     f.search = [b.lower() for b in by] or ["__default__"]
@@ -331,6 +341,20 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
                 elif dname == "embedding":
                     f.is_embedding = True
                     f.is_scalar = True
+                elif dname == "default":
+                    # @default(add: {value: "x"}, update: {value: "y"})
+                    # (ref gqlschema.go defaultDirective — values are
+                    # strings, converted by field type; "$now" = now)
+                    am = re.search(
+                        r'add\s*:\s*\{\s*value\s*:\s*"([^"]*)"', dargs
+                    )
+                    um = re.search(
+                        r'update\s*:\s*\{\s*value\s*:\s*"([^"]*)"', dargs
+                    )
+                    if am:
+                        f.default_add = am.group(1)
+                    if um:
+                        f.default_update = um.group(1)
                 elif dname == "custom":
                     from dgraph_tpu.graphql.auth import _parse_gql_object
 
@@ -368,6 +392,23 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
             elif ft is not None and ft.kind == "union":
                 f.is_union = True
                 f.is_scalar = False
+    # @hasInverse pairs are two-way: writing through EITHER side keeps
+    # both edges (ref mutation_rewriter.go addInverseLink). Propagate
+    # BEFORE interface-field inheritance so implementers inherit the
+    # back-pointer, and again after for pairs declared on implementers.
+    def _propagate_inverse():
+        for t in types.values():
+            for f in t.fields.values():
+                if not f.has_inverse or f.is_scalar:
+                    continue
+                ft = types.get(f.type_name)
+                if ft is None:
+                    continue
+                g = ft.fields.get(f.has_inverse)
+                if g is not None and not g.has_inverse:
+                    g.has_inverse = f.name
+
+    _propagate_inverse()
     for t in types.values():
         if t.kind != "type":
             continue
@@ -385,6 +426,32 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
                 g = GqlField(**{**f.__dict__, "search": list(f.search)})
                 g.owner = iname
                 t.fields[f.name] = g
+    _propagate_inverse()
+    # interface @auth rules apply to implementers too, AND-combined
+    # with the type's own rules (ref graphql/schema auth inheritance)
+    from dgraph_tpu.graphql.auth import AuthNode, TypeAuth
+
+    for t in types.values():
+        if t.kind != "type" or not t.interfaces:
+            continue
+        for iname in t.interfaces:
+            it = types.get(iname)
+            if it is None or it.auth is None:
+                continue
+            if t.auth is None:
+                t.auth = TypeAuth()
+            for op in ("query", "add", "update", "delete"):
+                mine = getattr(t.auth, op)
+                theirs = getattr(it.auth, op)
+                if theirs is None:
+                    continue
+                if mine is None:
+                    setattr(t.auth, op, theirs)
+                else:
+                    setattr(
+                        t.auth, op,
+                        AuthNode(kind="and", children=[theirs, mine]),
+                    )
     return types
 
 

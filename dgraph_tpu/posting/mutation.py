@@ -104,6 +104,18 @@ def apply_edge(
 def _apply_uid_edge(txn: Txn, su: SchemaUpdate, edge: DirectedEdge, data_key):
     if edge.value_id is None:
         raise ValueError(f"predicate {edge.attr!r} expects a uid edge")
+    if not su.is_list and edge.op == OP_SET:
+        # single-valued uid predicate: a set REPLACES the target (ref
+        # worker/mutation.go — non-list uid preds hold one value; the
+        # GraphQL rewriter relies on this when re-pointing references)
+        for old in txn.cache.uids(data_key):
+            if int(old) != edge.value_id:
+                txn.cache.add_delta(data_key, Posting(uid=int(old), op=OP_DEL))
+                if su.directive_reverse:
+                    rk = keys.ReverseKey(edge.attr, int(old), edge.ns)
+                    txn.cache.add_delta(
+                        rk, Posting(uid=edge.entity, op=OP_DEL)
+                    )
     p = Posting(uid=edge.value_id, op=edge.op)
     fb, ft = _facet_bytes(edge.facets)
     p.facets, p.facet_types = fb, ft

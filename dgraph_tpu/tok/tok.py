@@ -160,11 +160,13 @@ class NGramTokenizer(Tokenizer):
 
     @staticmethod
     def _shingle(tok: str) -> bytes:
-        if len(tok) < 30:
-            return tok.encode("utf-8")
+        # 30-byte cutoff is in UTF-8 bytes, not chars (ref tok.go:475)
+        raw = tok.encode("utf-8")
+        if len(raw) < 30:
+            return raw
         import hashlib
 
-        return hashlib.blake2b(tok.encode("utf-8"), digest_size=32).digest()
+        return hashlib.blake2b(raw, digest_size=32).digest()
 
     def tokens(self, v: Val, lang: str = "") -> List[bytes]:
         ws = self._analyze(v, lang)

@@ -1,0 +1,94 @@
+"""Extract the reference's @auth-rewriting oracles into auth_cases.json.
+
+Source YAMLs (graphql/resolve/, driven by auth_test.go over
+graphql/e2e/auth/schema.graphql — copied here as auth_schema.graphql):
+  auth_query_test.yaml   — query rewriting with JWT claims → dgquery
+  auth_delete_test.yaml  — delete rewriting → dgquery + dgmutations
+  auth_add_test.yaml     — add + post-mutation auth checks (error cases)
+  auth_update_test.yaml  — update + auth filters (error cases)
+  auth_closed_by_default_*.yaml — no-JWT rejections (closed mode)
+
+The conformance test runs both sides through OUR engine on the same
+seeded world: GraphQL-with-claims on side A, the reference-blessed
+dgquery/dgmutations on side B (query cases compare responses Tier-B
+style; delete cases compare resulting stores). Add/update cases with
+`error` assert rejection; success cases assert acceptance.
+
+Run from repo root: python tests/ref_golden_graphql/extract_auth.py
+"""
+
+import json
+import os
+
+import yaml
+
+REF = "/root/reference/graphql/resolve"
+OUT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "auth_cases.json"
+)
+
+FILES = [
+    ("query", "auth_query_test.yaml", False),
+    ("add", "auth_add_test.yaml", False),
+    ("update", "auth_update_test.yaml", False),
+    ("delete", "auth_delete_test.yaml", False),
+    ("query", "auth_closed_by_default_query_test.yaml", True),
+    ("add", "auth_closed_by_default_add_test.yaml", True),
+    ("update", "auth_closed_by_default_update_test.yaml", True),
+    ("delete", "auth_closed_by_default_delete_test.yaml", True),
+]
+
+
+def _mutations(raw):
+    out = []
+    for m in raw or []:
+        entry = {}
+        if m.get("setjson"):
+            entry["set"] = json.loads(m["setjson"])
+        if m.get("deletejson"):
+            entry["delete"] = json.loads(m["deletejson"])
+        if m.get("cond"):
+            entry["cond"] = m["cond"]
+        out.append(entry)
+    return out
+
+
+def main():
+    cases = []
+    for kind, fname, closed in FILES:
+        raw = yaml.safe_load(open(os.path.join(REF, fname)))
+        stem = fname.replace("_test.yaml", "").replace("auth_", "")
+        for i, c in enumerate(raw):
+            case = {
+                "id": f"auth/{stem}/{i:03d}",
+                "kind": kind,
+                "closed": closed,
+                "name": c["name"],
+                "gqlquery": c["gqlquery"],
+            }
+            jwt = c.get("jwtvar") or c.get("jwtVar")
+            if jwt:
+                case["jwtvar"] = jwt
+            for vk in ("variables", "dgvars"):
+                if c.get(vk):
+                    v = c[vk]
+                    case[vk] = json.loads(v) if isinstance(v, str) else v
+            for k in ("dgquery", "dgquerysec", "authquery", "error"):
+                if c.get(k):
+                    case[k] = (
+                        c[k]["message"]
+                        if isinstance(c[k], dict)
+                        else c[k]
+                    )
+            if c.get("dgmutations"):
+                case["dgmutations"] = _mutations(c["dgmutations"])
+            if c.get("uids"):
+                case["uids"] = json.loads(c["uids"])
+            cases.append(case)
+    with open(OUT, "w") as f:
+        json.dump(cases, f, indent=1)
+    print(f"wrote {len(cases)} cases to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

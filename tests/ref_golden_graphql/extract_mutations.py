@@ -1,0 +1,94 @@
+"""Extract the reference's GraphQL *mutation*-rewriting oracles into
+mutation_cases.json.
+
+Source YAMLs (graphql/resolve/, driven by mutation_test.go
+TestMutationRewriting):
+  add_mutation_test.yaml      — NewAddRewriter cases
+  update_mutation_test.yaml   — NewUpdateRewriter cases
+  delete_mutation_test.yaml   — NewDeleteRewriter cases
+  validate_mutation_test.yaml — schema-validation rejections
+
+Each case pairs a GraphQL mutation with the reference-blessed execution
+plan: `dgquery` (existence / delete-target queries), `dgquerysec` (the
+upsert's query block), `dgmutations` (setjson/deletejson + @if conds),
+and `qnametouid` (which referenced xids/uids the plan assumed to exist).
+
+The conformance test (test_ref_golden_graphql_mut.py) runs both sides
+through OUR engine against the same seeded world — our GraphQL layer on
+one store, the reference's plan (via Txn.upsert_json) on another — and
+compares the resulting graphs modulo uid renaming. Mutation *semantics*
+are therefore checked against the reference without requiring our
+internals to emit byte-identical rewrites.
+
+Run from repo root: python tests/ref_golden_graphql/extract_mutations.py
+mutation_cases.json is checked in so the suite is self-contained.
+"""
+
+import json
+import os
+
+import yaml
+
+REF = "/root/reference/graphql/resolve"
+OUT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "mutation_cases.json"
+)
+
+FILES = [
+    ("add", "add_mutation_test.yaml"),
+    ("update", "update_mutation_test.yaml"),
+    ("delete", "delete_mutation_test.yaml"),
+    ("validate", "validate_mutation_test.yaml"),
+]
+
+
+def _mutations(raw):
+    out = []
+    for m in raw or []:
+        entry = {}
+        if m.get("setjson"):
+            entry["set"] = json.loads(m["setjson"])
+        if m.get("deletejson"):
+            entry["delete"] = json.loads(m["deletejson"])
+        if m.get("cond"):
+            entry["cond"] = m["cond"]
+        out.append(entry)
+    return out
+
+
+def main():
+    cases = []
+    for kind, fname in FILES:
+        raw = yaml.safe_load(open(os.path.join(REF, fname)))
+        for i, c in enumerate(raw):
+            case = {
+                "id": f"mut/{kind}/{i:03d}",
+                "kind": kind,
+                "name": c["name"],
+                "gqlmutation": c["gqlmutation"],
+            }
+            if c.get("gqlvariables"):
+                case["gqlvariables"] = json.loads(c["gqlvariables"])
+            qn = (c.get("qnametouid") or "").strip()
+            if qn:
+                case["qnametouid"] = json.loads(qn)
+            for k in ("dgquery", "dgquerysec"):
+                if c.get(k):
+                    case[k] = c[k]
+            if c.get("dgmutations"):
+                case["dgmutations"] = _mutations(c["dgmutations"])
+            if c.get("dgmutationssec"):
+                case["dgmutationssec"] = _mutations(c["dgmutationssec"])
+            for k in ("error", "error2", "validationerror"):
+                if c.get(k):
+                    case[k] = (
+                        c[k]["message"] if isinstance(c[k], dict) else c[k]
+                    )
+            cases.append(case)
+    with open(OUT, "w") as f:
+        json.dump(cases, f, indent=1)
+    print(f"wrote {len(cases)} cases to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

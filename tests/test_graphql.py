@@ -166,12 +166,50 @@ def test_xid_dedup_on_add(gql):
     gql.execute(
         'mutation { addAuthor(input: [{name: "D", email: "d@d"}]) { numUids } }'
     )
-    gql.execute(
+    # a second add with the same @id errors (ref mutation_rewriter.go
+    # "id ... already exists") unless upsert: true, which updates
+    res = gql.execute(
         'mutation { addAuthor(input: [{name: "D2", email: "d@d"}]) { numUids } }'
+    )
+    assert res.get("errors"), res
+    gql.execute(
+        'mutation { addAuthor(input: [{name: "D2", email: "d@d"}], '
+        "upsert: true) { numUids } }"
     )
     res = gql.execute('query { queryAuthor(filter: {has: ["email"]}) { name } }')
     names = [a["name"] for a in res["data"]["queryAuthor"]]
-    assert names == ["D2"]  # second add updated the same node
+    assert names == ["D2"]  # upsert updated the same node
+
+
+def test_add_rejects_explicit_null_for_required_field(gql):
+    res = gql.execute(
+        'mutation { addAuthor(input: [{name: null, email: "n@n"}]) '
+        "{ numUids } }"
+    )
+    assert res.get("errors"), res
+
+
+def test_union_remove_does_not_create():
+    gql = GraphQLServer(
+        Server(),
+        """
+        type Dog { dname: String! @id }
+        type Cat { cname: String! @id }
+        union Pet = Dog | Cat
+        type Person {
+          id: ID!
+          pname: String
+          pet: Pet
+        }
+        """,
+    )
+    res = gql.execute(
+        "mutation { updatePerson(input: {filter: {}, "
+        'remove: {pet: {dogRef: {dname: "Ghost"}}}}) { numUids } }'
+    )
+    # removing a non-existent union member must not create it
+    q = gql.execute("query { queryDog { dname } }")
+    assert not (q["data"] or {}).get("queryDog"), (res, q)
 
 
 def test_error_envelope(gql):
@@ -254,8 +292,36 @@ def test_decimal_and_hex_ids():
     assert _parse_uid("17") == 17
     assert _parse_uid("0x11") == 17
     assert _parse_uid("alice") is None
-    assert _parse_uid("0") is None
+    # ParseUint accepts 0 (uid 0 just matches nothing) — ref convertIDs
+    assert _parse_uid("0") == 0
+    assert _parse_uid("0x0") == 0
     assert _parse_uid(str(1 << 65)) is None
+
+
+def test_fragment_with_directives_parses():
+    from dgraph_tpu.graphql.parser import parse_operation
+
+    op = parse_operation(
+        "fragment F on Person @include(if: true) { name }\n"
+        "query { queryPerson { ...F } }"
+    )
+    assert op.selections[0].name == "queryPerson"
+    op2 = parse_operation(
+        "query { queryPerson { ...G } }\n"
+        "fragment G on Person @cacheControl(maxAge: 5) { name }"
+    )
+    assert op2.selections[0].name == "queryPerson"
+
+
+def test_ngram_shingle_cutoff_is_utf8_bytes():
+    from dgraph_tpu.tok.tok import NGramTokenizer
+
+    sh = NGramTokenizer._shingle
+    # 29 chars ASCII = 29 bytes: raw
+    assert sh("a" * 29) == b"a" * 29
+    # 29 chars of 2-byte UTF-8 = 58 bytes: hashed (ref tok.go byte compare)
+    assert len(sh("é" * 29)) == 32
+    assert sh("a" * 30) != b"a" * 30
 
 
 def test_mutation_payload_shapes_typename_and_aggregates(gql):
