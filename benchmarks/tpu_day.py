@@ -28,6 +28,8 @@ import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python benchmarks/tpu_day.py` puts only
+    sys.path.insert(0, REPO)  # benchmarks/ on sys.path
 
 
 def run_stage(name, argv, timeout_s, out):
@@ -118,9 +120,18 @@ def main():
             st["scale"]["result"] = json.load(open(j))
 
     results["finished"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-    with open(args.out, "w") as f:
-        json.dump(results, f, indent=1)
-    print(json.dumps({"out": args.out, "stages": list(st)}, indent=1))
+    # provenance + TPU-artifact overwrite guard (VERDICT r4 #2): the
+    # platform is whatever the bench stage actually detected
+    from benchmarks.stamp import guarded_write
+
+    platform = "unknown"
+    bench_res = st.get("bench", {}).get("result") or {}
+    if bench_res.get("platform"):
+        platform = bench_res["platform"]
+    elif "cpu_fallback" in json.dumps(bench_res):
+        platform = "cpu_fallback"
+    wrote = guarded_write(args.out, results, platform)
+    print(json.dumps({"out": wrote, "stages": list(st)}, indent=1))
 
 
 if __name__ == "__main__":

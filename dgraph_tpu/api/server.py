@@ -97,6 +97,46 @@ class TxnHandle:
             self.commit()
         return uids
 
+    def _upsert_prologue(
+        self, query: str, mutation_preds_fn, access_jwt: Optional[str]
+    ):
+        """Shared upsert front half: ACL (READ on query preds, WRITE on
+        mutation preds, JWT namespace) + query execution binding
+        uid/val vars. `mutation_preds_fn` is called only when ACL is on
+        (computing preds means parsing the mutation — skip it for the
+        common unsecured path). Returns (ns, uid_vars, val_vars)."""
+        blocks = dql.parse(query) if query.strip() else []
+        ns = keys.GALAXY_NS
+        if self.server.acl is not None:
+            from dgraph_tpu.acl.acl import READ, WRITE, AclError
+
+            if access_jwt is None:
+                raise AclError("no access token (ACL enabled)")
+            claims = self.server.acl.claims(access_jwt)
+            ns = int(claims.get("namespace", 0))
+            self.server.acl.authorize_preds(
+                access_jwt, _query_preds(blocks), READ, claims=claims
+            )
+            self.server.acl.authorize_preds(
+                access_jwt, sorted(mutation_preds_fn()), WRITE,
+                claims=claims,
+            )
+        uid_vars: Dict[str, List[int]] = {}
+        val_vars: Dict[str, dict] = {}
+        if blocks:
+            ex = Executor(
+                self.txn.cache,
+                self.server.schema,
+                ns=ns,
+                vector_indexes=self.server.vector_indexes,
+            )
+            ex.process(blocks)
+            uid_vars = {
+                k: [int(u) for u in v] for k, v in ex.uid_vars.items()
+            }
+            val_vars = ex.val_vars
+        return ns, uid_vars, val_vars
+
     def upsert(
         self,
         query: str,
@@ -109,46 +149,23 @@ class TxnHandle:
         """Upsert block: run query, substitute uid(v)/val(v) refs in the
         mutation, apply (ref edgraph/server.go:874 buildUpsertQuery +
         dql upsert blocks). `cond` is '@if(eq(len(v), 0))'-style gate."""
-        from dgraph_tpu.query.subgraph import Executor
-
-        blocks = dql.parse(query)
-        ns = keys.GALAXY_NS
-        if self.server.acl is not None:
-            from dgraph_tpu.acl.acl import READ, AclError
+        def mpreds():
             from dgraph_tpu.loaders.rdf import parse_rdf as _prdf
 
-            if access_jwt is None:
-                raise AclError("no access token (ACL enabled)")
-            claims = self.server.acl.claims(access_jwt)
-            ns = int(claims.get("namespace", 0))
-            self.server.acl.authorize_preds(
-                access_jwt, _query_preds(blocks), READ, claims=claims
-            )
-            mpreds = sorted(
-                {nq.predicate for nq in _prdf(set_rdf) + _prdf(del_rdf)}
-            )
-            from dgraph_tpu.acl.acl import WRITE
+            return {
+                nq.predicate for nq in _prdf(set_rdf) + _prdf(del_rdf)
+            }
 
-            self.server.acl.authorize_preds(
-                access_jwt, mpreds, WRITE, claims=claims
-            )
-        ex = Executor(
-            self.txn.cache,
-            self.server.schema,
-            ns=ns,
-            vector_indexes=self.server.vector_indexes,
+        ns, uid_vars, val_vars = self._upsert_prologue(
+            query, mpreds, access_jwt
         )
-        ex.process(blocks)
-        uid_vars = {k: [int(u) for u in v] for k, v in ex.uid_vars.items()}
-        val_vars = ex.val_vars
-
         if cond is not None and not _eval_cond(cond, uid_vars):
             if commit_now:
                 self.commit()
             return {}
 
         out = self.server._apply_rdf_with_vars(
-            self.txn, set_rdf, del_rdf, uid_vars, val_vars
+            self.txn, set_rdf, del_rdf, uid_vars, val_vars, ns=ns
         )
         if commit_now:
             self.commit()
@@ -166,43 +183,19 @@ class TxnHandle:
         mutations applied against those bindings (ref edgraph/server.go
         doQuery with req.Mutations[] — the shape the GraphQL rewriters
         emit, graphql/resolve/mutation_rewriter.go UpsertMutation)."""
-        blocks = dql.parse(query) if query.strip() else []
-        ns = keys.GALAXY_NS
-        if self.server.acl is not None:
-            from dgraph_tpu.acl.acl import READ, WRITE, AclError
-
-            if access_jwt is None:
-                raise AclError("no access token (ACL enabled)")
-            claims = self.server.acl.claims(access_jwt)
-            ns = int(claims.get("namespace", 0))
-            self.server.acl.authorize_preds(
-                access_jwt, _query_preds(blocks), READ, claims=claims
-            )
-            mpreds = sorted(
-                {
-                    p
-                    for m in mutations
-                    for p in (
-                        _json_preds(m.get("set"))
-                        | _json_preds(m.get("delete"))
-                    )
-                }
-            )
-            self.server.acl.authorize_preds(
-                access_jwt, mpreds, WRITE, claims=claims
-            )
-        uid_vars: Dict[str, List[int]] = {}
-        if blocks:
-            ex = Executor(
-                self.txn.cache,
-                self.server.schema,
-                ns=ns,
-                vector_indexes=self.server.vector_indexes,
-            )
-            ex.process(blocks)
-            uid_vars = {
-                k: [int(u) for u in v] for k, v in ex.uid_vars.items()
+        def mpreds():
+            return {
+                p
+                for m in mutations
+                for p in (
+                    _json_preds(m.get("set"))
+                    | _json_preds(m.get("delete"))
+                )
             }
+
+        ns, uid_vars, val_vars = self._upsert_prologue(
+            query, mpreds, access_jwt
+        )
         blanks: Dict[str, int] = {}  # blank-node map SHARED across the
         # request's mutations (ref: one AssignUids per request)
         for m in mutations:
@@ -211,7 +204,7 @@ class TxnHandle:
                 continue
             self.server._apply_json_with_vars(
                 self.txn, m.get("set"), m.get("delete"), uid_vars,
-                ns=ns, blank=blanks,
+                ns=ns, blank=blanks, val_vars=val_vars,
             )
         if commit_now:
             self.commit()
@@ -547,7 +540,8 @@ class Server:
         )
 
     def _apply_rdf_with_vars(
-        self, txn: Txn, set_rdf: str, del_rdf: str, uid_vars, val_vars
+        self, txn: Txn, set_rdf: str, del_rdf: str, uid_vars, val_vars,
+        ns: int = keys.GALAXY_NS,
     ) -> Dict[str, str]:
         """RDF application where subjects/objects may be uid(v) refs and
         values val(v) refs; the mutation fans out over the var's uids
@@ -578,7 +572,7 @@ class Server:
                             self.schema,
                             DirectedEdge(
                                 subj, nq.predicate, value=v,
-                                facets=nq.facets, op=op,
+                                facets=nq.facets, op=op, ns=ns,
                             ),
                         )
                         continue
@@ -587,7 +581,8 @@ class Server:
                     )
                     for obj in objs:
                         self._apply_nquad(
-                            txn, nq, None, op, subj_uid=subj, obj_uid=obj
+                            txn, nq, None, op, subj_uid=subj, obj_uid=obj,
+                            ns=ns,
                         )
 
         apply_all(set_rdf, OP_SET)
@@ -658,6 +653,7 @@ class Server:
     def _apply_json_with_vars(
         self, txn: Txn, set_obj, del_obj, uid_vars,
         ns: int = keys.GALAXY_NS, blank: Optional[Dict[str, int]] = None,
+        val_vars: Optional[Dict[str, dict]] = None,
     ) -> Dict[str, str]:
         """JSON mutations whose uid refs may be upsert vars — the format
         the reference's GraphQL mutation rewriters emit (setjson /
@@ -761,6 +757,17 @@ class Server:
                         elif isinstance(item, dict):
                             for child in walk(item, op):
                                 edge(subj, pred, op, value_id=child)
+                        elif (
+                            isinstance(item, str)
+                            and item.startswith("val(")
+                            and item.endswith(")")
+                        ):
+                            # val(v): per-subject value substitution,
+                            # like the RDF upsert path
+                            vv = (val_vars or {}).get(item[4:-1], {})
+                            got = vv.get(subj)
+                            if got is not None:
+                                edge(subj, pred, op, value=got, lang=lang)
                         else:
                             edge(
                                 subj, pred, op,

@@ -304,6 +304,26 @@ def cmd_debuginfo(args):
     print(f"bundle: {bundle}")
 
 
+def cmd_decrypt(args):
+    """Decrypt an encrypted export/backup file offline (ref
+    dgraph/cmd/decrypt/decrypt.go:47 — enc.GetReader + optional gzip,
+    output re-gzipped)."""
+    import gzip
+
+    from dgraph_tpu.enc import enc
+
+    key = enc.read_key_file(args.encryption_key_file)
+    with open(args.file, "rb") as f:
+        data = f.read()
+    plain = enc.decrypt_stream(data, key)
+    if args.file.lower().endswith(".gz"):
+        plain = gzip.decompress(plain)
+    # the reference writes the output gzip-compressed
+    with gzip.open(args.out, "wb") as out:
+        out.write(plain)
+    print(f"decrypted {args.file} -> {args.out}")
+
+
 def cmd_upgrade(args):
     from dgraph_tpu import tools
 
@@ -457,6 +477,14 @@ def main(argv=None):
     p = sub.add_parser("upgrade", help="apply on-disk layout migrations")
     p.add_argument("-p", required=True)
     p.set_defaults(fn=cmd_upgrade)
+
+    p = sub.add_parser(
+        "decrypt", help="decrypt an encrypted export/backup file"
+    )
+    p.add_argument("-f", "--file", required=True)
+    p.add_argument("-o", "--out", required=True)
+    p.add_argument("--encryption-key-file", required=True)
+    p.set_defaults(fn=cmd_decrypt)
 
     p = sub.add_parser("mcp", help="MCP server on stdio")
     add_p(p)

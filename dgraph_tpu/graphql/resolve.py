@@ -116,6 +116,7 @@ class _MutCtx:
     def __init__(self, upsert: bool = False):
         self.upsert = upsert
         self.upsert_auth = True  # add-rule verdict for upsert pre-checks
+        self.now: Optional[str] = None  # one $now per request
         self.created: List[int] = []
         # (pred, xid-value) -> (new uid, the claiming input object)
         self.claimed: Dict[tuple, tuple] = {}
@@ -143,7 +144,48 @@ class GraphQLServer:
             or os.environ.get("DGRAPH_TPU_LAMBDA_URL", "")
         )
         self._tls = threading.local()  # per-request JWT claims
+        self._validate_remote_customs()  # reject BEFORE mutating schema
         engine.alter(to_dql_schema(self.types))
+
+    def _validate_remote_customs(self):
+        """@custom(http: {graphql: ...}) fields introspect their remote
+        endpoint at schema-update time and reject selections the remote
+        can't serve (ref graphql/schema/remote.go validateRemoteGraphql
+        — errors surface when the schema loads, not at first request).
+        Set DGRAPH_TPU_SKIP_REMOTE_INTROSPECTION=1 to defer (air-gapped
+        loads)."""
+        import os as _os
+
+        if _os.environ.get("DGRAPH_TPU_SKIP_REMOTE_INTROSPECTION") == "1":
+            return
+        from dgraph_tpu.graphql.remote import (
+            RemoteSchemaError,
+            introspect_remote,
+            validate_remote_graphql,
+        )
+
+        cache: Dict[str, dict] = {}
+        for t in self.types.values():
+            for f in t.fields.values():
+                cfg = (f.custom or {}).get("http") or {}
+                gql_op = cfg.get("graphql")
+                if not gql_op:
+                    continue
+                url = cfg.get("url", "")
+                try:
+                    if url not in cache:
+                        cache[url] = introspect_remote(url)
+                    validate_remote_graphql(
+                        cache[url],
+                        gql_op,
+                        f.type_name,
+                        is_batch=cfg.get("mode") == "BATCH",
+                    )
+                except RemoteSchemaError as e:
+                    raise GraphQLError(
+                        f"resolving updateGQLSchema failed because "
+                        f"input:{t.name}.{f.name}: {e}"
+                    ) from e
 
     # ------------------------------------------------------------------
     # Entry
@@ -420,6 +462,45 @@ class GraphQLServer:
         cfg = (f.custom or {}).get("http")
         if not cfg:
             raise GraphQLError(f"@custom field {f.name} has no http config")
+        if cfg.get("graphql"):
+            # remote-graphql mode (ref resolve/http.go graphql path):
+            # POST {query, variables} and unwrap data.<opName>
+            from dgraph_tpu.graphql.remote import _OP_RE
+
+            import re as _re
+
+            op_text = cfg["graphql"]
+            for k, v in sel.args.items():
+                op_text = _re.sub(
+                    rf"\$({k})\b", _json.dumps(v).replace("\\", "\\\\"),
+                    op_text,
+                )
+            # unsupplied optional args: drop `name: $var` pairs rather
+            # than sending literal $var tokens to the remote
+            op_text = _re.sub(r"\w+\s*:\s*\$\w+\s*,?", "", op_text)
+            op_text = _re.sub(r"\(\s*\)", "", op_text)
+            req = urllib.request.Request(
+                cfg.get("url", ""),
+                data=_json.dumps({"query": op_text}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    payload = _json.loads(r.read() or b"null")
+            except Exception as e:
+                raise GraphQLError(
+                    f"@custom graphql call failed: {e}"
+                ) from e
+            if payload.get("errors"):
+                raise GraphQLError(str(payload["errors"]))
+            m = _OP_RE.search(cfg["graphql"])
+            data = (payload.get("data") or {}).get(
+                m.group(2) if m else f.name
+            )
+            if sel.selections and isinstance(data, (dict, list)):
+                return _project(data, sel.selections)
+            return data
         url = cfg.get("url", "")
         for k, v in sel.args.items():
             url = url.replace(f"${k}", urllib.parse.quote(str(v)))
@@ -1696,23 +1777,28 @@ class GraphQLServer:
         for f in t.fields.values():
             if f.default_add is not None and obj.get(f.name) is None:
                 self._set_field(
-                    txn, t, uid, f, self._default_value(f.default_add),
-                    ctx=ctx,
+                    txn, t, uid, f,
+                    self._default_value(f.default_add, ctx), ctx=ctx,
                 )
         return uid
 
-    def _default_value(self, spec: str):
+    def _default_value(self, spec: str, ctx=None):
         if spec == "$now":
+            # ONE timestamp per mutation request (the reference stamps
+            # the request time, not per-field wall clocks)
+            if ctx is not None and ctx.now is not None:
+                return ctx.now
             import datetime as _dt
+            import os as _os
 
-            override = __import__("os").environ.get("DGRAPH_TPU_FAKE_NOW")
-            if override:
-                return override
-            return (
+            now = _os.environ.get("DGRAPH_TPU_FAKE_NOW") or (
                 _dt.datetime.now(_dt.timezone.utc)
                 .strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3]
                 + "Z"
             )
+            if ctx is not None:
+                ctx.now = now
+            return now
         return spec
 
     def _apply_update_defaults(self, txn, t: GqlType, uid: int, obj, ctx):
@@ -1723,7 +1809,7 @@ class GraphQLServer:
             if f.default_update is not None and f.name not in obj:
                 self._set_field(
                     txn, t, uid, f,
-                    self._default_value(f.default_update), ctx=ctx,
+                    self._default_value(f.default_update, ctx), ctx=ctx,
                 )
 
     def _add(self, t: GqlType, sel: Selection):

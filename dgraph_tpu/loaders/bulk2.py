@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import heapq
 import os
-import pickle
 import re
 import struct
 import tempfile
@@ -37,6 +36,8 @@ from dgraph_tpu.loaders.rdf import parse_rdf
 from dgraph_tpu.posting.pl import (
     OP_SET,
     Posting,
+    decode_posting_bytes,
+    encode_posting_bytes,
     encode_rollup,
     lang_uid,
     rollup_writes,
@@ -47,7 +48,7 @@ from dgraph_tpu.types.types import TypeID, Val, convert, to_binary
 from dgraph_tpu.x import keys
 
 _K_UID = 0  # payload: 8B target uid (data/reverse uid edge)
-_K_VAL = 1  # payload: pickled Posting
+_K_VAL = 1  # payload: wire-encoded Posting (pl.encode_posting_bytes)
 _K_IDX = 2  # payload: 8B uid (index entry)
 
 _REC = struct.Struct("<HBI")  # klen, kind, plen
@@ -197,11 +198,10 @@ def _map_chunk(args) -> dict:
                 st.add(
                     keys.DataKey(attr, subj, ns),
                     _K_VAL,
-                    pickle.dumps(
+                    encode_posting_bytes(
                         Posting(
                             uid=obj, op=OP_SET, facets=fb, facet_types=ft
-                        ),
-                        protocol=4,
+                        )
                     ),
                 )
             if su.directive_reverse:
@@ -214,12 +214,11 @@ def _map_chunk(args) -> dict:
                     st.add(
                         keys.ReverseKey(attr, obj, ns),
                         _K_VAL,
-                        pickle.dumps(
+                        encode_posting_bytes(
                             Posting(
                                 uid=subj, op=OP_SET, facets=fb,
                                 facet_types=ft,
-                            ),
-                            protocol=4,
+                            )
                         ),
                     )
             continue
@@ -249,7 +248,7 @@ def _map_chunk(args) -> dict:
         st.add(
             keys.DataKey(attr, subj, ns),
             _K_VAL,
-            pickle.dumps(post, protocol=4),
+            encode_posting_bytes(post),
         )
         for tokb in build_tokens(stored, su.tokenizer_objs()):
             st.add(
@@ -324,7 +323,205 @@ class ParallelBulkLoader:
     def load_text(self, text: str) -> int:
         return self.load_texts([text])
 
+    # -- native pipeline ------------------------------------------------------
+
+    # tokenizers the C++ fast path emits itself (tok/tok.py identifier
+    # bytes); predicates with any OTHER tokenizer are withheld from the
+    # native pred table so their lines take the Python slow path
+    _NATIVE_TOKS = {
+        "term": 0x1, "exact": 0x2, "year": 0x4, "month": 0x41,
+        "day": 0x42, "hour": 0x43, "int": 0x6, "float": 0x7,
+        "fulltext": 0x8, "bool": 0x9,
+    }
+    # (PASSWORD is excluded: conversion bcrypt-hashes the value)
+    _NATIVE_TYPES = {
+        TypeID.DEFAULT, TypeID.STRING, TypeID.UID, TypeID.INT,
+        TypeID.FLOAT, TypeID.BOOL, TypeID.DATETIME,
+    }
+
+    def _native_ok(self) -> bool:
+        from dgraph_tpu import native
+
+        if not getattr(native, "NATIVE_AVAILABLE", False):
+            return False
+        if os.environ.get("DGRAPH_TPU_BULK_NATIVE", "1") != "1":
+            return False
+        # vector predicates feed the similarity engine through the
+        # Python reduce — keep the whole load on the Python path
+        return not any(
+            getattr(self.server.schema.get(p), "vector_specs", None)
+            for p in self.server.schema.predicates()
+        )
+
+    def _native_push_preds(self, lib, ctx):
+        import ctypes
+
+        lib.bulk_clear_preds(ctx)
+        for pred in self.server.schema.predicates():
+            su = self.server.schema.get(pred)
+            if su is None or su.value_type not in self._NATIVE_TYPES:
+                continue
+            if su.lang:
+                continue  # @lang values need lang_uid plumbing: slow
+            toks = []
+            exotic = False
+            for t in su.tokenizers or []:
+                tid = self._NATIVE_TOKS.get(t)
+                if tid is None:
+                    exotic = True
+                    break
+                toks.append(tid)
+            if exotic:
+                continue
+            flags = (
+                (1 if su.is_list else 0)
+                | (2 if su.directive_reverse else 0)
+                | (4 if su.count else 0)
+            )
+            nb = pred.encode("utf-8")
+            arr = (ctypes.c_uint8 * len(toks))(*toks)
+            lib.bulk_add_pred(
+                ctx, nb, len(nb), int(su.value_type), flags,
+                arr, len(toks), self.ns,
+            )
+
+    def _load_texts_native(self, texts: List[str]) -> Optional[int]:
+        """C++ map+reduce for the common line shapes; unhandled lines
+        round-trip through the Python mapper into the same run format.
+        Returns the commit ts, or None to fall back entirely (with
+        nquads and temp files restored to their pre-call state)."""
+        import ctypes
+
+        from dgraph_tpu import native
+
+        lib = native._LIB
+        ctx = lib.bulk_new()
+        nquads_before = self.nquads
+        cleanup: List[str] = []
+
+        def fall_back():
+            self.nquads = nquads_before
+            for p in cleanup:
+                try:
+                    os.unlink(p)
+                except FileNotFoundError:
+                    pass
+            return None
+
+        try:
+            blob = "\n".join(texts).encode("utf-8")
+            n_xids = lib.bulk_scan_xids(ctx, blob, len(blob))
+            if n_xids:
+                base = self.server.zero.assign_uids(int(n_xids))
+                lib.bulk_set_base(ctx, base)
+            self._native_push_preds(lib, ctx)
+            slow_path = os.path.join(self.workdir, "slow.rdf")
+            n = lib.bulk_map(
+                ctx, blob, len(blob), self.ns,
+                self.workdir.encode(), slow_path.encode(),
+                self.spill_entries,
+            )
+            cleanup.append(slow_path)
+            if n < 0:
+                return fall_back()
+            self.nquads += int(n)
+            run_paths = []
+            for i in range(lib.bulk_run_count(ctx)):
+                buf = ctypes.create_string_buffer(4096)
+                if lib.bulk_run_path(ctx, i, buf, 4096) <= 0:
+                    # a dropped run would silently lose edges: fall back
+                    return fall_back()
+                run_paths.append(buf.value.decode())
+            cleanup.extend(run_paths)
+
+            # slow lines: Python mapper, same run format
+            slow_text = ""
+            if os.path.exists(slow_path):
+                with open(slow_path) as f:
+                    slow_text = f.read()
+            if slow_text.strip():
+                class _XidView(dict):
+                    def __missing__(_s, name):  # noqa: N805
+                        nb = name.encode("utf-8")
+                        u = lib.bulk_xid_lookup(ctx, nb, len(nb))
+                        if not u:
+                            u = self.server.zero.assign_uids(1)
+                        _s[name] = u
+                        return u
+
+                r = _map_chunk(
+                    (
+                        slow_text, 9999, self.workdir,
+                        self.spill_entries, self.server.schema,
+                        _XidView(), self.ns,
+                    )
+                )
+                self.nquads += r["nquads"]
+                run_paths.extend(r["runs"])
+                cleanup.extend(r["runs"])
+                for pred, tid in r["inferred"].items():
+                    self.server.schema.ensure_default(pred, TypeID(tid))
+                # inferred preds may carry count/reverse defaults the
+                # reduce needs; refresh the native pred table
+                self._native_push_preds(lib, ctx)
+
+            ts = self.server.zero.next_ts()
+            out_main = os.path.join(self.workdir, "reduced.main")
+            out_extra = os.path.join(self.workdir, "reduced.extra")
+            joined = "\n".join(run_paths).encode()
+            max_part = int(
+                os.environ.get("DGRAPH_TPU_MAX_PART_UIDS", 1 << 20)
+            )
+            kv = self.server.kv
+            sst_direct = (
+                hasattr(kv, "ingest_native_sst")
+                and getattr(kv, "enc_key", None) is None
+            )
+            cleanup.extend([out_main, out_extra])
+            if sst_direct:
+                # the reduce emits the SSTable itself — no per-record
+                # Python loop between merge and disk
+                def write_table(path: str, seq_base: int) -> int:
+                    n = lib.bulk_reduce(
+                        ctx, joined, len(joined), max_part,
+                        path.encode(), out_extra.encode(), self.ns,
+                        1, ts, seq_base,
+                    )
+                    if n < 0:
+                        raise RuntimeError("native reduce failed")
+                    return int(n)
+
+                try:
+                    kv.ingest_native_sst(write_table, ts)
+                except RuntimeError:
+                    return fall_back()
+                if os.path.getsize(out_extra) > 0:
+                    self._ingest(_iter_reduced(out_extra, ts), ts)
+            else:
+                nrec = lib.bulk_reduce(
+                    ctx, joined, len(joined), max_part,
+                    out_main.encode(), out_extra.encode(), self.ns,
+                    0, 0, 0,
+                )
+                if nrec < 0:
+                    return fall_back()
+                self._ingest(_iter_reduced(out_main, ts), ts)
+                if os.path.getsize(out_extra) > 0:
+                    self._ingest(_iter_reduced(out_extra, ts), ts)
+            for p in cleanup:
+                try:
+                    os.unlink(p)
+                except FileNotFoundError:
+                    pass
+            return ts
+        finally:
+            lib.bulk_free(ctx)
+
     def load_texts(self, texts: List[str]) -> int:
+        if self._native_ok():
+            ts = self._load_texts_native(texts)
+            if ts is not None:
+                return ts
         xidmap = self._assign_xids(texts)
         chunks = self._chunk(texts)
         jobs = [
@@ -421,7 +618,7 @@ class ParallelBulkLoader:
                     su = server.schema.get(pk.attr) if pk.is_data else None
                     dedup: Dict[int, Posting] = {}
                     for pb in posts:
-                        p: Posting = pickle.loads(pb)
+                        p: Posting = decode_posting_bytes(pb)
                         if (
                             p.is_value
                             and su is not None
@@ -512,6 +709,30 @@ class ParallelBulkLoader:
                 batch = []
         if batch:
             kv.put_batch(batch)
+
+
+def _iter_reduced(path: str, ts: int):
+    """Stream the native reduce output: [u16 klen][key][u32 rlen][rec].
+    A short read mid-record means the reduce output was truncated
+    (disk full / killed writer) — fail loudly, never ingest a prefix
+    silently."""
+    with open(path, "rb", buffering=1 << 22) as f:
+        while True:
+            hdr = f.read(2)
+            if not hdr:
+                return
+            if len(hdr) < 2:
+                raise ValueError(f"truncated reduce output: {path}")
+            (kl,) = struct.unpack("<H", hdr)
+            key = f.read(kl)
+            lenb = f.read(4)
+            if len(key) < kl or len(lenb) < 4:
+                raise ValueError(f"truncated reduce output: {path}")
+            (rl,) = struct.unpack("<I", lenb)
+            rec = f.read(rl)
+            if len(rec) < rl:
+                raise ValueError(f"truncated reduce output: {path}")
+            yield key, ts, rec
 
 
 def bulk_load_parallel(

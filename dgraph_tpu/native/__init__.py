@@ -23,9 +23,16 @@ NATIVE_AVAILABLE = False
 
 
 def _build_and_load() -> Optional[ctypes.CDLL]:
-    src = os.path.join(os.path.dirname(__file__), "codec.cpp")
-    with open(src, "rb") as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    here = os.path.dirname(__file__)
+    srcs = [
+        os.path.join(here, "codec.cpp"),
+        os.path.join(here, "bulkload.cpp"),
+    ]
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
     cache_dir = os.environ.get(
         "DGRAPH_TPU_NATIVE_CACHE",
         os.path.join(tempfile.gettempdir(), "dgraph_tpu_native"),
@@ -36,7 +43,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         tmp = so_path + f".tmp{os.getpid()}"
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-            "-o", tmp, src,
+            "-o", tmp, *srcs,
         ]
         # -march=native unlocks SIMD; retry without it if unsupported
         try:
@@ -88,6 +95,32 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         i64p, i64p, u64p, u64p, i64p, i64p, i64p,
     ]
     lib.sst_scan.restype = i64
+    # bulk-load pipeline (bulkload.cpp)
+    vp = ctypes.c_void_p
+    cp = ctypes.c_char_p
+    lib.bulk_new.restype = vp
+    lib.bulk_free.argtypes = [vp]
+    lib.bulk_scan_xids.argtypes = [vp, cp, i64]
+    lib.bulk_scan_xids.restype = i64
+    lib.bulk_set_base.argtypes = [vp, ctypes.c_uint64]
+    lib.bulk_xid_lookup.argtypes = [vp, cp, i64]
+    lib.bulk_xid_lookup.restype = ctypes.c_uint64
+    lib.bulk_clear_preds.argtypes = [vp]
+    lib.bulk_add_pred.argtypes = [
+        vp, cp, i64, ctypes.c_int, ctypes.c_int, u8p, i64,
+        ctypes.c_uint64,
+    ]
+    lib.bulk_map.argtypes = [vp, cp, i64, ctypes.c_uint64, cp, cp, i64]
+    lib.bulk_map.restype = i64
+    lib.bulk_run_count.argtypes = [vp]
+    lib.bulk_run_count.restype = i64
+    lib.bulk_run_path.argtypes = [vp, i64, cp, i64]
+    lib.bulk_run_path.restype = i64
+    lib.bulk_reduce.argtypes = [
+        vp, cp, i64, ctypes.c_uint64, cp, cp, ctypes.c_uint64,
+        i64, ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    lib.bulk_reduce.restype = i64
     return lib
 
 

@@ -133,6 +133,19 @@ def _enc_posting(p: Posting, out: List[bytes]):
         out.append(fv)
 
 
+def encode_posting_bytes(p: Posting) -> bytes:
+    """One posting in the record wire layout (the bulk loader's spill-run
+    payload format — shared with native/bulkload.cpp)."""
+    out: List[bytes] = []
+    _enc_posting(p, out)
+    return b"".join(out)
+
+
+def decode_posting_bytes(data: bytes) -> Posting:
+    p, _ = _dec_posting(data, 0)
+    return p
+
+
 def _need(data: bytes, pos: int, n: int):
     if pos + n > len(data):
         raise CorruptRecordError(

@@ -34,8 +34,21 @@ import jax.numpy as jnp
 from dgraph_tpu.codec.uidpack import join_segments, split_segments
 from dgraph_tpu.ops import setops
 
-# Below this much total work, numpy wins (dispatch overhead dominates).
-_DEVICE_MIN_TOTAL = int(os.environ.get("DGRAPH_TPU_DEVICE_MIN_TOTAL", 1 << 15))
+# Below this much total work, host kernels win (dispatch overhead
+# dominates). Default is backend-aware per tune_thresholds.py captures:
+# on the CPU backend XLA dispatch NEVER beats the native host kernels
+# (TUNE_THRESHOLDS_CPU.json: host <=855us vs device >=9.3ms at every
+# size, crossover None), so CPU — whether requested via JAX_PLATFORMS
+# or jax's silent no-accelerator fallback — keeps everything on host;
+# the 1<<15 TPU default stands until a tunnel-up capture retunes it.
+# Resolved lazily: jax.default_backend() initializes the backend, which
+# must not happen at import time (the axon tunnel may hang).
+# env semantics kept from earlier rounds: setting 0 means "always use
+# the device" (total < 0 was never true); unset means backend-aware auto
+_env_min_total = os.environ.get("DGRAPH_TPU_DEVICE_MIN_TOTAL")
+_DEVICE_MIN_TOTAL = (
+    0 if _env_min_total is None else max(1, int(_env_min_total))
+)
 # A shared operand at/above this size is row-sharded over the device mesh
 # (multi-part list data plane) when >1 device is visible.
 _SHARD_MIN_B = int(os.environ.get("DGRAPH_TPU_SHARD_MIN_B", 1 << 22))
@@ -148,6 +161,29 @@ class SetOpDispatcher:
         self.device_cache = DeviceCache()
         self._device_state: Optional[bool] = None  # None=unknown
 
+    def _min_total(self) -> int:
+        """Backend-aware device threshold, resolved WITHOUT triggering
+        backend init (that belongs to _device_ready's watchdog): env
+        override first; explicit cpu platform pins host kernels
+        (TUNE_THRESHOLDS_CPU.json: XLA-CPU never beats the native host
+        loops); an unprobed backend uses the TPU default so small ops
+        stay host-side and never force init; once the probe has run,
+        a cpu default_backend (jax's silent no-accelerator fallback)
+        also pins host kernels."""
+        if _DEVICE_MIN_TOTAL:
+            return _DEVICE_MIN_TOTAL
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            return 1 << 62
+        if self._device_state is None:
+            return 1 << 15  # not probed yet: don't init the backend here
+        if not self._device_state:
+            return 1 << 62  # device dead: everything host-side
+        try:
+            backend = jax.default_backend()  # safe: probe initialized it
+        except Exception:
+            return 1 << 62
+        return (1 << 62) if backend == "cpu" else (1 << 15)
+
     def _device_ready(self) -> bool:
         """Failure detection for the accelerator: the first device use
         probes backend init under a watchdog. A remote-TPU tunnel that is
@@ -212,7 +248,7 @@ class SetOpDispatcher:
             return []
         total = sum(len(r) for r in rows) + len(b)
         if (
-            not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL
+            not _FORCE_DEVICE and total < self._min_total()
         ) or not self._device_ready():
             if op in ("intersect", "difference") and len(rows) > 4:
                 # vectorized host fallback: ONE searchsorted over the
@@ -324,7 +360,7 @@ class SetOpDispatcher:
             # air (the uid_in reverse fan-out shape at 5M+ scale)
             return np.unique(np.concatenate(parts))
         if (
-            not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL
+            not _FORCE_DEVICE and total < self._min_total()
         ) or not self._device_ready():
             if op == "union" and len(parts) > 4:
                 return np.unique(np.concatenate(parts))
@@ -449,7 +485,7 @@ class SetOpDispatcher:
             return []
         total = sum(len(a) + len(b) for a, b in pairs)
         if (
-            not _FORCE_DEVICE and total < _DEVICE_MIN_TOTAL
+            not _FORCE_DEVICE and total < self._min_total()
         ) or not self._device_ready():
             return [_np_op(op, a, b) for a, b in pairs]
         return self._run_pairs_device(op, pairs)

@@ -962,8 +962,51 @@ class LsmKV(KV):
                 self._seq = base + n
 
             _SSTable.write(path, with_seq(), self.enc_key)
+            if self._seq == base:
+                # empty stream: an entry-less table would satisfy no
+                # lookup yet shadow older tables in get() — drop it
+                os.unlink(path)
+                return
             self._tables.insert(0, _SSTable(path, self.enc_key))
             self._save_manifest()
+
+    def ingest_native_sst(self, write_table, ts: int) -> int:
+        """Bulk-ingest seam for the native reduce (native/bulkload.cpp):
+        `write_table(path, seq_base) -> n` writes a complete SSTable in
+        the _SSTable layout directly; we allocate the seq range and
+        register the finished table. Unencrypted stores only — callers
+        gate on enc_key."""
+        if self.enc_key is not None:
+            raise ValueError("native SSTable ingest requires no enc_key")
+        with self._mu:
+            self._seq += 1
+            base = self._seq
+            name = f"sst_{base:016x}i.tbl"
+            path = os.path.join(self.dir, name)
+            try:
+                n = write_table(path, base)
+            except Exception:
+                self._seq = base - 1  # roll back the seq reservation
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+                raise
+            if n <= 0:
+                # same empty-stream rule as ingest_sorted: an entry-less
+                # table would shadow older tables in get()
+                self._seq = base - 1
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+                return 0
+            self._seq = base + n
+            if ts > self._max_ts:
+                self._max_ts = ts
+            self._tables.insert(0, _SSTable(path, self.enc_key))
+            self._save_manifest()
+            return n
 
     def mut_seq(self) -> int:
         """Global mutation counter: bumps on every write (put/markers/

@@ -105,6 +105,57 @@ class ExactTokenizer(Tokenizer):
         return self._wrap([str(v.value).encode("utf-8")])
 
 
+_CJK_LANGS = frozenset(("zh", "ja", "ko"))
+
+
+def _is_cjk(ch: str) -> bool:
+    o = ord(ch)
+    return (
+        0x4E00 <= o <= 0x9FFF      # CJK unified ideographs
+        or 0x3400 <= o <= 0x4DBF   # extension A
+        or 0x3040 <= o <= 0x30FF   # hiragana + katakana
+        or 0xAC00 <= o <= 0xD7AF   # hangul syllables
+        or 0xF900 <= o <= 0xFAFF   # compatibility ideographs
+    )
+
+
+def _has_cjk(s: str) -> bool:
+    return any(_is_cjk(c) for c in s)
+
+
+def _cjk_terms(text: str):
+    """bleve cjk_bigram semantics: each run of CJK characters emits
+    overlapping bigrams (a lone character emits itself); intervening
+    non-CJK segments tokenize as plain lowercase words."""
+    out = []
+    run: List[str] = []
+    other: List[str] = []
+
+    def flush_run():
+        if len(run) == 1:
+            out.append(run[0])
+        else:
+            for i in range(len(run) - 1):
+                out.append(run[i] + run[i + 1])
+        run.clear()
+
+    def flush_other():
+        if other:
+            out.extend(_word_re.findall(_normalize("".join(other))))
+            other.clear()
+
+    for ch in text:
+        if _is_cjk(ch):
+            flush_other()
+            run.append(ch)
+        else:
+            flush_run() if run else None
+            other.append(ch)
+    flush_run() if run else None
+    flush_other()
+    return out
+
+
 class FulltextTokenizer(Tokenizer):
     """Language-aware full-text analysis (ref tok.go FullTextTokenizer:
     per-@lang bleve analyzers; LangBase resolution). English stems with
@@ -118,8 +169,16 @@ class FulltextTokenizer(Tokenizer):
     def tokens(self, v: Val, lang: str = "") -> List[bytes]:
         from dgraph_tpu.tok.stemmers import REGISTRY, lang_base
 
-        words = _word_re.findall(_normalize(str(v.value)))
+        text = str(v.value)
         base = lang_base(lang)
+        if base in _CJK_LANGS or (not base and _has_cjk(text)):
+            # CJK analysis: no stemming/stopwords; ideograph runs index
+            # as overlapping bigrams (bleve's cjk_bigram filter, the
+            # analyzer tok.go selects for zh/ja/ko), other script runs
+            # go through the plain word pipeline
+            toks = {t.encode("utf-8") for t in _cjk_terms(text)}
+            return self._wrap(sorted(toks))
+        words = _word_re.findall(_normalize(text))
         if base and base != "en" and base in REGISTRY:
             stem, stop = REGISTRY[base]
             toks = {

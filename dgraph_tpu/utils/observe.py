@@ -176,11 +176,151 @@ class Tracer:
                 if self._sink is not None:
                     self._sink.write(json.dumps(sp.to_dict()) + "\n")
                     self._sink.flush()
+                if getattr(self, "_otlp", None) is not None:
+                    try:  # never block or raise into the traced path
+                        self._otlp["q"].put_nowait(
+                            self._otlp_span_json(sp)
+                        )
+                    except Exception:
+                        METRICS.inc("otlp_spans_dropped")
             METRICS.observe(f"span_{name}_seconds", sp.end - sp.start)
 
     def recent(self, n: int = 100) -> List[dict]:
         with self._lock:
             return [s.to_dict() for s in list(self.finished)[-n:]]
+
+    # -- OTLP/HTTP export (ref x/metrics.go:610 otlp trace wiring) ------
+
+    def enable_otlp(
+        self, endpoint: str, service_name: str = "dgraph_tpu",
+        batch: int = 64, timeout_s: float = 5.0,
+        flush_interval_s: float = 2.0,
+    ):
+        """Export finished spans to an OTLP/HTTP collector at
+        `endpoint`/v1/traces using the OTLP JSON protobuf mapping —
+        stdlib-only, batched, and drained by a BACKGROUND thread so a
+        slow collector never blocks the traced path (export errors are
+        counted, never raised)."""
+        import queue
+
+        self._otlp = {
+            "endpoint": endpoint.rstrip("/") + "/v1/traces",
+            "service": service_name,
+            "batch": batch,
+            "timeout": timeout_s,
+            "q": queue.Queue(maxsize=8192),
+        }
+
+        def drain():
+            q = self._otlp["q"]
+            pending: List[dict] = []
+            last_post = time.monotonic()
+            while True:
+                try:
+                    sp = q.get(timeout=flush_interval_s)
+                    if sp is None:
+                        break
+                    pending.append(sp)
+                except queue.Empty:
+                    pass  # interval tick
+                while len(pending) < batch:
+                    try:
+                        sp = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if sp is None:
+                        if pending:
+                            self._otlp_post(pending)
+                        return
+                    pending.append(sp)
+                # post only on a full batch or when the flush interval
+                # has elapsed — NOT per span (that defeats batching)
+                if pending and (
+                    len(pending) >= batch
+                    or time.monotonic() - last_post >= flush_interval_s
+                ):
+                    self._otlp_post(pending)
+                    pending = []
+                    last_post = time.monotonic()
+            if pending:
+                self._otlp_post(pending)
+
+        self._otlp_thread = threading.Thread(target=drain, daemon=True)
+        self._otlp_thread.start()
+
+    def otlp_flush(self):
+        """Synchronously export everything queued (tests/shutdown)."""
+        cfg = getattr(self, "_otlp", None)
+        if cfg is None:
+            return
+        import queue
+
+        pending = []
+        while True:
+            try:
+                pending.append(cfg["q"].get_nowait())
+            except queue.Empty:
+                break
+        pending = [p for p in pending if p is not None]
+        if pending:
+            self._otlp_post(pending)
+
+    def _otlp_span_json(self, sp: "Span") -> dict:
+        return {
+            "traceId": f"{sp.trace_id:032x}",
+            "spanId": f"{sp.span_id:016x}",
+            **(
+                {"parentSpanId": f"{sp.parent_id:016x}"}
+                if sp.parent_id is not None
+                else {}
+            ),
+            "name": sp.name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(int(sp.start * 1e9)),
+            "endTimeUnixNano": str(int((sp.end or sp.start) * 1e9)),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in sp.attrs.items()
+            ],
+        }
+
+    def _otlp_post(self, spans: List[dict]):
+        cfg = self._otlp
+        body = json.dumps(
+            {
+                "resourceSpans": [
+                    {
+                        "resource": {
+                            "attributes": [
+                                {
+                                    "key": "service.name",
+                                    "value": {
+                                        "stringValue": cfg["service"]
+                                    },
+                                }
+                            ]
+                        },
+                        "scopeSpans": [
+                            {
+                                "scope": {"name": "dgraph_tpu.tracer"},
+                                "spans": spans,
+                            }
+                        ],
+                    }
+                ]
+            }
+        ).encode()
+        import urllib.request
+
+        req = urllib.request.Request(
+            cfg["endpoint"], data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=cfg["timeout"]).read()
+            METRICS.inc("otlp_spans_exported", len(spans))
+        except Exception:
+            METRICS.inc("otlp_export_errors")
 
 
 TRACER = Tracer()

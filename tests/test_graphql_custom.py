@@ -93,3 +93,166 @@ def test_custom_error_surfaces(gql):
     )
     out = bad.execute("{ broken { id } }")
     assert out["errors"] and "http call failed" in out["errors"][0]["message"]
+
+
+def _stub_remote(schema_types, resolver):
+    """Local stub GraphQL server: answers introspection + one op."""
+    import http.server
+    import json as _json
+    import threading
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            q = _json.loads(self.rfile.read(n))["query"]
+            if "__schema" in q:
+                body = {"data": {"__schema": schema_types}}
+            else:
+                body = resolver(q)
+            out = _json.dumps(body).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+_REMOTE_SCHEMA = {
+    "queryType": {"name": "Query"},
+    "mutationType": None,
+    "types": [
+        {
+            "kind": "OBJECT",
+            "name": "Query",
+            "fields": [
+                {
+                    "name": "getWeather",
+                    "args": [
+                        {
+                            "name": "city",
+                            "type": {
+                                "kind": "NON_NULL",
+                                "name": None,
+                                "ofType": {"kind": "SCALAR", "name": "String"},
+                            },
+                        }
+                    ],
+                    "type": {"kind": "OBJECT", "name": "Weather"},
+                }
+            ],
+        },
+        {
+            "kind": "OBJECT",
+            "name": "Weather",
+            "fields": [
+                {"name": "city", "args": [], "type": {"kind": "SCALAR", "name": "String"}},
+                {"name": "tempC", "args": [], "type": {"kind": "SCALAR", "name": "Int"}},
+            ],
+        },
+        {"kind": "SCALAR", "name": "String", "fields": None},
+        {"kind": "SCALAR", "name": "Int", "fields": None},
+    ],
+}
+
+
+def test_custom_graphql_remote_introspection_validates_and_resolves():
+    """@custom graphql mode: the remote is introspected at schema load
+    (ref graphql/schema/remote.go validateRemoteGraphql) and the op is
+    executed via POST {query} at request time."""
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.graphql import GraphQLServer
+
+    srv = _stub_remote(
+        _REMOTE_SCHEMA,
+        lambda q: {
+            "data": {"getWeather": {"city": "Pune", "tempC": 31}}
+        },
+    )
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}/graphql"
+        sdl = f'''
+        type Weather @remote {{
+          city: String
+          tempC: Int
+        }}
+        type Query {{
+          weather(city: String!): Weather @custom(http: {{
+            url: "{url}",
+            method: "POST",
+            graphql: "query {{ getWeather(city: $city) }}"
+          }})
+        }}
+        '''
+        gql = GraphQLServer(Server(), sdl)
+        res = gql.execute('query { weather(city: "Pune") { city tempC } }')
+        assert not res.get("errors"), res
+        assert res["data"]["weather"] == {"city": "Pune", "tempC": 31}
+    finally:
+        srv.shutdown()
+
+
+def test_custom_graphql_remote_rejects_unknown_op():
+    """A @custom graphql op the remote does not serve is rejected at
+    schema-update time, like the reference."""
+    import pytest
+
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.graphql import GraphQLServer
+
+    srv = _stub_remote(_REMOTE_SCHEMA, lambda q: {"data": {}})
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}/graphql"
+        sdl = f'''
+        type Weather @remote {{
+          city: String
+          tempC: Int
+        }}
+        type Query {{
+          weather(city: String!): Weather @custom(http: {{
+            url: "{url}",
+            method: "POST",
+            graphql: "query {{ getForecast(city: $city) }}"
+          }})
+        }}
+        '''
+        from dgraph_tpu.graphql.resolve import GraphQLError
+
+        with pytest.raises(GraphQLError, match="not present in remote"):
+            GraphQLServer(Server(), sdl)
+    finally:
+        srv.shutdown()
+
+
+def test_custom_graphql_remote_rejects_missing_required_arg():
+    import pytest
+
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.graphql import GraphQLServer
+    from dgraph_tpu.graphql.resolve import GraphQLError
+
+    srv = _stub_remote(_REMOTE_SCHEMA, lambda q: {"data": {}})
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}/graphql"
+        sdl = f'''
+        type Weather @remote {{
+          city: String
+          tempC: Int
+        }}
+        type Query {{
+          weather: Weather @custom(http: {{
+            url: "{url}",
+            method: "POST",
+            graphql: "query {{ getWeather }}"
+          }})
+        }}
+        '''
+        with pytest.raises(GraphQLError, match="required by remote"):
+            GraphQLServer(Server(), sdl)
+    finally:
+        srv.shutdown()

@@ -100,3 +100,75 @@ def test_membership_prune_and_size_rebalance():
         assert out["data"]["q"][0]["heavy"].startswith("x")
     finally:
         c.close()
+
+
+def test_otlp_exporter_posts_spans():
+    """OTLP/HTTP trace export (VERDICT carry: utils/observe.py seam)."""
+    import http.server
+    import json as _json
+    import threading
+
+    from dgraph_tpu.utils.observe import Tracer
+
+    got = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            got.append((self.path, _json.loads(self.rfile.read(n))))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        tr = Tracer()
+        tr.enable_otlp(
+            f"http://127.0.0.1:{srv.server_port}", batch=2,
+            service_name="svc-x",
+        )
+        with tr.span("outer", q="abc"):
+            with tr.span("inner"):
+                pass
+        tr.otlp_flush()  # exporting is async; force anything queued out
+        import time as _time
+
+        deadline = _time.time() + 5
+        while not got and _time.time() < deadline:
+            _time.sleep(0.02)  # drainer may hold the batch briefly
+        assert got, "no OTLP batch received"
+        while (
+            sum(len(b["resourceSpans"][0]["scopeSpans"][0]["spans"]) for _, b in got) < 2
+            and _time.time() < deadline
+        ):
+            _time.sleep(0.02)
+        path, body = got[0]
+        assert path == "/v1/traces"
+        rs = body["resourceSpans"][0]
+        attrs = {
+            a["key"]: a["value"]["stringValue"]
+            for a in rs["resource"]["attributes"]
+        }
+        assert attrs["service.name"] == "svc-x"
+        # spans may arrive across one or two batches
+        spans = [
+            s
+            for _, b in got
+            for s in b["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        ]
+        names = {s["name"] for s in spans}
+        assert names == {"outer", "inner"}
+        inner = next(s for s in spans if s["name"] == "inner")
+        outer = next(s for s in spans if s["name"] == "outer")
+        assert inner["parentSpanId"] == outer["spanId"]
+        assert inner["traceId"] == outer["traceId"]
+        assert int(outer["endTimeUnixNano"]) >= int(
+            outer["startTimeUnixNano"]
+        )
+    finally:
+        srv.shutdown()

@@ -92,7 +92,7 @@ def test_dispatcher_pairs():
 def test_dispatcher_forced_device(monkeypatch):
     import dgraph_tpu.query.dispatch as dispatch
 
-    monkeypatch.setattr(dispatch, "_DEVICE_MIN_TOTAL", 0)
+    monkeypatch.setattr(dispatch, "_DEVICE_MIN_TOTAL", 1)
     rng = np.random.default_rng(22)
     d = dispatch.SetOpDispatcher()
     pairs = [
@@ -134,7 +134,7 @@ def test_native_layer():
 def test_rows_vs_one_shared_operand(monkeypatch):
     import dgraph_tpu.query.dispatch as dispatch
 
-    monkeypatch.setattr(dispatch, "_DEVICE_MIN_TOTAL", 0)
+    monkeypatch.setattr(dispatch, "_DEVICE_MIN_TOTAL", 1)
     rng = np.random.default_rng(31)
     d = dispatch.SetOpDispatcher()
     b = _rand_uids(rng, 2000, hi=1 << 31)
