@@ -93,6 +93,47 @@ def bench_codec(payload: dict) -> dict:
     }
 
 
+def bench_typed(payload: dict) -> dict:
+    """Typed KVList (conn/messages.py, pb wire format) vs the legacy
+    JSON+b64 body for the same record batch — the VERDICT r4 #6 metric:
+    small-record wire_ratio must exceed 1.0 (typed bytes < JSON bytes)."""
+    from dgraph_tpu.conn.messages import KV, KVList
+
+    t0 = time.perf_counter()
+    old_body = json.dumps(_old_jsonize(payload)).encode()
+    t_old_enc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _old_unjsonize(json.loads(old_body))
+    t_old_dec = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    msg = KVList(
+        kv=[KV(key=k, ts=ts, value=v) for k, ts, v in payload["rows"]]
+    )
+    typed_body = msg.encode()
+    t_new_enc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    back = KVList.decode(typed_body)
+    t_new_dec = time.perf_counter() - t0
+    assert len(back.kv) == len(payload["rows"])
+
+    return {
+        "payload_mb": round(
+            sum(len(r[0]) + len(r[2]) for r in payload["rows"]) / 1e6, 1
+        ),
+        "old_wire_mb": round(len(old_body) / 1e6, 2),
+        "typed_wire_mb": round(len(typed_body) / 1e6, 2),
+        "old_enc_s": round(t_old_enc, 3),
+        "old_dec_s": round(t_old_dec, 3),
+        "typed_enc_s": round(t_new_enc, 3),
+        "typed_dec_s": round(t_new_dec, 3),
+        "wire_ratio": round(len(old_body) / len(typed_body), 2),
+        "cpu_speedup": round(
+            (t_old_enc + t_old_dec) / (t_new_enc + t_new_dec), 2
+        ),
+    }
+
+
 def bench_proc_move(n_edges: int) -> dict:
     """A real cross-process predicate move over the live RPC framing."""
     import tempfile
@@ -153,6 +194,10 @@ def main():
         "codec_50mb_zlib": compressed,
         # many-small-records shape (index keys)
         "codec_small_records": bench_codec(tablet_payload(20_000, 64)),
+        # typed control-plane messages (conn/messages.py): the shape
+        # RemoteKV/tablet-move streams actually use now
+        "typed_small_records": bench_typed(tablet_payload(20_000, 64)),
+        "typed_large_records": bench_typed(tablet_payload(2_000, 4096)),
     }
     print(json.dumps(out, indent=1), flush=True)
     if args.move_edges:

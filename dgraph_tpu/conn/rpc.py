@@ -74,7 +74,17 @@ class RpcServer:
                     try:
                         if fn is None:
                             raise RpcError(f"no such method {req.get('m')!r}")
-                        result = fn(req.get("a") or {})
+                        from dgraph_tpu.conn.messages import (
+                            Message,
+                            from_wire,
+                            to_wire,
+                        )
+
+                        args = req.get("a") or {}
+                        typed = from_wire(args)
+                        result = fn(typed if typed is not None else args)
+                        if isinstance(result, Message):
+                            result = to_wire(result)
                         resp = {"id": rid, "r": result}
                     except Exception as e:  # surface to caller, keep serving
                         resp = {"id": rid, "e": f"{type(e).__name__}: {e}"}
@@ -124,6 +134,10 @@ class RpcClient:
         self._rfile = s.makefile("rb")
 
     def call(self, method: str, args: Optional[dict] = None, timeout=None):
+        from dgraph_tpu.conn.messages import Message, from_wire, to_wire
+
+        if isinstance(args, Message):
+            args = to_wire(args)  # typed control-plane message
         with self._lock:
             deadline = time.time() + (timeout or self.timeout)
             last_err: Optional[Exception] = None
@@ -144,7 +158,9 @@ class RpcClient:
                         raise OSError("connection closed")
                     if resp.get("e"):
                         raise RpcError(resp["e"])
-                    return resp.get("r")
+                    r = resp.get("r")
+                    typed = from_wire(r)
+                    return typed if typed is not None else r
                 except (OSError, socket.timeout) as e:
                     last_err = e
                     self.close_conn()

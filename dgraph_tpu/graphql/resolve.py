@@ -471,10 +471,8 @@ class GraphQLServer:
 
             op_text = cfg["graphql"]
             for k, v in sel.args.items():
-                op_text = _re.sub(
-                    rf"\$({k})\b", _json.dumps(v).replace("\\", "\\\\"),
-                    op_text,
-                )
+                lit = _gql_literal(v).replace("\\", "\\\\")
+                op_text = _re.sub(rf"\$({k})\b", lit, op_text)
             # unsupplied optional args: drop `name: $var` pairs rather
             # than sending literal $var tokens to the remote
             op_text = _re.sub(r"\w+\s*:\s*\$\w+\s*,?", "", op_text)
@@ -2123,3 +2121,32 @@ def _to_val(v, f: GqlField) -> Val:
 
         return convert(Val(TypeID.STRING, str(v)), TypeID.PASSWORD)
     return Val(TypeID.STRING, str(v))
+
+
+def _gql_literal(v) -> str:
+    """Render a Python value as a GraphQL literal (NOT JSON: object keys
+    are bare — a remote rejects {"name": ...}). Enum args can't be told
+    apart from strings without the remote arg types, so enum-typed
+    remote args must be passed as GraphQL variables by the schema
+    author (documented limitation, like @custom DQL substitution)."""
+    import json as _json
+
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, (int, float)):
+        return _json.dumps(v)
+    if isinstance(v, str):
+        return _json.dumps(v)
+    if isinstance(v, list):
+        return "[" + ", ".join(_gql_literal(x) for x in v) + "]"
+    if isinstance(v, dict):
+        return (
+            "{"
+            + ", ".join(
+                f"{k}: {_gql_literal(x)}" for k, x in v.items()
+            )
+            + "}"
+        )
+    return _json.dumps(str(v))

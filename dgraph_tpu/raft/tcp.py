@@ -20,6 +20,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from dgraph_tpu.conn.frame import pack_body, unpack_body
+from dgraph_tpu.conn.messages import RaftEnvelope
 from dgraph_tpu.raft.raft import Message
 
 _LEN = struct.Struct(">I")
@@ -60,10 +61,13 @@ class TcpNetwork:
                     if len(body) < n:
                         return
                     try:
-                        d = unpack_body(body)
+                        env = RaftEnvelope.decode(body)
                         msg = Message(
-                            kind=d["k"], frm=d["f"], to=d["t"],
-                            term=d["m"], payload=d["p"],
+                            kind=env.kind, frm=env.frm, to=env.to,
+                            term=env.term,
+                            payload=unpack_body(env.payload)
+                            if env.payload
+                            else {},
                         )
                     except (ValueError, KeyError, TypeError):
                         continue
@@ -105,10 +109,10 @@ class TcpNetwork:
                 self.inboxes[msg.to].append(msg)
             return
         try:
-            body = pack_body(
-                {"k": msg.kind, "f": msg.frm, "t": msg.to,
-                 "m": msg.term, "p": msg.payload}
-            )
+            body = RaftEnvelope(
+                kind=msg.kind, frm=msg.frm, to=msg.to, term=msg.term,
+                payload=pack_body(msg.payload) if msg.payload else b"",
+            ).encode()
             frame = _LEN.pack(len(body)) + body
         except (TypeError, ValueError):
             # an unserializable payload must never kill the tick thread —

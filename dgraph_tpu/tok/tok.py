@@ -171,11 +171,13 @@ class FulltextTokenizer(Tokenizer):
 
         text = str(v.value)
         base = lang_base(lang)
-        if base in _CJK_LANGS or (not base and _has_cjk(text)):
-            # CJK analysis: no stemming/stopwords; ideograph runs index
-            # as overlapping bigrams (bleve's cjk_bigram filter, the
-            # analyzer tok.go selects for zh/ja/ko), other script runs
-            # go through the plain word pipeline
+        if base in _CJK_LANGS:
+            # CJK analysis (tag-driven ONLY — sniffing content would
+            # desync index vs query tokenization for mixed text): no
+            # stemming/stopwords; ideograph runs index as overlapping
+            # bigrams (bleve's cjk_bigram filter, the analyzer tok.go
+            # selects for zh/ja/ko); other script runs go through the
+            # plain word pipeline
             toks = {t.encode("utf-8") for t in _cjk_terms(text)}
             return self._wrap(sorted(toks))
         words = _word_re.findall(_normalize(text))

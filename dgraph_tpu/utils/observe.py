@@ -203,59 +203,69 @@ class Tracer:
         counted, never raised)."""
         import queue
 
-        self._otlp = {
+        cfg = self._otlp = {
             "endpoint": endpoint.rstrip("/") + "/v1/traces",
             "service": service_name,
             "batch": batch,
             "timeout": timeout_s,
             "q": queue.Queue(maxsize=8192),
+            # the drainer's working batch, shared (under lock) so
+            # otlp_flush() can export spans the thread already dequeued
+            "pending": [],
+            "lock": threading.Lock(),
         }
 
         def drain():
-            q = self._otlp["q"]
-            pending: List[dict] = []
+            q = cfg["q"]
             last_post = time.monotonic()
             while True:
                 try:
                     sp = q.get(timeout=flush_interval_s)
                     if sp is None:
                         break
-                    pending.append(sp)
+                    with cfg["lock"]:
+                        cfg["pending"].append(sp)
                 except queue.Empty:
                     pass  # interval tick
-                while len(pending) < batch:
+                while True:
                     try:
                         sp = q.get_nowait()
                     except queue.Empty:
                         break
                     if sp is None:
-                        if pending:
-                            self._otlp_post(pending)
+                        self.otlp_flush()
                         return
-                    pending.append(sp)
+                    with cfg["lock"]:
+                        cfg["pending"].append(sp)
                 # post only on a full batch or when the flush interval
                 # has elapsed — NOT per span (that defeats batching)
-                if pending and (
-                    len(pending) >= batch
-                    or time.monotonic() - last_post >= flush_interval_s
-                ):
-                    self._otlp_post(pending)
-                    pending = []
+                with cfg["lock"]:
+                    due = cfg["pending"] and (
+                        len(cfg["pending"]) >= batch
+                        or time.monotonic() - last_post
+                        >= flush_interval_s
+                    )
+                    spans, cfg["pending"] = (
+                        (cfg["pending"], []) if due else ([], cfg["pending"])
+                    )
+                if spans:
+                    self._otlp_post(spans)
                     last_post = time.monotonic()
-            if pending:
-                self._otlp_post(pending)
+            self.otlp_flush()
 
         self._otlp_thread = threading.Thread(target=drain, daemon=True)
         self._otlp_thread.start()
 
     def otlp_flush(self):
-        """Synchronously export everything queued (tests/shutdown)."""
+        """Synchronously export everything queued AND whatever the
+        drain thread has already dequeued (tests/shutdown)."""
         cfg = getattr(self, "_otlp", None)
         if cfg is None:
             return
         import queue
 
-        pending = []
+        with cfg["lock"]:
+            pending, cfg["pending"] = cfg["pending"], []
         while True:
             try:
                 pending.append(cfg["q"].get_nowait())

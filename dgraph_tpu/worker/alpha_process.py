@@ -26,6 +26,16 @@ import threading
 import time
 from typing import Optional
 
+from dgraph_tpu.conn.messages import (
+    KV,
+    GetRequest,
+    GetResponse,
+    HealthInfo,
+    IterateRequest,
+    KVList,
+    Proposal,
+    ProposalResponse,
+)
 from dgraph_tpu.conn.rpc import RpcServer
 from dgraph_tpu.raft.raft import RaftNode
 from dgraph_tpu.raft.tcp import TcpNetwork
@@ -123,51 +133,71 @@ class AlphaProcess:
         r("kv.iterate", self._h_iterate)
         r("kv.iterate_versions", self._h_iterate_versions)
         r("propose", self._h_propose)
-        r("take_snapshot", lambda a: self.raft.take_snapshot() or {"ok": True})
+        from dgraph_tpu.conn.messages import Ack
+
+        r("take_snapshot", lambda a: self.raft.take_snapshot() or Ack(ok=True))
 
     def _h_health(self, a):
-        return {
-            "ok": True,
-            "node": self.node_id,
-            "group": self.group_id,
-            "is_leader": self.raft.is_leader(),
-            "term": self.raft.term,
-            "applied": self.applied_index,
-        }
+        return HealthInfo(
+            ok=True,
+            node=self.node_id,
+            group=self.group_id,
+            is_leader=self.raft.is_leader(),
+            term=self.raft.term,
+            applied=self.applied_index,
+        )
 
-    def _h_get(self, a):
-        got = self.kv.get(a["key"], int(a["ts"]))
-        return None if got is None else [got[0], got[1]]
+    def _h_get(self, a: GetRequest):
+        got = self.kv.get(a.key, a.ts)
+        if got is None:
+            return GetResponse(found=False)
+        return GetResponse(found=True, ts=got[0], value=got[1])
 
-    def _h_versions(self, a):
-        return [[ts, v] for ts, v in self.kv.versions(a["key"], int(a["ts"]))]
+    def _h_versions(self, a: GetRequest):
+        return KVList(
+            kv=[
+                KV(ts=ts, value=v)
+                for ts, v in self.kv.versions(a.key, a.ts)
+            ]
+        )
 
-    def _h_iterate(self, a):
-        return [
-            [k, ts, v]
-            for k, ts, v in self.kv.iterate(a["prefix"], int(a["ts"]))
-        ]
+    def _h_iterate(self, a: IterateRequest):
+        return KVList(
+            kv=[
+                KV(key=k, ts=ts, value=v)
+                for k, ts, v in self.kv.iterate(a.prefix, a.ts)
+            ]
+        )
 
-    def _h_iterate_versions(self, a):
-        return [
-            [k, [[ts, v] for ts, v in vers]]
-            for k, vers in self.kv.iterate_versions(a["prefix"], int(a["ts"]))
-        ]
+    def _h_iterate_versions(self, a: IterateRequest):
+        # flat KVList; consecutive same-key runs group client-side
+        # (the stream shape of pb.KVS)
+        out = []
+        for k, vers in self.kv.iterate_versions(a.prefix, a.ts):
+            for ts, v in vers:
+                out.append(KV(key=k, ts=ts, value=v))
+        return KVList(kv=out)
 
-    def _h_propose(self, a):
+    def _h_propose(self, a: Proposal):
         """Leader-only append + wait-for-apply (proposeAndWait). Non-leaders
         answer with a leader hint so the coordinator retries there."""
-        data = _as_tuple_data(a["data"])
+        from dgraph_tpu.conn.frame import unpack_body
+
+        req = unpack_body(a.data)
+        data = _as_tuple_data(req["data"])
         if not self.raft.propose(data):
-            return {"ok": False, "leader_hint": self.raft.leader_id}
+            return ProposalResponse(
+                ok=False, error="not leader",
+                leader_hint=self.raft.leader_id or 0,
+            )
         target = self.raft.last_index()
-        deadline = time.time() + float(a.get("timeout", 10.0))
+        deadline = time.time() + float(req.get("timeout", 10.0))
         with self._apply_cv:
             while self.applied_index < target:
                 if not self._apply_cv.wait(timeout=0.1):
                     if time.time() > deadline:
-                        return {"ok": False, "timeout": True}
-        return {"ok": True, "index": target}
+                        return ProposalResponse(ok=False, error="timeout")
+        return ProposalResponse(ok=True, index=target)
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -191,7 +191,7 @@ class ProcCluster:
                 for a in g.addrs:
                     try:
                         h = self.pool.call(a, "health", timeout=1.0)
-                        if h.get("is_leader"):
+                        if h.is_leader:
                             g._leader = tuple(a)
                             g._leader_at = time.time()
                             ok = True
@@ -293,9 +293,15 @@ class ProcCluster:
                 keys.PredicatePrefix(pred),
                 keys.SplitPredicatePrefix(pred),
             ):
-                for k, vers in src.read(
-                    "kv.iterate_versions", {"prefix": prefix, "ts": 1 << 62}
-                ):
+                from dgraph_tpu.conn.messages import IterateRequest
+
+                by_key = {}
+                for r in src.read(
+                    "kv.iterate_versions",
+                    IterateRequest(prefix=prefix, ts=1 << 62),
+                ).kv:
+                    by_key.setdefault(r.key, []).append((r.ts, r.value))
+                for k, vers in by_key.items():
                     for ts, val in reversed(vers):  # oldest first
                         writes.append((bytes(k), int(ts), bytes(val)))
             if writes:

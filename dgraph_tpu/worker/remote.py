@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dgraph_tpu.conn.frame import pack_body
+from dgraph_tpu.conn.messages import GetRequest, IterateRequest, Proposal
 from dgraph_tpu.conn.rpc import RpcError, RpcPool
 from dgraph_tpu.storage.kv import KV
 from dgraph_tpu.x import keys
@@ -49,7 +51,7 @@ class RemoteGroup:
             for a in self.healthy_addrs():
                 try:
                     h = self.pool.call(a, "health", timeout=1.0)
-                    if h.get("is_leader"):
+                    if h.is_leader:
                         self._leader = a
                         self._leader_at = time.time()
                         return a
@@ -68,14 +70,17 @@ class RemoteGroup:
                 continue
             try:
                 out = self.pool.call(
-                    addr, "propose", {"data": data, "timeout": 5.0},
+                    addr, "propose",
+                    Proposal(
+                        data=pack_body({"data": data, "timeout": 5.0})
+                    ),
                     timeout=8.0,
                 )
             except RpcError as e:
                 last = str(e)
                 continue
-            if out.get("ok"):
-                return out
+            if out.ok:
+                return {"ok": True, "index": out.index}
             last = f"not leader / timeout from {addr}: {out}"
             time.sleep(0.05)
         raise TimeoutError(f"proposal to group {self.gid} failed: {last}")
@@ -136,16 +141,18 @@ class RemoteKV(KV):
         g = self._group_for(keys.parse_key(key).attr)
         if g is None:
             return None
-        got = g.read("kv.get", {"key": key, "ts": read_ts})
-        return None if got is None else (got[0], bytes(got[1]))
+        got = g.read("kv.get", GetRequest(key=key, ts=read_ts))
+        return None if not got.found else (got.ts, got.value)
 
     def versions(self, key, read_ts):
         g = self._group_for(keys.parse_key(key).attr)
         if g is None:
             return []
         return [
-            (ts, bytes(v))
-            for ts, v in g.read("kv.versions", {"key": key, "ts": read_ts})
+            (r.ts, r.value)
+            for r in g.read(
+                "kv.versions", GetRequest(key=key, ts=read_ts)
+            ).kv
         ]
 
     def iterate(self, prefix, read_ts):
@@ -158,17 +165,26 @@ class RemoteKV(KV):
         for g in groups:
             if g is None:
                 continue
-            for k, ts, v in g.read(
-                "kv.iterate", {"prefix": prefix, "ts": read_ts}
-            ):
-                yield (bytes(k), ts, bytes(v))
+            for r in g.read(
+                "kv.iterate", IterateRequest(prefix=prefix, ts=read_ts)
+            ).kv:
+                yield (r.key, r.ts, r.value)
 
     def iterate_versions(self, prefix, read_ts):
         for g in self.cluster.remote_groups.values():
-            for k, vers in g.read(
-                "kv.iterate_versions", {"prefix": prefix, "ts": read_ts}
-            ):
-                yield (bytes(k), [(ts, bytes(v)) for ts, v in vers])
+            cur_key = None
+            vers = []
+            for r in g.read(
+                "kv.iterate_versions",
+                IterateRequest(prefix=prefix, ts=read_ts),
+            ).kv:
+                if r.key != cur_key:
+                    if cur_key is not None:
+                        yield (cur_key, vers)
+                    cur_key, vers = r.key, []
+                vers.append((r.ts, r.value))
+            if cur_key is not None:
+                yield (cur_key, vers)
 
     def put(self, key, ts, value):
         raise RuntimeError("RemoteKV is read-only; commit via cluster txns")

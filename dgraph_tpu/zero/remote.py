@@ -10,6 +10,7 @@ worker/zero.go).
 from __future__ import annotations
 
 import threading
+import json
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -34,6 +35,10 @@ class RemoteZero:
 
     # -- rpc plumbing --------------------------------------------------------
 
+    def _state(self, addr) -> dict:
+        got = self.pool.call(addr, "zero.state", timeout=2.0)
+        return json.loads(got.state_json)
+
     def _exec(self, kind: str, *args, timeout: float = 15.0):
         deadline = time.time() + timeout
         last = "no zero leader"
@@ -45,10 +50,17 @@ class RemoteZero:
             )
             for addr in order:
                 try:
+                    from dgraph_tpu.conn.messages import ZeroExec
+
                     out = self.pool.call(
                         addr,
                         "zero.exec",
-                        {"kind": kind, "args": list(args), "timeout": 5.0},
+                        ZeroExec(
+                            op=kind,
+                            args_json=json.dumps(
+                                {"args": list(args), "timeout": 5.0}
+                            ).encode(),
+                        ),
                         timeout=8.0,
                     )
                 except RpcError as e:
@@ -104,7 +116,7 @@ class RemoteZero:
     def max_assigned(self) -> int:
         for addr in self.addrs:
             try:
-                return int(self.pool.call(addr, "zero.state", timeout=2.0)["max_ts"])
+                return int(self._state(addr)["max_ts"])
             except RpcError:
                 continue
         return 0
@@ -114,7 +126,7 @@ class RemoteZero:
         for addr in self.addrs:
             try:
                 return int(
-                    self.pool.call(addr, "zero.state", timeout=2.0)["max_uid"]
+                    self._state(addr)["max_uid"]
                 )
             except RpcError:
                 continue
@@ -163,7 +175,7 @@ class RemoteZero:
         for addr in self.addrs:
             try:
                 return dict(
-                    self.pool.call(addr, "zero.state", timeout=2.0)["tablets"]
+                    self._state(addr)["tablets"]
                 )
             except RpcError:
                 continue

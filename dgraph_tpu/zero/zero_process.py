@@ -76,22 +76,35 @@ class ZeroProcess:
             self._apply_cv.notify_all()
 
     def _h_state(self, a):
-        return {
-            "is_leader": self.raft.is_leader(),
-            "term": self.raft.term,
-            "max_ts": self.sm.max_ts,
-            "max_uid": self.sm.max_uid,
-            "tablets": self.sm.tablets,
-        }
+        from dgraph_tpu.conn.messages import ZeroState
 
-    def _h_exec(self, a):
+        return ZeroState(
+            state_json=json.dumps(
+                {
+                    "is_leader": self.raft.is_leader(),
+                    "term": self.raft.term,
+                    "max_ts": self.sm.max_ts,
+                    "max_uid": self.sm.max_uid,
+                    "tablets": self.sm.tablets,
+                }
+            ).encode()
+        )
+
+    def _h_exec(self, m):
         """Leader-only propose + wait (the coordinator's consensus op)."""
+        from dgraph_tpu.conn.messages import ZeroExec
+
+        if isinstance(m, ZeroExec):
+            a = json.loads(m.args_json)
+            kind = m.op  # the typed field is authoritative
+        else:
+            a = m
+            kind = a["kind"]
         if not self.raft.is_leader():
             return {"not_leader": True, "hint": self.raft.leader_id}
         with self._apply_cv:
             self._req_id += 1
             rid = self._req_id
-        kind = a["kind"]
         args = a.get("args") or []
         # JSON round-trip turns tuples/ints-as-keys; normalize args
         args = [

@@ -312,12 +312,14 @@ def _normalize_pair(ours_data, ref_data):
 
     if not _has_vd(got):
         want = _drop_vd(want)
-    # rewriter helper blocks (checkPwd var fetches etc.) appear in the
-    # dgquery response but have no GraphQL counterpart
-    if "checkPwd" in want and "checkPwd" not in got:
-        want = {k: v for k, v in want.items() if k != "checkPwd"}
-    if set(got) < set(want):
-        want = {k: want[k] for k in got}
+    # rewriter helper blocks appear in the dgquery response but have no
+    # GraphQL counterpart — an EXPLICIT allowlist only (VERDICT r4 #5:
+    # a blanket subset-drop would also hide root fields our resolver
+    # silently failed to return)
+    _HELPER_KEYS = ("checkPwd",)
+    for hk in _HELPER_KEYS:
+        if hk in want and hk not in got:
+            want = {k: v for k, v in want.items() if k != hk}
     # DQL encodes a root aggregate as one single-key object per
     # aggregate child; GraphQL completion merges them and turns a
     # missing count into 0 (ref completeAggregateValues). Apply the
