@@ -272,7 +272,7 @@ class GraphQLServer:
         sel = op.selections[0]
         t = self._type_for(sel.name, ["query", "get"])
         gq = GraphQuery(attr="q")
-        gq.func = FuncSpec(name="type", attr=t.name)
+        gq.func = FuncSpec(name="type", attr=t.stored_name)
         fobj = sel.args.get("filter")
         if fobj:
             gq.filter = self._filter_tree(t, fobj)
@@ -362,9 +362,9 @@ class GraphQLServer:
         values — what __typename must report for interface/union
         results (ref outputnode_graphql.go)."""
         for n in row_types or []:
-            tt = self.types.get(n)
+            tt = self.types.get(n) or self._by_stored().get(n)
             if tt is not None and tt.kind == "type":
-                return n
+                return tt.name
         return fallback
 
     def _add_typename(self, results, t: GqlType, sels: List[Selection]):
@@ -395,10 +395,15 @@ class GraphQLServer:
                     # fragment matched statically; otherwise the row's
                     # dgraph.type list (which includes interfaces)
                     # decides
+                    frag_t = self.types.get(s.frag_on)
                     if (
                         not s.frag_on
                         or row_types is None
                         or s.frag_on in row_types
+                        or (
+                            frag_t is not None
+                            and frag_t.stored_name in row_types
+                        )
                     ):
                         collect(ft, s.selections)
                 elif s.name == "__typename":
@@ -410,10 +415,20 @@ class GraphQLServer:
                 ):
                     if s.key in keep:
                         continue  # already computed (fragment overlap)
+                    base_f = tt.fields[s.name[: -len("Aggregate")]]
+                    ct = self.types.get(base_f.type_name)
+                    if (
+                        ct is not None
+                        and self._auth(ct, "query") is False
+                    ):
+                        # deny-all child auth: null, not count 0 (the
+                        # hidden fetch was never emitted)
+                        row[s.key] = None
+                        keep.setdefault(s.key, (tt, s))
+                        continue
                     items = row.pop(f"__agg_{s.key}", None) or []
                     if not isinstance(items, list):
                         items = [items]
-                    base_f = tt.fields[s.name[: -len("Aggregate")]]
                     row[s.key] = _compute_child_agg(
                         s, items, base_f.type_name
                     )
@@ -731,6 +746,14 @@ class GraphQLServer:
                 if s.args.get("filter") and ct is not None:
                     hidden.filter = self._filter_tree(ct, s.args["filter"])
                 if ct is not None:
+                    if (
+                        not getattr(self._tls, "in_auth_rule", False)
+                        and self._auth(ct, "query") is False
+                    ):
+                        # deny-all child auth: the aggregate resolves
+                        # null, NOT count 0 (ref auth_query_rewriting
+                        # aggregate cases)
+                        continue
                     self._merge_child_auth(ct, hidden)
                 need = set()
                 for a in s.selections:
@@ -838,7 +861,10 @@ class GraphQLServer:
                     f"{mname} is not a member of union {ut.name}"
                 )
             mt = self.types.get(mname)
-            tf = FilterTree(func=FuncSpec(name="type", attr=mname))
+            tf = FilterTree(func=FuncSpec(
+                        name="type",
+                        attr=mt.stored_name if mt else mname,
+                    ))
             sub = fobj.get(mname[0].lower() + mname[1:] + "Filter")
             if sub and mt is not None:
                 inner = self._filter_tree(mt, sub)
@@ -1021,7 +1047,7 @@ class GraphQLServer:
         if not allowed:
             return []
         gq = GraphQuery(attr="q")
-        gq.func = FuncSpec(name="type", attr=t.name)
+        gq.func = FuncSpec(name="type", attr=t.stored_name)
         gq.filter = self._filter_tree(t, fobj)
         self._apply_cascade_dir(t, sel, gq)
         self._apply_order(t, gq, sel.args.get("order") or {})
@@ -1071,7 +1097,9 @@ class GraphQLServer:
                 name="eq", attr=t.pred(t.key_field), args=keyvals
             )
             gq.order.append(Order(attr=t.pred(t.key_field)))
-            gq.filter = FilterTree(func=FuncSpec(name="type", attr=tn))
+            gq.filter = FilterTree(
+                func=FuncSpec(name="type", attr=t.stored_name)
+            )
             frags = [
                 s
                 for s in sel.selections
@@ -1120,7 +1148,7 @@ class GraphQLServer:
         gq.filter = FilterTree(
             op="and",
             children=[
-                FilterTree(func=FuncSpec(name="type", attr=t.name)),
+                FilterTree(func=FuncSpec(name="type", attr=t.stored_name)),
                 FilterTree(
                     func=FuncSpec(
                         name="checkpwd",
@@ -1145,7 +1173,7 @@ class GraphQLServer:
             if u is None:
                 return None
             gq.func = FuncSpec(name="uid", args=[u])
-            gq.filter = FilterTree(func=FuncSpec(name="type", attr=t.name))
+            gq.filter = FilterTree(func=FuncSpec(name="type", attr=t.stored_name))
         else:
             xf = t.xid_field()
             if xf is None or xf.name not in sel.args:
@@ -1181,7 +1209,7 @@ class GraphQLServer:
             # denied aggregate resolves to null (ref `aggregateX()`)
             return None
         gq = GraphQuery(attr="q")
-        gq.func = FuncSpec(name="type", attr=t.name)
+        gq.func = FuncSpec(name="type", attr=t.stored_name)
         gq.filter = self._filter_tree(t, fobj)
         count_keys = [s.key for s in sel.selections if s.name == "count"]
         count_key = count_keys[0] if count_keys else "count"
@@ -1394,6 +1422,15 @@ class GraphQLServer:
             for u in txn.cache.uids(_keys.DataKey(attr, uid))
         ]
 
+    def _by_stored(self) -> dict:
+        """stored dgraph.type name -> GqlType (for @dgraph(type:) maps)."""
+        m = getattr(self, "_stored_map", None)
+        if m is None:
+            m = self._stored_map = {
+                t.stored_name: t for t in self.types.values()
+            }
+        return m
+
     def _node_types(self, txn, uid: int) -> set:
         from dgraph_tpu.x import keys as _keys
 
@@ -1402,10 +1439,12 @@ class GraphQLServer:
 
     def _node_is(self, txn, uid: int, t: GqlType) -> bool:
         tys = self._node_types(txn, uid)
-        if t.name in tys:
+        if t.stored_name in tys:
             return True
         return t.kind == "interface" and any(
-            m in tys for m in t.implementers
+            self.types[m].stored_name in tys
+            for m in t.implementers
+            if m in self.types
         )
 
     def _xid_lookup(self, txn, pred: str, value) -> List[int]:
@@ -1652,7 +1691,11 @@ class GraphQLServer:
             hits = self._xid_lookup(txn, t.pred(f.name), v)
             if not hits:
                 continue
-            same = [h for h in hits if t.name in self._node_types(txn, h)]
+            same = [
+                h
+                for h in hits
+                if t.stored_name in self._node_types(txn, h)
+            ]
             if len(same) > 1:
                 raise GraphQLError(
                     "multiple nodes found for given xid values, "
@@ -1751,7 +1794,14 @@ class GraphQLServer:
         # a node is a member of its type AND every interface it
         # implements (ref mutation_rewriter.go — dgraph.type gets both,
         # so queryCharacter(func: type(Character)) finds Humans)
-        for tyname in [t.name, *t.interfaces]:
+        for tyname in [
+            t.stored_name,
+            *(
+                self.types[i].stored_name
+                for i in t.interfaces
+                if i in self.types
+            ),
+        ]:
             apply_edge(
                 txn,
                 self.engine.schema,
@@ -1841,9 +1891,9 @@ class GraphQLServer:
         by_type: Dict[str, List[int]] = {}
         for u in created:
             for tn in self._node_types(txn.txn, u):
-                ct = self.types.get(tn)
+                ct = self.types.get(tn) or self._by_stored().get(tn)
                 if ct is not None and ct.kind == "type":
-                    by_type.setdefault(tn, []).append(u)
+                    by_type.setdefault(ct.name, []).append(u)
         for tn, us in by_type.items():
             ct = self.types[tn]
             if ct.auth is None or ct.auth.add is None:
@@ -1875,7 +1925,7 @@ class GraphQLServer:
 
     def _match_filter_uids(self, t: GqlType, fobj) -> List[int]:
         gq = GraphQuery(attr="q")
-        gq.func = FuncSpec(name="type", attr=t.name)
+        gq.func = FuncSpec(name="type", attr=t.stored_name)
         gq.filter = self._filter_tree(t, fobj)
         gq.children = [GraphQuery(attr="uid", is_uid=True)]
         return [int(o["uid"], 16) for o in self._run_block(gq)]

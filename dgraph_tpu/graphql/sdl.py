@@ -94,6 +94,12 @@ class GqlType:
     # graphql/schema apollo support; _entities resolver)
     key_field: str = ""
     is_extended: bool = False
+    # @dgraph(type: "...") storage type-name override
+    dgraph_name: str = ""
+
+    @property
+    def stored_name(self) -> str:
+        return self.dgraph_name or self.name
 
     def pred(self, fname: str) -> str:
         """DQL predicate for a field: owner-qualified so interface
@@ -289,6 +295,12 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
             t.key_field = km.group(1)
         if re.search(r"@extends\b", header):
             t.is_extended = True
+        dm = re.search(r'@dgraph\s*\(\s*type:\s*"([^"]+)"', header)
+        if dm:
+            # type T @dgraph(type: "stored.name"): the node type name
+            # in storage differs from the GraphQL name (ref
+            # gqlschema.go dgraph directive on types)
+            t.dgraph_name = dm.group(1)
         sm = re.search(r'@secret\s*\(\s*field:\s*"(\w+)"', header)
         if sm:
             # type T @secret(field: "pwd") stores a hashed password
@@ -426,6 +438,17 @@ def parse_sdl(sdl: str) -> Dict[str, GqlType]:
                 g = GqlField(**{**f.__dict__, "search": list(f.search)})
                 g.owner = iname
                 t.fields[f.name] = g
+    # @dgraph(type: "stored") types default their unmapped fields to
+    # "<stored>.<field>" (ref schemagen.go — the directives e2e data
+    # stores myPost.title for `type Post @dgraph(type: "myPost")`)
+    for t in types.values():
+        for f in t.fields.values():
+            if f.dql_pred or f.type_name == "ID":
+                continue
+            owner = types.get(f.owner) if f.owner else t
+            owner = owner or t
+            if owner.dgraph_name:
+                f.dql_pred = f"{owner.dgraph_name}.{f.name}"
     _propagate_inverse()
     # interface @auth rules apply to implementers too, AND-combined
     # with the type's own rules (ref graphql/schema auth inheritance)
@@ -523,5 +546,5 @@ def to_dql_schema(types: Dict[str, GqlType]) -> str:
             d = (" " + " ".join(directives)) if directives else ""
             lines.append(f"<{pred}>: {type_str}{d} .")
         fl = "\n  ".join(tfields)
-        lines.append(f"type {t.name} {{\n  {fl}\n}}")
+        lines.append(f"type {t.stored_name} {{\n  {fl}\n}}")
     return "\n".join(lines)

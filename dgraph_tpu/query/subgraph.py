@@ -756,8 +756,13 @@ class Executor:
                     vmap[int(u)] for u in parent.dest_uids if int(u) in vmap
                 ]
             else:
-                # aggregate-root (`me() { sum(val(a)) }`): the whole map
+                # aggregate-root (`me() { sum(val(a)) }`): the whole map;
+                # a broadcast scalar (`c as count(uid)`, keyed MAXUID
+                # only) IS the value to aggregate (ref auth rewrites:
+                # `ProjectAggregateResult.count : max(val(countVar))`)
                 xs = [v for u, v in vmap.items() if u != MAXUID]
+                if not xs and MAXUID in vmap:
+                    xs = [vmap[MAXUID]]
             agg = _agg_vals(cgq.aggregator, xs)
             cnode.agg_scalar = True  # type: ignore[attr-defined]
             if agg is not None:
