@@ -1049,6 +1049,15 @@ class GraphQLServer:
         gq = GraphQuery(attr="q")
         gq.func = FuncSpec(name="type", attr=t.stored_name)
         gq.filter = self._filter_tree(t, fobj)
+        if t.kind == "interface" and not getattr(
+            self._tls, "in_auth_rule", False
+        ):
+            pre = self._match_filter_uids(t, fobj, "query")
+            if pre is not None:
+                # implementer auth applies BEFORE pagination (the
+                # reference injects it into the query itself)
+                gq.func = FuncSpec(name="uid", args=pre)
+                gq.filter = None
         self._apply_cascade_dir(t, sel, gq)
         self._apply_order(t, gq, sel.args.get("order") or {})
         gq.first = sel.args.get("first")
@@ -1196,7 +1205,20 @@ class GraphQLServer:
             )
         self._apply_cascade_dir(t, sel, gq)
         gq.children = self._selection_children(t, sel.selections)
+        if not any(c.alias == "__uid" for c in gq.children):
+            gq.children.append(
+                GraphQuery(attr="uid", is_uid=True, alias="__uid")
+            )
         res = self._run_block(gq)
+        if (
+            res
+            and t.kind == "interface"
+            and not getattr(self._tls, "in_auth_rule", False)
+        ):
+            # getX through an interface honors implementer auth too
+            u = int(res[0].get("__uid", "0x0"), 16)
+            if not self._apply_interface_auth(t, [u], "query"):
+                return None
         self._enrich_lambda_fields(t, sel.selections, res)
         self._add_typename(res, t, sel.selections)
         return res[0] if res else None
@@ -1211,6 +1233,12 @@ class GraphQLServer:
         gq = GraphQuery(attr="q")
         gq.func = FuncSpec(name="type", attr=t.stored_name)
         gq.filter = self._filter_tree(t, fobj)
+        if t.kind == "interface" and not getattr(
+            self._tls, "in_auth_rule", False
+        ):
+            pre = self._match_filter_uids(t, fobj, "query")
+            gq.func = FuncSpec(name="uid", args=pre)
+            gq.filter = None
         count_keys = [s.key for s in sel.selections if s.name == "count"]
         count_key = count_keys[0] if count_keys else "count"
         gq.children = [GraphQuery(attr="uid", is_count=True, alias=count_key)]
@@ -1923,12 +1951,78 @@ class GraphQLServer:
         self._fire_webhook(t, "add", uids, sel)
         return self._payload(t, sel, uids, len(created))
 
-    def _match_filter_uids(self, t: GqlType, fobj) -> List[int]:
+    def _match_filter_uids(
+        self, t: GqlType, fobj, op: str = "query"
+    ) -> List[int]:
         gq = GraphQuery(attr="q")
         gq.func = FuncSpec(name="type", attr=t.stored_name)
         gq.filter = self._filter_tree(t, fobj)
         gq.children = [GraphQuery(attr="uid", is_uid=True)]
-        return [int(o["uid"], 16) for o in self._run_block(gq)]
+        uids = [int(o["uid"], 16) for o in self._run_block(gq)]
+        return self._apply_interface_auth(t, uids, op)
+
+    def _apply_interface_auth(
+        self, t: GqlType, uids: List[int], op: str
+    ) -> List[int]:
+        """Operating on an INTERFACE applies the implementing types'
+        own auth rules with OR semantics (ref auth rewriting:
+        `uid(A_chain) OR uid(B_chain)` — a node passing ANY of its
+        implementers' chains stays). For mutations, nodes belonging to
+        no implementing type drop out entirely when implementer auth is
+        in play (`ARoot ... @filter(uid(B_2))`)."""
+        if t.kind != "interface" or not uids:
+            return uids
+        auth_impls = [
+            impl
+            for n in t.implementers
+            if (impl := self.types.get(n)) is not None
+            and impl.auth is not None
+            and getattr(impl.auth, op, None) is not None
+        ]
+        if not auth_impls:
+            return uids
+        plain_impls = [
+            impl
+            for n in t.implementers
+            if (impl := self.types.get(n)) is not None
+            and impl not in auth_impls
+        ]
+
+        def impl_members(impl) -> set:
+            gq = GraphQuery(attr="q")
+            gq.func = FuncSpec(name="uid", args=list(uids))
+            gq.filter = FilterTree(
+                func=FuncSpec(name="type", attr=impl.stored_name)
+            )
+            gq.children = [GraphQuery(attr="uid", is_uid=True)]
+            return {int(o["uid"], 16) for o in self._run_block(gq)}
+
+        member: set = set()
+        allowed: set = set()
+        for impl in auth_impls:
+            impl_uids = impl_members(impl)
+            member |= impl_uids
+            verdict = self._auth(impl, op)
+            if verdict is True:
+                allowed |= impl_uids
+            elif verdict is not False:
+                allowed |= self._auth_allowed_uids(
+                    impl, verdict, sorted(impl_uids)
+                )
+        for impl in plain_impls:
+            # implementers without rules keep their nodes (OR branch
+            # with no auth filter)
+            allowed |= impl_members(impl)
+        if op in ("update", "delete"):
+            # mutation targets come only from the implementer chains
+            drop = set(uids) - allowed
+        else:
+            # queries keep interface-only nodes (they match no chain
+            # but also no deny)
+            drop = member - allowed
+        if not drop:
+            return uids
+        return [u for u in uids if u not in drop]
 
     def _update(self, t: GqlType, sel: Selection):
         inp = sel.args.get("input", {})
@@ -1940,7 +2034,11 @@ class GraphQLServer:
         # rewriter rejects malformed patches even when the filter is
         # empty — e.g. a remove reference without its identity)
         self._validate_remove_patch(t, inp.get("remove"))
-        uids = [] if denied else self._match_filter_uids(t, fobj)
+        uids = (
+            []
+            if denied
+            else self._match_filter_uids(t, fobj, "update")
+        )
         txn = self.engine.new_txn()
         try:
             return self._update_in_txn(t, sel, inp, uids, txn)
@@ -2048,7 +2146,11 @@ class GraphQLServer:
             t, sel.args.get("filter"), "delete"
         )
         # denied delete matches nothing (`x as deleteLog()`): no error
-        uids = [] if not allowed else self._match_filter_uids(t, fobj)
+        uids = (
+            []
+            if not allowed
+            else self._match_filter_uids(t, fobj, "delete")
+        )
         txn = self.engine.new_txn()
         try:
             return self._delete_in_txn(t, sel, uids, txn)
