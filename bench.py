@@ -6,9 +6,21 @@ the same shape as a *batch*: 256 independent 10-vs-1M intersections in one
 vmapped dispatch (the way the query engine issues them), and report the
 per-op amortized latency.
 
-Prints ONE JSON line:
+Also reports the compressed-domain path (ops/packed_setops.py — the
+direct analog of IntersectCompressedWithBin, which never fully decodes):
+
+  intersect_packed_10v1M_batch256  ns/op for 256 block-skip intersects
+  decode_bytes_per_query           decoded vs full-decode bytes across the
+                                   selectivity ratio ladder; the dense
+                                   (ratio=1) row must show the fallback to
+                                   full decode (no packed regression)
+
+Prints one JSON line per metric:
   {"metric": ..., "value": N, "unit": "ns/op", "vs_baseline": N}
 vs_baseline > 1.0 means faster than the reference's 2430 ns/op.
+The packed metrics are also stamped into BENCH_PACKED.json via
+benchmarks/stamp.guarded_write (a cpu_fallback run cannot overwrite a TPU
+capture).
 """
 
 import json
@@ -161,6 +173,107 @@ def main():
         file=sys.stderr,
     )
     print(json.dumps(result))
+    _bench_packed(rng, big, platform)
+
+
+def _bench_packed(rng, big, platform):
+    """Compressed-domain headline: 256 block-skip 10-vs-1M intersects with
+    the big side kept packed (the shape IntersectCompressedWithBin times in
+    the reference), plus the decoded-bytes ladder across selectivity
+    ratios."""
+    from benchmarks import stamp
+    from dgraph_tpu.codec import uidpack
+    from dgraph_tpu.ops import packed_setops
+
+    b64 = big.astype(np.uint64)
+    pack = uidpack.encode(b64)
+    smalls = []
+    for i in range(BATCH):
+        if i % 2 == 0:
+            a = np.sort(rng.choice(b64, SMALL, replace=False))
+        else:
+            a = np.unique(
+                rng.integers(0, 1 << 31, SMALL, dtype=np.uint64)
+            )[:SMALL]
+        smalls.append(a)
+
+    # warm (first-touch candidate metadata: block_maxes builds once)
+    packed_setops.intersect_packed(smalls[0], pack)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for a in smalls:
+            packed_setops.intersect_packed(a, pack)
+        times.append(time.perf_counter() - t0)
+    per_op_ns = (np.median(times) / BATCH) * 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "intersect_packed_10v1M_batch256",
+                "value": round(per_op_ns, 1),
+                "unit": "ns/op",
+                "vs_baseline": round(REF_NS_PER_OP / per_op_ns, 3),
+                "platform": platform,
+            }
+        )
+    )
+
+    # decoded-bytes ladder: per-query decode cost packed vs full decode.
+    # ratio=1 runs through the dispatcher and must FALL BACK to the dense
+    # path (packed_ops == 0) — the no-regression guard for dense ops.
+    from dgraph_tpu.query.dispatch import PackedOperand, SetOpDispatcher
+
+    disp = SetOpDispatcher()
+    ladder = []
+    for ratio in (1, 100, 1000, 100000):
+        n_small = max(10, len(b64) // ratio)
+        a = np.sort(rng.choice(b64, n_small, replace=False))
+        packed_setops.reset_counters()
+        got = disp.run_pairs("intersect", [(a, PackedOperand(pack))])[0]
+        c = packed_setops.counters()
+        full = pack.num_uids * 8 + a.size * 8
+        decoded = (
+            c["decoded_bytes"] + a.size * 8
+            if c["packed_ops"]
+            else full
+        )
+        ladder.append(
+            {
+                "ratio": ratio,
+                "packed_path": bool(c["packed_ops"]),
+                "decoded_bytes_per_query": decoded,
+                "full_decode_bytes": full,
+                "reduction_x": round(full / max(1, decoded), 1),
+                "result_n": int(len(got)),
+            }
+        )
+        print(
+            f"packed ladder ratio={ratio}: packed={bool(c['packed_ops'])} "
+            f"decoded={decoded}B full={full}B "
+            f"reduction={full/max(1,decoded):.1f}x",
+            file=sys.stderr,
+        )
+    headline = ladder[-1]  # the 10-vs-1M (most selective) row
+    print(
+        json.dumps(
+            {
+                "metric": "decode_bytes_per_query",
+                "value": headline["decoded_bytes_per_query"],
+                "unit": "bytes",
+                "reduction_x": headline["reduction_x"],
+                "ladder": ladder,
+                "platform": platform,
+            }
+        )
+    )
+    stamp.guarded_write(
+        "BENCH_PACKED.json",
+        {
+            "intersect_packed_10v1M_batch256_ns": round(per_op_ns, 1),
+            "decode_bytes_ladder": ladder,
+        },
+        platform,
+    )
 
 
 if __name__ == "__main__":

@@ -73,7 +73,23 @@ def main():
         p = run_stage("bench", [py, "bench.py"], 900, st)
         if p and p.returncode == 0:
             try:
-                st["bench"]["result"] = json.loads(p.stdout.strip().splitlines()[-1])
+                # bench.py emits one JSON line per metric (headline +
+                # packed + decode-bytes ladder) — keep them all, with the
+                # headline under the historical "result" key
+                recs = [
+                    json.loads(l)
+                    for l in p.stdout.strip().splitlines()
+                    if l.startswith("{")
+                ]
+                st["bench"]["result"] = next(
+                    (
+                        r
+                        for r in recs
+                        if r.get("metric") == "intersect_10v1M_batch256"
+                    ),
+                    recs[-1],
+                )
+                st["bench"]["all_metrics"] = recs
                 st["bench"]["sweep_stderr"] = p.stderr[-1500:]
             except Exception:
                 st["bench"]["raw"] = p.stdout[-1000:]
@@ -88,14 +104,23 @@ def main():
 
     if "thresholds" not in skip:
         j = os.path.join(tmp, "thr.json")
+        pj = os.path.join(tmp, "thr_packed.json")
+        # 2400s: the device sweep AND the packed-crossover sweep both run;
+        # the packed capture is what re-pins DGRAPH_TPU_PACKED_MIN_RATIO
+        # on TPU (NOTES_NEXT_ROUND §1)
         p = run_stage(
             "thresholds",
-            [py, "benchmarks/tune_thresholds.py", "--json", j],
-            1200,
+            [
+                py, "benchmarks/tune_thresholds.py",
+                "--json", j, "--packed-json", pj,
+            ],
+            2400,
             st,
         )
         if os.path.exists(j):
             st["thresholds"]["result"] = json.load(open(j))
+        if os.path.exists(pj):
+            st["thresholds"]["packed"] = json.load(open(pj))
 
     if "suite" not in skip:
         j = os.path.join(tmp, "suite.json")

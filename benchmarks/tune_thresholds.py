@@ -13,7 +13,15 @@ and reports the crossover total. Run with the TPU tunnel up to tune for
 real dispatch latency; the recommended value is printed and can be
 pinned via DGRAPH_TPU_DEVICE_MIN_TOTAL.
 
-Usage: python benchmarks/tune_thresholds.py [--json out]
+It also sweeps the packed-vs-decode crossover (--packed-only for just that
+sweep; it runs after the device sweep by default):
+the compressed-domain block-skip ops (ops/packed_setops.py) win when the
+big operand is selective relative to the small one; below the crossover
+ratio, one full decode + the dense kernels win. The recommended ratio is
+printed and pinned the same way dispatch._min_total is — via
+DGRAPH_TPU_PACKED_MIN_RATIO (default in query/dispatch.py).
+
+Usage: python benchmarks/tune_thresholds.py [--json out] [--packed-json out]
 """
 
 import sys as _sys
@@ -27,10 +35,98 @@ import time
 import numpy as np
 
 
+def sweep_packed(out_json=None):
+    """Measure the packed-vs-decode crossover RATIO (|big| / |small|) on
+    the live host kernels (run via --packed-only): t_packed =
+    candidate-block search + partial decode + intersect vs t_decoded =
+    full decode + intersect. A fresh
+    pack per ratio row; one warmup call builds the pack's skip metadata
+    (block_maxes + cached ctypes pointers) before timing — that matches
+    production, where a pack's metadata persists across queries while the
+    decode itself re-runs per commit epoch (the decoded side here pays
+    full decode every rep as the first-touch proxy)."""
+    import time
+
+    import numpy as np
+
+    from dgraph_tpu.codec import uidpack
+    from dgraph_tpu.ops import packed_setops
+
+    rng = np.random.default_rng(7)
+    big_n = 1_000_000
+    b = np.unique(
+        rng.integers(1, 1 << 33, big_n + big_n // 8, dtype=np.uint64)
+    )[:big_n]
+    rows = []
+    crossover = None
+    for ratio in [1, 2, 4, 8, 16, 64, 256, 1024, 10_000, 100_000]:
+        pack = uidpack.encode(b)  # fresh pack: no metadata carry-over
+        small_n = max(1, big_n // ratio)
+        a = np.sort(rng.choice(b, small_n, replace=False))
+        reps = 5 if small_n > 10_000 else 20
+
+        packed_setops.intersect_packed(a, pack)  # warm skip metadata
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            got_p = packed_setops.intersect_packed(a, pack)
+        t_packed = (time.perf_counter() - t0) / reps
+
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            from dgraph_tpu import native
+
+            got_d = native.intersect(uidpack.decode(pack), a)
+        t_decoded = (time.perf_counter() - t0) / reps
+        np.testing.assert_array_equal(got_p, np.sort(got_d))
+
+        row = {
+            "ratio": ratio,
+            "small": small_n,
+            "packed_us": round(t_packed * 1e6, 1),
+            "decoded_us": round(t_decoded * 1e6, 1),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    # robust crossover: smallest ratio from which packed wins (within 5%
+    # noise) at EVERY larger ratio — a single noisy win must not pin a
+    # too-aggressive threshold
+    for row in rows:
+        if all(
+            r["packed_us"] <= r["decoded_us"] * 1.05
+            for r in rows
+            if r["ratio"] >= row["ratio"]
+        ):
+            crossover = row["ratio"]
+            break
+    result = {
+        "big": big_n,
+        "rows": rows,
+        "crossover_ratio": crossover,
+        "recommended_PACKED_MIN_RATIO": crossover if crossover else 1 << 30,
+    }
+    if out_json:
+        from benchmarks import stamp
+
+        import jax
+
+        stamp.guarded_write(out_json, result, jax.default_backend())
+    print(json.dumps(result, indent=1))
+    return result
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
+    ap.add_argument("--packed-json", default=None)
+    ap.add_argument(
+        "--packed-only", action="store_true",
+        help="run only the packed-vs-decode crossover sweep",
+    )
     args = ap.parse_args()
+
+    if args.packed_only:
+        sweep_packed(args.packed_json)
+        return
 
     import jax
 
@@ -93,6 +189,7 @@ def main():
         with open(args.json, "w") as f:
             json.dump(result, f, indent=1)
     print(json.dumps(result, indent=1))
+    sweep_packed(args.packed_json)
 
 
 if __name__ == "__main__":

@@ -24,8 +24,8 @@ algo/packed.go).
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass
-from typing import Dict, List, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -47,6 +47,11 @@ class UidPack:
     counts: np.ndarray
     offsets: np.ndarray
     num_uids: int
+    # lazily-computed per-block max UIDs (block_maxes); immutable like the
+    # block arrays themselves
+    _maxes: Optional[np.ndarray] = field(
+        default=None, repr=False, compare=False
+    )
 
     def __len__(self) -> int:
         return self.num_uids
@@ -127,18 +132,90 @@ def encode(uids: np.ndarray) -> UidPack:
 
 
 def decode(pack: UidPack) -> np.ndarray:
-    """Decode a UidPack back to a sorted u64 array. Ref codec.go:444 Decode."""
+    """Decode a UidPack back to a sorted u64 array. Ref codec.go:444 Decode.
+
+    Implemented as a full-range partial decode — one vectorized/native pass
+    instead of the old per-block Python loop. Single-block packs (the
+    dominant fan-out shape: small per-key lists) take a direct slice, no
+    native marshaling."""
     if pack.num_uids == 0:
         return np.zeros((0,), np.uint64)
-    out = np.empty((pack.num_uids,), np.uint64)
-    pos = 0
-    for bi in range(pack.nblocks):
-        c = int(pack.counts[bi])
-        out[pos : pos + c] = pack.bases[bi] + pack.offsets[bi, :c].astype(
-            np.uint64
-        )
-        pos += c
-    return out
+    if pack.nblocks == 1:
+        c = int(pack.counts[0])
+        return pack.bases[0] + pack.offsets[0, :c].astype(np.uint64)
+    return decode_blocks(pack, np.arange(pack.nblocks, dtype=np.int64))
+
+
+def block_maxes(pack: UidPack) -> np.ndarray:
+    """(nblocks,) uint64 — last (max) UID of each block.
+
+    Together with `pack.bases` this is the per-block skip metadata of the
+    compressed-domain set ops (ops/packed_setops.py): a block's UID range is
+    [bases[i], maxes[i]], ranges are disjoint and ascending. Derivable from
+    the next block's base in the reference (algo/packed.go walks per-block
+    Base values); here the last in-block offset gives the exact max. Cached
+    on the pack — the metadata is immutable once encoded."""
+    if pack._maxes is None:
+        nb = pack.nblocks
+        if nb == 0:
+            pack._maxes = np.zeros((0,), np.uint64)
+        else:
+            last = np.maximum(pack.counts.astype(np.int64) - 1, 0)
+            pack._maxes = pack.bases + pack.offsets[
+                np.arange(nb), last
+            ].astype(np.uint64)
+    return pack._maxes
+
+
+def decode_blocks(pack: UidPack, idxs: np.ndarray) -> np.ndarray:
+    """Decode ONLY the blocks in `idxs` (sorted ascending) -> sorted u64.
+
+    The partial decoder behind the block-skip set ops: candidate blocks
+    found by range overlap decode; everything else stays compressed. The
+    native fast path (codec.cpp pack_decode_blocks) avoids the (k, 256)
+    gather temp; the numpy fallback is a masked broadcast."""
+    idxs = np.asarray(idxs, dtype=np.int64)
+    if idxs.size == 0:
+        return np.zeros((0,), np.uint64)
+    if idxs.size <= 4:
+        # few blocks: per-block slices beat the ctypes marshal and the
+        # masked broadcast alike
+        parts = []
+        for bi in idxs:
+            c = int(pack.counts[bi])
+            parts.append(
+                pack.bases[bi] + pack.offsets[bi, :c].astype(np.uint64)
+            )
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+    from dgraph_tpu import native
+
+    got = native.pack_decode_blocks(
+        pack.bases, pack.counts, pack.offsets, idxs
+    )
+    if got is not None:
+        return got
+    counts = pack.counts[idxs].astype(np.int64)
+    rows = pack.offsets[idxs]
+    mask = np.arange(BLOCK_SIZE, dtype=np.int64)[None, :] < counts[:, None]
+    return (pack.bases[idxs][:, None] + rows.astype(np.uint64))[mask]
+
+
+def merge_packs(packs: List[UidPack]) -> UidPack:
+    """Concatenate packs holding disjoint ascending UID ranges (multi-part
+    posting-list parts, ref posting/list.go:519 pIterator) into one logical
+    pack WITHOUT decoding — pure block-array concatenation, so the merged
+    view feeds the compressed-domain ops directly."""
+    packs = [p for p in packs if p.num_uids]
+    if not packs:
+        return encode(np.zeros((0,), np.uint64))
+    if len(packs) == 1:
+        return packs[0]
+    return UidPack(
+        bases=np.concatenate([p.bases for p in packs]),
+        counts=np.concatenate([p.counts for p in packs]),
+        offsets=np.concatenate([p.offsets for p in packs]),
+        num_uids=sum(p.num_uids for p in packs),
+    )
 
 
 def split_segments(uids: np.ndarray) -> Dict[int, np.ndarray]:

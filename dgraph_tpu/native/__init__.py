@@ -70,6 +70,16 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
 
     lib.bitpack.argtypes = [u32p, i64, ctypes.c_int, u8p]
     lib.bitunpack.argtypes = [u8p, i64, i64, ctypes.c_int, u32p]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.pack_decode_blocks.argtypes = [
+        u64p, i32p, u32p, i64, ctypes.POINTER(i64), i64, u64p
+    ]
+    lib.pack_decode_blocks.restype = i64
+    lib.pack_intersect_small.argtypes = [
+        u64p, i32p, u32p, i64, i64, u64p, u64p, i64, u64p,
+        ctypes.POINTER(i64),
+    ]
+    lib.pack_intersect_small.restype = i64
     for name in ("intersect_u64", "union_u64", "difference_u64"):
         fn = getattr(lib, name)
         fn.argtypes = [u64p, i64, u64p, i64, u64p]
@@ -117,7 +127,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.bulk_run_path.argtypes = [vp, i64, cp, i64]
     lib.bulk_run_path.restype = i64
     lib.bulk_reduce.argtypes = [
-        vp, cp, i64, ctypes.c_uint64, cp, cp, ctypes.c_uint64,
+        vp, cp, i64, ctypes.c_uint64, cp, cp, cp, ctypes.c_uint64,
         i64, ctypes.c_uint64, ctypes.c_uint64,
     ]
     lib.bulk_reduce.restype = i64
@@ -193,6 +203,74 @@ def bitunpack(data: bytes, count: int, width: int) -> np.ndarray:
     from dgraph_tpu.codec.uidpack import _bitunpack_py
 
     return _bitunpack_py(data, count, width)
+
+
+def pack_decode_blocks(bases, counts, offsets, idxs):
+    """Partial UidPack decode (codec/uidpack.decode_blocks fast path).
+    Returns the decoded sorted u64 array, or None when the native lib is
+    unavailable (caller falls back to the numpy masked broadcast)."""
+    if _LIB is None:
+        return None
+    idxs = np.ascontiguousarray(idxs, np.int64)
+    total = int(counts[idxs].sum())
+    out = np.empty((total,), np.uint64)
+    if total == 0:
+        return out
+    # bind conversions to locals so any converted temporaries outlive the
+    # native call (inline _ptr(ascontiguousarray(...)) would free them
+    # before the call runs)
+    bases = np.ascontiguousarray(bases, np.uint64)
+    counts = np.ascontiguousarray(counts, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.uint32)
+    n = _LIB.pack_decode_blocks(
+        _ptr(bases, ctypes.c_uint64),
+        _ptr(counts, ctypes.c_int32),
+        _ptr(offsets, ctypes.c_uint32),
+        offsets.shape[1],
+        idxs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        idxs.size,
+        _ptr(out, ctypes.c_uint64),
+    )
+    return out[:n]
+
+
+def pack_ptrs(bases, counts, offsets, maxes):
+    """Pre-built ctypes pointers for a long-lived pack's block arrays —
+    callers cache the tuple on the pack so per-op calls skip the
+    numpy->ctypes marshaling that dominates tiny-frontier latency (same
+    trick as buf_ptr for SSTable mmaps)."""
+    return (
+        _ptr(bases, ctypes.c_uint64),
+        _ptr(counts, ctypes.c_int32),
+        _ptr(offsets, ctypes.c_uint32),
+        _ptr(maxes, ctypes.c_uint64),
+    )
+
+
+def pack_intersect_small(bases, counts, offsets, maxes, a, ptrs=None):
+    """Tiny-frontier compressed-domain intersect: one native call, zero
+    decode. Returns (hits u64 array, touched_uids) or None when the native
+    lib is unavailable."""
+    if _LIB is None:
+        return None
+    if ptrs is None:
+        ptrs = pack_ptrs(bases, counts, offsets, maxes)
+    a = np.ascontiguousarray(a, np.uint64)
+    out = np.empty((a.size,), np.uint64)
+    touched = ctypes.c_int64(0)
+    n = _LIB.pack_intersect_small(
+        ptrs[0],
+        ptrs[1],
+        ptrs[2],
+        offsets.shape[1],
+        bases.size,
+        ptrs[3],
+        _ptr(a, ctypes.c_uint64),
+        a.size,
+        _ptr(out, ctypes.c_uint64),
+        ctypes.byref(touched),
+    )
+    return out[:n], int(touched.value)
 
 
 def _setop(name: str, a: np.ndarray, b: np.ndarray, out_size: int) -> np.ndarray:

@@ -872,6 +872,15 @@ struct HeapCmp {
   }
 };
 
+// kind byte of any storage key (0x00 data | 0x02 index | 0x04 reverse),
+// -1 for malformed keys
+static int key_kind(const std::string& k) {
+  if (k.size() < 4 || k[0] != '\x00') return -1;
+  u16 alen = (u8(k[1]) << 8) | u8(k[2]);
+  if (k.size() < size_t(3 + alen + 1)) return -1;
+  return u8(k[3 + alen]);
+}
+
 // parse attr + uid + kind back out of a data key (for count flags)
 static bool parse_data_key(const std::string& k, std::string* attr, u64* uid) {
   if (k.size() < 12 || k[0] != '\x00') return false;
@@ -1011,10 +1020,14 @@ i64 bulk_run_path(void* h, i64 i, char* out, i64 cap) {
 // sst=0: out_main is a [u16 klen][key][u32 rlen][rec] stream.
 // sst=1: out_main is a finished SSTable (storage/lsm.py _SSTable
 //        layout, unencrypted) with version `ts` and seqs from seq_base+1.
+// out_stats (may be null/empty): index-key selectivity records
+// [u16 klen][key][u64 uid_count], one per index key — the StatsHolder
+// feed the Python slow path emits inline but the native path previously
+// skipped (NOTES_NEXT_ROUND §2 known gap).
 i64 bulk_reduce(void* h, const char* paths_joined, i64 plen,
                 u64 max_part_uids, const char* out_main,
-                const char* out_counts, u64 ns, i64 sst, u64 ts,
-                u64 seq_base) {
+                const char* out_counts, const char* out_stats, u64 ns,
+                i64 sst, u64 ts, u64 seq_base) {
   Ctx* c = (Ctx*)h;
   std::vector<std::string> paths;
   {
@@ -1046,6 +1059,13 @@ i64 bulk_reduce(void* h, const char* paths_joined, i64 plen,
     if (!fm) return -1;
     setvbuf(fm, nullptr, _IOFBF, 1 << 22);
   }
+  FILE* fs = nullptr;
+  if (out_stats && out_stats[0]) {
+    // stats are advisory (the Python reader tolerates a missing file):
+    // an open failure must not fail the reduce itself
+    fs = fopen(out_stats, "wb");
+    if (fs) setvbuf(fs, nullptr, _IOFBF, 1 << 20);
+  }
 
   // (attr, count) -> uids, for @count predicates
   std::map<std::pair<std::string, u64>, std::vector<u64>> counts;
@@ -1072,6 +1092,17 @@ i64 bulk_reduce(void* h, const char* paths_joined, i64 plen,
       auto pit = c->preds.find(attr);
       if (pit != c->preds.end() && pit->second.count)
         counts[{attr, u64(uids.size())}].push_back(subj);
+    }
+    if (fs && !uids.empty() && cur_key.size() <= 0xFFFF &&
+        key_kind(cur_key) == 0x02) {
+      // index key: emit its (key, posting-count) selectivity record;
+      // oversized keys are skipped — a truncated u16 klen would corrupt
+      // every later record in the stream
+      u16 kl = u16(cur_key.size());
+      u64 n = u64(uids.size());
+      fwrite(&kl, 2, 1, fs);
+      fwrite(cur_key.data(), 1, kl, fs);
+      fwrite(&n, 8, 1, fs);
     }
 
     auto write_rec = [&](const std::string& key, const std::string& rec) {
@@ -1144,6 +1175,7 @@ i64 bulk_reduce(void* h, const char* paths_joined, i64 plen,
   emit_group();
   if (sst) sw.finish();
   else fclose(fm);
+  if (fs) fclose(fs);
   for (auto& r : rs) if (r.f) fclose(r.f);
 
   FILE* fc = fopen(out_counts, "wb");

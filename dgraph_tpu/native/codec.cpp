@@ -53,6 +53,59 @@ void bitunpack(const uint8_t* data, int64_t nbytes, int64_t n, int width,
     }
 }
 
+// Partial UidPack decode: materialize ONLY the listed blocks (codec/
+// uidpack.py decode_blocks). offsets is the (nblocks, block_size) u32
+// matrix; idxs are ascending block indices. Returns UIDs written.
+int64_t pack_decode_blocks(const uint64_t* bases, const int32_t* counts,
+                           const uint32_t* offsets, int64_t block_size,
+                           const int64_t* idxs, int64_t nidx, uint64_t* out) {
+    int64_t k = 0;
+    for (int64_t i = 0; i < nidx; i++) {
+        int64_t bi = idxs[i];
+        uint64_t base = bases[bi];
+        const uint32_t* row = offsets + bi * block_size;
+        int64_t c = counts[bi];
+        for (int64_t j = 0; j < c; j++) out[k++] = base + row[j];
+    }
+    return k;
+}
+
+// Compressed-domain tiny-frontier intersect (ops/packed_setops.py small
+// path; the scalar analog of algo/packed.go IntersectCompressedWithBin):
+// for each frontier element binary-search its containing block by base,
+// range-check against the block max, then binary-search the in-block
+// offsets — the pack is never decoded. Writes hits to out; *touched_uids
+// gets the summed count of distinct blocks probed (decode accounting).
+int64_t pack_intersect_small(const uint64_t* bases, const int32_t* counts,
+                             const uint32_t* offsets, int64_t block_size,
+                             int64_t nblocks, const uint64_t* maxes,
+                             const uint64_t* a, int64_t na, uint64_t* out,
+                             int64_t* touched_uids) {
+    int64_t k = 0, touched = 0, last_blk = -1;
+    for (int64_t i = 0; i < na; i++) {
+        uint64_t x = a[i];
+        // last block with base <= x
+        int64_t lo = 0, hi = nblocks;
+        while (lo < hi) {
+            int64_t mid = lo + ((hi - lo) >> 1);
+            if (bases[mid] <= x) lo = mid + 1; else hi = mid;
+        }
+        int64_t bi = lo - 1;
+        if (bi < 0 || x > maxes[bi]) continue;
+        if (bi != last_blk) { touched += counts[bi]; last_blk = bi; }
+        uint32_t off = (uint32_t)(x - bases[bi]);
+        const uint32_t* row = offsets + bi * block_size;
+        int64_t c = counts[bi], l = 0, h = c;
+        while (l < h) {
+            int64_t mid = l + ((h - l) >> 1);
+            if (row[mid] < off) l = mid + 1; else h = mid;
+        }
+        if (l < c && row[l] == off) out[k++] = x;
+    }
+    *touched_uids = touched;
+    return k;
+}
+
 // ---------------------------------------------------------------------------
 // Sorted u64 set algebra (ref algo/uidlist.go IntersectWith:142 adaptive
 // strategies; same linear/gallop split here).

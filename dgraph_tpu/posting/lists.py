@@ -78,6 +78,28 @@ class LocalCache:
         tok = None if extra else (key, pl.latest_ts)
         return uids, tok
 
+    def packed_operand(self, key: bytes):
+        """The posting list as a compressed-domain dispatcher operand
+        (query/dispatch.PackedOperand), or None when any uid delta —
+        committed or txn-local — makes the packed layers stale. Carries the
+        list's block-cached partial decoder, so candidate blocks decode
+        once per list per commit epoch."""
+        extra = self.deltas.get(key)
+        if extra and any(not p.is_value for p in extra):
+            return None
+        pl = self.get(key)
+        pack = pl.packed()
+        if pack is None:
+            return None
+        from dgraph_tpu.query.dispatch import PackedOperand
+
+        return PackedOperand(
+            pack,
+            decode_fn=pl.decode_blocks,
+            uids=pl._uids_cache,
+            uids_fn=pl.uids,
+        )
+
     def value(self, key: bytes, lang: str = ""):
         return self.get(key).get_value(lang, self.deltas.get(key))
 

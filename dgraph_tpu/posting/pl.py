@@ -330,6 +330,79 @@ class PostingList:
         # pIterator walking split parts)
         self.part_packs: List[uidpack.UidPack] = []
         self.split_starts: List[int] = []
+        # compressed-domain read state: merged multi-part view (block-array
+        # concat, no decode) + decoded-block cache for the block-skip set
+        # ops (ops/packed_setops.py). Both live on the PostingList, so a
+        # commit invalidates them together with the list itself (MemoryLayer
+        # drops the entry; DeviceCache mirrors the same invalidation).
+        self._merged_pack: Optional[uidpack.UidPack] = None
+        self._block_cache: Dict[int, np.ndarray] = {}
+        self._has_uid_deltas: Optional[bool] = None
+
+    # -- compressed-domain access -------------------------------------------
+
+    # decoded-block cache bound: 4096 blocks ≈ 1M UIDs ≈ 8 MB per hot list
+    BLOCK_CACHE_MAX = 4096
+
+    def merged_pack(self) -> uidpack.UidPack:
+        """The full uid set as ONE UidPack — the main pack, or the
+        multi-part parts concatenated at the block level WITHOUT decoding
+        (parts hold disjoint ascending ranges, so their block arrays chain
+        into a valid pack). This is the operand the block-skip set ops
+        consume; part packs are no longer eagerly decoded just to exist."""
+        if self._merged_pack is None:
+            if self.part_packs:
+                self._merged_pack = uidpack.merge_packs(self.part_packs)
+            else:
+                self._merged_pack = self.pack
+        return self._merged_pack
+
+    def has_uid_deltas(self) -> bool:
+        """True when committed deltas touch the uid set (value-only deltas
+        leave the packed view exact)."""
+        if self._has_uid_deltas is None:
+            self._has_uid_deltas = any(
+                not p.is_value for _, posts in self.deltas for p in posts
+            )
+        return self._has_uid_deltas
+
+    def packed(self) -> Optional[uidpack.UidPack]:
+        """The uid set as a UidPack when the compressed view is exact —
+        None when committed uid deltas exist (the packed layers are stale
+        then and callers must take the decoded path)."""
+        if self.has_uid_deltas():
+            return None
+        return self.merged_pack()
+
+    def decode_blocks(
+        self, pack: uidpack.UidPack, idxs: np.ndarray
+    ) -> np.ndarray:
+        """Partial decoder with a per-list block cache: repeated traversals
+        hitting the same candidate blocks stop re-decoding. `pack` must be
+        this list's merged_pack() (the cache keys are its block indices)."""
+        idxs = np.asarray(idxs, np.int64)
+        if idxs.size == 0:
+            return np.zeros((0,), np.uint64)
+        missing = [int(i) for i in idxs if int(i) not in self._block_cache]
+        tmp: Dict[int, np.ndarray] = {}
+        if missing:
+            decoded = uidpack.decode_blocks(
+                pack, np.asarray(missing, np.int64)
+            )
+            pos = 0
+            for bi in missing:
+                c = int(pack.counts[bi])
+                tmp[bi] = decoded[pos : pos + c]
+                pos += c
+            # cache-full: still serve cached blocks, just don't grow —
+            # a hot list at the cap keeps its cache useful
+            if len(self._block_cache) + len(tmp) <= self.BLOCK_CACHE_MAX:
+                self._block_cache.update(tmp)
+        parts = []
+        for i in idxs:
+            got = self._block_cache.get(int(i))
+            parts.append(got if got is not None else tmp[int(i)])
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
     # -- construction from KV versions --------------------------------------
 
@@ -405,14 +478,11 @@ class PostingList:
         return out
 
     def _compute_uids(self, extra_deltas: Optional[List[Posting]]) -> np.ndarray:
-        if self.part_packs:
-            # parts hold disjoint ascending uid ranges: concatenation of
-            # decoded parts is already sorted
-            base = np.concatenate(
-                [uidpack.decode(pp) for pp in self.part_packs]
-            ).astype(np.uint64)
-        else:
-            base = uidpack.decode(self.pack)
+        # one partial-decoder pass over the merged block view — multi-part
+        # lists no longer decode every part pack through its own per-pack
+        # call, and packed-path readers that never call uids() decode
+        # nothing at all here
+        base = uidpack.decode(self.merged_pack())
         # last-writer-wins per uid across layers in commit order
         final_op: Dict[int, int] = {}
         for _, posts in self.deltas:

@@ -65,6 +65,39 @@ class FuncRunner:
     def _index_uids(self, attr: str, token: bytes) -> np.ndarray:
         return self.cache.uids(keys.IndexKey(attr, token, self.ns))
 
+    def _index_src_intersect(
+        self, attr: str, token: bytes, src: np.ndarray
+    ) -> np.ndarray:
+        """index-posting-list ∩ src with the index list kept COMPRESSED
+        when the op is selective (the filter hot path: small candidate set
+        vs a huge index list, e.g. type(Person) at 1M scale). The packed-
+        vs-decoded choice is fed by StatsHolder selectivity estimates —
+        when stats say the list is below the packed crossover the decoded
+        path runs without any packed plumbing; cold stats (estimate 0)
+        defer to the actual pack size, which the dispatcher re-checks."""
+        if len(src) == 0:
+            return EMPTY
+        from dgraph_tpu.query.dispatch import DISPATCHER
+
+        key = keys.IndexKey(attr, token, self.ns)
+        est = (
+            self.stats.estimate(attr, token)
+            if self.stats is not None
+            else 0
+        )
+        pop = None
+        if not (
+            0 < est < DISPATCHER.packed_min_ratio() * max(1, len(src))
+        ):
+            pop = self.cache.packed_operand(key)
+        if pop is None:
+            return np.intersect1d(
+                self.cache.uids(key), src, assume_unique=True
+            )
+        return DISPATCHER.run_chain(
+            "intersect", [np.asarray(src, np.uint64), pop]
+        ).astype(np.uint64)
+
     def _eq_tokenizer(self, su):
         """Pick a non-lossy tokenizer for eq (ref tok.go:372 pickTokenizer)."""
         toks = su.tokenizer_objs()
@@ -428,10 +461,10 @@ class FuncRunner:
     def _type(self, fn: FuncSpec, src) -> np.ndarray:
         # dgraph.type is an exact-indexed string predicate (ref systems schema)
         token = b"\x02" + fn.attr.encode("utf-8")
-        out = self._index_uids("dgraph.type", token)
         if src is not None:
-            out = np.intersect1d(out, src, assume_unique=True)
-        return out
+            # filter form: keep the (potentially huge) type index packed
+            return self._index_src_intersect("dgraph.type", token, src)
+        return self._index_uids("dgraph.type", token)
 
     def _uid_in(self, fn: FuncSpec, src) -> np.ndarray:
         """uid_in(pred, uids): entities whose pred edge reaches a target
@@ -509,7 +542,15 @@ class FuncRunner:
             if tok is not None and toks_v:
                 cand = EMPTY
                 for tb in toks_v:
-                    cand = np.union1d(cand, self._index_uids(fn.attr, tb))
+                    # as a filter, (∪ tokens) ∩ src distributes to
+                    # ∪ (token ∩ src): each token's index list stays
+                    # packed against the candidate set
+                    l = (
+                        self._index_src_intersect(fn.attr, tb, src)
+                        if src is not None
+                        else self._index_uids(fn.attr, tb)
+                    )
+                    cand = np.union1d(cand, l)
             elif tok is not None and not toks_v:
                 # value produced no tokens (eq(room, "") on a term index):
                 # fall back to a value scan (ref handles empty-string eq)
