@@ -76,6 +76,126 @@ def _probe_device(timeout_s: int = 240) -> bool:
         return False
 
 
+def _bench_fanout(platform, fanout=100, pool=200_000):
+    """Level-batched fan-out headline (BENCH_FANOUT.json):
+
+      fanout_3level_1M        3-level traversal latency over ~1.01M edges
+                              (1 -> 100 -> 10k -> 1M), batched level tasks
+                              vs the per-uid baseline
+                              (DGRAPH_TPU_LEVEL_BATCH=0), both warm
+      level_batch_read_calls  cache round-trips per query in each mode —
+                              the batched executor issues ONE uids_many
+                              per (predicate, level) instead of one
+                              uids_tok per parent uid
+    """
+    import os
+
+    from benchmarks import stamp
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.loaders.bulk2 import ParallelBulkLoader
+    from dgraph_tpu.posting.lists import READ_COUNTERS
+
+    f = fanout
+    rng = np.random.default_rng(7)
+    l1 = [0x100 + i for i in range(f)]
+    l2 = [0x10000 + i for i in range(f * f)]
+    base3 = 0x1000000
+    lines = [f"<0x1> <child> <{hex(v)}> ." for v in l1]
+    for i, v in enumerate(l2):
+        lines.append(f"<{hex(l1[i // f])}> <child> <{hex(v)}> .")
+    tgts = rng.integers(base3, base3 + pool, size=len(l2) * f)
+    for i, v in enumerate(l2):
+        hv = hex(v)
+        for t in tgts[i * f : (i + 1) * f]:
+            lines.append(f"<{hv}> <child> <{hex(int(t))}> .")
+    edges = len(lines)
+
+    s = Server()
+    s.alter("child: [uid] .")
+    t0 = time.perf_counter()
+    ParallelBulkLoader(s).load_text("\n".join(lines))
+    load_s = time.perf_counter() - t0
+    print(f"fanout graph: {edges} edges loaded in {load_s:.1f}s",
+          file=sys.stderr)
+
+    q = "{ q(func: uid(0x1)) { child { child { c: count(child) } } } }"
+
+    def run_mode(batch: bool):
+        os.environ["DGRAPH_TPU_LEVEL_BATCH"] = "1" if batch else "0"
+        s.query(q)  # warm the decoded-list caches
+        p0 = READ_COUNTERS.point_reads
+        b0 = READ_COUNTERS.batch_reads
+        best = float("inf")
+        reps = 3
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = s.query(q)
+            best = min(best, time.perf_counter() - t0)
+        trips = (
+            (READ_COUNTERS.point_reads - p0)
+            + (READ_COUNTERS.batch_reads - b0)
+        ) / reps
+        n2 = sum(
+            len(c1.get("child", []))
+            for c1 in out["data"]["q"][0]["child"]
+        )
+        return best * 1e3, trips, n2
+
+    per_uid_ms, per_uid_trips, n2 = run_mode(batch=False)
+    batched_ms, batched_trips, n2b = run_mode(batch=True)
+    os.environ.pop("DGRAPH_TPU_LEVEL_BATCH", None)
+    assert n2 == n2b, (n2, n2b)
+    reduction = per_uid_trips / max(1.0, batched_trips)
+    print(
+        json.dumps(
+            {
+                "metric": "fanout_3level_1M",
+                "value": round(batched_ms, 2),
+                "unit": "ms",
+                "per_uid_baseline_ms": round(per_uid_ms, 2),
+                "speedup_x": round(per_uid_ms / batched_ms, 2),
+                "platform": platform,
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "level_batch_read_calls",
+                "value": batched_trips,
+                "unit": "round-trips/query",
+                "per_uid_baseline": per_uid_trips,
+                "reduction_x": round(reduction, 1),
+                "platform": platform,
+            }
+        )
+    )
+    stamp.guarded_write(
+        "BENCH_FANOUT.json",
+        {
+            "fanout_3level_1M_ms": {
+                "batched": round(batched_ms, 2),
+                "per_uid_baseline": round(per_uid_ms, 2),
+                "speedup_x": round(per_uid_ms / batched_ms, 2),
+            },
+            "level_batch_read_calls": {
+                "batched": batched_trips,
+                "per_uid_baseline": per_uid_trips,
+                "reduction_x": round(reduction, 1),
+            },
+            "graph": {
+                "edges": edges,
+                "levels": 3,
+                "fanout": f,
+                "l2_parents": len(l2),
+                "l3_rows": int(n2),
+                "load_seconds": round(load_s, 1),
+            },
+        },
+        platform,
+    )
+
+
 def main():
     _watchdog(900)
     platform_note = ""
@@ -174,6 +294,7 @@ def main():
     )
     print(json.dumps(result))
     _bench_packed(rng, big, platform)
+    _bench_fanout(platform)
 
 
 def _bench_packed(rng, big, platform):
@@ -277,4 +398,14 @@ def _bench_packed(rng, big, platform):
 
 
 if __name__ == "__main__":
-    main()
+    if "--fanout-only" in sys.argv:
+        # query-engine-only capture: no device probe (the executor's
+        # dispatcher handles backend fallback itself)
+        from dgraph_tpu.devsetup import maybe_force_cpu
+
+        maybe_force_cpu()
+        import jax as _jax
+
+        _bench_fanout(_jax.default_backend())
+    else:
+        main()

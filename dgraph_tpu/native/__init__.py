@@ -80,6 +80,11 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(i64),
     ]
     lib.pack_intersect_small.restype = i64
+    lib.packs_decode_many.argtypes = [
+        ctypes.POINTER(u64p), ctypes.POINTER(i32p), ctypes.POINTER(u32p),
+        ctypes.POINTER(i64), i64, i64, u64p, ctypes.POINTER(i64),
+    ]
+    lib.packs_decode_many.restype = i64
     for name in ("intersect_u64", "union_u64", "difference_u64"):
         fn = getattr(lib, name)
         fn.argtypes = [u64p, i64, u64p, i64, u64p]
@@ -234,6 +239,53 @@ def pack_decode_blocks(bases, counts, offsets, idxs):
     return out[:n]
 
 
+def packs_decode_many(packs):
+    """Decode N UidPacks into (flat u64 buffer, int64[n+1] prefix offsets)
+    in ONE native call — the level-batched fan-out read path (N parents'
+    posting lists materialized together). Returns None when the native lib
+    is unavailable (caller falls back to per-pack decode)."""
+    if _LIB is None:
+        return None
+    n = len(packs)
+    offs = np.zeros((n + 1,), np.int64)
+    total = sum(p.num_uids for p in packs)
+    out = np.empty((total,), np.uint64)
+    if n == 0 or total == 0:
+        return out, offs
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    bases_pp = (u64p * n)()
+    counts_pp = (i32p * n)()
+    offsets_pp = (u32p * n)()
+    nblocks = np.empty((n,), np.int64)
+    block_size = 0
+    # keep converted temporaries alive past the native call
+    keep = []
+    for i, p in enumerate(packs):
+        b = np.ascontiguousarray(p.bases, np.uint64)
+        c = np.ascontiguousarray(p.counts, np.int32)
+        o = np.ascontiguousarray(p.offsets, np.uint32)
+        keep.append((b, c, o))
+        bases_pp[i] = _ptr(b, ctypes.c_uint64)
+        counts_pp[i] = _ptr(c, ctypes.c_int32)
+        offsets_pp[i] = _ptr(o, ctypes.c_uint32)
+        nblocks[i] = b.size
+        if o.ndim == 2 and o.shape[1]:
+            block_size = o.shape[1]
+    _LIB.packs_decode_many(
+        bases_pp,
+        counts_pp,
+        offsets_pp,
+        nblocks.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        block_size,
+        n,
+        _ptr(out, ctypes.c_uint64),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out, offs
+
+
 def pack_ptrs(bases, counts, offsets, maxes):
     """Pre-built ctypes pointers for a long-lived pack's block arrays —
     callers cache the tuple on the pack so per-op calls skip the
@@ -314,6 +366,34 @@ def merge_sorted(lists) -> np.ndarray:
         return np.unique(np.concatenate(lists))
     flat = np.concatenate(lists)
     lens = np.asarray([x.size for x in lists], np.int64)
+    total = int(flat.size)
+    out = np.empty((total,), np.uint64)
+    scratch = np.empty((total,), np.uint64)
+    n = _LIB.merge_sorted_u64(
+        _ptr(flat, ctypes.c_uint64),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lens.size,
+        _ptr(out, ctypes.c_uint64),
+        _ptr(scratch, ctypes.c_uint64),
+    )
+    return out[:n]
+
+
+def merge_sorted_flat(flat: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """K-way sorted union over an ALREADY-FLAT ragged buffer (row i is
+    flat[sum(lens[:i]) : sum(lens[:i+1])], each row sorted) — the
+    level-batched read form, skipping the per-row concatenate that
+    merge_sorted() does. Falls back to numpy unique without the lib."""
+    flat = np.ascontiguousarray(flat, np.uint64)
+    lens = np.ascontiguousarray(lens, np.int64)
+    if flat.size == 0:
+        return np.zeros((0,), np.uint64)
+    if _LIB is None:
+        return np.unique(flat)
+    # empty rows don't move flat but each would still cost two O(acc)
+    # copies in merge_sorted_u64's fold — sparse wide levels are mostly
+    # empty rows, so drop them first
+    lens = lens[lens != 0]
     total = int(flat.size)
     out = np.empty((total,), np.uint64)
     scratch = np.empty((total,), np.uint64)

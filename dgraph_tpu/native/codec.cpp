@@ -70,6 +70,36 @@ int64_t pack_decode_blocks(const uint64_t* bases, const int32_t* counts,
     return k;
 }
 
+// Level-batched fan-out fast path: decode N packs (one per parent uid of a
+// traversal level) into ONE flat uid buffer + a per-pack prefix-offsets
+// array in a single native pass. Per-pack pointer arrays avoid
+// concatenating the block matrices host-side; out_offsets has npacks+1
+// entries (out_offsets[p]..out_offsets[p+1] is pack p's row). Returns
+// total UIDs written.
+int64_t packs_decode_many(const uint64_t* const* bases,
+                          const int32_t* const* counts,
+                          const uint32_t* const* offsets,
+                          const int64_t* nblocks, int64_t block_size,
+                          int64_t npacks, uint64_t* out,
+                          int64_t* out_offsets) {
+    int64_t k = 0;
+    for (int64_t p = 0; p < npacks; p++) {
+        out_offsets[p] = k;
+        const uint64_t* pb = bases[p];
+        const int32_t* pc = counts[p];
+        const uint32_t* po = offsets[p];
+        int64_t nb = nblocks[p];
+        for (int64_t bi = 0; bi < nb; bi++) {
+            uint64_t base = pb[bi];
+            const uint32_t* row = po + bi * block_size;
+            int64_t c = pc[bi];
+            for (int64_t j = 0; j < c; j++) out[k++] = base + row[j];
+        }
+    }
+    out_offsets[npacks] = k;
+    return k;
+}
+
 // Compressed-domain tiny-frontier intersect (ops/packed_setops.py small
 // path; the scalar analog of algo/packed.go IntersectCompressedWithBin):
 // for each frontier element binary-search its containing block by base,

@@ -19,6 +19,40 @@ from dgraph_tpu.posting.pl import (
     fingerprint64,
 )
 from dgraph_tpu.storage.kv import KV
+from dgraph_tpu.utils.observe import METRICS
+
+
+class ReadCounters:
+    """Process-wide cache round-trip accounting (level_batch_read_calls
+    benchmark + fan-out observability). Plain unsynchronized ints: point
+    reads are the hottest call sites in the engine, so a lock per
+    increment (METRICS.inc) is not acceptable there; a lost increment
+    under racing threads is noise, not corruption. `publish()` mirrors
+    the totals into the Prometheus registry as gauges."""
+
+    __slots__ = ("point_reads", "batch_reads", "batch_read_keys")
+
+    def __init__(self):
+        self.point_reads = 0
+        self.batch_reads = 0
+        self.batch_read_keys = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "point_reads": self.point_reads,
+            "batch_reads": self.batch_reads,
+            "batch_read_keys": self.batch_read_keys,
+        }
+
+    def publish(self):
+        METRICS.set_gauge("cache_point_reads", float(self.point_reads))
+        METRICS.set_gauge("cache_batch_reads", float(self.batch_reads))
+        METRICS.set_gauge(
+            "cache_batch_read_keys", float(self.batch_read_keys)
+        )
+
+
+READ_COUNTERS = ReadCounters()
 
 
 class LocalCache:
@@ -66,17 +100,99 @@ class LocalCache:
     # -- reads (uncommitted deltas visible to this txn) ----------------------
 
     def uids(self, key: bytes) -> np.ndarray:
+        READ_COUNTERS.point_reads += 1
         return self.get(key).uids(self.deltas.get(key))
 
     def uids_tok(self, key: bytes):
         """(uids, version token). The token is the posting list's device-
         cache identity (key, latest_ts) — None when this txn has local
         deltas on the key (the materialized view is txn-private then)."""
+        READ_COUNTERS.point_reads += 1
         pl = self.get(key)
         extra = self.deltas.get(key)
         uids = pl.uids(extra)
         tok = None if extra else (key, pl.latest_ts)
         return uids, tok
+
+    # -- level-batched reads (one task per (predicate, level)) ---------------
+
+    def _resolve_many(self, keys_list) -> None:
+        """Materialize PostingLists for every key in ONE memlayer pass
+        (single lock acquisition + one versions_batch LSM probe) instead
+        of N read-throughs."""
+        missing = [k for k in keys_list if k not in self._plists]
+        if not missing:
+            return
+        if self.mem is not None:
+            self._plists.update(
+                self.mem.read_many(self.kv, missing, self.read_ts)
+            )
+        else:
+            for k in missing:
+                if k not in self._plists:
+                    self.get(k)
+
+    def uids_many(self, keys_list):
+        """Batched uid read for a whole traversal level: returns
+        (flat, offsets, toks) where row i = flat[offsets[i]:offsets[i+1]]
+        is key i's sorted uid set and toks[i] is its device-cache version
+        token ((key, latest_ts), None when txn-local deltas exist).
+
+        One memlayer/LSM pass resolves every list; all-committed no-delta
+        packs then decode through ONE native pass (codec.cpp
+        packs_decode_many) into the shared flat buffer — each list adopts
+        its slice as the memoized materialization, so later point reads
+        stay free. Lists with uid deltas fall back to the layered path."""
+        from dgraph_tpu.codec import uidpack
+
+        n = len(keys_list)
+        READ_COUNTERS.batch_reads += 1
+        READ_COUNTERS.batch_read_keys += n
+        self._resolve_many(keys_list)
+        rows: list = [None] * n
+        toks: list = [None] * n
+        batch = []  # (row index, PostingList) pending the one-pass decode
+        for i, k in enumerate(keys_list):
+            pl = self._plists.get(k)
+            if pl is None:
+                pl = self.get(k)
+            extra = self.deltas.get(k)
+            if not extra:
+                toks[i] = (k, pl.latest_ts)
+                if pl._uids_cache is not None:
+                    rows[i] = pl._uids_cache
+                elif not pl.has_uid_deltas():
+                    batch.append((i, pl))
+                else:
+                    rows[i] = pl.uids(None)
+            else:
+                rows[i] = pl.uids(extra)
+        if batch:
+            flat_b, offs_b = uidpack.decode_packs(
+                [pl.merged_pack() for _, pl in batch]
+            )
+            for j, (i, pl) in enumerate(batch):
+                row = flat_b[offs_b[j] : offs_b[j + 1]]
+                pl.adopt_uids(row)
+                rows[i] = row
+        from dgraph_tpu.query.ragged import pack_rows
+
+        flat, offsets = pack_rows(rows)
+        METRICS.inc("level_batch_read_bytes", int(flat.nbytes))
+        return flat, offsets, toks
+
+    def values_many(self, keys_list):
+        """Batched value-posting read: one memlayer/LSM pass for the whole
+        level, then the per-list merge (values are heterogeneous posting
+        objects — the batched KV probe is the win, not the merge loop).
+        Returns a list aligned with keys_list."""
+        READ_COUNTERS.batch_reads += 1
+        READ_COUNTERS.batch_read_keys += len(keys_list)
+        self._resolve_many(keys_list)
+        return [
+            self.get(k).get_all_values(self.deltas.get(k))
+            for k in keys_list
+        ]
 
     def packed_operand(self, key: bytes):
         """The posting list as a compressed-domain dispatcher operand
@@ -101,9 +217,11 @@ class LocalCache:
         )
 
     def value(self, key: bytes, lang: str = ""):
+        READ_COUNTERS.point_reads += 1
         return self.get(key).get_value(lang, self.deltas.get(key))
 
     def values(self, key: bytes) -> List[Posting]:
+        READ_COUNTERS.point_reads += 1
         return self.get(key).get_all_values(self.deltas.get(key))
 
     def has(self, key: bytes) -> bool:

@@ -141,15 +141,24 @@ class MemoryLayer:
                 else:
                     out[k] = None  # decode outside the lock
                     to_store.append((k, newest_ts, versions))
+        # one decode loop outside the lock, then ONE lock acquisition to
+        # publish the whole level's entries (level-batched fan-out: the
+        # per-key lock round-trips dominated wide levels)
+        decoded = []
         for k, newest_ts, versions in to_store:
-            self.misses += 1
             pl = PostingList.from_versions(
                 k, versions, kv=kv, read_ts=read_ts
             )
             out[k] = pl
+            decoded.append((k, newest_ts, pl))
+        if decoded:
             with self._lock:
-                self._cache[k] = (newest_ts, pl, seq, read_ts, complete)
-                self._cache.move_to_end(k)
+                self.misses += len(decoded)
+                for k, newest_ts, pl in decoded:
+                    self._cache[k] = (
+                        newest_ts, pl, seq, read_ts, complete
+                    )
+                    self._cache.move_to_end(k)
                 while len(self._cache) > self.max_entries:
                     self._cache.popitem(last=False)
         return out

@@ -464,6 +464,18 @@ class PostingList:
 
     # -- reads ---------------------------------------------------------------
 
+    def adopt_uids(self, uids: np.ndarray) -> None:
+        """Install an externally decoded uid set as the memoized
+        materialization (level-batched reads decode N lists' packs into one
+        flat buffer and hand each list back its slice). Only valid for a
+        list whose packed view is exact — callers check has_uid_deltas()
+        first; the adopted array must equal what uids() would compute.
+        The slice keeps its level buffer alive; total retention matches
+        per-list copies while the whole cohort stays cached (one commit
+        drops them together via MemoryLayer invalidation)."""
+        if self._uids_cache is None:
+            self._uids_cache = uids
+
     def uids(self, extra_deltas: Optional[List[Posting]] = None) -> np.ndarray:
         """Materialized sorted u64 uid set (ref list.go:1758 Uids).
 

@@ -452,6 +452,61 @@ class SetOpDispatcher:
             res.append(join_segments({hi: out[i, : cnt[i]]}))
         return res
 
+    def run_rows_vs_one_ragged(
+        self,
+        op: str,
+        flat: np.ndarray,
+        offs: np.ndarray,
+        b: np.ndarray,
+        row_tokens: Optional[Sequence[Optional[tuple]]] = None,
+        b_token: Optional[tuple] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """run_rows_vs_one over a ragged level buffer: rows live in ONE
+        flat sorted-per-row u64 array with prefix `offs` (level-batched
+        task form, query/ragged.py). Returns the result in the same
+        (flat, offs) shape without materializing per-row lists.
+
+        The host path is fully vectorized — one searchsorted over the
+        whole flat buffer plus one cumsum to rebuild offsets — which is
+        the CPU-backend fast path for every traversal level. The device
+        path reuses the padded-matrix upload via zero-copy row views."""
+        from dgraph_tpu.query import ragged
+
+        n = len(offs) - 1
+        b64 = np.asarray(b, np.uint64)
+        if n == 0:
+            return flat, offs
+        if not flat.size and op != "union":
+            return flat, offs  # all rows empty: intersect/difference stay so
+        if op == "intersect" and not b64.size:
+            return np.zeros((0,), np.uint64), np.zeros_like(offs)
+        if op in ("difference", "union") and not b64.size:
+            return flat, offs
+        total = flat.size + b64.size
+        host = (
+            not _FORCE_DEVICE and total < self._min_total()
+        ) or not self._device_ready()
+        if host and op in ("intersect", "difference") and flat.size:
+            idx = np.minimum(
+                np.searchsorted(b64, flat), b64.size - 1
+            )
+            mask = b64[idx] == flat
+            if op == "difference":
+                mask = ~mask
+            return ragged.apply_mask(flat, offs, mask)
+        rows = [flat[offs[i] : offs[i + 1]] for i in range(n)]
+        res = self.run_rows_vs_one(
+            op, rows, b64, row_tokens=row_tokens, b_token=b_token
+        )
+        out_offs = np.zeros((n + 1,), np.int64)
+        np.cumsum([len(r) for r in res], out=out_offs[1:])
+        if not out_offs[-1]:
+            return np.zeros((0,), np.uint64), out_offs
+        return (
+            np.concatenate(res).astype(np.uint64, copy=False),
+            out_offs,
+        )
+
     def run_chain(self, op: str, parts: Sequence[np.ndarray]) -> np.ndarray:
         """Combine k sorted u64 sets with one associative op (AND/OR filter
         chains, ref query.go:2355-2372) in a single device dispatch instead

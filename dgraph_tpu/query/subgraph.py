@@ -14,6 +14,9 @@ Execution order of blocks follows variable dependencies
 
 from __future__ import annotations
 
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -22,6 +25,7 @@ import numpy as np
 from dgraph_tpu.dql.parser import FilterTree, GraphQuery, Order
 from dgraph_tpu.posting.lists import LocalCache
 from dgraph_tpu.posting.pl import Posting
+from dgraph_tpu.query import ragged
 from dgraph_tpu.query.dispatch import DISPATCHER
 from dgraph_tpu.query.functions import (
     EMPTY,
@@ -32,7 +36,44 @@ from dgraph_tpu.query.functions import (
 )
 from dgraph_tpu.schema.schema import State
 from dgraph_tpu.types.types import TypeID, Val, compare_vals, convert
+from dgraph_tpu.utils.observe import METRICS, TRACER
 from dgraph_tpu.x import keys
+
+# ---------------------------------------------------------------------------
+# Sibling-expansion worker pool (ref query.go ProcessGraph goroutine-per-
+# child). One process-wide bounded pool, sized by DGRAPH_TPU_EXEC_WORKERS
+# (0/1 = serial escape hatch). Only the OUTERMOST expansion of a query
+# fans out — nested levels inside a worker run serially (a worker that
+# blocks on its own nested futures could deadlock a bounded pool) — so the
+# widest level gets the threads and the pool can never self-starve.
+# ---------------------------------------------------------------------------
+
+_EXPAND_POOLS: Dict[int, ThreadPoolExecutor] = {}
+_EXPAND_POOL_LOCK = threading.Lock()
+_EXPAND_TLS = threading.local()
+
+
+def _exec_workers() -> int:
+    try:
+        return int(os.environ.get("DGRAPH_TPU_EXEC_WORKERS", "0") or "0")
+    except ValueError:
+        return 0
+
+
+def _expand_pool(workers: int) -> ThreadPoolExecutor:
+    # one pool per distinct width, never shut down mid-process: a query
+    # holding a stale pool reference must keep submitting safely even if
+    # another query re-reads a changed DGRAPH_TPU_EXEC_WORKERS (the set
+    # of widths a deployment uses is tiny, so leaked idle threads are
+    # bounded; they exit with the process)
+    with _EXPAND_POOL_LOCK:
+        pool = _EXPAND_POOLS.get(workers)
+        if pool is None:
+            pool = _EXPAND_POOLS[workers] = ThreadPoolExecutor(
+                max_workers=workers,
+                thread_name_prefix="dgraph-tpu-expand",
+            )
+        return pool
 
 
 @dataclass
@@ -84,6 +125,14 @@ class Executor:
         # None = unrestricted; a set filters expand(_all_) expansion to
         # ACL-readable predicates (ref expand filtering in edgraph auth)
         self.allowed_preds = allowed_preds
+        # level-batched task reads (uids_many/values_many); the per-uid
+        # escape hatch exists for A/B benchmarking (level_batch_read_calls)
+        self.level_batch = (
+            os.environ.get("DGRAPH_TPU_LEVEL_BATCH", "1") != "0"
+        )
+        # sibling fan-out width; 0/1 = serial (resolved per Executor so
+        # tests can flip the env between queries)
+        self.exec_workers = _exec_workers()
         self.uid_vars: Dict[str, np.ndarray] = {}
         # vars whose stored order is MEANINGFUL (shortest-path vars hold
         # path order; uid(var) roots preserve it — ref TestShortestPathRev)
@@ -522,19 +571,56 @@ class Executor:
         # vars defined anywhere below (ref query.go dependency execution)
         made: Dict[int, ExecNode] = {}
         deferred = []
+        structural = []
         for cgq in gqs:
             if cgq.math_expr is not None or (cgq.aggregator and cgq.val_var):
                 deferred.append(cgq)
-                continue
-            cnode = self._make_child(node, cgq)
-            if cnode is None:
-                continue
-            made[id(cgq)] = cnode
-            if cnode.is_uid_pred and cgq.children:
-                # descend even with no dest uids: the subtree may define
-                # vars later blocks depend on (empty bindings)
-                self._propagate_level_vars(node, cnode)
-                self._expand_children(cnode, depth + 1)
+            else:
+                structural.append(cgq)
+        # sibling fan-out (ref query.go:2459 one goroutine per child):
+        # var-FREE subtrees expand concurrently — they neither read nor
+        # write uid_vars/val_vars, so any interleaving reproduces the
+        # serial result bit-for-bit. Var-touching siblings stay serial in
+        # declaration order (serial semantics are order-sensitive there).
+        results: Dict[int, Tuple[str, Any]] = {}
+        workers = self.exec_workers
+        # only non-worker threads submit (and wait on) futures; workers
+        # expand their subtrees serially — a bounded pool whose workers
+        # block on their own nested futures could self-starve
+        if workers > 1 and not getattr(_EXPAND_TLS, "in_worker", False):
+            par = [
+                cgq
+                for cgq in structural
+                if not self._gq_touches_vars(cgq)
+            ]
+            if len(par) > 1:
+                pool = _expand_pool(workers)
+                futs = [
+                    (
+                        cgq,
+                        pool.submit(
+                            self._expand_one_worker, node, cgq, depth
+                        ),
+                    )
+                    for cgq in par
+                ]
+                METRICS.inc("exec_parallel_siblings", len(futs))
+                for cgq, fut in futs:
+                    try:
+                        results[id(cgq)] = ("ok", fut.result())
+                    except Exception as exc:  # re-raised in decl order
+                        results[id(cgq)] = ("err", exc)
+        for cgq in structural:
+            got = results.get(id(cgq))
+            if got is not None:
+                status, val = got
+                if status == "err":
+                    raise val
+                cnode = val
+            else:
+                cnode = self._expand_one(node, cgq, depth)
+            if cnode is not None:
+                made[id(cgq)] = cnode
         for cgq in deferred:
             cnode = self._make_child(node, cgq)
             if cnode is not None:
@@ -542,6 +628,71 @@ class Executor:
         node.children.extend(
             made[id(g)] for g in gqs if id(g) in made
         )
+
+    def _expand_one(
+        self, node: ExecNode, cgq: GraphQuery, depth: int
+    ) -> Optional[ExecNode]:
+        """One structural child: make it, then descend its subtree
+        (descend even with no dest uids — the subtree may define vars
+        later blocks depend on, as empty bindings)."""
+        cnode = self._make_child(node, cgq)
+        if cnode is not None and cnode.is_uid_pred and cgq.children:
+            self._propagate_level_vars(node, cnode)
+            self._expand_children(cnode, depth + 1)
+        return cnode
+
+    def _expand_one_worker(
+        self, node: ExecNode, cgq: GraphQuery, depth: int
+    ) -> Optional[ExecNode]:
+        _EXPAND_TLS.in_worker = True
+        try:
+            return self._expand_one(node, cgq, depth)
+        finally:
+            _EXPAND_TLS.in_worker = False
+
+    def _gq_touches_vars(self, g: GraphQuery) -> bool:
+        """True when the subtree rooted at `g` defines OR consumes query
+        variables (uid vars, val vars, facet vars) anywhere — those
+        children must run serially in declaration order; everything else
+        is safe to expand concurrently."""
+
+        def func_vars(fn) -> bool:
+            if fn is None:
+                return False
+            if fn.uid_var or fn.val_var:
+                return True
+            # val(x) as a comparison ARGUMENT — ge(age, val(x)) — is
+            # stored as a ("valarg", name) tuple in fn.args, not val_var
+            return any(
+                isinstance(a, tuple) and len(a) == 2 and a[0] == "valarg"
+                for a in fn.args
+            )
+
+        def tree_vars(ft) -> bool:
+            if ft is None:
+                return False
+            if hasattr(ft, "args"):  # a bare FuncSpec leaf (facet filter)
+                return func_vars(ft)
+            if ft.func is not None and func_vars(ft.func):
+                return True
+            return any(tree_vars(c) for c in ft.children)
+
+        if (
+            g.var_name
+            or g.val_var
+            or g.aggregator
+            or g.math_expr is not None
+            or g.facet_vars
+            or g.expand.startswith("val:")
+        ):
+            return True
+        if any(o.val_var for o in g.order):
+            return True
+        if func_vars(g.func) or tree_vars(g.filter) or tree_vars(
+            g.facet_filter
+        ):
+            return True
+        return any(self._gq_touches_vars(c) for c in g.children)
 
     def _propagate_level_vars(self, node: ExecNode, cnode: ExecNode):
         """Push value vars available at `node`'s level one hop down into
@@ -616,57 +767,94 @@ class Executor:
                 else keys.DataKey(attr, int(u), self.ns)
                 for u in parent.dest_uids
             ]
-            self.cache.prefetch(level_keys)
-            rows = []
-            row_toks = []
-            for key in level_keys:
-                r, tok = self.cache.uids_tok(key)
-                rows.append(r)
-                row_toks.append(tok)
-            cnode.uid_matrix = rows
-            dest = _merge_rows(rows)
+            # ONE task per (predicate, level): the whole parent list reads
+            # in a single batched call returning the ragged (flat, offsets)
+            # level buffer (ref worker/task.go one task per attr; the
+            # per-uid loop is the DGRAPH_TPU_LEVEL_BATCH=0 escape hatch)
+            with TRACER.span(
+                "level_task", attr=attr, parents=len(level_keys)
+            ):
+                METRICS.inc("level_tasks_started")
+                METRICS.inc("level_task_uids", len(level_keys))
+                if self.level_batch:
+                    flat, offs, row_toks = self.cache.uids_many(level_keys)
+                else:
+                    self.cache.prefetch(level_keys)
+                    rows = []
+                    row_toks = []
+                    for key in level_keys:
+                        r, tok = self.cache.uids_tok(key)
+                        rows.append(r)
+                        row_toks.append(tok)
+                    flat, offs = ragged.pack_rows(rows)
             if cgq.filter is not None:
-                dest = self.eval_filter(cgq.filter, dest)
-                cnode.uid_matrix = DISPATCHER.run_rows_vs_one(
-                    "intersect", rows, dest, row_tokens=row_toks
+                dest = self.eval_filter(
+                    cgq.filter, ragged.merge_flat(flat, offs)
                 )
-            if cgq.facet_filter is not None or cgq.facet_order or cgq.facets:
-                self._apply_edge_facets(cnode, cgq, parent, reverse)
-            # per-row order & pagination (ref query.go:2493,2511);
-            # under @cascade, order fully — bounded top-k would truncate
-            # to offset+first BEFORE pruning restores the window
-            if cgq.order:
-                cnode.uid_matrix = [
-                    self._order_uids(cgq, r, full=cnode.under_cascade)
-                    for r in cnode.uid_matrix
-                ]
-            if (
-                cgq.first is not None
-                or cgq.offset is not None
-                or cgq.after is not None
-            ) and not cnode.under_cascade:
-                # any block inside a @cascade subtree defers pagination
-                # until after pruning (_apply_deferred_pagination; ref
-                # TestCascadeWithPaginationDeep)
-                cnode.uid_matrix = [
-                    _paginate(r, cgq.first, cgq.offset, cgq.after)
-                    for r in cnode.uid_matrix
-                ]
-            cnode.dest_uids = _merge_rows(cnode.uid_matrix)
+                flat, offs = DISPATCHER.run_rows_vs_one_ragged(
+                    "intersect", flat, offs, dest, row_tokens=row_toks
+                )
+            lens = None
+            # per-row Python features (edge facets, per-row ordering) still
+            # walk rows: materialize zero-copy VIEWS into the flat buffer;
+            # the plain path stays ragged end-to-end
+            if cgq.facet_filter is not None or cgq.facet_order or cgq.facets or cgq.order:
+                cnode.uid_matrix = ragged.row_views(flat, offs)
+                if cgq.facet_filter is not None or cgq.facet_order or cgq.facets:
+                    self._apply_edge_facets(cnode, cgq, parent, reverse)
+                # per-row order & pagination (ref query.go:2493,2511);
+                # under @cascade, order fully — bounded top-k would
+                # truncate to offset+first BEFORE pruning restores the
+                # window
+                if cgq.order:
+                    cnode.uid_matrix = [
+                        self._order_uids(cgq, r, full=cnode.under_cascade)
+                        for r in cnode.uid_matrix
+                    ]
+                if (
+                    cgq.first is not None
+                    or cgq.offset is not None
+                    or cgq.after is not None
+                ) and not cnode.under_cascade:
+                    # any block inside a @cascade subtree defers pagination
+                    # until after pruning (_apply_deferred_pagination; ref
+                    # TestCascadeWithPaginationDeep)
+                    cnode.uid_matrix = [
+                        _paginate(r, cgq.first, cgq.offset, cgq.after)
+                        for r in cnode.uid_matrix
+                    ]
+                cnode.dest_uids = _merge_rows(cnode.uid_matrix)
+            else:
+                if (
+                    cgq.first is not None
+                    or cgq.offset is not None
+                    or cgq.after is not None
+                ) and not cnode.under_cascade:
+                    # vectorized pagination: offsets arithmetic over the
+                    # flat buffer instead of n per-row _paginate calls
+                    flat, offs = ragged.paginate(
+                        flat, offs, cgq.first, cgq.offset, cgq.after
+                    )
+                cnode.uid_matrix = ragged.RaggedRows(flat, offs)
+                cnode.dest_uids = ragged.merge_flat(flat, offs)
+                lens = np.diff(offs)
             if cgq.groupby_attrs:
                 self._group_children(cgq, cnode, parent)
             if cgq.is_count:
-                cnode.counts = {
-                    int(u): len(r)
-                    for u, r in zip(parent.dest_uids, cnode.uid_matrix)
-                }
+                # vectorized off the ragged offsets (np.diff) — no per-row
+                # len() comprehension; the dict materializes only here,
+                # where a count child / count-var actually consumes it
+                if lens is None:
+                    lens = [len(r) for r in cnode.uid_matrix]
+                pu = [int(u) for u in parent.dest_uids]
+                cs = [int(c) for c in lens]
+                cnode.counts = dict(zip(pu, cs))
             if cgq.var_name:
                 if cgq.is_count:
                     # `c as count(follow)`: a VALUE var keyed by the parent
                     # (ref query.go count-var binding)
                     self.val_vars[cgq.var_name] = {
-                        u: Val(TypeID.INT, c)
-                        for u, c in cnode.counts.items()
+                        u: Val(TypeID.INT, c) for u, c in zip(pu, cs)
                     }
                     parent.own_vars.add(cgq.var_name)
                     self.var_def_node[cgq.var_name] = parent
@@ -675,9 +863,25 @@ class Executor:
         else:
             if attr.startswith("~"):
                 raise QueryError(f"reverse on non-uid predicate {attr[1:]!r}")
-            # value predicate: fetch postings per parent uid
-            for u in parent.dest_uids:
-                posts = self.cache.values(keys.DataKey(attr, int(u), self.ns))
+            # value predicate: ONE batched read for the whole level — the
+            # per-uid loop here never prefetched its DataKeys, so the LSM
+            # path was N point lookups (bugfix); values_many batches the
+            # memlayer/LSM probe in a single pass
+            dkeys = [
+                keys.DataKey(attr, int(u), self.ns)
+                for u in parent.dest_uids
+            ]
+            with TRACER.span(
+                "level_task", attr=attr, parents=len(dkeys)
+            ):
+                METRICS.inc("level_tasks_started")
+                METRICS.inc("level_task_uids", len(dkeys))
+                if self.level_batch:
+                    all_posts = self.cache.values_many(dkeys)
+                else:
+                    self.cache.prefetch(dkeys)
+                    all_posts = [self.cache.values(k) for k in dkeys]
+            for u, posts in zip(parent.dest_uids, all_posts):
                 if cgq.lang == "*":
                     pass  # @* keeps every language; encoder fans out fields
                 elif cgq.lang:

@@ -60,6 +60,15 @@ class Metrics:
         with self._lock:
             self._gauges[name] = value
 
+    def value(self, name: str) -> float:
+        """Current value of a counter/gauge (0 when never touched) — used
+        by benchmarks asserting on round-trip counts (level_batch_read
+        accounting) without parsing the exposition text."""
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, 0.0)
+
     def observe(self, name: str, seconds: float):
         with self._lock:
             h = self._hists.get(name)

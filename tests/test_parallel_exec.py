@@ -1,0 +1,198 @@
+"""Serial-vs-parallel executor equivalence (level-batched task fan-out).
+
+The sibling-expansion worker pool (subgraph._expand_children,
+DGRAPH_TPU_EXEC_WORKERS) must be a pure performance knob: byte-identical
+JSON against the serial executor on every query — the DQL golden corpus,
+randomized multi-level queries, and var-binding queries (uid_vars /
+val_vars are shared executor state and must stay race-free).
+
+Tier-1 runs the smoke subset; the full 535-case corpus sweep is
+slow-marked (one pass keeps thread-safety regressions out of main without
+stalling the 1-core box).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "ref_golden")
+CASES = json.load(open(os.path.join(HERE, "cases.json")))
+
+
+def _query_both(server, q):
+    """Run q with the serial and the 4-worker executor; return the two
+    byte-exact JSON payloads (or the error reprs when the query fails —
+    both modes must fail identically)."""
+    out = []
+    for workers in ("1", "4"):
+        os.environ["DGRAPH_TPU_EXEC_WORKERS"] = workers
+        try:
+            got = json.dumps(server.query(q)["data"], sort_keys=False)
+        except Exception as exc:  # must fail the same way serially
+            got = f"{type(exc).__name__}: {exc}"
+        out.append(got)
+    os.environ.pop("DGRAPH_TPU_EXEC_WORKERS", None)
+    return out
+
+
+@pytest.fixture(scope="module")
+def golden_server():
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter(open(os.path.join(HERE, "schema.txt")).read())
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf=open(os.path.join(HERE, "triples.rdf")).read(),
+        commit_now=True,
+    )
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf=open(os.path.join(HERE, "triples_facets.rdf")).read(),
+        commit_now=True,
+    )
+    return s
+
+
+# every ~9th case: wide coverage across the query0..4/facets/math suites
+# without stalling tier-1 on the 1-core box
+SMOKE_CASES = CASES[::9]
+
+
+@pytest.mark.parametrize(
+    "case", SMOKE_CASES, ids=[c["id"] for c in SMOKE_CASES]
+)
+def test_exec_workers_smoke(golden_server, case):
+    serial, parallel = _query_both(golden_server, case["query"])
+    assert serial == parallel
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("case", CASES, ids=[c["id"] for c in CASES])
+def test_exec_workers_full_corpus(golden_server, case):
+    serial, parallel = _query_both(golden_server, case["query"])
+    assert serial == parallel
+
+
+# ---------------------------------------------------------------------------
+# Var-binding equivalence: vars are shared executor state; the classifier
+# must serialize every var-touching sibling, in declaration order.
+# ---------------------------------------------------------------------------
+
+VAR_QUERIES = [
+    # count-var consumed by a sibling math node
+    """{ me(func: eq(name, "Michonne")) {
+        name
+        c as count(friend)
+        friend { name }
+        score: math(c + 1)
+    } }""",
+    # value var defined at one level, aggregated above
+    """{ var(func: has(friend)) { friend { a as age } }
+        me(func: has(friend)) {
+            name
+            mn: min(val(a))
+            friend { name age }
+        } }""",
+    # uid var from one block, consumed as a sibling filter
+    """{ f as var(func: eq(name, "Michonne")) { fr as friend }
+        me(func: uid(f)) {
+            name
+            friend @filter(uid(fr)) { name }
+            dgraph.type
+        } }""",
+    # facet var + per-parent propagation
+    """{ me(func: eq(name, "Michonne")) {
+        name
+        friend @facets(w as since) { name }
+        sum: math(w + 0)
+    } }""",
+    # val(x) as a comparison ARGUMENT (("valarg", x) in fn.args, not
+    # fn.val_var) — the classifier must serialize this sibling AFTER the
+    # `x as age` definition or the filter sees an unbound var
+    """{ me(func: eq(name, "Michonne")) {
+        x as age
+        friend @filter(le(age, val(x))) { name age }
+    } }""",
+]
+
+
+@pytest.mark.parametrize("q", VAR_QUERIES, ids=range(len(VAR_QUERIES)))
+def test_exec_workers_var_binding(golden_server, q):
+    serial, parallel = _query_both(golden_server, q)
+    assert serial == parallel
+
+
+# ---------------------------------------------------------------------------
+# Randomized multi-level fuzz: random graph, random query shapes.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fuzz_server():
+    from dgraph_tpu.api.server import Server
+
+    rng = np.random.default_rng(42)
+    n = 120
+    s = Server()
+    s.alter(
+        "name: string @index(exact, term) .\n"
+        "age: int @index(int) .\n"
+        "knows: [uid] @reverse @count .\n"
+        "likes: [uid] @reverse .\n"
+        "boss: uid .\n"
+    )
+    lines = []
+    for u in range(1, n + 1):
+        lines.append(f'<{hex(u)}> <name> "node{u}" .')
+        lines.append(f'<{hex(u)}> <age> "{u % 60}"^^<xs:int> .')
+        for v in rng.integers(1, n + 1, 6):
+            if int(v) != u:
+                lines.append(f"<{hex(u)}> <knows> <{hex(int(v))}> .")
+        for v in rng.integers(1, n + 1, 3):
+            lines.append(f"<{hex(u)}> <likes> <{hex(int(v))}> .")
+        lines.append(f"<{hex(u)}> <boss> <{hex(int(rng.integers(1, n + 1)))}> .")
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf="\n".join(lines), commit_now=True)
+    return s
+
+
+def _rand_query(rng) -> str:
+    preds = ["knows", "likes", "~knows", "~likes", "boss"]
+
+    def block(depth: int) -> str:
+        fields = ["name"]
+        if rng.random() < 0.5:
+            fields.append("age")
+        if rng.random() < 0.3:
+            fields.append("cnt: count(knows)")
+        k = 1 if depth >= 2 else int(rng.integers(1, 3))
+        for p in rng.choice(preds, size=k, replace=False):
+            mods = ""
+            if rng.random() < 0.4:
+                mods += " @filter(lt(age, %d))" % int(rng.integers(10, 60))
+            page = ""
+            if rng.random() < 0.4:
+                page = " (first: %d, offset: %d)" % (
+                    int(rng.integers(1, 6)),
+                    int(rng.integers(0, 3)),
+                )
+            if depth < 3:
+                mods = f"{page}{mods} {{ {block(depth + 1)} }}"
+            else:
+                mods = f"{page}{mods} {{ name }}"
+            fields.append(f"{p}{mods}")
+        return " ".join(fields)
+
+    root = int(rng.integers(1, 120))
+    return "{ q(func: uid(%s)) { %s } }" % (hex(root), block(1))
+
+
+def test_exec_workers_fuzz(fuzz_server):
+    rng = np.random.default_rng(1234)
+    for _ in range(25):
+        q = _rand_query(rng)
+        serial, parallel = _query_both(fuzz_server, q)
+        assert serial == parallel, q
