@@ -295,6 +295,7 @@ def main():
     print(json.dumps(result))
     _bench_packed(rng, big, platform)
     _bench_fanout(platform)
+    _bench_chaos(platform)
 
 
 def _bench_packed(rng, big, platform):
@@ -397,8 +398,89 @@ def _bench_packed(rng, big, platform):
     )
 
 
+def _bench_chaos(platform):
+    """Retry-storm visibility (BENCH_CHAOS.json): a fixed-seed fault
+    schedule (drops + delays + disconnects + lost acks) over an
+    in-process RPC pair, with idempotent retries. Stamps wall time and
+    the fault/retry/idempotency counters so a regression that turns
+    recoverable faults into retry storms — or worse, double-applies —
+    shows up as a diff in the artifact."""
+    import time as _t
+
+    from benchmarks import stamp
+    from dgraph_tpu.conn import faults as _faults
+    from dgraph_tpu.conn.faults import FaultPlan
+    from dgraph_tpu.conn.retry import Deadline
+    from dgraph_tpu.conn.rpc import RpcClient, RpcServer
+    from dgraph_tpu.utils.observe import METRICS
+
+    N = 400
+    srv = RpcServer().start()
+    applied = []
+    srv.register("apply", lambda a: applied.append(a["v"]) or {"ok": True})
+    keys = (
+        "rpc_retries_total", "rpc_giveups_total", "faults_injected_total",
+        "fault_drop_total", "fault_delay_total", "fault_disconnect_total",
+        "idem_hits_total",
+    )
+    before = {k: METRICS.value(k) for k in keys}
+    _faults.install(
+        FaultPlan(
+            seed=2024,
+            rules=[
+                {"point": "send", "action": "drop", "p": 0.06},
+                {"point": "send", "action": "delay", "p": 0.10,
+                 "delay_ms": 2},
+                {"point": "send", "action": "disconnect", "p": 0.04},
+                {"point": "resp", "action": "drop", "p": 0.04},
+            ],
+        )
+    )
+    try:
+        c = RpcClient(srv.addr, timeout=0.1)
+        t0 = _t.perf_counter()
+        for i in range(N):
+            c.call("apply", {"v": i}, timeout=0.1,
+                   deadline=Deadline.after(10.0), idem=True)
+        wall = _t.perf_counter() - t0
+    finally:
+        _faults.reset()
+        srv.close()
+    delta = {k: METRICS.value(k) - before[k] for k in keys}
+    lost = N - len(set(applied))
+    dupes = len(applied) - len(set(applied))
+    result = {
+        "metric": "chaos_rpc_400calls",
+        "value": round(wall, 3),
+        "unit": "s",
+        "retries_per_100_calls": round(delta["rpc_retries_total"] / N * 100, 1),
+        "faults_injected": delta["faults_injected_total"],
+        "idem_hits": delta["idem_hits_total"],
+        "lost_applies": lost,
+        "double_applies": dupes,
+        "platform": platform,
+    }
+    print(json.dumps(result))
+    assert lost == 0 and dupes == 0, (lost, dupes)
+    stamp.guarded_write(
+        "BENCH_CHAOS.json",
+        {
+            "chaos_rpc_400calls_s": round(wall, 3),
+            "seed": 2024,
+            "counters": {k: delta[k] for k in keys},
+            "retries_per_100_calls": result["retries_per_100_calls"],
+            "lost_applies": lost,
+            "double_applies": dupes,
+        },
+        platform,
+    )
+
+
 if __name__ == "__main__":
-    if "--fanout-only" in sys.argv:
+    if "--chaos-only" in sys.argv:
+        # host-only capture: no device involved in the RPC plane
+        _bench_chaos("cpu")
+    elif "--fanout-only" in sys.argv:
         # query-engine-only capture: no device probe (the executor's
         # dispatcher handles backend fallback itself)
         from dgraph_tpu.devsetup import maybe_force_cpu

@@ -1,6 +1,24 @@
 """Cluster RPC layer (ref /root/reference/conn/): pooled connections,
-heartbeat health, request/response framing over TCP."""
+heartbeat health + circuit breaking, request/response framing over TCP,
+deterministic fault injection (faults.py) and the shared
+retry/deadline vocabulary (retry.py)."""
 
-from dgraph_tpu.conn.rpc import RpcClient, RpcError, RpcPool, RpcServer
+from dgraph_tpu.conn.retry import Deadline, RetryPolicy, deadline_scope
+from dgraph_tpu.conn.rpc import (
+    PeerDownError,
+    RpcClient,
+    RpcError,
+    RpcPool,
+    RpcServer,
+)
 
-__all__ = ["RpcClient", "RpcError", "RpcPool", "RpcServer"]
+__all__ = [
+    "Deadline",
+    "PeerDownError",
+    "RetryPolicy",
+    "RpcClient",
+    "RpcError",
+    "RpcPool",
+    "RpcServer",
+    "deadline_scope",
+]

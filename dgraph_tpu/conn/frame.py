@@ -47,6 +47,12 @@ from typing import Any, List, Tuple
 
 MAGIC = 0x01
 _U32 = struct.Struct(">I")
+# Hard cap on a single wire frame (header-declared length). A corrupt or
+# hostile 4-byte length prefix must never drive an arbitrarily large
+# allocation in _recv_frame readers; matches the reference's 256MB gRPC
+# message cap (conn/pool.go grpc.MaxCallRecvMsgSize). Shared by
+# conn/rpc.py and raft/tcp.py so both planes enforce the same bound.
+MAX_FRAME = int(os.environ.get("DGRAPH_TPU_MAX_FRAME_BYTES", str(256 << 20)))
 _BLOB_MIN = 256  # bytes values at least this long leave the JSON
 _ZLIB_LEVEL = 1
 # Compression default OFF: raw blobs already beat the old JSON+b64 path

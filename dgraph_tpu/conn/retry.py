@@ -1,0 +1,128 @@
+"""Unified retry policy + deadline propagation for cluster RPCs.
+
+Before this module every call site hand-rolled failure handling: fixed
+`time.sleep(0.05)` loops in conn/rpc.py, zero/remote.py and
+worker/remote.py, and an independent 5s/8s/15s budget invented at each
+layer. This gives the stack one vocabulary:
+
+  RetryPolicy — exponential backoff with FULL JITTER (AWS-style:
+    sleep ~ U(0, min(cap, base * mult^attempt))), optionally bounded by
+    a max attempt count, always bounded by the caller's Deadline.
+
+  Deadline — a monotonic-clock budget stamped ONCE at the entry point
+    (query / commit / admin op) and flowed through every layer beneath:
+    RemoteGroup.read/propose, RemoteZero._exec, RpcClient.call all
+    clamp their per-attempt timeouts to what remains instead of
+    stacking their own defaults.
+
+  deadline_scope — thread-local propagation so the deadline crosses
+    layers without threading a parameter through every signature.
+    (Worker threads of the parallel executor do not inherit the scope;
+    calls made there fall back to per-layer defaults.)
+
+Retries/giveups are counted in utils/observe.METRICS
+(`rpc_retries_total`, `rpc_giveups_total` are incremented by the
+transports; this module only supplies the arithmetic).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+class Deadline:
+    """An absolute point on the monotonic clock."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float):
+        self.at = float(at)
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(time.monotonic() + seconds)
+
+    def remaining(self) -> float:
+        return self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def clamp(self, seconds: float, floor: float = 0.001) -> float:
+        """Cap a per-attempt budget to what remains of the deadline."""
+        return max(floor, min(seconds, self.remaining()))
+
+    def __repr__(self):
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+class RetryPolicy:
+    """Exponential backoff + full jitter, deadline-aware."""
+
+    def __init__(
+        self,
+        base: float = 0.02,
+        mult: float = 2.0,
+        cap: float = 1.0,
+        max_attempts: int = 0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.base = base
+        self.mult = mult
+        self.cap = cap
+        self.max_attempts = max_attempts  # 0 = unbounded (deadline rules)
+        self.rng = rng or random.Random()
+
+    def backoff(self, attempt: int) -> float:
+        """Jittered sleep for the given 1-based attempt number."""
+        ceiling = min(self.cap, self.base * (self.mult ** max(0, attempt - 1)))
+        return self.rng.uniform(0.0, ceiling)
+
+    def exhausted(self, attempt: int) -> bool:
+        return bool(self.max_attempts) and attempt >= self.max_attempts
+
+    def sleep(self, attempt: int, deadline: Optional[Deadline] = None) -> float:
+        """Sleep the jittered backoff, never past the deadline. Returns
+        the duration actually slept."""
+        d = self.backoff(attempt)
+        if deadline is not None:
+            d = min(d, max(0.0, deadline.remaining()))
+        if d > 0:
+            time.sleep(d)
+        return d
+
+
+# ---------------------------------------------------------------------------
+# thread-local deadline propagation
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+def current_deadline() -> Optional[Deadline]:
+    return getattr(_TLS, "deadline", None)
+
+
+@contextmanager
+def deadline_scope(deadline: Deadline):
+    """Install `deadline` as the ambient budget for this thread. Nested
+    scopes keep the TIGHTER deadline (an inner layer may shrink the
+    budget, never extend it)."""
+    prev = getattr(_TLS, "deadline", None)
+    if prev is not None and prev.at < deadline.at:
+        deadline = prev
+    _TLS.deadline = deadline
+    try:
+        yield deadline
+    finally:
+        _TLS.deadline = prev
+
+
+def effective_deadline(default_s: float) -> Deadline:
+    """The ambient deadline, or a fresh one of `default_s` — the seam
+    every mid-layer uses instead of inventing its own budget."""
+    return current_deadline() or Deadline.after(default_s)

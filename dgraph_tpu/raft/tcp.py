@@ -17,9 +17,11 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
-from dgraph_tpu.conn.frame import pack_body, unpack_body
+from dgraph_tpu.conn import faults
+from dgraph_tpu.conn.frame import MAX_FRAME, pack_body, unpack_body
 from dgraph_tpu.conn.messages import RaftEnvelope
 from dgraph_tpu.raft.raft import Message
 
@@ -57,6 +59,8 @@ class TcpNetwork:
                     if len(hdr) < _LEN.size:
                         return
                     (n,) = _LEN.unpack(hdr)
+                    if n > MAX_FRAME:
+                        return  # corrupt length header: drop the conn
                     body = self.rfile.read(n)
                     if len(body) < n:
                         return
@@ -71,6 +75,16 @@ class TcpNetwork:
                         )
                     except (ValueError, KeyError, TypeError):
                         continue
+                    plan = faults.active()
+                    if plan is not None:
+                        act = plan.decide("raft_recv", str(msg.frm), msg.kind)
+                        if act is not None:
+                            if act.action in ("drop", "partition"):
+                                continue
+                            if act.action == "disconnect":
+                                return
+                            if act.action == "delay":
+                                time.sleep(act.delay_s)
                     with net.lock:
                         if msg.to in net.inboxes:
                             net.inboxes[msg.to].append(msg)
@@ -108,6 +122,24 @@ class TcpNetwork:
             with self.lock:
                 self.inboxes[msg.to].append(msg)
             return
+        act = None
+        plan = faults.active()
+        if plan is not None:
+            act = plan.decide("raft_send", str(msg.to), msg.kind)
+            if act is not None:
+                if act.action in ("drop", "partition"):
+                    return  # lost on the wire: raft retries via timeouts
+                if act.action == "disconnect":
+                    with self.lock:
+                        s = self._conns.pop(msg.to, None)
+                    if s is not None:
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                    return
+                if act.action == "delay":
+                    time.sleep(act.delay_s)
         try:
             body = RaftEnvelope(
                 kind=msg.kind, frm=msg.frm, to=msg.to, term=msg.term,
@@ -135,6 +167,8 @@ class TcpNetwork:
                 return  # peer unreachable: raft retries via timeouts
             try:
                 s.sendall(frame)
+                if act is not None and act.action == "dup":
+                    s.sendall(frame)  # duplicate delivery
             except OSError:
                 self._conns.pop(msg.to, None)
                 try:

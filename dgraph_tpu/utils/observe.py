@@ -69,6 +69,23 @@ class Metrics:
                 return self._counters[name]
             return self._gauges.get(name, 0.0)
 
+    def snapshot(self, prefix: str = "") -> Dict[str, float]:
+        """Counters+gauges whose names start with `prefix` — used by the
+        chaos suite and bench.py to diff fault/retry/circuit counters
+        around a workload without parsing the exposition text."""
+        with self._lock:
+            out = {
+                k: v for k, v in self._counters.items()
+                if k.startswith(prefix)
+            }
+            out.update(
+                {
+                    k: v for k, v in self._gauges.items()
+                    if k.startswith(prefix)
+                }
+            )
+        return out
+
     def observe(self, name: str, seconds: float):
         with self._lock:
             h = self._hists.get(name)

@@ -221,6 +221,16 @@ class AlphaProcess:
 def main():
     with open(sys.argv[1]) as f:
         cfg = json.load(f)
+    from dgraph_tpu.conn import faults
+
+    plan = faults.init_from_env()
+    if plan is not None:
+        # chaos runs must be auditable: announce the inherited schedule
+        print(
+            f"[faults] alpha {cfg.get('node_id')}: chaos plan active "
+            f"seed={plan.seed} rules={len(plan.rules)}",
+            file=sys.stderr, flush=True,
+        )
     proc = AlphaProcess(cfg)
     try:
         proc.run_forever()

@@ -21,8 +21,10 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dgraph_tpu.conn.retry import Deadline, current_deadline, deadline_scope
 from dgraph_tpu.conn.rpc import RpcError, RpcPool
 from dgraph_tpu.posting.lists import Txn
+from dgraph_tpu.utils.observe import METRICS
 from dgraph_tpu.schema.schema import State, parse_schema
 from dgraph_tpu.worker.groups import ClusterTxn, IntentLog, ZeroService
 from dgraph_tpu.worker.remote import RemoteGroup, RemoteKV
@@ -227,15 +229,19 @@ class ProcCluster:
         for tu in types:
             self.schema.set_type(tu)
 
-    def read_kv(self):
-        return RemoteKV(self)
+    def read_kv(self, partial_ok: bool = False):
+        return RemoteKV(self, partial_ok=partial_ok)
 
     def new_txn(self) -> ClusterTxn:
         return ClusterTxn(self)
 
     def _commit(self, txn: Txn) -> int:
-        with self._commit_lock:
-            return self._commit_locked(txn)
+        # the mutation entry point stamps ONE deadline that flows through
+        # zero.commit and every group proposal beneath it
+        budget = float(os.environ.get("DGRAPH_TPU_COMMIT_DEADLINE_S", "20"))
+        with deadline_scope(current_deadline() or Deadline.after(budget)):
+            with self._commit_lock:
+                return self._commit_locked(txn)
 
     def _commit_locked(self, txn: Txn) -> int:
         from dgraph_tpu.posting.pl import encode_delta
@@ -311,15 +317,40 @@ class ProcCluster:
             src.propose(("drop", keys.SplitPredicatePrefix(pred)))
             self.mem.clear()
 
-    def query(self, q: str, read_ts: Optional[int] = None) -> dict:
+    def query(self, q: str, read_ts: Optional[int] = None,
+              timeout_s: Optional[float] = None) -> dict:
+        """Query with graceful degradation: the entry point stamps one
+        deadline for the whole read fan-out, and a group whose quorum is
+        unreachable yields empty reads plus a `degraded`/`partial`
+        marker in the response extensions instead of an error — queries
+        touching only healthy groups are unaffected."""
         from dgraph_tpu import dql
         from dgraph_tpu.posting.lists import LocalCache
         from dgraph_tpu.query.outputjson import JsonEncoder
         from dgraph_tpu.query.subgraph import Executor
 
-        ts = read_ts if read_ts is not None else self.zero.zero.read_ts()
-        cache = LocalCache(self.read_kv(), ts, mem=self.mem)
-        ex = Executor(cache, self.schema, vector_indexes=self.vector_indexes)
-        nodes = ex.process(dql.parse(q))
-        enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
-        return {"data": enc.encode_blocks(nodes)}
+        budget = timeout_s or float(
+            os.environ.get("DGRAPH_TPU_QUERY_DEADLINE_S", "15")
+        )
+        kv = self.read_kv(partial_ok=True)
+        with deadline_scope(current_deadline() or Deadline.after(budget)):
+            ts = read_ts if read_ts is not None else self.zero.zero.read_ts()
+            cache = LocalCache(kv, ts, mem=self.mem)
+            ex = Executor(
+                cache, self.schema, vector_indexes=self.vector_indexes
+            )
+            nodes = ex.process(dql.parse(q))
+            enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
+            out = {"data": enc.encode_blocks(nodes)}
+        if kv.degraded_groups:
+            METRICS.inc("degraded_queries_total")
+            # no cache wipe needed: RemoteKV exposes no mut_seq, so the
+            # MemoryLayer revalidates every entry against kv.versions on
+            # each read — an empty list cached during the outage heals
+            # itself on the first read after the group returns
+            out["extensions"] = {
+                "degraded": True,
+                "partial": True,
+                "unreachable_groups": sorted(kv.degraded_groups),
+            }
+        return out

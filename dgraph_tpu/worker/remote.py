@@ -7,6 +7,22 @@ replica of the owning group with request hedging (task.go:60 — a backup
 request fires if the primary is slow; first answer wins), proposals go to
 the group leader with not-leader retry.
 
+Failure semantics (PR 3 resilience layer):
+  - every retry loop here runs the shared RetryPolicy (full-jitter
+    backoff) under the ambient Deadline stamped by the query/commit
+    entry point (conn/retry.py) instead of fixed 50ms sleeps and
+    per-layer 5s/15s budgets;
+  - proposals go out `idem=True`, so a reconnect-and-resend cannot
+    double-apply through the server's idempotency LRU;
+  - a group whose every replica has an open circuit fails fast with
+    GroupUnavailableError instead of burning the caller's deadline, and
+    RemoteKV (in `partial_ok` mode, used by queries) converts that into
+    an empty read plus a degraded marker the entry point surfaces in
+    the response extensions;
+  - hedged reads run on one shared bounded executor; losing futures are
+    cancelled or reaped via done-callbacks (never abandoned), with
+    `hedge_wins` / `hedge_losses_joined` counters.
+
 The RemoteKV satisfies the same KV read interface the executor uses, so
 the whole query engine runs unchanged against OS-process alphas.
 """
@@ -20,13 +36,53 @@ from typing import Dict, List, Optional, Tuple
 
 from dgraph_tpu.conn.frame import pack_body
 from dgraph_tpu.conn.messages import GetRequest, IterateRequest, Proposal
-from dgraph_tpu.conn.rpc import RpcError, RpcPool
+from dgraph_tpu.conn.retry import Deadline, RetryPolicy, effective_deadline
+from dgraph_tpu.conn.rpc import PeerDownError, RpcError, RpcPool
 from dgraph_tpu.storage.kv import KV
+from dgraph_tpu.utils.observe import METRICS
 from dgraph_tpu.x import keys
+
+
+class GroupUnavailableError(RpcError):
+    """No replica of a raft group is reachable (all circuits open or the
+    deadline ran out probing). Queries degrade; commits surface it."""
+
+    def __init__(self, gid: int, detail: str = ""):
+        super().__init__(f"group {gid} unavailable: {detail}")
+        self.gid = gid
+
+
+_HEDGE_LOCK = threading.Lock()
+_HEDGE_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+
+def _hedge_pool() -> concurrent.futures.ThreadPoolExecutor:
+    """One shared bounded executor for hedge requests (the old
+    per-read ThreadPoolExecutor leaked its threads via
+    shutdown(wait=False) whenever the loser was still in flight)."""
+    global _HEDGE_POOL
+    with _HEDGE_LOCK:
+        if _HEDGE_POOL is None:
+            _HEDGE_POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="hedge"
+            )
+        return _HEDGE_POOL
+
+
+def _reap_loser(f: concurrent.futures.Future):
+    """Done-callback joining a losing hedge future: consume its result
+    or exception so nothing dangles on the client locks unobserved."""
+    try:
+        f.result()
+    except Exception:
+        pass
+    METRICS.inc("hedge_losses_joined")
 
 
 class RemoteGroup:
     """Client handle for one raft group of alpha processes."""
+
+    retry = RetryPolicy(base=0.02, cap=0.5)
 
     def __init__(self, gid: int, rpc_addrs: List[Tuple[str, int]], pool: RpcPool):
         self.gid = gid
@@ -39,97 +95,206 @@ class RemoteGroup:
         healthy = [a for a in self.addrs if self.pool.healthy(a)]
         return healthy or list(self.addrs)
 
-    def leader_addr(self, timeout: float = 5.0) -> Optional[Tuple[str, int]]:
+    def all_down(self) -> bool:
+        return not any(self.pool.healthy(a) for a in self.addrs)
+
+    def leader_addr(self, timeout: float = 5.0,
+                    deadline: Optional[Deadline] = None) -> Optional[Tuple[str, int]]:
         # short-lived cache: reads are leader-first (committed writes wait
         # only for the leader's apply, so followers may lag) and probing
         # health on every read would double RPC traffic
         if self._leader is not None and time.time() - self._leader_at < 1.0:
             if self.pool.healthy(self._leader):
                 return self._leader
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        dl = deadline or effective_deadline(timeout)
+        attempt = 0
+        while True:
+            all_failfast = True
             for a in self.healthy_addrs():
                 try:
-                    h = self.pool.call(a, "health", timeout=1.0)
-                    if h.is_leader:
-                        self._leader = a
-                        self._leader_at = time.time()
-                        return a
-                except RpcError:
+                    h = self.pool.call(
+                        a, "health", timeout=1.0,
+                        deadline=Deadline.after(dl.clamp(1.0)),
+                    )
+                except PeerDownError:
                     continue
-            time.sleep(0.05)
-        return None
+                except RpcError:
+                    all_failfast = False
+                    continue
+                all_failfast = False
+                if h.is_leader:
+                    self._leader = a
+                    self._leader_at = time.time()
+                    return a
+            if all_failfast:
+                return None  # every probe hit an open circuit: bail now
+            attempt += 1
+            if dl.remaining() <= 0:
+                return None
+            self.retry.sleep(attempt, dl)
+            if dl.expired():
+                return None
 
     def propose(self, data, timeout: float = 15.0):
-        """Leader-routed proposal with retry across elections."""
-        deadline = time.time() + timeout
+        """Leader-routed proposal with retry across elections. Runs under
+        the ambient deadline (commit entry point) and sends `idem=True`
+        so a transport-level resend after a lost ack dedupes in the
+        server's LRU. A retry of THIS loop (fresh logical call, e.g.
+        after the server's apply-wait timed out post-append) may re-add
+        the entry to the raft log — safe because delta/drop proposals
+        apply idempotently (same-ts puts); Zero-side ops get their
+        exactly-once verdicts from the state machine itself
+        (ZeroStateMachine.txn_verdicts)."""
+        dl = effective_deadline(timeout)
         last = "no leader found"
-        while time.time() < deadline:
-            addr = self.leader_addr(timeout=max(0.1, deadline - time.time()))
+        attempt = 0
+        while not dl.expired():
+            addr = self.leader_addr(deadline=dl)
             if addr is None:
+                if self.all_down():
+                    raise GroupUnavailableError(
+                        self.gid, f"no reachable replica for propose: {last}"
+                    )
+                attempt += 1
+                self.retry.sleep(attempt, dl)
                 continue
+            # the server-side apply wait gets the remaining budget (the
+            # wire deadline), not a fixed 5s
+            wait_s = dl.clamp(8.0, floor=0.1)
             try:
                 out = self.pool.call(
                     addr, "propose",
                     Proposal(
-                        data=pack_body({"data": data, "timeout": 5.0})
+                        data=pack_body({"data": data, "timeout": wait_s})
                     ),
-                    timeout=8.0,
+                    timeout=wait_s + 2.0,
+                    idem=True,
+                    deadline=dl,
                 )
             except RpcError as e:
                 last = str(e)
+                attempt += 1
+                self.retry.sleep(attempt, dl)
                 continue
             if out.ok:
                 return {"ok": True, "index": out.index}
             last = f"not leader / timeout from {addr}: {out}"
-            time.sleep(0.05)
+            self._leader = None  # force re-discovery next attempt
+            attempt += 1
+            self.retry.sleep(attempt, dl)
         raise TimeoutError(f"proposal to group {self.gid} failed: {last}")
 
-    def read(self, method: str, args: dict, hedge_after: float = 0.15):
-        """Hedged read (worker/task.go:60): fire at the leader (it has
-        applied every acked commit); if it hasn't answered within
-        `hedge_after`, race a follower and take whichever returns first."""
+    def read(self, method: str, args: dict, hedge_after: float = 0.15,
+             deadline: Optional[Deadline] = None, timeout: float = 5.0):
+        """Hedged read (worker/task.go:60) with replica rotation: single
+        attempts fail fast (refusals, open circuits), and this loop
+        re-discovers the leader and retries with jittered backoff until
+        the deadline — so one dead/rebooting replica costs milliseconds,
+        not a stacked per-layer timeout."""
+        dl = deadline or effective_deadline(timeout)
+        attempt = 0
+        last: Optional[Exception] = None
+        while True:
+            if self.all_down():
+                METRICS.inc("group_unavailable_failfast_total")
+                raise GroupUnavailableError(
+                    self.gid, f"every replica circuit is open ({last})"
+                )
+            try:
+                return self._read_once(method, args, hedge_after, dl)
+            except GroupUnavailableError:
+                raise
+            except RpcError as e:
+                last = e
+                attempt += 1
+                if dl.remaining() <= 0:
+                    break
+                self._leader = None  # re-discover before the next try
+                self.retry.sleep(attempt, dl)
+                if dl.expired():
+                    break
+        raise RpcError(
+            f"read {method} on group {self.gid} failed after "
+            f"{attempt} attempts: {last}"
+        )
+
+    def _read_once(self, method: str, args: dict, hedge_after: float,
+                   dl: Deadline):
+        """One hedged attempt: leader first; if it hasn't answered within
+        `hedge_after`, race a follower and take whichever returns first.
+        Losing futures are cancelled/reaped, never abandoned."""
         addrs = self.healthy_addrs()
-        lead = self.leader_addr(timeout=2.0)
+        lead = self.leader_addr(
+            deadline=Deadline.after(dl.clamp(2.0))
+        )
         if lead is not None:
             addrs = [lead] + [a for a in addrs if a != lead]
+        if dl.expired():
+            raise GroupUnavailableError(self.gid, "deadline exhausted")
+        # one attempt never gets the whole read budget — the outer retry
+        # loop owns rotation across replicas
+        call_dl = Deadline.after(dl.clamp(self.pool.timeout))
         if len(addrs) == 1:
-            return self.pool.call(addrs[0], method, args)
-        ex = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+            return self.pool.call(addrs[0], method, args, deadline=call_dl)
+        ex = _hedge_pool()
+        f1 = ex.submit(
+            self.pool.call, addrs[0], method, args, deadline=call_dl
+        )
         try:
-            f1 = ex.submit(self.pool.call, addrs[0], method, args)
-            try:
-                return f1.result(timeout=hedge_after)
-            except concurrent.futures.TimeoutError:
-                pass
-            except RpcError:
-                return self.pool.call(addrs[1], method, args)
-            f2 = ex.submit(self.pool.call, addrs[1], method, args)
+            return f1.result(timeout=dl.clamp(hedge_after))
+        except concurrent.futures.TimeoutError:
+            pass
+        except RpcError:
+            return self.pool.call(addrs[1], method, args, deadline=call_dl)
+        f2 = ex.submit(
+            self.pool.call, addrs[1], method, args, deadline=call_dl
+        )
+        METRICS.inc("hedge_fired_total")
+        pending = {f1, f2}
+        errs: List[Exception] = []
+        while pending:
             done, _ = concurrent.futures.wait(
-                [f1, f2], return_when=concurrent.futures.FIRST_COMPLETED
+                pending, timeout=call_dl.clamp(self.pool.timeout),
+                return_when=concurrent.futures.FIRST_COMPLETED,
             )
-            errs = []
+            if not done:
+                break  # deadline exhausted with calls still in flight
             for f in done:
+                pending.discard(f)
                 try:
-                    return f.result()
-                except RpcError as e:
+                    out = f.result()
+                except Exception as e:
                     errs.append(e)
-            for f in (f1, f2):
-                try:
-                    return f.result(timeout=5.0)
-                except (RpcError, concurrent.futures.TimeoutError) as e:
-                    errs.append(e)
-            raise RpcError(f"all hedged reads failed: {errs}")
-        finally:
-            ex.shutdown(wait=False)
+                    continue
+                if f is f2:
+                    METRICS.inc("hedge_wins")
+                for loser in pending:
+                    if not loser.cancel():
+                        loser.add_done_callback(_reap_loser)
+                return out
+        for f in pending:
+            if not f.cancel():
+                f.add_done_callback(_reap_loser)
+        raise RpcError(
+            f"hedged read {method} on group {self.gid} failed: "
+            f"{errs or 'deadline exhausted'}"
+        )
 
 
 class RemoteKV(KV):
     """Read-only KV routing each key to its tablet's owning group over RPC
-    (the ServeTask seam made real across OS processes)."""
+    (the ServeTask seam made real across OS processes).
 
-    def __init__(self, cluster):
+    With `partial_ok=True` (the query path) an unreachable group yields
+    EMPTY results instead of an exception; the group id is recorded in
+    `degraded_groups` so the entry point can mark the response
+    degraded/partial — queries over healthy predicates keep answering
+    while one group is partitioned."""
+
+    def __init__(self, cluster, partial_ok: bool = False):
         self.cluster = cluster
+        self.partial_ok = partial_ok
+        self.degraded_groups: set = set()
 
     def _group_for(self, attr: str) -> Optional[RemoteGroup]:
         gid = self.cluster.zero.belongs_to(attr)
@@ -137,23 +302,35 @@ class RemoteKV(KV):
             return None
         return self.cluster.remote_groups[gid]
 
+    def _degrade(self, g: RemoteGroup):
+        self.degraded_groups.add(g.gid)
+        METRICS.inc("degraded_group_reads_total")
+
     def get(self, key, read_ts):
         g = self._group_for(keys.parse_key(key).attr)
         if g is None:
             return None
-        got = g.read("kv.get", GetRequest(key=key, ts=read_ts))
+        try:
+            got = g.read("kv.get", GetRequest(key=key, ts=read_ts))
+        except RpcError:
+            if not self.partial_ok:
+                raise
+            self._degrade(g)
+            return None
         return None if not got.found else (got.ts, got.value)
 
     def versions(self, key, read_ts):
         g = self._group_for(keys.parse_key(key).attr)
         if g is None:
             return []
-        return [
-            (r.ts, r.value)
-            for r in g.read(
-                "kv.versions", GetRequest(key=key, ts=read_ts)
-            ).kv
-        ]
+        try:
+            got = g.read("kv.versions", GetRequest(key=key, ts=read_ts))
+        except RpcError:
+            if not self.partial_ok:
+                raise
+            self._degrade(g)
+            return []
+        return [(r.ts, r.value) for r in got.kv]
 
     def iterate(self, prefix, read_ts):
         attr = keys.attr_of(prefix)
@@ -165,19 +342,33 @@ class RemoteKV(KV):
         for g in groups:
             if g is None:
                 continue
-            for r in g.read(
-                "kv.iterate", IterateRequest(prefix=prefix, ts=read_ts)
-            ).kv:
+            try:
+                got = g.read(
+                    "kv.iterate", IterateRequest(prefix=prefix, ts=read_ts)
+                )
+            except RpcError:
+                if not self.partial_ok:
+                    raise
+                self._degrade(g)
+                continue
+            for r in got.kv:
                 yield (r.key, r.ts, r.value)
 
     def iterate_versions(self, prefix, read_ts):
         for g in self.cluster.remote_groups.values():
+            try:
+                got = g.read(
+                    "kv.iterate_versions",
+                    IterateRequest(prefix=prefix, ts=read_ts),
+                )
+            except RpcError:
+                if not self.partial_ok:
+                    raise
+                self._degrade(g)
+                continue
             cur_key = None
             vers = []
-            for r in g.read(
-                "kv.iterate_versions",
-                IterateRequest(prefix=prefix, ts=read_ts),
-            ).kv:
+            for r in got.kv:
                 if r.key != cur_key:
                     if cur_key is not None:
                         yield (cur_key, vers)

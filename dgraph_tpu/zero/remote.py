@@ -14,12 +14,14 @@ import json
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from dgraph_tpu.conn.retry import RetryPolicy, effective_deadline
 from dgraph_tpu.conn.rpc import RpcError, RpcPool
 from dgraph_tpu.zero.zero import TxnConflictError
 
 
 class RemoteZero:
     TS_BLOCK = 128
+    retry = RetryPolicy(base=0.02, cap=0.5)
 
     def __init__(self, rpc_addrs: List[Tuple[str, int]], pool: RpcPool):
         self.addrs = [tuple(a) for a in rpc_addrs]
@@ -40,14 +42,22 @@ class RemoteZero:
         return json.loads(got.state_json)
 
     def _exec(self, kind: str, *args, timeout: float = 15.0):
-        deadline = time.time() + timeout
+        """Leader-routed Zero op. Runs under the ambient deadline (see
+        conn/retry.py), retries with full-jitter backoff instead of a
+        fixed 50ms sleep, and sends `idem=True`: a reconnect-and-resend
+        of a lease/commit/abort dedupes in the server's idempotency LRU
+        rather than re-proposing (a double-applied commit could flip a
+        verdict; a double-applied lease leaks a block)."""
+        dl = effective_deadline(timeout)
         last = "no zero leader"
-        while time.time() < deadline:
+        attempt = 0
+        while not dl.expired():
             order = (
                 [self._leader] + [a for a in self.addrs if a != self._leader]
                 if self._leader
                 else list(self.addrs)
             )
+            wait_s = dl.clamp(5.0, floor=0.1)
             for addr in order:
                 try:
                     from dgraph_tpu.conn.messages import ZeroExec
@@ -58,10 +68,12 @@ class RemoteZero:
                         ZeroExec(
                             op=kind,
                             args_json=json.dumps(
-                                {"args": list(args), "timeout": 5.0}
+                                {"args": list(args), "timeout": wait_s}
                             ).encode(),
                         ),
-                        timeout=8.0,
+                        timeout=wait_s + 3.0,
+                        idem=True,
+                        deadline=dl,
                     )
                 except RpcError as e:
                     last = str(e)
@@ -69,8 +81,11 @@ class RemoteZero:
                 if out.get("ok"):
                     self._leader = addr
                     return out["result"]
+                if out.get("not_leader"):
+                    self._leader = None
                 last = f"{addr}: {out}"
-            time.sleep(0.05)
+            attempt += 1
+            self.retry.sleep(attempt, dl)
         raise TimeoutError(f"zero.exec {kind} failed: {last}")
 
     # -- ZeroLite face -------------------------------------------------------

@@ -39,6 +39,13 @@ class ZeroStateMachine:
         # proposal results keyed by (proposer, req_id): the proposing
         # node's wrapper reads its own result after apply
         self.results: Dict[Tuple[int, int], object] = {}
+        # start_ts -> final commit/abort verdict. A txn's verdict is
+        # decided EXACTLY once: a commit op re-proposed through a
+        # different server (e.g. the first server applied it but timed
+        # out waiting, so the client retried elsewhere with a fresh
+        # request id) returns the recorded verdict instead of re-running
+        # conflict detection — which could flip commit into abort.
+        self.txn_verdicts: Dict[int, tuple] = {}
 
     def apply(self, op: tuple):
         kind = op[0]
@@ -72,17 +79,25 @@ class ZeroStateMachine:
             return first
         if kind == "commit":
             start_ts, cks = args
+            prior = self.txn_verdicts.get(start_ts)
+            if prior is not None:
+                return prior
+            if start_ts in self.aborted:
+                return ("abort", 0)
             for ck in cks:
                 if self.commits.get(ck, 0) > start_ts:
                     self.aborted.add(start_ts)
-                    return ("abort", self.commits[ck])
+                    return self._record_verdict(
+                        start_ts, ("abort", self.commits[ck])
+                    )
             self.max_ts += 1
             for ck in cks:
                 self.commits[ck] = self.max_ts
-            return ("commit", self.max_ts)
+            return self._record_verdict(start_ts, ("commit", self.max_ts))
         if kind == "abort":
             (start_ts,) = args
             self.aborted.add(start_ts)
+            self.txn_verdicts.setdefault(start_ts, ("abort", 0))
             return ("ok",)
         if kind == "tablet":
             (pred,) = args
@@ -103,8 +118,22 @@ class ZeroStateMachine:
             for ck in [c for c, ts in self.commits.items() if ts <= floor]:
                 del self.commits[ck]
             self.aborted = {t for t in self.aborted if t >= floor}
+            self.txn_verdicts = {
+                t: v for t, v in self.txn_verdicts.items() if t >= floor
+            }
             return ("ok",)
         raise ValueError(f"unknown zero op {kind!r}")
+
+    def _record_verdict(self, start_ts: int, verdict: tuple) -> tuple:
+        self.txn_verdicts[start_ts] = verdict
+        if len(self.txn_verdicts) > 20_000:
+            # deterministic bound (applied at the same op on every
+            # replica): keep the newest half by start_ts
+            cut = sorted(self.txn_verdicts)[len(self.txn_verdicts) // 2]
+            self.txn_verdicts = {
+                t: v for t, v in self.txn_verdicts.items() if t >= cut
+            }
+        return verdict
 
     # -- snapshot support ----------------------------------------------------
 
@@ -119,12 +148,14 @@ class ZeroStateMachine:
                 self.aborted,
                 self.tablets,
                 self.n_groups,
+                self.txn_verdicts,
             )
         )
 
     def load(self, blob: bytes):
         import pickle
 
+        state = pickle.loads(blob)
         (
             self.max_ts,
             self.max_uid,
@@ -132,7 +163,9 @@ class ZeroStateMachine:
             self.aborted,
             self.tablets,
             self.n_groups,
-        ) = pickle.loads(blob)
+        ) = state[:6]
+        # snapshots from before verdict dedup carry 6 fields
+        self.txn_verdicts = state[6] if len(state) > 6 else {}
         self.results = {}
 
 

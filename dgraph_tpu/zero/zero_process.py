@@ -143,6 +143,15 @@ class ZeroProcess:
 def main():
     with open(sys.argv[1]) as f:
         cfg = json.load(f)
+    from dgraph_tpu.conn import faults
+
+    plan = faults.init_from_env()
+    if plan is not None:
+        print(
+            f"[faults] zero {cfg.get('node_id')}: chaos plan active "
+            f"seed={plan.seed} rules={len(plan.rules)}",
+            file=sys.stderr, flush=True,
+        )
     proc = ZeroProcess(cfg)
     try:
         proc.run_forever()

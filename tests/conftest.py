@@ -46,3 +46,10 @@ def pytest_configure(config):
         "markers",
         "slow: full-corpus / large-scale passes excluded from tier-1",
     )
+    # chaos: fault-injection suites (tests/test_chaos.py). The fixed-seed
+    # smoke schedules stay in tier-1 (<30s); long randomized schedules
+    # carry `slow` as well and run out-of-band.
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection schedules against the cluster stack",
+    )

@@ -1,0 +1,698 @@
+"""Chaos suite: deterministic fault injection against the cluster stack.
+
+Unit layer: FaultPlan determinism (same seed => same per-stream fault
+sequence, byte-for-byte), RetryPolicy backoff/jitter/deadline math, the
+RpcClient timeout-restore and frame-size-cap satellites, idempotency-key
+dedup (no double-apply across reconnect-and-resend), the per-peer
+circuit breaker, and hedged-read loser reaping.
+
+Cluster layer (marked `chaos`): a fixed-seed fault schedule
+(drop+delay+disconnect across the Zero quorum and an alpha group) runs
+the bank workload on a real multi-process cluster with invariants
+checked — balance sum conserved at every snapshot, acked transfers
+applied exactly once (ledger-exact), read timestamps never going back in
+time — plus the graceful-degradation contract: with one alpha group
+fully partitioned, queries over healthy predicates still answer inside
+their deadline and queries touching the dead group return a
+`degraded`/`partial` response instead of hanging. Long randomized
+schedules are additionally marked `slow` (out of tier-1).
+"""
+
+import io
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu.conn import faults
+from dgraph_tpu.conn.faults import FaultPlan
+from dgraph_tpu.conn.frame import MAX_FRAME, FrameError
+from dgraph_tpu.conn.messages import HealthInfo
+from dgraph_tpu.conn.retry import (
+    Deadline,
+    RetryPolicy,
+    current_deadline,
+    deadline_scope,
+)
+from dgraph_tpu.conn.rpc import (
+    PeerDownError,
+    RpcClient,
+    RpcError,
+    RpcPool,
+    RpcServer,
+    _recv_frame,
+)
+from dgraph_tpu.utils.observe import METRICS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _dead_addr():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = s.getsockname()
+    s.close()
+    return addr
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan determinism
+# ---------------------------------------------------------------------------
+
+
+def test_same_seed_reproduces_fault_sequence_byte_for_byte():
+    rules = [
+        dict(point="send", action="drop", p=0.2),
+        dict(point="send", action="delay", p=0.3, delay_ms=5),
+        dict(point="resp", action="drop", p=0.15),
+    ]
+    runs = []
+    for _ in range(2):
+        plan = FaultPlan(seed=42, rules=rules)
+        seq = []
+        for peer in (("a", 1), ("b", 2)):
+            for point in ("send", "resp"):
+                for _n in range(40):
+                    r = plan.decide(point, peer, "m")
+                    seq.append(r.action if r is not None else None)
+        runs.append((seq, sorted(plan.trace().items())))
+    assert runs[0] == runs[1]  # byte-for-byte identical schedules
+    assert any(a for a in runs[0][0])  # and faults actually fired
+    # replay is a pure function of (seed, stream, n): it reconstructs the
+    # live decisions without consuming state
+    plan = FaultPlan(seed=42, rules=rules)
+    live = [
+        (r.action if r is not None else None)
+        for _ in range(40)
+        for r in (plan.decide("send", ("a", 1), "m"),)
+    ]
+    assert live == plan.replay("send", ("a", 1), 40, "m")
+    assert live == FaultPlan(seed=42, rules=rules).replay(
+        "send", ("a", 1), 40, "m"
+    )
+    # a different seed yields a different schedule
+    other = FaultPlan(seed=43, rules=rules).replay("send", ("a", 1), 40, "m")
+    assert live != other
+
+
+def test_fault_plan_streams_are_independent():
+    """Interleaving order across streams cannot change a stream's own
+    decisions — the determinism guarantee under thread scheduling."""
+    rules = [dict(action="drop", p=0.25)]
+    a = FaultPlan(seed=7, rules=rules)
+    for _ in range(30):
+        a.decide("send", "x")
+    seq_x_alone = [n_act for n_act in a.trace().get(("send", "x"), [])]
+    b = FaultPlan(seed=7, rules=rules)
+    for i in range(30):  # interleave with another stream
+        b.decide("send", "y")
+        b.decide("send", "x")
+    assert b.trace().get(("send", "x"), []) == seq_x_alone
+
+
+def test_env_spec_and_partition(monkeypatch):
+    import json
+
+    monkeypatch.setenv(
+        faults.ENV_VAR,
+        json.dumps(
+            {"seed": 5, "rules": [{"action": "drop", "p": 1.0, "max": 2}]}
+        ),
+    )
+    plan = faults.init_from_env(force=True)
+    assert plan is not None and plan.seed == 5
+    assert plan.decide("send", "p").action == "drop"
+    assert plan.decide("send", "p").action == "drop"
+    assert plan.decide("send", "p") is None  # max=2 exhausted
+    # directional partition blocks deterministically
+    plan.partition(("10.0.0.1", 1), direction="to")
+    assert plan.decide("send", ("10.0.0.1", 1)).action == "partition"
+    assert plan.decide("recv", ("10.0.0.1", 1)) is None  # other direction
+    plan.heal()
+    assert plan.decide("send", ("10.0.0.1", 1)) is None
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / Deadline
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_full_jitter_and_cap():
+    import random
+
+    p = RetryPolicy(base=0.1, mult=2.0, cap=0.5, rng=random.Random(0))
+    for attempt in range(1, 10):
+        ceiling = min(0.5, 0.1 * 2 ** (attempt - 1))
+        for _ in range(50):
+            d = p.backoff(attempt)
+            assert 0.0 <= d <= ceiling
+    assert p.exhausted(3) is False
+    assert RetryPolicy(max_attempts=3).exhausted(3) is True
+
+
+def test_retry_sleep_never_outlives_deadline():
+    p = RetryPolicy(base=5.0, cap=10.0)  # huge backoff...
+    dl = Deadline.after(0.05)
+    t0 = time.perf_counter()
+    p.sleep(5, dl)  # ...must be clipped to the deadline
+    assert time.perf_counter() - t0 < 0.2
+
+
+def test_deadline_scope_nests_tighter_only():
+    with deadline_scope(Deadline.after(10.0)) as outer:
+        with deadline_scope(Deadline.after(99.0)) as inner:
+            assert inner.at == outer.at  # cannot extend
+        with deadline_scope(Deadline.after(0.5)) as inner2:
+            assert inner2.at < outer.at  # may shrink
+        assert current_deadline() is outer
+    assert current_deadline() is None
+
+
+# ---------------------------------------------------------------------------
+# RPC satellites: timeout restore, frame cap
+# ---------------------------------------------------------------------------
+
+
+def test_per_call_timeout_restored_after_long_deadline_call():
+    srv = RpcServer().start()
+    try:
+        c = RpcClient(srv.addr, timeout=1.5)
+        c.call("ping", timeout=60.0)
+        # the old code left the 60s timeout on the socket, slowing
+        # failure detection for every later call
+        assert c._sock.gettimeout() == 1.5
+        c.close_conn()
+    finally:
+        srv.close()
+
+
+def test_recv_frame_rejects_oversize_length_header():
+    with pytest.raises(FrameError):
+        _recv_frame(io.BytesIO(struct.Struct(">I").pack(MAX_FRAME + 1)))
+    # and a server receiving one drops the connection cleanly
+    srv = RpcServer().start()
+    try:
+        s = socket.create_connection(srv.addr)
+        s.sendall(struct.Struct(">I").pack(1 << 31))
+        s.settimeout(2.0)
+        assert s.recv(64) == b""  # closed, no allocation attempted
+        s.close()
+        # the server keeps serving other connections
+        assert RpcPool(timeout=1.0).call(srv.addr, "ping")["pong"]
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# idempotency: reconnect-and-resend cannot double-apply
+# ---------------------------------------------------------------------------
+
+
+def _counting_server():
+    srv = RpcServer().start()
+    applied = []
+
+    def apply(a):
+        applied.append(a.get("v"))
+        return {"applied": len(applied)}
+
+    srv.register("apply", apply)
+    return srv, applied
+
+
+def test_lost_ack_resend_applies_once():
+    srv, applied = _counting_server()
+    try:
+        # the server applies, then the ack is lost — the classic
+        # double-apply trap for reconnect-and-resend
+        faults.install(
+            FaultPlan(
+                seed=1,
+                rules=[
+                    dict(point="resp", method="apply", action="drop",
+                         p=1.0, max=2)
+                ],
+            )
+        )
+        c = RpcClient(srv.addr, timeout=0.25)
+        h0 = METRICS.value("idem_hits_total")
+        out = c.call(
+            "apply", {"v": 7}, timeout=0.25,
+            deadline=Deadline.after(5.0), idem=True,
+        )
+        assert out["applied"] == 1
+        assert applied == [7]  # applied exactly once despite 2 resends
+        assert METRICS.value("idem_hits_total") >= h0 + 1
+        c.close_conn()
+    finally:
+        srv.close()
+
+
+def test_duplicated_request_applies_once():
+    srv, applied = _counting_server()
+    try:
+        faults.install(
+            FaultPlan(
+                seed=2,
+                rules=[
+                    dict(point="send", method="apply", action="dup",
+                         p=1.0, max=1)
+                ],
+            )
+        )
+        c = RpcClient(srv.addr, timeout=1.0)
+        out = c.call("apply", {"v": 1}, idem=True)
+        assert out["applied"] == 1 and applied == [1]
+        # the duplicate's extra response is skipped as stale by the
+        # NEXT call on the same connection
+        assert c.call("apply", {"v": 2}, idem=True)["applied"] == 2
+        assert applied == [1, 2]
+        c.close_conn()
+    finally:
+        srv.close()
+
+
+def test_non_idem_call_still_works_plain():
+    srv, applied = _counting_server()
+    try:
+        c = RpcClient(srv.addr)
+        assert c.call("apply", {"v": 5})["applied"] == 1
+        c.close_conn()
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_opens_then_fails_fast_then_halfopen_recovers():
+    addr = _dead_addr()
+    pool = RpcPool(timeout=0.3, heartbeat_s=0.4, max_misses=2)
+    try:
+        for _ in range(2):
+            with pytest.raises(RpcError):
+                pool.call(addr, "ping", timeout=0.2)
+        assert not pool.healthy(addr)
+        t0 = time.perf_counter()
+        with pytest.raises(PeerDownError):
+            pool.call(addr, "ping")
+        assert time.perf_counter() - t0 < 0.05  # no connect/timeout cost
+        # peer comes back: the next half-open probe closes the circuit
+        srv = RpcServer(host=addr[0], port=addr[1]).start()
+        try:
+            time.sleep(0.45)
+            assert pool.call(addr, "ping")["pong"]
+            assert pool.healthy(addr)
+        finally:
+            srv.close()
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# hedged reads
+# ---------------------------------------------------------------------------
+
+
+def test_hedge_backup_wins_and_loser_is_reaped():
+    from dgraph_tpu.worker.remote import RemoteGroup
+
+    slow = RpcServer().start()
+    fast = RpcServer().start()
+    slow.register(
+        "health",
+        lambda a: HealthInfo(ok=True, is_leader=True, node=1, group=1),
+    )
+    fast.register(
+        "health",
+        lambda a: HealthInfo(ok=True, is_leader=False, node=2, group=1),
+    )
+
+    def slow_get(a):
+        time.sleep(0.5)
+        return {"who": "slow"}
+
+    slow.register("kv.get", slow_get)
+    fast.register("kv.get", lambda a: {"who": "fast"})
+    pool = RpcPool(timeout=2.0)
+    try:
+        g = RemoteGroup(1, [slow.addr, fast.addr], pool)
+        w0 = METRICS.value("hedge_wins")
+        out = g.read("kv.get", {}, hedge_after=0.05)
+        assert out["who"] == "fast"  # the backup answered first
+        assert METRICS.value("hedge_wins") >= w0 + 1
+        j0 = METRICS.value("hedge_losses_joined")
+        time.sleep(0.6)  # the slow loser finishes and is reaped
+        assert METRICS.value("hedge_losses_joined") >= j0 + 1
+    finally:
+        pool.close()
+        slow.close()
+        fast.close()
+
+
+def test_propose_respects_ambient_deadline_not_layer_default():
+    """A down group must fail within the caller's stamped deadline, not
+    the old hardwired 15s proposal budget."""
+    from dgraph_tpu.worker.remote import RemoteGroup
+
+    pool = RpcPool(timeout=0.3, max_misses=2)
+    try:
+        g = RemoteGroup(1, [_dead_addr(), _dead_addr()], pool)
+        t0 = time.perf_counter()
+        with deadline_scope(Deadline.after(0.8)):
+            with pytest.raises((RpcError, TimeoutError)):
+                g.propose(("delta", []))
+        assert time.perf_counter() - t0 < 4.0
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster chaos (fixed-seed smoke — tier-1)
+# ---------------------------------------------------------------------------
+
+N_ACCOUNTS = 8
+START_BAL = 100
+
+
+def _seed_bank(c):
+    c.alter("bal: int @upsert .\nacct: string @index(exact) @upsert .")
+    rdf = []
+    for i in range(1, N_ACCOUNTS + 1):
+        rdf.append(f'<0x{i:x}> <acct> "a{i}" .')
+        rdf.append(f'<0x{i:x}> <bal> "{START_BAL}"^^<xs:int> .')
+    c.new_txn().mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+
+
+def _balances(c):
+    out = c.query("{ q(func: has(bal)) { uid bal } }")
+    assert "extensions" not in out, out.get("extensions")
+    return {int(x["uid"], 16): x["bal"] for x in out["data"]["q"]}
+
+
+@pytest.mark.chaos
+def test_chaos_bank_fixed_seed_smoke():
+    """Seeded drop+delay+disconnect across the Zero quorum and an alpha
+    group: balance sum conserved, acked transfers applied exactly once,
+    read timestamps monotonic, schedule reproducible from the seed."""
+    import numpy as np
+
+    from dgraph_tpu.worker.harness import ProcCluster
+
+    c = ProcCluster(
+        n_groups=1, replicas=3, replicated_zero=True, zero_replicas=3
+    )
+    plan = None
+    try:
+        _seed_bank(c)
+        plan = faults.install(
+            FaultPlan(
+                seed=1234,
+                rules=[
+                    dict(point="send", action="drop", p=0.05),
+                    dict(point="send", action="delay", p=0.12, delay_ms=5),
+                    dict(point="send", action="disconnect", p=0.03),
+                ],
+            )
+        )
+        rng = np.random.default_rng(0)
+        ledger = {i: START_BAL for i in range(1, N_ACCOUNTS + 1)}
+        ambiguous = 0
+        last_ts = 0
+        for step in range(10):
+            frm, to = (
+                int(x) + 1 for x in rng.choice(N_ACCOUNTS, 2, replace=False)
+            )
+            amt = int(rng.integers(1, 20))
+            t = c.new_txn()
+            try:
+                t.mutate_rdf(
+                    set_rdf=(
+                        f'<0x{frm:x}> <bal> "{ledger[frm] - amt}"'
+                        f"^^<xs:int> .\n"
+                        f'<0x{to:x}> <bal> "{ledger[to] + amt}"^^<xs:int> .'
+                    ),
+                    commit_now=True,
+                )
+                ledger[frm] -= amt
+                ledger[to] += amt
+            except TimeoutError:
+                ambiguous += 1  # may or may not have applied
+            ts = c.zero.zero.read_ts()
+            assert ts > last_ts, "linearizable reads went back in time"
+            last_ts = ts
+            if step % 3 == 0:
+                bals = _balances(c)
+                assert sum(bals.values()) == N_ACCOUNTS * START_BAL, bals
+        faults.reset()
+        bals = _balances(c)
+        assert sum(bals.values()) == N_ACCOUNTS * START_BAL
+        if ambiguous == 0:
+            # every acked transfer applied exactly once — a duplicated
+            # proposal would shift two accounts off the ledger
+            assert bals == ledger
+        # the schedule hit RPC streams and is reproducible from the seed
+        trace = plan.trace()
+        counts = plan.counts()
+        assert sum(len(v) for v in trace.values()) >= 3
+        zero_peers = {f"{h}:{p}" for h, p in c.zero.zero.addrs}
+        alpha_peers = {
+            f"{h}:{p}" for h, p in c.remote_groups[1].addrs
+        }
+        consulted = {peer for (_pt, peer) in counts}
+        assert consulted & zero_peers and consulted & alpha_peers
+        replayed = {
+            stream: [
+                (n, act)
+                for n, act in enumerate(
+                    plan.replay(stream[0], stream[1], counts[stream]), 1
+                )
+                if act is not None
+            ]
+            for stream in trace
+        }
+        # partitions are runtime state, not seeded draws; none were used
+        assert replayed == trace
+    finally:
+        faults.reset()
+        c.close()
+
+
+@pytest.mark.chaos
+def test_partitioned_group_degrades_instead_of_hanging():
+    """With one alpha group fully partitioned: queries over healthy
+    predicates answer within their deadline; queries touching the dead
+    group come back `degraded`/`partial` (and fast, once the breaker
+    opens) instead of stacking per-layer timeouts."""
+    from dgraph_tpu.worker.harness import ProcCluster
+
+    c = ProcCluster(n_groups=2, replicas=1)
+    try:
+        c.alter("pa: string @index(exact) .\npb: string @index(exact) .")
+        ga, gb = c.zero.belongs_to("pa"), c.zero.belongs_to("pb")
+        assert {ga, gb} == {1, 2}
+        c.new_txn().mutate_rdf(
+            set_rdf='<0x1> <pa> "ha" .\n<0x2> <pb> "hb" .', commit_now=True
+        )
+        plan = faults.install(FaultPlan(seed=9))
+        for addr in c.remote_groups[gb].addrs:
+            plan.partition(addr)  # full partition of group B
+
+        t0 = time.perf_counter()
+        out = c.query('{ q(func: eq(pa, "ha")) { pa } }')
+        healthy_dt = time.perf_counter() - t0
+        assert out["data"]["q"] == [{"pa": "ha"}]
+        assert "extensions" not in out
+        assert healthy_dt < 10.0  # well inside the query deadline
+
+        t0 = time.perf_counter()
+        out = c.query('{ q(func: eq(pb, "hb")) { pb } }')
+        first_dt = time.perf_counter() - t0
+        assert out["extensions"]["degraded"] is True
+        assert out["extensions"]["partial"] is True
+        assert out["extensions"]["unreachable_groups"] == [gb]
+        assert out["data"]["q"] == []
+        assert first_dt < 12.0  # not the stacked 5s/8s/15s ladder
+
+        # breaker is open now: the dead group costs ~nothing per query
+        t0 = time.perf_counter()
+        out = c.query('{ q(func: eq(pb, "hb")) { pb } }')
+        assert out["extensions"]["degraded"] is True
+        assert time.perf_counter() - t0 < 2.0
+        # and healthy-predicate queries were never impacted
+        out = c.query('{ q(func: eq(pa, "ha")) { pa } }')
+        assert out["data"]["q"] == [{"pa": "ha"}]
+
+        # heal: the heartbeat's half-open probe closes the circuit and
+        # full (non-degraded) answers resume
+        plan.heal()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            out = c.query('{ q(func: eq(pb, "hb")) { pb } }')
+            if "extensions" not in out and out["data"]["q"]:
+                break
+            time.sleep(0.3)
+        assert out["data"]["q"] == [{"pb": "hb"}]
+        assert "extensions" not in out
+    finally:
+        faults.reset()
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# long randomized schedules (out of tier-1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_long_schedule_with_raft_faults(tmp_path, monkeypatch):
+    """Heavier seeded schedule, including raft-plane faults inside the
+    replica processes (via DGRAPH_TPU_FAULT_PLAN inheritance), a
+    concurrent bank workload, and a multi-level query corpus checked
+    serial-vs-parallel identical under chaos."""
+    import json
+    import os
+
+    import numpy as np
+
+    from dgraph_tpu.worker.harness import ProcCluster
+    from dgraph_tpu.zero.zero import TxnConflictError
+
+    child_spec = {
+        "seed": 77,
+        "rules": [
+            {"point": "raft_send", "action": "drop", "p": 0.03},
+            {"point": "raft_send", "action": "delay", "p": 0.10,
+             "delay_ms": 5},
+            {"point": "raft_send", "action": "dup", "p": 0.05},
+            {"point": "resp", "action": "drop", "p": 0.03},
+        ],
+    }
+    monkeypatch.setenv(faults.ENV_VAR, json.dumps(child_spec))
+    c = ProcCluster(
+        n_groups=2, replicas=3, replicated_zero=True, zero_replicas=3,
+        data_dir=str(tmp_path / "chaos"),
+    )
+    try:
+        # children announced the inherited schedule (auditability)
+        logs = [
+            p for p in os.listdir(str(tmp_path / "chaos"))
+            if p.endswith(".log")
+        ]
+        tagged = 0
+        for p in logs:
+            with open(tmp_path / "chaos" / p, "rb") as f:
+                if b"[faults]" in f.read():
+                    tagged += 1
+        assert tagged >= 1, logs
+        _seed_bank(c)
+        c.alter("follows: [uid] .")
+        c.new_txn().mutate_rdf(
+            set_rdf="\n".join(
+                f"<0x{i:x}> <follows> <0x{(i % N_ACCOUNTS) + 1:x}> ."
+                for i in range(1, N_ACCOUNTS + 1)
+            ),
+            commit_now=True,
+        )
+        faults.install(
+            FaultPlan(
+                seed=4321,
+                rules=[
+                    dict(point="send", action="drop", p=0.08),
+                    dict(point="send", action="delay", p=0.15, delay_ms=8),
+                    dict(point="send", action="disconnect", p=0.05),
+                ],
+            )
+        )
+        stats = {"ok": 0, "conflict": 0, "ambiguous": 0}
+        lock = threading.Lock()
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(12):
+                frm, to = (
+                    int(x) + 1
+                    for x in rng.choice(N_ACCOUNTS, 2, replace=False)
+                )
+                amt = int(rng.integers(1, 10))
+                t = c.new_txn()
+                try:
+                    got = c.query(
+                        "{ a(func: uid(0x%x)) { bal } "
+                        "b(func: uid(0x%x)) { bal } }" % (frm, to)
+                    )["data"]
+                    if not got["a"] or not got["b"]:
+                        continue  # degraded snapshot: skip the transfer
+                    t.mutate_rdf(
+                        set_rdf=(
+                            f'<0x{frm:x}> <bal> '
+                            f'"{got["a"][0]["bal"] - amt}"^^<xs:int> .\n'
+                            f'<0x{to:x}> <bal> '
+                            f'"{got["b"][0]["bal"] + amt}"^^<xs:int> .'
+                        ),
+                        commit_now=True,
+                    )
+                    with lock:
+                        stats["ok"] += 1
+                except TxnConflictError:
+                    with lock:
+                        stats["conflict"] += 1
+                except (TimeoutError, RpcError, RuntimeError):
+                    with lock:
+                        stats["ambiguous"] += 1
+
+        threads = [
+            threading.Thread(target=worker, args=(s,)) for s in (1, 2)
+        ]
+        for th in threads:
+            th.start()
+        corpus = [
+            "{ q(func: has(bal)) { uid bal } }",
+            '{ q(func: eq(acct, "a1")) { acct bal '
+            "follows { acct follows { acct } } } }",
+            "{ q(func: has(acct), orderasc: acct) { acct } }",
+        ]
+        last_ts = 0
+        while any(th.is_alive() for th in threads):
+            out = c.query(corpus[0])
+            if "extensions" not in out:
+                bals = {
+                    int(x["uid"], 16): x["bal"] for x in out["data"]["q"]
+                }
+                assert sum(bals.values()) == N_ACCOUNTS * START_BAL, bals
+            ts = c.zero.zero.read_ts()
+            assert ts > last_ts
+            last_ts = ts
+            time.sleep(0.05)
+        for th in threads:
+            th.join(timeout=30)
+        assert stats["ok"] > 0, stats
+        # final invariant after chaos quiesces on the coordinator side
+        faults.reset()
+        bals = _balances(c)
+        assert sum(bals.values()) == N_ACCOUNTS * START_BAL, (bals, stats)
+
+        # multi-level corpus: serial and parallel executors identical
+        # (both non-degraded; raft-plane chaos continues in children)
+        for q in corpus:
+            monkeypatch.setenv("DGRAPH_TPU_EXEC_WORKERS", "1")
+            serial = c.query(q)
+            monkeypatch.setenv("DGRAPH_TPU_EXEC_WORKERS", "4")
+            parallel = c.query(q)
+            if "extensions" in serial or "extensions" in parallel:
+                continue
+            assert serial == parallel, q
+    finally:
+        faults.reset()
+        c.close()

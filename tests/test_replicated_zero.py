@@ -102,3 +102,37 @@ def test_replicated_zero_durable_restart(tmp_path):
         assert out["data"]["q"][0]["name"] == "rz-post"
     finally:
         c2.close()
+
+
+def test_commit_verdict_decided_exactly_once():
+    """A commit op re-proposed with a fresh request id (the client
+    retried through another server after a lost/timed-out ack) must
+    return the ORIGINAL verdict — re-running conflict detection would
+    flip commit into abort and burn a timestamp."""
+    from dgraph_tpu.zero.replicated import ZeroStateMachine
+
+    sm = ZeroStateMachine()
+    sm.apply(("lease_ts", 9, 1, 10))
+    v1 = sm.apply(("commit", 1, 1, 5, ["ck"]))
+    assert v1 == ("commit", 11)
+    # duplicate via a different (proposer, req_id): same verdict, no
+    # extra timestamp
+    assert sm.apply(("commit", 2, 9, 5, ["ck"])) == v1
+    assert sm.max_ts == 11
+    # a genuinely conflicting later txn still aborts, and ITS duplicate
+    # replays the same abort
+    v3 = sm.apply(("commit", 1, 2, 3, ["ck"]))
+    assert v3 == ("abort", 11)
+    assert sm.apply(("commit", 2, 7, 3, ["ck"])) == v3
+    # late duplicate commit after an explicit abort stays aborted
+    sm.apply(("abort", 1, 3, 100))
+    assert sm.apply(("commit", 1, 4, 100, []))[0] == "abort"
+    # verdicts survive snapshot round-trips (and old 6-field snapshots
+    # still load)
+    import pickle
+
+    sm2 = ZeroStateMachine()
+    sm2.load(sm.dump())
+    assert sm2.txn_verdicts == sm.txn_verdicts
+    sm2.load(pickle.dumps((1, 1, {}, set(), {}, 1)))
+    assert sm2.txn_verdicts == {}
