@@ -28,8 +28,6 @@ import sys
 
 
 def _server(args):
-    import os
-
     from dgraph_tpu.api.server import Server
     from dgraph_tpu.x.flags import STORAGE_DEFAULTS, SuperFlag
 
@@ -45,7 +43,9 @@ def _server(args):
         key = read_key_file(sf.get_string("encryption-key-file"))
     backend = sf.get_string("backend", "mem")
     if backend != "mem":
-        os.environ["DGRAPH_TPU_STORAGE"] = backend
+        from dgraph_tpu.x import config
+
+        config.set_env("STORAGE", backend)
     return Server(data_dir=args.p, encryption_key=key)
 
 
@@ -332,6 +332,66 @@ def cmd_upgrade(args):
         f"layout now v{tools.layout_version(args.p)}; applied: {applied or 'none'}"
     )
 
+def cmd_lint(args):
+    """Run the project-invariant analyzer suite (dgraph_tpu/analysis).
+
+    Exit-code contract (stable, for external CI):
+      0 — clean: no unallowlisted violations, no stale allowlist entries
+      1 — violations (or stale allowlist entries) found
+      2 — internal analyzer error
+    """
+    import json as _json
+    import traceback
+
+    from dgraph_tpu import analysis
+
+    try:
+        checkers = None
+        if getattr(args, "checker", None):
+            unknown = set(args.checker) - set(analysis.CHECKERS)
+            if unknown:
+                print(
+                    f"unknown checker(s) {sorted(unknown)}; available: "
+                    f"{sorted(analysis.CHECKERS)}"
+                )
+                return 2
+            checkers = args.checker
+        rep = analysis.run(checkers=checkers)
+    except Exception:
+        traceback.print_exc()
+        return 2
+    if args.json:
+        print(_json.dumps(rep.to_dict(), indent=2))
+    else:
+        for v in rep.violations:
+            print(v.render())
+        for a in rep.unused_allows:
+            print(
+                f"allowlist.py: stale entry ({a.checker}, {a.path}, "
+                f"{a.match!r}) matches nothing — remove it"
+            )
+        print(
+            f"lint: {len(rep.violations)} violation(s), "
+            f"{len(rep.suppressed)} allowlisted, "
+            f"{len(rep.unused_allows)} stale allowlist entr(y/ies)"
+        )
+    return 0 if rep.ok else 1
+
+
+def cmd_config_ref(args):
+    """Regenerate (or print) the CONFIG.md env-var reference."""
+    from dgraph_tpu.x import config
+
+    text = config.reference_table()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text, end="")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="dgraph-tpu")
     ap.add_argument("--version", action="version", version="dgraph-tpu 0.1.0")
@@ -489,6 +549,28 @@ def main(argv=None):
     p = sub.add_parser("mcp", help="MCP server on stdio")
     add_p(p)
     p.set_defaults(fn=cmd_mcp)
+
+    p = sub.add_parser(
+        "lint",
+        help="run the project-invariant static-analysis suite "
+        "(exit 0 clean / 1 violations / 2 internal error)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable report on stdout",
+    )
+    p.add_argument(
+        "--checker", action="append", default=None,
+        help="run only this checker (repeatable); default: all",
+    )
+    p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser(
+        "config-ref",
+        help="print (or write) the generated DGRAPH_TPU_* env reference",
+    )
+    p.add_argument("-o", "--out", default=None, help="write to this path")
+    p.set_defaults(fn=cmd_config_ref)
 
     args = ap.parse_args(argv)
     return args.fn(args)

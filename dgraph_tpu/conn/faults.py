@@ -234,11 +234,14 @@ class FaultPlan:
 _LOCK = threading.Lock()
 _ACTIVE: Optional[FaultPlan] = None
 
-ENV_VAR = "DGRAPH_TPU_FAULT_PLAN"
+# single source: the registry owns the variable's name and doc
+from dgraph_tpu.x import config as _config
+
+ENV_VAR = _config.knob("FAULT_PLAN").env
 
 
 def _plan_from_env() -> Optional[FaultPlan]:
-    spec = os.environ.get(ENV_VAR, "").strip()
+    spec = _config.get("FAULT_PLAN").strip()
     if not spec:
         return None
     if spec.startswith("@"):

@@ -40,10 +40,11 @@ from __future__ import annotations
 
 import base64
 import json
-import os
 import struct
 import zlib
 from typing import Any, List, Tuple
+
+from dgraph_tpu.x import config
 
 MAGIC = 0x01
 _U32 = struct.Struct(">I")
@@ -52,7 +53,7 @@ _U32 = struct.Struct(">I")
 # allocation in _recv_frame readers; matches the reference's 256MB gRPC
 # message cap (conn/pool.go grpc.MaxCallRecvMsgSize). Shared by
 # conn/rpc.py and raft/tcp.py so both planes enforce the same bound.
-MAX_FRAME = int(os.environ.get("DGRAPH_TPU_MAX_FRAME_BYTES", str(256 << 20)))
+MAX_FRAME = int(config.get("MAX_FRAME_BYTES"))
 _BLOB_MIN = 256  # bytes values at least this long leave the JSON
 _ZLIB_LEVEL = 1
 # Compression default OFF: raw blobs already beat the old JSON+b64 path
@@ -62,7 +63,7 @@ _ZLIB_LEVEL = 1
 # Python stdlib cannot match. Set DGRAPH_TPU_WIRE_COMPRESS=1 for
 # DCN-class links where 2.8x fewer bytes wins; blobs are sample-probed
 # so incompressible payloads skip the cost either way.
-_COMPRESS = os.environ.get("DGRAPH_TPU_WIRE_COMPRESS", "") == "1"
+_COMPRESS = bool(config.get("WIRE_COMPRESS"))
 _ZLIB_MIN = 1 << 16  # probe/compress only genuinely bulk blobs
 _PROBE = 4096
 

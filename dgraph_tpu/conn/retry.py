@@ -96,6 +96,18 @@ class RetryPolicy:
         return d
 
 
+def poll_policy(interval_s: float) -> RetryPolicy:
+    """Jittered fixed-cadence poll: every attempt sleeps
+    uniform(0, 2*interval), so the MEAN period equals `interval_s` (the
+    old fixed-sleep cadence) while concurrent pollers de-synchronize.
+    The sanctioned replacement for `while ...: time.sleep(c)` loops —
+    the deadline-hygiene checker flags naked sleeps in the cluster
+    directories."""
+    return RetryPolicy(
+        base=2.0 * interval_s, mult=1.0, cap=2.0 * interval_s
+    )
+
+
 # ---------------------------------------------------------------------------
 # thread-local deadline propagation
 # ---------------------------------------------------------------------------

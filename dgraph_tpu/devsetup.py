@@ -43,8 +43,10 @@ def force_cpu(device_count: int = 1) -> None:
 
 def maybe_force_cpu() -> None:
     """Honor DGRAPH_TPU_FORCE_CPU=1 or JAX_PLATFORMS=cpu."""
+    from dgraph_tpu.x import config
+
     if (
-        os.environ.get("DGRAPH_TPU_FORCE_CPU") == "1"
+        config.get("FORCE_CPU")
         or os.environ.get("JAX_PLATFORMS", "") == "cpu"
     ):
         force_cpu()

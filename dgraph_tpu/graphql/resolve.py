@@ -25,7 +25,7 @@ from dgraph_tpu.posting.pl import OP_DEL, OP_SET
 from dgraph_tpu.query.outputjson import JsonEncoder
 from dgraph_tpu.query.subgraph import Executor
 from dgraph_tpu.types.types import TypeID, Val
-from dgraph_tpu.x import keys
+from dgraph_tpu.x import config, keys
 
 _FILTER_OPS = {
     "eq": "eq",
@@ -124,7 +124,6 @@ class _MutCtx:
 
 class GraphQLServer:
     def __init__(self, engine, sdl: str, lambda_url: Optional[str] = None):
-        import os
         import threading
 
         from dgraph_tpu.graphql.auth import parse_authorization
@@ -141,7 +140,7 @@ class GraphQLServer:
         self.lambda_url = (
             lambda_url
             or getattr(engine, "graphql_lambda_url", None)
-            or os.environ.get("DGRAPH_TPU_LAMBDA_URL", "")
+            or config.get("LAMBDA_URL")
         )
         self._tls = threading.local()  # per-request JWT claims
         self._validate_remote_customs()  # reject BEFORE mutating schema
@@ -154,9 +153,7 @@ class GraphQLServer:
         — errors surface when the schema loads, not at first request).
         Set DGRAPH_TPU_SKIP_REMOTE_INTROSPECTION=1 to defer (air-gapped
         loads)."""
-        import os as _os
-
-        if _os.environ.get("DGRAPH_TPU_SKIP_REMOTE_INTROSPECTION") == "1":
+        if config.get("SKIP_REMOTE_INTROSPECTION"):
             return
         from dgraph_tpu.graphql.remote import (
             RemoteSchemaError,
@@ -1865,9 +1862,8 @@ class GraphQLServer:
             if ctx is not None and ctx.now is not None:
                 return ctx.now
             import datetime as _dt
-            import os as _os
 
-            now = _os.environ.get("DGRAPH_TPU_FAKE_NOW") or (
+            now = config.get("FAKE_NOW") or (
                 _dt.datetime.now(_dt.timezone.utc)
                 .strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3]
                 + "Z"

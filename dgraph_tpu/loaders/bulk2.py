@@ -33,6 +33,7 @@ import numpy as np
 
 from dgraph_tpu.codec import uidpack
 from dgraph_tpu.loaders.rdf import parse_rdf
+from dgraph_tpu.x import config
 from dgraph_tpu.posting.pl import (
     OP_SET,
     Posting,
@@ -344,7 +345,7 @@ class ParallelBulkLoader:
 
         if not getattr(native, "NATIVE_AVAILABLE", False):
             return False
-        if os.environ.get("DGRAPH_TPU_BULK_NATIVE", "1") != "1":
+        if not config.get("BULK_NATIVE"):
             return False
         # vector predicates feed the similarity engine through the
         # Python reduce — keep the whole load on the Python path
@@ -470,9 +471,7 @@ class ParallelBulkLoader:
             out_extra = os.path.join(self.workdir, "reduced.extra")
             out_stats = os.path.join(self.workdir, "reduced.stats")
             joined = "\n".join(run_paths).encode()
-            max_part = int(
-                os.environ.get("DGRAPH_TPU_MAX_PART_UIDS", 1 << 20)
-            )
+            max_part = int(config.get("MAX_PART_UIDS"))
             kv = self.server.kv
             sst_direct = (
                 hasattr(kv, "ingest_native_sst")

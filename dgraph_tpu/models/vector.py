@@ -221,10 +221,10 @@ class VectorIndex:
     # -- device state ---------------------------------------------------------
 
     def _sync_device(self):
-        import os as _os
-
         import jax
         import jax.numpy as jnp
+
+        from dgraph_tpu.x import config
 
         if not self._dirty and self._device is not None:
             return
@@ -238,7 +238,7 @@ class VectorIndex:
         valid[: self._n] = True
         self._uids_np = uids
         self._mesh = None
-        shard = _os.environ.get("DGRAPH_TPU_SHARD_VECTORS", "") == "1"
+        shard = bool(config.get("SHARD_VECTORS"))
         if shard and len(jax.devices()) > 1:
             # row-shard the corpus over the device mesh: per-shard top-k,
             # all_gather, global reduce (parallel/mesh.py sharded_topk —

@@ -18,8 +18,103 @@ from typing import Optional
 
 import numpy as np
 
+from dgraph_tpu.x import config
+
 _LIB: Optional[ctypes.CDLL] = None
 NATIVE_AVAILABLE = False
+
+# ---------------------------------------------------------------------------
+# ctypes ABI declarations
+#
+# ONE declarative table, consumed by BOTH the binder below and the static
+# ABI cross-checker (dgraph_tpu/analysis/check_ctypes_abi.py), which parses
+# the extern "C" signatures in codec.cpp / bulkload.cpp and verifies arity,
+# widths and signedness against this table. Every exported function must be
+# listed with an EXPLICIT restype: a missing restype on an int64_t-returning
+# function silently truncates through ctypes' c_int default — on results
+# >= 2**31 (flat decode counts, file offsets) that is a memory-corruption
+# class bug, not a style nit. restype None == C void.
+# ---------------------------------------------------------------------------
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64 = ctypes.c_int64
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_u64 = ctypes.c_uint64
+_int = ctypes.c_int
+_vp = ctypes.c_void_p
+_cp = ctypes.c_char_p
+
+DECLS = {
+    # codec.cpp — bit-pack codec + sorted-set kernels
+    "bitpack": (None, [_u32p, _i64, _int, _u8p]),
+    "bitunpack": (None, [_u8p, _i64, _i64, _int, _u32p]),
+    "pack_decode_blocks": (_i64, [_u64p, _i32p, _u32p, _i64, _i64p, _i64, _u64p]),
+    "packs_decode_many": (
+        _i64,
+        [
+            ctypes.POINTER(_u64p), ctypes.POINTER(_i32p),
+            ctypes.POINTER(_u32p), _i64p, _i64, _i64, _u64p, _i64p,
+        ],
+    ),
+    "pack_intersect_small": (
+        _i64,
+        [_u64p, _i32p, _u32p, _i64, _i64, _u64p, _u64p, _i64, _u64p, _i64p],
+    ),
+    "intersect_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
+    "union_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
+    "difference_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
+    "merge_sorted_u64": (_i64, [_u64p, _i64p, _i64, _u64p, _u64p]),
+    # codec.cpp — SSTable entry scans
+    "sst_seek": (_i64, [_u8p, _i64, _i64, _u8p, _i64]),
+    "sst_versions": (
+        _i64,
+        [_u8p, _i64, _i64, _u8p, _i64, _i64, _u64p, _u64p, _i64p, _i64p],
+    ),
+    "sst_versions_multi": (
+        _i64,
+        [
+            _u8p, _i64, _i64, _u8p, _i64p, _i64p, _i64p, _i64,
+            _i64p, _u64p, _u64p, _i64p, _i64p,
+        ],
+    ),
+    "sst_scan": (
+        _i64,
+        [
+            _u8p, _i64, _i64, _u8p, _i64, _i64,
+            _i64p, _i64p, _u64p, _u64p, _i64p, _i64p, _i64p,
+        ],
+    ),
+    # bulkload.cpp — offline bulk-load pipeline
+    "bulk_new": (_vp, []),
+    "bulk_free": (None, [_vp]),
+    "bulk_scan_xids": (_i64, [_vp, _cp, _i64]),
+    "bulk_set_base": (None, [_vp, _u64]),
+    "bulk_xid_lookup": (_u64, [_vp, _cp, _i64]),
+    "bulk_clear_preds": (None, [_vp]),
+    "bulk_add_pred": (_int, [_vp, _cp, _i64, _int, _int, _u8p, _i64, _u64]),
+    "bulk_map": (_i64, [_vp, _cp, _i64, _u64, _cp, _cp, _i64]),
+    "bulk_run_count": (_i64, [_vp]),
+    "bulk_run_path": (_i64, [_vp, _i64, _cp, _i64]),
+    "bulk_reduce": (
+        _i64,
+        [_vp, _cp, _i64, _u64, _cp, _cp, _cp, _u64, _i64, _u64, _u64],
+    ),
+}
+
+# sanitizer build modes: flags + a cache-key suffix so instrumented and
+# plain builds never collide in the shared /tmp cache dir
+_SAN_FLAGS = {
+    "": [],
+    # UBSan aborts on the first finding (no silent recovery) — the
+    # randomized packed-setops corpus runs under this in the slow suite
+    "ubsan": ["-fsanitize=undefined", "-fno-sanitize-recover=all"],
+    # ASan .so needs the asan runtime loaded FIRST: run python under
+    # LD_PRELOAD=$(g++ -print-file-name=libasan.so) (see README)
+    "asan": ["-fsanitize=address"],
+}
 
 
 def _build_and_load() -> Optional[ctypes.CDLL]:
@@ -33,9 +128,14 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         with open(s, "rb") as f:
             h.update(f.read())
     tag = h.hexdigest()[:16]
-    cache_dir = os.environ.get(
-        "DGRAPH_TPU_NATIVE_CACHE",
-        os.path.join(tempfile.gettempdir(), "dgraph_tpu_native"),
+    san = config.get("NATIVE_SAN").strip().lower()
+    san_flags = _SAN_FLAGS.get(san)
+    if san_flags is None:
+        return None  # unknown sanitizer name: fail to python, don't guess
+    if san:
+        tag = f"{tag}-{san}"
+    cache_dir = config.get("NATIVE_CACHE") or os.path.join(
+        tempfile.gettempdir(), "dgraph_tpu_native"
     )
     os.makedirs(cache_dir, exist_ok=True)
     so_path = os.path.join(cache_dir, f"codec-{tag}.so")
@@ -43,7 +143,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         tmp = so_path + f".tmp{os.getpid()}"
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-            "-o", tmp, *srcs,
+            *san_flags, "-o", tmp, *srcs,
         ]
         # -march=native unlocks SIMD; retry without it if unsupported
         try:
@@ -62,80 +162,10 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(so_path)
     except OSError:
         return None
-
-    u8p = ctypes.POINTER(ctypes.c_uint8)
-    u32p = ctypes.POINTER(ctypes.c_uint32)
-    u64p = ctypes.POINTER(ctypes.c_uint64)
-    i64 = ctypes.c_int64
-
-    lib.bitpack.argtypes = [u32p, i64, ctypes.c_int, u8p]
-    lib.bitunpack.argtypes = [u8p, i64, i64, ctypes.c_int, u32p]
-    i32p = ctypes.POINTER(ctypes.c_int32)
-    lib.pack_decode_blocks.argtypes = [
-        u64p, i32p, u32p, i64, ctypes.POINTER(i64), i64, u64p
-    ]
-    lib.pack_decode_blocks.restype = i64
-    lib.pack_intersect_small.argtypes = [
-        u64p, i32p, u32p, i64, i64, u64p, u64p, i64, u64p,
-        ctypes.POINTER(i64),
-    ]
-    lib.pack_intersect_small.restype = i64
-    lib.packs_decode_many.argtypes = [
-        ctypes.POINTER(u64p), ctypes.POINTER(i32p), ctypes.POINTER(u32p),
-        ctypes.POINTER(i64), i64, i64, u64p, ctypes.POINTER(i64),
-    ]
-    lib.packs_decode_many.restype = i64
-    for name in ("intersect_u64", "union_u64", "difference_u64"):
+    for name, (restype, argtypes) in DECLS.items():
         fn = getattr(lib, name)
-        fn.argtypes = [u64p, i64, u64p, i64, u64p]
-        fn.restype = i64
-    lib.merge_sorted_u64.argtypes = [
-        u64p, ctypes.POINTER(i64), i64, u64p, u64p
-    ]
-    lib.merge_sorted_u64.restype = i64
-    i64p = ctypes.POINTER(i64)
-    lib.sst_seek.argtypes = [u8p, i64, i64, u8p, i64]
-    lib.sst_seek.restype = i64
-    lib.sst_versions.argtypes = [
-        u8p, i64, i64, u8p, i64, i64, u64p, u64p, i64p, i64p
-    ]
-    lib.sst_versions.restype = i64
-    lib.sst_versions_multi.argtypes = [
-        u8p, i64, i64, u8p, i64p, i64p, i64p, i64,
-        i64p, u64p, u64p, i64p, i64p,
-    ]
-    lib.sst_versions_multi.restype = i64
-    lib.sst_scan.argtypes = [
-        u8p, i64, i64, u8p, i64, i64,
-        i64p, i64p, u64p, u64p, i64p, i64p, i64p,
-    ]
-    lib.sst_scan.restype = i64
-    # bulk-load pipeline (bulkload.cpp)
-    vp = ctypes.c_void_p
-    cp = ctypes.c_char_p
-    lib.bulk_new.restype = vp
-    lib.bulk_free.argtypes = [vp]
-    lib.bulk_scan_xids.argtypes = [vp, cp, i64]
-    lib.bulk_scan_xids.restype = i64
-    lib.bulk_set_base.argtypes = [vp, ctypes.c_uint64]
-    lib.bulk_xid_lookup.argtypes = [vp, cp, i64]
-    lib.bulk_xid_lookup.restype = ctypes.c_uint64
-    lib.bulk_clear_preds.argtypes = [vp]
-    lib.bulk_add_pred.argtypes = [
-        vp, cp, i64, ctypes.c_int, ctypes.c_int, u8p, i64,
-        ctypes.c_uint64,
-    ]
-    lib.bulk_map.argtypes = [vp, cp, i64, ctypes.c_uint64, cp, cp, i64]
-    lib.bulk_map.restype = i64
-    lib.bulk_run_count.argtypes = [vp]
-    lib.bulk_run_count.restype = i64
-    lib.bulk_run_path.argtypes = [vp, i64, cp, i64]
-    lib.bulk_run_path.restype = i64
-    lib.bulk_reduce.argtypes = [
-        vp, cp, i64, ctypes.c_uint64, cp, cp, cp, ctypes.c_uint64,
-        i64, ctypes.c_uint64, ctypes.c_uint64,
-    ]
-    lib.bulk_reduce.restype = i64
+        fn.restype = restype
+        fn.argtypes = argtypes
     return lib
 
 

@@ -24,15 +24,13 @@ from dgraph_tpu.posting.pl import PostingList
 
 class MemoryLayer:
     def __init__(self, max_entries: Optional[int] = None):
-        import os
-
         if max_entries is None:
             # must exceed the touched-key count of one large traversal
             # level or the LRU thrashes (a 5M-edge 2-hop touches ~140k
             # lists); decoded entries are small, ~300B typical
-            max_entries = int(
-                os.environ.get("DGRAPH_TPU_MEMLAYER_ENTRIES", 400_000)
-            )
+            from dgraph_tpu.x import config
+
+            max_entries = int(config.get("MEMLAYER_ENTRIES"))
         self.max_entries = max_entries
         self._lock = threading.Lock()
         # key -> (newest_version_ts, PostingList); LRU by insertion order

@@ -41,10 +41,11 @@ OP_DEL = 2
 
 # multi-part list threshold: a rollup whose uid set exceeds this is split
 # into part records under keys.SplitKey (ref posting/list.go:44 maxListSize,
-# rollup re-split list.go:1590). Tunable for tests / memory budgets.
-import os as _os
+# rollup re-split list.go:1590). Tunable for tests / memory budgets; the
+# native bulk reduce (loaders/bulk2.py) reads the same registry knob.
+from dgraph_tpu.x import config as _config
 
-MAX_PART_UIDS = int(_os.environ.get("DGRAPH_TPU_MAX_PART_UIDS", 1 << 20))
+MAX_PART_UIDS = int(_config.get("MAX_PART_UIDS"))
 
 VALUE_UID = (1 << 64) - 1  # plain scalar value posting
 

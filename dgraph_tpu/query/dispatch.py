@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from dgraph_tpu.codec import uidpack
 from dgraph_tpu.codec.uidpack import join_segments, split_segments
 from dgraph_tpu.ops import packed_setops, setops
+from dgraph_tpu.x import config
 
 # Below this much total work, host kernels win (dispatch overhead
 # dominates). Default is backend-aware per tune_thresholds.py captures:
@@ -46,13 +47,13 @@ from dgraph_tpu.ops import packed_setops, setops
 # must not happen at import time (the axon tunnel may hang).
 # env semantics kept from earlier rounds: setting 0 means "always use
 # the device" (total < 0 was never true); unset means backend-aware auto
-_env_min_total = os.environ.get("DGRAPH_TPU_DEVICE_MIN_TOTAL")
+_env_min_total = config.get("DEVICE_MIN_TOTAL")
 _DEVICE_MIN_TOTAL = (
     0 if _env_min_total is None else max(1, int(_env_min_total))
 )
 # A shared operand at/above this size is row-sharded over the device mesh
 # (multi-part list data plane) when >1 device is visible.
-_SHARD_MIN_B = int(os.environ.get("DGRAPH_TPU_SHARD_MIN_B", 1 << 22))
+_SHARD_MIN_B = int(config.get("SHARD_MIN_B"))
 # Packed-vs-decode crossover: a pair takes the compressed-domain block-skip
 # path (ops/packed_setops.py) when |big| >= ratio * |small| — i.e. the op
 # is selective enough that skipping non-candidate blocks beats one full
@@ -65,10 +66,10 @@ _SHARD_MIN_B = int(os.environ.get("DGRAPH_TPU_SHARD_MIN_B", 1 << 22))
 # skipping saves nothing and full decode + the dense kernels win — the
 # packed path falls back there. Re-tune on TPU (device dispatch shifts
 # the decoded path's cost) and pin per-deploy via env, like _min_total.
-_PACKED_MIN_RATIO = int(os.environ.get("DGRAPH_TPU_PACKED_MIN_RATIO", 256))
-_FORCE_DEVICE = os.environ.get("DGRAPH_TPU_FORCE_DEVICE", "") == "1"
+_PACKED_MIN_RATIO = int(config.get("PACKED_MIN_RATIO"))
+_FORCE_DEVICE = bool(config.get("FORCE_DEVICE"))
 # opt-in Pallas compare-all sweep for small-side intersect buckets
-_USE_PALLAS = os.environ.get("DGRAPH_TPU_PALLAS", "") == "1"
+_USE_PALLAS = bool(config.get("PALLAS"))
 _MIN_PAD = 8
 
 
@@ -107,7 +108,7 @@ class DeviceCache:
 
     def __init__(self, max_bytes: Optional[int] = None):
         self.max_bytes = max_bytes if max_bytes is not None else int(
-            os.environ.get("DGRAPH_TPU_DEVCACHE_BYTES", 256 << 20)
+            config.get("DEVCACHE_BYTES")
         )
         self._lock = threading.Lock()
         # cache token -> (device arrays tuple, nbytes)
@@ -303,7 +304,7 @@ class SetOpDispatcher:
         detection)."""
         if self._device_state is not None:
             return self._device_state
-        timeout = float(os.environ.get("DGRAPH_TPU_DEVICE_INIT_TIMEOUT_S", 120))
+        timeout = float(config.get("DEVICE_INIT_TIMEOUT_S"))
         import threading
 
         got: list = []

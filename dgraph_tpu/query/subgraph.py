@@ -14,7 +14,6 @@ Execution order of blocks follows variable dependencies
 
 from __future__ import annotations
 
-import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -37,7 +36,7 @@ from dgraph_tpu.query.functions import (
 from dgraph_tpu.schema.schema import State
 from dgraph_tpu.types.types import TypeID, Val, compare_vals, convert
 from dgraph_tpu.utils.observe import METRICS, TRACER
-from dgraph_tpu.x import keys
+from dgraph_tpu.x import config, keys
 
 # ---------------------------------------------------------------------------
 # Sibling-expansion worker pool (ref query.go ProcessGraph goroutine-per-
@@ -54,10 +53,7 @@ _EXPAND_TLS = threading.local()
 
 
 def _exec_workers() -> int:
-    try:
-        return int(os.environ.get("DGRAPH_TPU_EXEC_WORKERS", "0") or "0")
-    except ValueError:
-        return 0
+    return int(config.get("EXEC_WORKERS"))
 
 
 def _expand_pool(workers: int) -> ThreadPoolExecutor:
@@ -127,9 +123,7 @@ class Executor:
         self.allowed_preds = allowed_preds
         # level-batched task reads (uids_many/values_many); the per-uid
         # escape hatch exists for A/B benchmarking (level_batch_read_calls)
-        self.level_batch = (
-            os.environ.get("DGRAPH_TPU_LEVEL_BATCH", "1") != "0"
-        )
+        self.level_batch = bool(config.get("LEVEL_BATCH"))
         # sibling fan-out width; 0/1 = serial (resolved per Executor so
         # tests can flip the env between queries)
         self.exec_workers = _exec_workers()

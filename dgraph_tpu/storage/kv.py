@@ -336,7 +336,9 @@ def open_kv(
 
             kv = EncryptedKV(kv, encryption_key)
         return kv
-    backend = backend or os.environ.get("DGRAPH_TPU_STORAGE", "mem")
+    from dgraph_tpu.x import config
+
+    backend = backend or config.get("STORAGE")
     os.makedirs(path, exist_ok=True)
     if backend == "lsm":
         from dgraph_tpu.storage.lsm import LsmKV

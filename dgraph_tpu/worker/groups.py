@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dgraph_tpu.conn.retry import poll_policy
 from dgraph_tpu.posting.lists import LocalCache, Txn
 from dgraph_tpu.raft.raft import InProcNetwork, RaftNode
 from dgraph_tpu.schema.schema import State, parse_schema
@@ -426,6 +427,7 @@ class DistributedCluster:
             # deterministic config entry so every replica assigns tablets
             # over the same group count
             deadline = time.time() + 10
+            poll = poll_policy(0.01)
             while time.time() < deadline:
                 lead = next(
                     (z for z in self.zero_nodes if z.raft.is_leader()), None
@@ -434,7 +436,7 @@ class DistributedCluster:
                     ("config", self.zero.n_groups)
                 ):
                     break
-                time.sleep(0.01)
+                poll.sleep(1)
         if data_dir is not None:
             self.recover_intents()
 
@@ -534,13 +536,14 @@ class DistributedCluster:
 
     def _wait_for_leaders(self, timeout: float = 10.0):
         deadline = time.time() + timeout
+        poll = poll_policy(0.01)
         while time.time() < deadline:
             if all(g.leader() is not None for g in self.groups.values()) and (
                 not self.zero_nodes
                 or any(z.raft.is_leader() for z in self.zero_nodes)
             ):
                 return
-            time.sleep(0.01)
+            poll.sleep(1)
         raise TimeoutError("raft groups failed to elect leaders")
 
     def close(self):
@@ -675,6 +678,8 @@ class DistributedCluster:
         """ref worker/proposal.go:125 proposeAndWait."""
         group = self.groups[gid]
         deadline = time.time() + timeout
+        apply_poll = poll_policy(0.002)
+        propose_poll = poll_policy(0.01)
         while time.time() < deadline:
             leader = group.leader()
             if leader is not None and leader.raft.propose(proposal):
@@ -682,9 +687,9 @@ class DistributedCluster:
                 while time.time() < deadline:
                     if leader.applied_index >= target:
                         return
-                    time.sleep(0.002)
+                    apply_poll.sleep(1)
                 break
-            time.sleep(0.01)
+            propose_poll.sleep(1)
         raise TimeoutError(f"proposal to group {gid} timed out")
 
     # -- reads -------------------------------------------------------------------

@@ -21,14 +21,19 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from dgraph_tpu.conn.retry import Deadline, current_deadline, deadline_scope
+from dgraph_tpu.conn.retry import (
+    Deadline,
+    current_deadline,
+    deadline_scope,
+    poll_policy,
+)
 from dgraph_tpu.conn.rpc import RpcError, RpcPool
 from dgraph_tpu.posting.lists import Txn
 from dgraph_tpu.utils.observe import METRICS
 from dgraph_tpu.schema.schema import State, parse_schema
 from dgraph_tpu.worker.groups import ClusterTxn, IntentLog, ZeroService
 from dgraph_tpu.worker.remote import RemoteGroup, RemoteKV
-from dgraph_tpu.x import keys
+from dgraph_tpu.x import config, keys
 
 
 def _free_ports(n: int) -> List[int]:
@@ -89,12 +94,13 @@ class ProcCluster:
             zero_impl = RemoteZero(zaddrs, self.pool)
             # wait for the zero quorum's leader
             deadline = time.time() + 30
+            poll = poll_policy(0.2)
             while time.time() < deadline:
                 try:
                     zero_impl._exec("lease_ts", 1, timeout=2.0)
                     break
                 except TimeoutError:
-                    time.sleep(0.2)
+                    poll.sleep(1)
             else:
                 raise TimeoutError("zero quorum never elected a leader")
         self.zero = ZeroService(n_groups, zero=zero_impl)
@@ -186,6 +192,7 @@ class ProcCluster:
         the leader/health caches: after a respawn the caches are stale and
         freshly-booted replica interpreters can take seconds to bind."""
         deadline = time.time() + timeout
+        poll = poll_policy(0.2)
         for g in self.remote_groups.values():
             g._leader = None  # force fresh discovery
             ok = False
@@ -201,7 +208,7 @@ class ProcCluster:
                     except RpcError:
                         continue
                 if not ok:
-                    time.sleep(0.2)
+                    poll.sleep(1)
             if not ok:
                 raise TimeoutError(f"group {g.gid} never elected a leader")
 
@@ -238,7 +245,7 @@ class ProcCluster:
     def _commit(self, txn: Txn) -> int:
         # the mutation entry point stamps ONE deadline that flows through
         # zero.commit and every group proposal beneath it
-        budget = float(os.environ.get("DGRAPH_TPU_COMMIT_DEADLINE_S", "20"))
+        budget = float(config.get("COMMIT_DEADLINE_S"))
         with deadline_scope(current_deadline() or Deadline.after(budget)):
             with self._commit_lock:
                 return self._commit_locked(txn)
@@ -329,9 +336,7 @@ class ProcCluster:
         from dgraph_tpu.query.outputjson import JsonEncoder
         from dgraph_tpu.query.subgraph import Executor
 
-        budget = timeout_s or float(
-            os.environ.get("DGRAPH_TPU_QUERY_DEADLINE_S", "15")
-        )
+        budget = timeout_s or float(config.get("QUERY_DEADLINE_S"))
         kv = self.read_kv(partial_ok=True)
         with deadline_scope(current_deadline() or Deadline.after(budget)):
             ts = read_ts if read_ts is not None else self.zero.zero.read_ts()

@@ -1,0 +1,309 @@
+"""Typed registry for every `DGRAPH_TPU_*` environment knob.
+
+Before this module each knob was a raw `os.environ.get` at its call
+site, with the default duplicated (and free to drift) per site and no
+single place documenting what exists. This registry is now the ONLY
+sanctioned reader of `DGRAPH_TPU_*` variables — the static-analysis
+suite (`dgraph_tpu/analysis`, `dgraph-tpu lint`) flags any raw
+`os.environ` / `os.getenv` access elsewhere in the package.
+
+Contract:
+
+  - Every knob is declared ONCE here with (name, type, default, doc).
+  - `get("NAME")` reads `DGRAPH_TPU_<NAME>` from the environment,
+    parses it to the declared type, and falls back to the declared
+    default when unset OR unparseable (a malformed value must never
+    crash a server at import time).
+  - Booleans accept 1/true/yes/on and 0/false/no/off (case-insensitive);
+    anything else falls back to the default.
+  - `reference_table()` renders the whole registry as the Markdown
+    table checked in at CONFIG.md (tests assert the file is in sync).
+
+Call sites keep their own read-at-import vs read-per-call timing; this
+module only centralizes the parse + default.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+PREFIX = "DGRAPH_TPU_"
+
+_TRUE = frozenset({"1", "true", "yes", "on"})
+_FALSE = frozenset({"0", "false", "no", "off"})
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str  # short name; env var is PREFIX + name
+    type: str  # "str" | "int" | "float" | "bool"
+    default: Any
+    doc: str
+
+    @property
+    def env(self) -> str:
+        return PREFIX + self.name
+
+    def parse(self, raw: str) -> Any:
+        """Parse a raw env string; raises ValueError when malformed."""
+        if self.type == "str":
+            return raw
+        if self.type == "bool":
+            v = raw.strip().lower()
+            if v in _TRUE:
+                return True
+            if v in _FALSE:
+                return False
+            raise ValueError(f"{self.env}={raw!r} is not a boolean")
+        if self.type == "int":
+            return int(raw.strip())
+        if self.type == "float":
+            return float(raw.strip())
+        raise ValueError(f"unknown knob type {self.type!r}")
+
+
+REGISTRY: Dict[str, Knob] = {}
+
+
+def _define(name: str, type_: str, default: Any, doc: str) -> Knob:
+    if name in REGISTRY:
+        raise ValueError(f"duplicate knob {name!r}")
+    k = Knob(name=name, type=type_, default=default, doc=doc)
+    REGISTRY[name] = k
+    return k
+
+
+# ---------------------------------------------------------------------------
+# knob declarations (one line per knob; keep alphabetical)
+# ---------------------------------------------------------------------------
+
+_define(
+    "BULK_NATIVE", "bool", True,
+    "Use the native C++ map/reduce pipeline for offline bulk loads when "
+    "the compiled library is available (loaders/bulk2.py). Disable to "
+    "force the pure-Python slow path.",
+)
+_define(
+    "COMMIT_DEADLINE_S", "float", 20.0,
+    "Budget stamped on a commit at the ProcCluster entry point; flows "
+    "through zero.commit and every group proposal beneath it "
+    "(worker/harness.py).",
+)
+_define(
+    "DEVCACHE_BYTES", "int", 256 << 20,
+    "LRU bound, in device bytes, for the HBM operand cache "
+    "(query/dispatch.py DeviceCache).",
+)
+_define(
+    "DEVICE_INIT_TIMEOUT_S", "float", 120.0,
+    "Watchdog on first jax backend init; on timeout the dispatcher "
+    "degrades permanently to host kernels (query/dispatch.py).",
+)
+_define(
+    "DEVICE_MIN_TOTAL", "int", None,
+    "Min combined operand size routed to the device kernels. Unset = "
+    "backend-aware auto (host-only on cpu backends, 1<<15 on TPU); "
+    "0 means ALWAYS use the device (query/dispatch.py).",
+)
+_define(
+    "EXEC_WORKERS", "int", 0,
+    "Sibling fan-out width for the parallel query executor; 0/1 = "
+    "serial escape hatch (query/subgraph.py). Re-read per Executor so "
+    "tests can flip it between queries.",
+)
+_define(
+    "FAKE_NOW", "str", "",
+    "Frozen timestamp for @default($now) GraphQL values — test "
+    "determinism hook (graphql/resolve.py). Empty = real UTC now.",
+)
+_define(
+    "FAULT_PLAN", "str", "",
+    "Deterministic fault-injection plan: inline JSON or @/path/to/file "
+    "(conn/faults.py). Inherited by alpha/zero replica processes.",
+)
+_define(
+    "FORCE_CPU", "bool", False,
+    "Unregister the remote-TPU backend and pin jax to the CPU platform "
+    "before first backend init (devsetup.maybe_force_cpu).",
+)
+_define(
+    "FORCE_DEVICE", "bool", False,
+    "Route every set op to the device kernels regardless of size "
+    "thresholds (query/dispatch.py) — benchmarking hook.",
+)
+_define(
+    "LAMBDA_URL", "str", "",
+    "GraphQL @lambda resolver endpoint; the alpha CLI superflag takes "
+    "precedence (graphql/resolve.py).",
+)
+_define(
+    "LEVEL_BATCH", "bool", True,
+    "Level-batched task reads (uids_many/values_many, one MemoryLayer "
+    "pass per level). 0 = per-uid escape hatch for A/B benchmarking "
+    "(query/subgraph.py).",
+)
+_define(
+    "MAX_FRAME_BYTES", "int", 256 << 20,
+    "Hard cap on a single wire frame on BOTH the RPC and raft planes; "
+    "a corrupt length prefix must never drive an unbounded allocation "
+    "(conn/frame.py, matches the reference's 256MB gRPC cap).",
+)
+_define(
+    "MAX_PART_UIDS", "int", 1 << 20,
+    "Multi-part posting list threshold: a rollup whose uid set exceeds "
+    "this splits into part records. ONE default shared by the runtime "
+    "split (posting/pl.py) and the native bulk reduce (loaders/"
+    "bulk2.py) — these previously duplicated the constant per site.",
+)
+_define(
+    "MEMLAYER_ENTRIES", "int", 400_000,
+    "MemoryLayer LRU capacity (decoded posting lists). Must exceed the "
+    "touched-key count of one large traversal level or the LRU "
+    "thrashes (posting/memlayer.py).",
+)
+_define(
+    "NATIVE_CACHE", "str", None,
+    "Directory holding the compiled native kernel library "
+    "(native/__init__.py); keyed by source hash + sanitizer mode. "
+    "Unset = <system tempdir>/dgraph_tpu_native.",
+)
+_define(
+    "NATIVE_SAN", "str", "",
+    "Sanitizer build mode for the native library: 'asan' or 'ubsan' "
+    "compile the .so with the matching -fsanitize= flags under a "
+    "separate cache key; empty = plain -O3 (native/__init__.py).",
+)
+_define(
+    "PACKED_MIN_RATIO", "int", 256,
+    "Packed-vs-decode crossover: an intersect takes the compressed-"
+    "domain block-skip path when |big| >= ratio * |small| "
+    "(query/dispatch.py; tuned via TUNE_PACKED_CPU.json).",
+)
+_define(
+    "PALLAS", "bool", False,
+    "Opt-in Pallas compare-all sweep for small-side intersect buckets "
+    "(query/dispatch.py, ops/pallas_setops.py).",
+)
+_define(
+    "QUERY_DEADLINE_S", "float", 15.0,
+    "Budget stamped on a query at the ProcCluster entry point; flows "
+    "through every remote read beneath it (worker/harness.py).",
+)
+_define(
+    "SHARD_MIN_B", "int", 1 << 22,
+    "A shared operand at/above this byte size is row-sharded over the "
+    "device mesh when >1 device is visible (query/dispatch.py).",
+)
+_define(
+    "SHARD_VECTORS", "bool", False,
+    "Row-shard vector similarity corpora over the device mesh "
+    "(models/vector.py + parallel/mesh.py sharded_topk).",
+)
+_define(
+    "SKIP_REMOTE_INTROSPECTION", "bool", False,
+    "Defer @custom(http:{graphql:...}) remote-endpoint introspection "
+    "at schema-update time — air-gapped loads (graphql/resolve.py).",
+)
+_define(
+    "STORAGE", "str", "mem",
+    "Default KV backend: 'mem' (WAL-backed in-memory) or 'lsm' "
+    "(spill-to-disk SSTables) (storage/kv.py).",
+)
+_define(
+    "WIRE_COMPRESS", "bool", False,
+    "zlib-compress bulk wire blobs; default OFF because zlib-1 is "
+    "slower than LAN/ICI-class links — enable for DCN-class links "
+    "(conn/frame.py, FRAMING_BENCH.json).",
+)
+
+
+# ---------------------------------------------------------------------------
+# accessors
+# ---------------------------------------------------------------------------
+
+
+def knob(name: str) -> Knob:
+    return REGISTRY[name]
+
+
+def get_raw(name: str) -> Optional[str]:
+    """The raw env string for a registered knob, or None when unset."""
+    return os.environ.get(REGISTRY[name].env)
+
+
+def get(name: str) -> Any:
+    """Parsed value of a registered knob; the declared default when the
+    variable is unset or malformed."""
+    k = REGISTRY[name]
+    raw = os.environ.get(k.env)
+    if raw is None:
+        return k.default
+    try:
+        return k.parse(raw)
+    except ValueError:
+        return k.default
+
+
+def set_env(name: str, value: Any) -> None:
+    """Write a knob into the process environment (inherited by spawned
+    replicas) — the sanctioned alternative to a raw os.environ write."""
+    k = REGISTRY[name]
+    if k.type == "bool":
+        raw = "1" if value else "0"
+    else:
+        raw = str(value)
+    os.environ[k.env] = raw
+
+
+def unset_env(name: str) -> None:
+    os.environ.pop(REGISTRY[name].env, None)
+
+
+def is_set(name: str) -> bool:
+    return REGISTRY[name].env in os.environ
+
+
+# ---------------------------------------------------------------------------
+# documentation
+# ---------------------------------------------------------------------------
+
+
+def _default_repr(k: Knob) -> str:
+    if k.default is None:
+        return "_(unset)_"
+    if k.type == "bool":
+        return "`1`" if k.default else "`0`"
+    if k.type == "str":
+        return f"`{k.default}`" if k.default else "_(empty)_"
+    if k.type == "int" and k.default >= 1 << 16:
+        # big byte/size constants read better as shifted forms
+        v = int(k.default)
+        if v and (v & (v - 1)) == 0:
+            return f"`{v}` (1<<{v.bit_length() - 1})"
+    return f"`{k.default}`"
+
+
+def reference_table() -> str:
+    """The CONFIG.md body: one Markdown table row per registered knob."""
+    lines = [
+        "# CONFIG — `DGRAPH_TPU_*` environment reference",
+        "",
+        "Generated from `dgraph_tpu/x/config.py` "
+        "(`python -m dgraph_tpu.cli config-ref`); a tier-1 test asserts "
+        "this file matches the registry. Booleans accept "
+        "`1/true/yes/on` and `0/false/no/off`; malformed values fall "
+        "back to the default instead of crashing.",
+        "",
+        "| Variable | Type | Default | Description |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(REGISTRY):
+        k = REGISTRY[name]
+        doc = " ".join(k.doc.split())
+        lines.append(
+            f"| `{k.env}` | {k.type} | {_default_repr(k)} | {doc} |"
+        )
+    lines.append("")
+    return "\n".join(lines)

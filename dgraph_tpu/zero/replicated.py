@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from dgraph_tpu.conn.retry import poll_policy
 from dgraph_tpu.raft.raft import RaftNode
 from dgraph_tpu.zero.zero import TxnConflictError
 
@@ -220,6 +221,7 @@ class ReplicatedZero:
 
     def _leader(self, timeout: float = 5.0) -> ZeroReplica:
         deadline = time.time() + timeout
+        poll = poll_policy(0.002)
         while time.time() < deadline:
             down = getattr(self.replicas[0].net, "down", set())
             live = [
@@ -231,7 +233,7 @@ class ReplicatedZero:
                 # highest term wins: a partitioned stale leader lingers
                 # until it hears the new term
                 return max(live, key=lambda r: r.raft.term)
-            time.sleep(0.002)
+            poll.sleep(1)
         raise TimeoutError("no zero leader")
 
     def _propose(self, kind: str, *args, timeout: float = 10.0):
@@ -250,6 +252,7 @@ class ReplicatedZero:
             # bounded wait per attempt: if leadership flips mid-flight we
             # re-propose; the state machine dedups by (client, req_id)
             attempt_end = min(deadline, time.time() + 1.5)
+            apply_poll = poll_policy(0.001)
             while time.time() < attempt_end:
                 if key in leader.sm.results:
                     return leader.sm.results[key]
@@ -257,7 +260,7 @@ class ReplicatedZero:
                 for r in self.replicas:
                     if key in r.sm.results and r.raft.is_leader():
                         return r.sm.results[key]
-                time.sleep(0.001)
+                apply_poll.sleep(1)
         raise TimeoutError(f"zero proposal {kind} timed out")
 
     # -- ZeroLite interface --------------------------------------------------

@@ -24,6 +24,11 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# XLA/TSL C++ logging writes to RAW stderr (bypassing pytest capture):
+# a cold compile-cache INFO mid-run splices into the progress-dot lines
+# and corrupts dot-counting harnesses. Level 2 keeps ERROR visible.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
 # Persistent XLA compile cache: this box is 1-core, each compile is seconds.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dgraph_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")

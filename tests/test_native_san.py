@@ -1,0 +1,77 @@
+"""Sanitizer builds of the native kernels (DGRAPH_TPU_NATIVE_SAN).
+
+The randomized packed-setops equivalence corpus is the best UB probe we
+have for the C++ hot paths (block-skip intersect, partial decode,
+bulk reduce): it drives adversarial block alignments, UINT32_MAX uids
+and empty/singleton blocks through the same ctypes bindings production
+uses. Here it re-runs in a subprocess whose native .so is compiled
+with -fsanitize=undefined -fno-sanitize-recover=all, so ANY signed
+overflow / misaligned access / OOB shift aborts the interpreter and
+fails the test. slow-marked: it recompiles the library and re-runs a
+whole test module.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _san_env(mode: str) -> dict:
+    env = dict(os.environ)
+    env["DGRAPH_TPU_NATIVE_SAN"] = mode
+    env["JAX_PLATFORMS"] = "cpu"
+    env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
+    return env
+
+
+def _native_available(env: dict) -> bool:
+    r = subprocess.run(
+        [
+            sys.executable, "-c",
+            "from dgraph_tpu import native; "
+            "print(int(native.NATIVE_AVAILABLE))",
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    return r.returncode == 0 and r.stdout.strip() == "1"
+
+
+def test_ubsan_build_is_separate_cache_entry(tmp_path):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in this environment")
+    env = _san_env("ubsan")
+    env["DGRAPH_TPU_NATIVE_CACHE"] = str(tmp_path)
+    if not _native_available(env):
+        pytest.skip("ubsan build unavailable (toolchain lacks libubsan)")
+    names = os.listdir(tmp_path)
+    assert any(n.endswith("-ubsan.so") for n in names), names
+    assert not any(
+        n.endswith(".so") and "-ubsan" not in n for n in names
+    ), f"plain and sanitized builds share a cache key: {names}"
+
+
+def test_packed_setops_corpus_under_ubsan():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in this environment")
+    env = _san_env("ubsan")  # default cache dir: reuses the -ubsan .so
+    if not _native_available(env):
+        pytest.skip("ubsan build unavailable (toolchain lacks libubsan)")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            "tests/test_packed_setops.py", "tests/test_uidpack.py",
+            "-q", "-m", "not slow", "-p", "no:cacheprovider",
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=900,
+    )
+    assert r.returncode == 0, (
+        "packed-setops corpus failed under UBSan:\n"
+        + r.stdout[-4000:] + r.stderr[-4000:]
+    )

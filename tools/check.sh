@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# One-shot verification gate: static analysis + tests.
+#
+#   tools/check.sh          lint + analyzer/registry tests + smoke subset
+#   tools/check.sh --full   lint + the FULL tier-1 suite (same command the
+#                           ROADMAP pins for tier-1 verify)
+#
+# Exit code is nonzero on the first failing stage, so CI can consume it
+# directly. JAX is pinned to CPU: the gate must never dial an accelerator.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== dgraph-tpu lint =="
+python -m dgraph_tpu.cli lint
+
+echo "== analyzer + config-registry self-tests =="
+python -m pytest tests/test_static_analysis.py -q -p no:cacheprovider
+
+if [[ "${1:-}" == "--full" ]]; then
+    echo "== full tier-1 suite =="
+    python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+else
+    echo "== tier-1 smoke subset =="
+    python -m pytest \
+        tests/test_setops.py tests/test_uidpack.py \
+        tests/test_packed_setops.py tests/test_posting.py \
+        tests/test_storage.py tests/test_raft.py \
+        tests/test_replicated_zero.py tests/test_cluster_facade.py \
+        -q -p no:cacheprovider
+fi
+
+echo "check.sh: all stages passed"
