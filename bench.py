@@ -76,24 +76,12 @@ def _probe_device(timeout_s: int = 240) -> bool:
         return False
 
 
-def _bench_fanout(platform, fanout=100, pool=200_000):
-    """Level-batched fan-out headline (BENCH_FANOUT.json):
-
-      fanout_3level_1M        3-level traversal latency over ~1.01M edges
-                              (1 -> 100 -> 10k -> 1M), batched level tasks
-                              vs the per-uid baseline
-                              (DGRAPH_TPU_LEVEL_BATCH=0), both warm
-      level_batch_read_calls  cache round-trips per query in each mode —
-                              the batched executor issues ONE uids_many
-                              per (predicate, level) instead of one
-                              uids_tok per parent uid
-    """
-    import os
-
-    from benchmarks import stamp
+def _build_fanout_graph(fanout=100, pool=200_000):
+    """The 3-level 1 -> f -> f^2 -> f^3 traversal graph (~1.01M edges at
+    f=100) shared by the fan-out and observability benchmarks. Returns
+    (server, query, edges, load_seconds)."""
     from dgraph_tpu.api.server import Server
     from dgraph_tpu.loaders.bulk2 import ParallelBulkLoader
-    from dgraph_tpu.posting.lists import READ_COUNTERS
 
     f = fanout
     rng = np.random.default_rng(7)
@@ -117,8 +105,29 @@ def _bench_fanout(platform, fanout=100, pool=200_000):
     load_s = time.perf_counter() - t0
     print(f"fanout graph: {edges} edges loaded in {load_s:.1f}s",
           file=sys.stderr)
-
     q = "{ q(func: uid(0x1)) { child { child { c: count(child) } } } }"
+    return s, q, edges, load_s
+
+
+def _bench_fanout(platform, fanout=100, pool=200_000):
+    """Level-batched fan-out headline (BENCH_FANOUT.json):
+
+      fanout_3level_1M        3-level traversal latency over ~1.01M edges
+                              (1 -> 100 -> 10k -> 1M), batched level tasks
+                              vs the per-uid baseline
+                              (DGRAPH_TPU_LEVEL_BATCH=0), both warm
+      level_batch_read_calls  cache round-trips per query in each mode —
+                              the batched executor issues ONE uids_many
+                              per (predicate, level) instead of one
+                              uids_tok per parent uid
+    """
+    import os
+
+    from benchmarks import stamp
+    from dgraph_tpu.posting.lists import READ_COUNTERS
+
+    f = fanout
+    s, q, edges, load_s = _build_fanout_graph(fanout, pool)
 
     def run_mode(batch: bool):
         os.environ["DGRAPH_TPU_LEVEL_BATCH"] = "1" if batch else "0"
@@ -187,7 +196,7 @@ def _bench_fanout(platform, fanout=100, pool=200_000):
                 "edges": edges,
                 "levels": 3,
                 "fanout": f,
-                "l2_parents": len(l2),
+                "l2_parents": f * f,
                 "l3_rows": int(n2),
                 "load_seconds": round(load_s, 1),
             },
@@ -295,6 +304,7 @@ def main():
     print(json.dumps(result))
     _bench_packed(rng, big, platform)
     _bench_fanout(platform)
+    _bench_obs(platform)
     _bench_chaos(platform)
 
 
@@ -398,6 +408,96 @@ def _bench_packed(rng, big, platform):
     )
 
 
+def _bench_obs(platform, fanout=100, pool=200_000):
+    """Tracing overhead (BENCH_OBS.json): the fanout_3level_1M warm
+    query under three modes — tracing OFF (DGRAPH_TPU_TRACE=0),
+    enabled-but-UNSAMPLED (the production default posture: context
+    propagates, histograms fill, nothing exported), and FULLY SAMPLED
+    with every span written to a JSONL sink — plus the sink's raw
+    spans/s throughput. The acceptance bar: enabled-unsampled must stay
+    within 5% of off, proving instrumentation is off the hot path."""
+    import os
+    import tempfile
+
+    from benchmarks import stamp
+    from dgraph_tpu.utils import observe
+    from dgraph_tpu.x import config
+
+    s, q, edges, load_s = _build_fanout_graph(fanout, pool)
+
+    def run_mode(trace: bool, sample: float, sink: str = ""):
+        config.set_env("TRACE", trace)
+        config.set_env("TRACE_SAMPLE", sample)
+        observe.TRACER.set_sink(sink or None)
+        try:
+            s.query(q)  # warm caches under the mode's settings
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                s.query(q)
+                best = min(best, time.perf_counter() - t0)
+            return best * 1e3
+        finally:
+            observe.TRACER.set_sink(None)
+            config.unset_env("TRACE")
+            config.unset_env("TRACE_SAMPLE")
+
+    sink_path = os.path.join(
+        tempfile.mkdtemp(prefix="dgraph_obs_bench_"), "spans.jsonl"
+    )
+    off_ms = run_mode(trace=False, sample=0.0)
+    unsampled_ms = run_mode(trace=True, sample=0.0)
+    sampled_ms = run_mode(trace=True, sample=1.0, sink=sink_path)
+    overhead_pct = (unsampled_ms - off_ms) / off_ms * 100.0
+
+    # raw JSONL sink throughput: how many spans/s the exporter absorbs
+    n_spans = 20_000
+    tr = observe.Tracer(capacity=16, sink_path=sink_path + ".tput")
+    t0 = time.perf_counter()
+    for _ in range(n_spans):
+        with tr.span("bench"):
+            pass
+    sink_spans_per_s = n_spans / (time.perf_counter() - t0)
+
+    for metric, value, extra in (
+        (
+            "fanout_3level_1M_traced",
+            round(unsampled_ms, 2),
+            {
+                "unit": "ms",
+                "tracing_off_ms": round(off_ms, 2),
+                "fully_sampled_ms": round(sampled_ms, 2),
+                "unsampled_overhead_pct": round(overhead_pct, 2),
+            },
+        ),
+        (
+            "trace_sink_throughput",
+            round(sink_spans_per_s),
+            {"unit": "spans/s"},
+        ),
+    ):
+        print(
+            json.dumps(
+                {"metric": metric, "value": value, **extra,
+                 "platform": platform}
+            )
+        )
+    stamp.guarded_write(
+        "BENCH_OBS.json",
+        {
+            "fanout_3level_1M_ms": {
+                "tracing_off": round(off_ms, 2),
+                "enabled_unsampled": round(unsampled_ms, 2),
+                "fully_sampled_jsonl": round(sampled_ms, 2),
+            },
+            "unsampled_overhead_pct": round(overhead_pct, 2),
+            "jsonl_sink_spans_per_s": round(sink_spans_per_s),
+            "graph": {"edges": edges, "load_seconds": round(load_s, 1)},
+        },
+        platform,
+    )
+
+
 def _bench_chaos(platform):
     """Retry-storm visibility (BENCH_CHAOS.json): a fixed-seed fault
     schedule (drops + delays + disconnects + lost acks) over an
@@ -489,5 +589,13 @@ if __name__ == "__main__":
         import jax as _jax
 
         _bench_fanout(_jax.default_backend())
+    elif "--obs-only" in sys.argv:
+        # tracing-overhead capture (BENCH_OBS.json); host-path only
+        from dgraph_tpu.devsetup import maybe_force_cpu
+
+        maybe_force_cpu()
+        import jax as _jax
+
+        _bench_obs(_jax.default_backend())
     else:
         main()

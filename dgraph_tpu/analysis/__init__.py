@@ -12,6 +12,8 @@ introduced by convention and this PR makes machine-checked:
                     (arity, widths, signedness, restype)
   jax-hygiene       no host numpy / implicit syncs inside jitted fns
                     (ops/, query/dispatch.py)
+  metrics-registry  every METRICS.inc/observe/set_gauge/timer name is
+                    declared in utils/observe.METRIC_DEFS (METRICS.md)
 
 `run()` scans the installed package by default, applies the allowlist
 (`allowlist.py`; every entry carries a reason, stale entries fail the
@@ -31,6 +33,7 @@ from dgraph_tpu.analysis import (
     check_deadline,
     check_jax,
     check_locks,
+    check_metrics,
 )
 from dgraph_tpu.analysis.allowlist import ALLOWLIST
 from dgraph_tpu.analysis.core import (
@@ -48,6 +51,7 @@ CHECKERS = {
     check_deadline.NAME: check_deadline.check,
     check_ctypes_abi.NAME: check_ctypes_abi.check,
     check_jax.NAME: check_jax.check,
+    check_metrics.NAME: check_metrics.check,
 }
 
 

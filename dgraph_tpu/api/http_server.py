@@ -105,7 +105,13 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/traces":
             from dgraph_tpu.utils.observe import TRACER
 
-            self._reply({"spans": TRACER.recent(200)})
+            # a cluster engine (ProcCluster) merges every process's
+            # spans; single-process engines serve the local ring
+            merged_traces = getattr(self.engine, "merged_traces", None)
+            if merged_traces is not None:
+                self._reply({"spans": merged_traces(200)})
+            else:
+                self._reply({"spans": TRACER.recent(200)})
         elif path == "/debug/prometheus_metrics":
             from dgraph_tpu.utils.observe import METRICS
 
@@ -113,8 +119,11 @@ class _Handler(BaseHTTPRequestHandler):
             for k, v in sorted(self.metrics.items()):
                 out.append(f"# TYPE dgraph_tpu_http_{k} counter")
                 out.append(f"dgraph_tpu_http_{k} {v}")
-            # registry: engine counters/gauges/latency histograms
-            out.append(METRICS.render())
+            # cluster engines scrape every alpha/zero process and merge
+            # (counters summed, histogram buckets merged, per-instance
+            # labels); single-process engines render the local registry
+            merged = getattr(self.engine, "merged_metrics", None)
+            out.append(merged() if merged is not None else METRICS.render())
             data = ("\n".join(out) + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")
@@ -208,11 +217,11 @@ class _Handler(BaseHTTPRequestHandler):
                     variables=variables,
                     timeout_ms=timeout_ms,
                 )
-                res["extensions"] = {
-                    "server_latency": {
-                        "total_ns": int((time.time() - t0) * 1e9)
-                    }
-                }
+                # keep the engine's server_latency/profile/trace_id and
+                # stamp the HTTP-layer total on top (reference envelope)
+                ext = res.setdefault("extensions", {})
+                lat = ext.setdefault("server_latency", {})
+                lat["total_ns"] = int((time.time() - t0) * 1e9)
                 self._reply(res)
             elif path == "/mutate":
                 if getattr(self.engine, "draining", False):

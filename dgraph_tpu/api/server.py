@@ -238,7 +238,10 @@ class Server:
 
         self.acl = None  # enabled via enable_acl() (ref --acl superflag)
         self.audit = None  # enabled via enable_audit()
-        self.slow_query_ms = 1000.0  # slow-query log threshold
+        from dgraph_tpu.x import config as _config
+
+        # slow-query threshold (instance override of the registry knob)
+        self.slow_query_ms = float(_config.get("SLOW_QUERY_MS"))
         self.mem = MemoryLayer()  # shared decoded-list read cache
         from dgraph_tpu.utils.cmsketch import StatsHolder
 
@@ -792,9 +795,18 @@ class Server:
         timeout_ms: Optional[float] = None,
     ) -> dict:
         """Run a read-only query at a fresh (or given) read ts.
-        timeout_ms bounds execution (ref x/limits --query timeout)."""
+        timeout_ms bounds execution (ref x/limits --query timeout).
+        The response carries reference-shaped extensions.server_latency
+        plus the per-query profile; slow queries are force-sampled and
+        appended to the slow-query JSONL log (DGRAPH_TPU_SLOW_QUERY_MS,
+        DGRAPH_TPU_SLOW_QUERY_LOG)."""
+        import time as _time
+
+        t_begin = _time.monotonic()
         ts = read_ts if read_ts is not None else self.zero.read_ts()
+        t_assigned = _time.monotonic()
         blocks = dql.parse(q, variables)
+        t_parsed = _time.monotonic()
         ns = keys.GALAXY_NS
         allowed = None
         user = ""
@@ -815,9 +827,8 @@ class Server:
                 self._audit("query", user=user, body=q, status="DENIED")
                 raise
         self._audit("query", user=user, ns=ns, body=q)
-        import time as _time
-
-        from dgraph_tpu.utils.observe import METRICS, TRACER
+        from dgraph_tpu.utils import observe
+        from dgraph_tpu.utils.observe import METRICS, TRACER, profile_scope
 
         t0 = _time.monotonic()
         deadline = (
@@ -825,9 +836,8 @@ class Server:
             if timeout_ms is not None
             else None
         )
-        with TRACER.span("query", ns=ns), METRICS.timer(
-            "query_latency_seconds"
-        ):
+        with TRACER.span("query", ns=ns) as root, profile_scope() as prof, \
+                METRICS.timer("query_latency_seconds"):
             out = self._query_parsed(
                 blocks,
                 LocalCache(self.kv, ts, mem=self.mem),
@@ -836,18 +846,28 @@ class Server:
                 deadline=deadline,
             )
         METRICS.inc("num_queries")
-        took_ms = (_time.monotonic() - t0) * 1e3
-        if took_ms > self.slow_query_ms:
-            # structured slow-query log (ref x/log.go LogSlowOperation,
-            # edgraph/server.go:1448)
-            import logging
-
-            logging.getLogger("dgraph_tpu.slow").warning(
-                "slow query: %.1fms ns=%d query=%s",
-                took_ms,
-                ns,
-                q[:500].replace("\n", " "),
-            )
+        t_done = _time.monotonic()
+        took_ms = (t_done - t_begin) * 1e3
+        ext = out.setdefault("extensions", {})
+        ext["server_latency"] = {
+            "assign_timestamp_ns": int((t_assigned - t_begin) * 1e9),
+            "parsing_ns": int((t_parsed - t_assigned) * 1e9),
+            # everything after parse (ACL/audit + execution) so the
+            # components sum to total_ns with no unattributed gap
+            "processing_ns": int((t_done - t_parsed) * 1e9),
+            "encoding_ns": 0,  # encoding happens inside _query_parsed
+            "total_ns": int((t_done - t_begin) * 1e9),
+        }
+        ext["profile"] = prof.to_dict()
+        if root.trace_id:
+            ext["trace_id"] = f"{root.trace_id:032x}"
+        # structured slow-query log (ref x/log.go LogSlowOperation,
+        # edgraph/server.go:1448): force-sample + bounded JSONL
+        observe.maybe_log_slow(
+            "query", q, took_ms, root,
+            extra={"ns": ns},
+            threshold_ms=self.slow_query_ms,
+        )
         return out
 
     def query_rdf(

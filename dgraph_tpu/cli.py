@@ -93,7 +93,9 @@ def cmd_alpha(args):
     if tf.get_string("sink-file"):
         from dgraph_tpu.utils import observe
 
-        observe.TRACER = observe.Tracer(sink_path=tf.get_string("sink-file"))
+        # point the GLOBAL tracer at the sink (replacing the instance
+        # would orphan every module that imported TRACER by value)
+        observe.TRACER.set_sink(tf.get_string("sink-file"))
     srv = HTTPServer(engine, host=args.bind, port=args.port).start()
     print(f"alpha listening on http://{args.bind}:{srv.port}")
     if args.grpc_port >= 0:
@@ -378,6 +380,56 @@ def cmd_lint(args):
     return 0 if rep.ok else 1
 
 
+def cmd_metrics(args):
+    """Scrape the cluster-merged metrics endpoint of a running alpha
+    (`/debug/prometheus_metrics`: counters summed across every alpha/
+    zero process, histograms bucket-merged, per-instance labels kept)
+    and print the exposition text — or, with --json, a parsed
+    {counters, gauges, histograms} object."""
+    import urllib.request
+
+    from dgraph_tpu.utils import observe
+
+    url = args.addr.rstrip("/") + "/debug/prometheus_metrics"
+    try:
+        text = urllib.request.urlopen(
+            url, timeout=args.timeout
+        ).read().decode("utf-8")
+    except Exception as e:
+        print(f"scrape of {url} failed: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        parsed = observe.parse_exposition(text)
+        print(
+            json.dumps(
+                {
+                    "counters": parsed["counter"],
+                    "gauges": parsed["gauge"],
+                    "histograms": parsed["histogram"],
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        print(text, end="")
+    return 0
+
+
+def cmd_metrics_ref(args):
+    """Regenerate (or print) the METRICS.md metric-name reference."""
+    from dgraph_tpu.utils import observe
+
+    text = observe.metrics_reference()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text, end="")
+    return 0
+
+
 def cmd_config_ref(args):
     """Regenerate (or print) the CONFIG.md env-var reference."""
     from dgraph_tpu.x import config
@@ -564,6 +616,30 @@ def main(argv=None):
         help="run only this checker (repeatable); default: all",
     )
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser(
+        "metrics",
+        help="scrape + print the cluster-merged Prometheus metrics of "
+        "a running alpha",
+    )
+    p.add_argument(
+        "--addr", default="http://127.0.0.1:8080",
+        help="base URL of the alpha HTTP endpoint",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="parsed {counters,gauges,histograms} JSON instead of text",
+    )
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "metrics-ref",
+        help="print (or write) the generated metric-name reference "
+        "(METRICS.md)",
+    )
+    p.add_argument("-o", "--out", default=None, help="write to this path")
+    p.set_defaults(fn=cmd_metrics_ref)
 
     p = sub.add_parser(
         "config-ref",

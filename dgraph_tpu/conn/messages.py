@@ -266,7 +266,10 @@ class RaftEnvelope(Message):
     """raftpb.Message analog for the raft TCP plane; payload nests the
     kind-specific body as an opaque framed blob (entries carry app
     proposal data, like raftpb.Entry.Data — the frame codec keeps bulk
-    snapshot bytes raw instead of base64)."""
+    snapshot bytes raw instead of base64). `trace` carries the ambient
+    W3C traceparent of the sender (empty for untraced tick traffic) so
+    a traced proposal's replication hop stays attributable; decoders
+    that predate the field skip it (forward compat)."""
 
     FIELDS = {
         "kind": (1, "str"),
@@ -274,6 +277,7 @@ class RaftEnvelope(Message):
         "to": (3, "uint"),
         "term": (4, "uint"),
         "payload": (5, "bytes"),
+        "trace": (6, "str"),
     }
 
 

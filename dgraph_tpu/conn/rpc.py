@@ -32,6 +32,7 @@ JSON (not pickle) on purpose: the wire should never execute code.
 
 from __future__ import annotations
 
+import os
 import socket
 import socketserver
 import struct
@@ -44,7 +45,12 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from dgraph_tpu.conn import faults
 from dgraph_tpu.conn.frame import MAX_FRAME, FrameError, pack_body, unpack_body
 from dgraph_tpu.conn.retry import Deadline, RetryPolicy
-from dgraph_tpu.utils.observe import METRICS
+from dgraph_tpu.utils.observe import (
+    METRICS,
+    TRACER,
+    current_profile,
+    parse_traceparent,
+)
 
 _LEN = struct.Struct(">I")
 
@@ -117,7 +123,10 @@ class RpcServer:
     after a lost ack cannot double-apply a non-idempotent handler."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 idem_cache: int = 1024):
+                 idem_cache: int = 1024, instance: str = ""):
+        # per-process label stamped on rpc_server spans and piggybacked
+        # profile fragments (alpha/zero processes set "alpha-<id>" etc.)
+        self.instance = instance or f"pid{os.getpid()}"
         self.handlers: Dict[str, Callable[[dict], Any]] = {}
         self.register("ping", lambda a: {"pong": True, "t": time.time()})
         self._idem_cap = idem_cache
@@ -173,21 +182,49 @@ class RpcServer:
     # -- request execution ---------------------------------------------------
 
     def _execute(self, req: dict) -> dict:
+        """Run the handler. A request carrying a `tp` traceparent joins
+        the caller's trace: its context is attached around the handler
+        so server-side spans parent correctly, one rpc_server span
+        covers the execution, and a profile fragment (instance, method,
+        ms) rides back on the response (`p`) for the client's
+        QueryProfile — the reference's per-query server-side latency
+        attribution, made cross-process."""
         rid = req.get("id")
-        fn = self.handlers.get(req.get("m"))
+        method = req.get("m")
+        fn = self.handlers.get(method)
+        ctx = parse_traceparent(req["tp"]) if req.get("tp") else None
+        token = TRACER.attach(ctx) if ctx is not None else None
+        t0 = time.perf_counter()
         try:
             if fn is None:
-                raise RpcError(f"no such method {req.get('m')!r}")
+                raise RpcError(f"no such method {method!r}")
             from dgraph_tpu.conn.messages import Message, from_wire, to_wire
 
             args = req.get("a") or {}
             typed = from_wire(args)
-            result = fn(typed if typed is not None else args)
+            if ctx is not None:
+                METRICS.inc("rpc_server_requests_total")
+                with TRACER.span(
+                    "rpc_server", method=method, instance=self.instance
+                ):
+                    result = fn(typed if typed is not None else args)
+            else:
+                result = fn(typed if typed is not None else args)
             if isinstance(result, Message):
                 result = to_wire(result)
-            return {"id": rid, "r": result}
+            resp = {"id": rid, "r": result}
         except Exception as e:  # surface to caller, keep serving
-            return {"id": rid, "e": f"{type(e).__name__}: {e}"}
+            resp = {"id": rid, "e": f"{type(e).__name__}: {e}"}
+        finally:
+            if token is not None:
+                TRACER.detach(token)
+        if ctx is not None:
+            resp["p"] = {
+                "i": self.instance,
+                "m": method,
+                "ms": round((time.perf_counter() - t0) * 1e3, 3),
+            }
+        return resp
 
     def _dispatch(self, req: dict) -> dict:
         """Execute with idempotency-key dedup: a completed (client, seq)
@@ -294,6 +331,9 @@ class RpcClient:
         if isinstance(args, Message):
             args = to_wire(args)  # typed control-plane message
         per_attempt = timeout or self.timeout
+        # propagate the ambient trace context (W3C traceparent) — stable
+        # across every reconnect/resend attempt of this logical call
+        tp = TRACER.current_traceparent()
         with self._lock:
             dl = deadline or Deadline.after(per_attempt)
             self._seq += 1
@@ -326,6 +366,8 @@ class RpcClient:
                     # detection (the old settimeout leak)
                     self._sock.settimeout(dl.clamp(per_attempt))
                     req = {"id": rid, "m": method, "a": args or {}}
+                    if tp:
+                        req["tp"] = tp
                     if idem:
                         req["c"] = self.client_id
                         req["q"] = seq
@@ -342,6 +384,11 @@ class RpcClient:
                         # duplicated request): skip to ours
                         METRICS.inc("rpc_stale_responses_total")
                     self._sock.settimeout(self.timeout)
+                    frag = resp.get("p")
+                    if frag:
+                        prof = current_profile()
+                        if prof is not None:
+                            prof.record_rpc_fragment(frag)
                     if resp.get("e"):
                         raise RpcError(resp["e"])
                     r = resp.get("r")

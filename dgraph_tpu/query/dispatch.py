@@ -702,6 +702,13 @@ class SetOpDispatcher:
                 a, b = _as_array(a), _as_array(b)
             dense.append((a, b))
             dense_at.append(i)
+        # kernel-choice accounting (packed vs decoded) for the per-query
+        # profile and the cluster metrics endpoint
+        from dgraph_tpu.utils.observe import METRICS
+
+        METRICS.inc("setop_pairs_total", len(pairs))
+        if len(dense) < len(pairs):
+            METRICS.inc("setop_packed_total", len(pairs) - len(dense))
         if dense:
             total = sum(len(a) + len(b) for a, b in dense)
             if (

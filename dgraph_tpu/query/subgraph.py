@@ -14,7 +14,9 @@ Execution order of blocks follows variable dependencies
 
 from __future__ import annotations
 
+import contextvars
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -35,7 +37,7 @@ from dgraph_tpu.query.functions import (
 )
 from dgraph_tpu.schema.schema import State
 from dgraph_tpu.types.types import TypeID, Val, compare_vals, convert
-from dgraph_tpu.utils.observe import METRICS, TRACER
+from dgraph_tpu.utils.observe import METRICS, TRACER, current_profile
 from dgraph_tpu.x import config, keys
 
 # ---------------------------------------------------------------------------
@@ -589,11 +591,15 @@ class Executor:
             ]
             if len(par) > 1:
                 pool = _expand_pool(workers)
+                # each subtree runs under a COPY of this context so
+                # worker threads inherit the query's span parent and
+                # profile instead of starting orphan traces
                 futs = [
                     (
                         cgq,
                         pool.submit(
-                            self._expand_one_worker, node, cgq, depth
+                            contextvars.copy_context().run,
+                            self._expand_one_worker, node, cgq, depth,
                         ),
                     )
                     for cgq in par
@@ -718,6 +724,24 @@ class Executor:
                 for u, x in prop.items()
             }
 
+    def _record_level_task(
+        self, attr: str, parent: ExecNode, parents: int, t0: float
+    ) -> None:
+        """Attribute one (predicate, level) task to the active query
+        profile; level = depth of the parent chain (root reads are 1)."""
+        prof = current_profile()
+        if prof is None:
+            return
+        level = 1
+        p = parent
+        while getattr(p, "parent_node", None) is not None:
+            level += 1
+            p = p.parent_node
+        prof.record_level_task(
+            attr, level, parents, (time.perf_counter() - t0) * 1e3,
+            self.level_batch,
+        )
+
     def _make_child(self, parent: ExecNode, cgq: GraphQuery) -> Optional[ExecNode]:
         attr = cgq.attr
         if cgq.math_expr is not None:
@@ -765,6 +789,7 @@ class Executor:
             # in a single batched call returning the ragged (flat, offsets)
             # level buffer (ref worker/task.go one task per attr; the
             # per-uid loop is the DGRAPH_TPU_LEVEL_BATCH=0 escape hatch)
+            t0 = time.perf_counter()
             with TRACER.span(
                 "level_task", attr=attr, parents=len(level_keys)
             ):
@@ -781,6 +806,7 @@ class Executor:
                         rows.append(r)
                         row_toks.append(tok)
                     flat, offs = ragged.pack_rows(rows)
+            self._record_level_task(attr, parent, len(level_keys), t0)
             if cgq.filter is not None:
                 dest = self.eval_filter(
                     cgq.filter, ragged.merge_flat(flat, offs)
@@ -865,6 +891,7 @@ class Executor:
                 keys.DataKey(attr, int(u), self.ns)
                 for u in parent.dest_uids
             ]
+            t0 = time.perf_counter()
             with TRACER.span(
                 "level_task", attr=attr, parents=len(dkeys)
             ):
@@ -875,6 +902,7 @@ class Executor:
                 else:
                     self.cache.prefetch(dkeys)
                     all_posts = [self.cache.values(k) for k in dkeys]
+            self._record_level_task(attr, parent, len(dkeys), t0)
             for u, posts in zip(parent.dest_uids, all_posts):
                 if cgq.lang == "*":
                     pass  # @* keeps every language; encoder fans out fields

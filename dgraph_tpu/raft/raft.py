@@ -43,6 +43,9 @@ class Message:
     to: int
     term: int
     payload: dict = field(default_factory=dict)
+    # W3C traceparent of the sender's ambient span, carried on the TCP
+    # plane (conn/messages.RaftEnvelope.trace); "" for untraced traffic
+    trace: str = ""
 
 
 class InProcNetwork:
@@ -227,6 +230,16 @@ class RaftNode:
             self.log.append(e)
             self._persist_append(e)
             self._persist_flush()
+            # remember the proposer's ambient trace context (set when a
+            # traced RPC handler proposes): the next append broadcast
+            # carries it on the wire so the replication hop of a traced
+            # proposal stays attributable (RaftEnvelope.trace ->
+            # follower-side raft_recv spans)
+            from dgraph_tpu.utils.observe import TRACER
+
+            tp = TRACER.current_traceparent()
+            if tp:
+                self._pending_trace = tp
             self.match_index[self.id] = self.last_index()
             if self._voting_size() == 1:
                 # a single-voter group commits on its own match alone —
@@ -326,6 +339,7 @@ class RaftNode:
         self._last_heartbeat_sent = now
         for p in self.peers:
             self._send_append(p)
+        self._pending_trace = ""  # carried on one broadcast round only
 
     def _send_append(self, p: int):
         ni = self.next_index.get(p, self.last_index() + 1)
@@ -363,6 +377,8 @@ class RaftNode:
                     "entries": entries,
                     "leader_commit": self.commit_index,
                 },
+                trace=getattr(self, "_pending_trace", "") if entries
+                else "",
             )
         )
 

@@ -24,6 +24,7 @@ from dgraph_tpu.conn import faults
 from dgraph_tpu.conn.frame import MAX_FRAME, pack_body, unpack_body
 from dgraph_tpu.conn.messages import RaftEnvelope
 from dgraph_tpu.raft.raft import Message
+from dgraph_tpu.utils.observe import TRACER, parse_traceparent
 
 _LEN = struct.Struct(">I")
 
@@ -72,6 +73,7 @@ class TcpNetwork:
                             payload=unpack_body(env.payload)
                             if env.payload
                             else {},
+                            trace=env.trace,
                         )
                     except (ValueError, KeyError, TypeError):
                         continue
@@ -85,6 +87,17 @@ class TcpNetwork:
                                 return
                             if act.action == "delay":
                                 time.sleep(act.delay_s)
+                    if msg.trace:
+                        # a traced proposal's replication hop: join the
+                        # proposer's trace so the follower-side arrival
+                        # is attributable in the merged view
+                        ctx = parse_traceparent(msg.trace)
+                        if ctx is not None:
+                            with TRACER.span(
+                                "raft_recv", parent=ctx, kind=msg.kind,
+                                frm=msg.frm, to=msg.to,
+                            ):
+                                pass
                     with net.lock:
                         if msg.to in net.inboxes:
                             net.inboxes[msg.to].append(msg)
@@ -144,6 +157,13 @@ class TcpNetwork:
             body = RaftEnvelope(
                 kind=msg.kind, frm=msg.frm, to=msg.to, term=msg.term,
                 payload=pack_body(msg.payload) if msg.payload else b"",
+                # the proposer's trace context (RaftNode stamps it on
+                # the append broadcast that replicates a traced
+                # proposal; "" on the untraced tick/heartbeat plane) —
+                # msg.trace is the ONLY stamping point: sends happen on
+                # the tick thread, so any ambient context here would
+                # belong to an unrelated trace
+                trace=msg.trace,
             ).encode()
             frame = _LEN.pack(len(body)) + body
         except (TypeError, ValueError):

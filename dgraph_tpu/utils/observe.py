@@ -1,21 +1,56 @@
-"""Observability: metrics registry (counters/gauges/histograms) + spans.
+"""Observability: metric registry, distributed tracing, query profiles.
 
 Mirrors /root/reference/x/metrics.go (ostats counters + latency
 distributions exported at /debug/prometheus_metrics) and the opencensus
 span plumbing in x/trace (spans around query/mutation/proposal paths,
-exported to a collector). Stdlib-only: Prometheus text exposition for
-metrics; spans keep an in-process ring buffer and can stream to a JSONL
-file (the OTLP-exporter seam — swap the sink, keep the API).
+exported to a collector). Stdlib-only.
+
+Three subsystems:
+
+  Metrics — process-wide counters/gauges/histograms with Prometheus
+    text exposition. Every metric NAME is declared in METRIC_DEFS (one
+    line of doc per name; `*` entries are families for dynamically
+    formatted names like span_*_seconds) — the `metrics-registry`
+    analyzer flags METRICS calls with unregistered names, and
+    `dgraph-tpu metrics-ref` renders the registry as METRICS.md.
+    `parse_exposition` / `merge_expositions` implement the cluster
+    aggregation: the facade scrapes every alpha/zero process and merges
+    (counters summed, histogram buckets merged on the cumulative grid,
+    per-instance labels preserved).
+
+  Tracer — W3C-traceparent-style distributed tracing. Span ids are
+    random (128-bit trace / 64-bit span, drawn from os.urandom, so ids
+    never collide across forked alpha/zero processes). The CURRENT span
+    lives in a contextvars.ContextVar — NOT a thread-local stack — so
+    executor pools propagate parents by running submitted work under
+    `contextvars.copy_context()`, and RPC servers restore a remote
+    parent with the explicit attach/detach API. Sampling is decided at
+    the trace root (DGRAPH_TPU_TRACE_SAMPLE) and carried in the
+    propagated context; unsampled spans still hit the in-process ring,
+    the per-trace buffer, and the latency histograms — only the
+    JSONL/OTLP export is skipped, and `force_sample` retro-exports a
+    buffered trace (the slow-query path).
+
+  QueryProfile — per-query attribution carried in its own ContextVar:
+    per-(predicate, level) task timings, packed-vs-decoded kernel
+    counts, decoded bytes, retry/degradation counter deltas, and
+    child-server RPC fragments piggybacked on responses. Entry points
+    wrap execution in `profile_scope()` and attach the result as
+    `extensions.profile`.
 """
 
 from __future__ import annotations
 
+import fnmatch
 import json
+import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from contextlib import contextmanager
-from typing import Dict, List, Optional
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 # default latency buckets (seconds) — same decade ladder the reference's
 # defaultLatencyMsDistribution covers
@@ -23,6 +58,62 @@ _BUCKETS = [
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 ]
+
+
+# ---------------------------------------------------------------------------
+# metric-name registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MetricDef:
+    name: str  # exact name, or a family glob containing `*`
+    kind: str  # "counter" | "gauge" | "histogram"
+    doc: str
+
+
+METRIC_DEFS: "OrderedDict[str, MetricDef]" = OrderedDict()
+
+
+def declare_metric(kind: str, name: str, doc: str) -> None:
+    if name in METRIC_DEFS:
+        raise ValueError(f"duplicate metric declaration {name!r}")
+    METRIC_DEFS[name] = MetricDef(name=name, kind=kind, doc=doc)
+
+
+def registered_metric(name: str) -> bool:
+    """True when `name` is declared exactly or matches a `*` family."""
+    if name in METRIC_DEFS:
+        return True
+    return any(
+        "*" in pat and fnmatch.fnmatchcase(name, pat)
+        for pat in METRIC_DEFS
+    )
+
+
+def metrics_reference() -> str:
+    """The METRICS.md body: one row per declared metric/family."""
+    lines = [
+        "# METRICS — `dgraph_tpu` metric reference",
+        "",
+        "Generated from `dgraph_tpu/utils/observe.py` METRIC_DEFS "
+        "(`python -m dgraph_tpu.cli metrics-ref`); a tier-1 test asserts "
+        "this file matches the registry, and the `metrics-registry` "
+        "analyzer flags any `METRICS.inc/observe/set_gauge/timer` call "
+        "whose name is not declared here. Names containing `*` are "
+        "families covering dynamically formatted metrics. All metrics "
+        "are exported with the `dgraph_tpu_` prefix at "
+        "`/debug/prometheus_metrics`.",
+        "",
+        "| Metric | Kind | Description |",
+        "|---|---|---|",
+    ]
+    for name in sorted(METRIC_DEFS):
+        d = METRIC_DEFS[name]
+        doc = " ".join(d.doc.split())
+        lines.append(f"| `{d.name}` | {d.kind} | {doc} |")
+    lines.append("")
+    return "\n".join(lines)
 
 
 class Histogram:
@@ -128,16 +219,237 @@ METRICS = Metrics()
 
 
 # ---------------------------------------------------------------------------
+# Prometheus exposition: parse + multi-instance merge
+# ---------------------------------------------------------------------------
+
+
+def escape_label(v: str) -> str:
+    """Prometheus text-format label-value escaping (backslash first)."""
+    return (
+        v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _unescape_label(v: str) -> str:
+    out, i, n = [], 0, len(v)
+    while i < n:
+        c = v[i]
+        if c == "\\" and i + 1 < n:
+            nxt = v[i + 1]
+            out.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(raw: str) -> Dict[str, str]:
+    """Parse `a="x",b="y"` with escaped quotes inside values. Raises
+    ValueError on malformed input (parse_exposition skips such lines)."""
+    labels: Dict[str, str] = {}
+    i, n = 0, len(raw)
+    while i < n:
+        j = raw.index("=", i)  # ValueError when no '=' remains
+        key = raw[i:j].strip().strip(",").strip()
+        if j + 1 >= n or raw[j + 1] != '"':
+            raise ValueError(f"malformed labels {raw!r}")
+        k = j + 2
+        buf = []
+        while k < n:
+            c = raw[k]
+            if c == "\\" and k + 1 < n:
+                buf.append(raw[k : k + 2])
+                k += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            k += 1
+        labels[key] = _unescape_label("".join(buf))
+        i = k + 1
+        while i < n and raw[i] in ", ":
+            i += 1
+    return labels
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse the subset of the Prometheus text format this package emits
+    into {"counter": {name: v}, "gauge": {name: v},
+    "histogram": {name: {"buckets": {le: cum}, "sum": s, "count": c}}}.
+    Labeled series are keyed by `name{k="v",...}` with labels sorted.
+    Histogram child series (`_bucket`/`_sum`/`_count`) fold into the
+    base name declared `# TYPE ... histogram`."""
+    types: Dict[str, str] = {}
+    out = {"counter": {}, "gauge": {}, "histogram": {}}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        name_part, _, val_s = line.rpartition(" ")
+        try:
+            val = float(val_s)
+        except ValueError:
+            continue
+        labels: Dict[str, str] = {}
+        name = name_part
+        if "{" in name_part:
+            name = name_part[: name_part.index("{")]
+            try:
+                labels = _parse_labels(
+                    name_part[
+                        name_part.index("{") + 1 : name_part.rindex("}")
+                    ]
+                )
+            except ValueError:
+                continue  # malformed labels: skip the line, keep parsing
+        # histogram child series?
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    types.get(name[: -len(suffix)]) == "histogram":
+                base = name[: -len(suffix)]
+                h = out["histogram"].setdefault(
+                    base, {"buckets": {}, "sum": 0.0, "count": 0.0}
+                )
+                if suffix == "_bucket":
+                    h["buckets"][labels.get("le", "+Inf")] = val
+                elif suffix == "_sum":
+                    h["sum"] = val
+                else:
+                    h["count"] = val
+                break
+        else:
+            kind = types.get(name, "counter")
+            kind = kind if kind in ("counter", "gauge") else "counter"
+            key = name
+            if labels:
+                inner = ",".join(
+                    f'{k}="{escape_label(v)}"'
+                    for k, v in sorted(labels.items())
+                )
+                key = f"{name}{{{inner}}}"
+            out[kind][key] = out[kind].get(key, 0.0) + val
+    return out
+
+
+def _le_sortkey(le: str) -> float:
+    return float("inf") if le == "+Inf" else float(le)
+
+
+def merge_expositions(texts: Dict[str, str]) -> str:
+    """Merge per-instance exposition texts into ONE cluster view:
+    counters and gauges are summed into an unlabeled series PLUS one
+    `{instance="..."}` series per source; histograms are merged exactly
+    on the union of their cumulative bucket grids (an instance's
+    cumulative count at `le` is its count at the nearest bound <= le,
+    so identical ladders merge to exact per-bucket sums)."""
+    parsed = {inst: parse_exposition(t) for inst, t in texts.items()}
+    counters: Dict[str, Dict[str, float]] = {}
+    gauges: Dict[str, Dict[str, float]] = {}
+    hists: Dict[str, Dict[str, dict]] = {}
+    for inst, p in parsed.items():
+        for name, v in p["counter"].items():
+            counters.setdefault(name, {})[inst] = v
+        for name, v in p["gauge"].items():
+            gauges.setdefault(name, {})[inst] = v
+        for name, h in p["histogram"].items():
+            hists.setdefault(name, {})[inst] = h
+    out: List[str] = []
+    for kind, table in (("counter", counters), ("gauge", gauges)):
+        for name in sorted(table):
+            by = table[name]
+            out.append(f"# TYPE {name} {kind}")
+            out.append(f"{name} {sum(by.values())}")
+            for inst in sorted(by):
+                sep = "," if name.endswith("}") else ""
+                if name.endswith("}"):
+                    series = (
+                        f'{name[:-1]}{sep}instance='
+                        f'"{escape_label(inst)}"}}'
+                    )
+                else:
+                    series = f'{name}{{instance="{escape_label(inst)}"}}'
+                out.append(f"{series} {by[inst]}")
+    for name in sorted(hists):
+        by = hists[name]
+        out.append(f"# TYPE {name} histogram")
+        les = sorted(
+            {le for h in by.values() for le in h["buckets"]},
+            key=_le_sortkey,
+        )
+        for le in les:
+            total = 0.0
+            for h in by.values():
+                # cumulative value at `le`: nearest own bound <= le
+                best = 0.0
+                for own_le, cum in h["buckets"].items():
+                    if _le_sortkey(own_le) <= _le_sortkey(le):
+                        best = max(best, cum)
+                total += best
+            out.append(f'{name}_bucket{{le="{le}"}} {total}')
+        out.append(f"{name}_sum {sum(h['sum'] for h in by.values())}")
+        out.append(
+            f"{name}_count {sum(h['count'] for h in by.values())}"
+        )
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # Spans
 # ---------------------------------------------------------------------------
 
 
-class Span:
-    __slots__ = (
-        "name", "trace_id", "span_id", "parent_id", "start", "end", "attrs"
+class SpanContext(NamedTuple):
+    """Propagated trace context (W3C traceparent fields)."""
+
+    trace_id: int
+    span_id: int
+    sampled: bool
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    return (
+        f"00-{ctx.trace_id:032x}-{ctx.span_id:016x}-"
+        f"{'01' if ctx.sampled else '00'}"
     )
 
-    def __init__(self, name, trace_id, span_id, parent_id):
+
+def parse_traceparent(header: str) -> Optional[SpanContext]:
+    try:
+        version, tid, sid, flags = header.strip().split("-")
+        if version != "00" or len(tid) != 32 or len(sid) != 16:
+            return None
+        trace_id, span_id = int(tid, 16), int(sid, 16)
+        if not trace_id or not span_id:
+            return None
+        return SpanContext(trace_id, span_id, bool(int(flags, 16) & 1))
+    except (ValueError, AttributeError):
+        return None
+
+
+def _gen_trace_id() -> int:
+    """Random 128-bit trace id. os.urandom is fork-safe and per-call, so
+    ids never collide across alpha/zero processes (the old sequential
+    per-process counter corrupted merged traces)."""
+    return int.from_bytes(os.urandom(16), "big") or 1
+
+
+def _gen_span_id() -> int:
+    return int.from_bytes(os.urandom(8), "big") or 1
+
+
+class Span:
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start", "end",
+        "attrs", "sampled", "_exported",
+    )
+
+    def __init__(self, name, trace_id, span_id, parent_id, sampled=True):
         self.name = name
         self.trace_id = trace_id
         self.span_id = span_id
@@ -145,6 +457,8 @@ class Span:
         self.start = time.time()
         self.end: Optional[float] = None
         self.attrs: Dict[str, object] = {}
+        self.sampled = sampled
+        self._exported = False
 
     def to_dict(self) -> dict:
         return {
@@ -157,59 +471,152 @@ class Span:
             "duration_ms": (
                 None if self.end is None else (self.end - self.start) * 1e3
             ),
+            "sampled": self.sampled,
             "attrs": self.attrs,
         }
 
 
+def _trace_enabled() -> bool:
+    from dgraph_tpu.x import config
+
+    return bool(config.get("TRACE"))
+
+
+def _sample_root() -> bool:
+    from dgraph_tpu.x import config
+
+    ratio = float(config.get("TRACE_SAMPLE"))
+    if ratio >= 1.0:
+        return True
+    if ratio <= 0.0:
+        return False
+    return int.from_bytes(os.urandom(4), "big") / 2.0**32 < ratio
+
+
+# the CURRENT span/context: a ContextVar (not a thread-local stack) so
+# executor pools inherit parents via contextvars.copy_context().run and
+# RPC servers restore remote parents with attach/detach
+_CURRENT: "ContextVar[Optional[object]]" = ContextVar(
+    "dgraph_tpu_current_span", default=None
+)
+
+# cap on the per-trace retention buffer (slow-query force-sampling)
+_TRACE_BUF_TRACES = 256
+_TRACE_BUF_SPANS = 512
+
+
 class Tracer:
-    """Nested spans with an in-process ring + optional JSONL sink (the
-    exporter seam; an OTLP exporter would replace _emit)."""
+    """Distributed spans with an in-process ring, a per-trace retention
+    buffer, and optional JSONL / OTLP export of SAMPLED spans."""
 
     def __init__(self, capacity: int = 2048, sink_path: Optional[str] = None):
         self._lock = threading.Lock()
         self.finished: deque = deque(maxlen=capacity)
-        self._tls = threading.local()
-        self._next_id = 0
+        self._by_trace: "OrderedDict[int, List[Span]]" = OrderedDict()
         self.sink_path = sink_path
         self._sink = open(sink_path, "a") if sink_path else None
 
-    def _gen_id(self) -> int:
+    # -- context API ----------------------------------------------------
+
+    def attach(self, ctx: Optional[SpanContext]):
+        """Install a (usually remote) parent context for this execution
+        context; returns a token for detach(). New spans parent under it
+        and inherit its sampling decision."""
+        return _CURRENT.set(ctx)
+
+    def detach(self, token) -> None:
+        _CURRENT.reset(token)
+
+    def current_context(self) -> Optional[SpanContext]:
+        cur = _CURRENT.get()
+        if cur is None:
+            return None
+        return SpanContext(cur.trace_id, cur.span_id, cur.sampled)
+
+    def current_traceparent(self) -> str:
+        ctx = self.current_context()
+        return format_traceparent(ctx) if ctx is not None else ""
+
+    def set_sink(self, path: Optional[str]) -> None:
         with self._lock:
-            self._next_id += 1
-            return self._next_id
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                except OSError:
+                    pass
+            self.sink_path = path
+            self._sink = open(path, "a") if path else None
+
+    # -- spans ----------------------------------------------------------
 
     @contextmanager
-    def span(self, name: str, **attrs):
-        stack = getattr(self._tls, "stack", None)
-        if stack is None:
-            stack = self._tls.stack = []
-        parent = stack[-1] if stack else None
-        sp = Span(
-            name,
-            trace_id=parent.trace_id if parent else self._gen_id(),
-            span_id=self._gen_id(),
-            parent_id=parent.span_id if parent else None,
-        )
+    def span(self, name: str, parent: Optional[SpanContext] = None, **attrs):
+        if not _trace_enabled():
+            sp = Span(name, 0, 0, None)
+            sp.attrs.update(attrs)
+            yield sp
+            return
+        par = parent if parent is not None else _CURRENT.get()
+        if par is None:
+            sp = Span(
+                name, _gen_trace_id(), _gen_span_id(), None,
+                sampled=_sample_root(),
+            )
+        else:
+            sp = Span(
+                name, par.trace_id, _gen_span_id(), par.span_id,
+                sampled=par.sampled,
+            )
         sp.attrs.update(attrs)
-        stack.append(sp)
+        token = _CURRENT.set(sp)
         try:
             yield sp
         finally:
             sp.end = time.time()
-            stack.pop()
-            with self._lock:
-                self.finished.append(sp)
-                if self._sink is not None:
-                    self._sink.write(json.dumps(sp.to_dict()) + "\n")
-                    self._sink.flush()
-                if getattr(self, "_otlp", None) is not None:
-                    try:  # never block or raise into the traced path
-                        self._otlp["q"].put_nowait(
-                            self._otlp_span_json(sp)
-                        )
-                    except Exception:
-                        METRICS.inc("otlp_spans_dropped")
+            _CURRENT.reset(token)
+            self._finish(sp)
             METRICS.observe(f"span_{name}_seconds", sp.end - sp.start)
+
+    def _finish(self, sp: Span) -> None:
+        with self._lock:
+            self.finished.append(sp)
+            buf = self._by_trace.setdefault(sp.trace_id, [])
+            if len(buf) < _TRACE_BUF_SPANS:
+                buf.append(sp)
+            self._by_trace.move_to_end(sp.trace_id)
+            while len(self._by_trace) > _TRACE_BUF_TRACES:
+                self._by_trace.popitem(last=False)
+            if sp.sampled:
+                self._export_locked(sp)
+
+    def _export_locked(self, sp: Span) -> None:
+        sp._exported = True
+        if self._sink is not None:
+            self._sink.write(json.dumps(sp.to_dict()) + "\n")
+            self._sink.flush()
+        if getattr(self, "_otlp", None) is not None:
+            try:  # never block or raise into the traced path
+                self._otlp["q"].put_nowait(self._otlp_span_json(sp))
+            except Exception:
+                METRICS.inc("otlp_spans_dropped")
+
+    def force_sample(self, trace_id: int) -> int:
+        """Retro-export every buffered span of `trace_id` that was not
+        exported at finish time (the trace was unsampled). The
+        slow-query path calls this so slow traces always reach the
+        sink. Returns the number of spans exported."""
+        n = 0
+        with self._lock:
+            for sp in self._by_trace.get(trace_id, ()):  # oldest first
+                if not sp._exported and sp.end is not None:
+                    self._export_locked(sp)
+                    n += 1
+        return n
+
+    def trace_spans(self, trace_id: int) -> List[dict]:
+        """The retained spans of one trace (this process only)."""
+        with self._lock:
+            return [s.to_dict() for s in self._by_trace.get(trace_id, ())]
 
     def recent(self, n: int = 100) -> List[dict]:
         with self._lock:
@@ -360,3 +767,493 @@ class Tracer:
 
 
 TRACER = Tracer()
+
+
+def init_from_env(instance: str = "") -> Tracer:
+    """Per-process observability bootstrap: when DGRAPH_TPU_TRACE_SINK
+    names a directory, point the global TRACER's JSONL sink at a
+    process-unique file inside it (spans-<instance|pid>.jsonl). Called
+    by the alpha/zero process mains and the cluster coordinator so a
+    multi-process cluster writes one sink file per process."""
+    from dgraph_tpu.x import config
+
+    sink_dir = config.get("TRACE_SINK")
+    if sink_dir:
+        os.makedirs(sink_dir, exist_ok=True)
+        label = instance or f"pid{os.getpid()}"
+        path = os.path.join(sink_dir, f"spans-{label}.jsonl")
+        if TRACER.sink_path != path:
+            TRACER.set_sink(path)
+    return TRACER
+
+
+# ---------------------------------------------------------------------------
+# Per-query profile
+# ---------------------------------------------------------------------------
+
+
+_PROFILE: "ContextVar[Optional[QueryProfile]]" = ContextVar(
+    "dgraph_tpu_query_profile", default=None
+)
+
+# process-local counters whose per-query delta the profile reports as
+# `events` (retry/degradation/fault attribution)
+_PROFILE_EVENT_KEYS = (
+    "rpc_retries_total", "rpc_giveups_total", "rpc_refused_total",
+    "degraded_group_reads_total", "group_unavailable_failfast_total",
+    "hedge_fired_total", "faults_injected_total", "idem_hits_total",
+    "circuit_failfast_total", "setop_pairs_total", "setop_packed_total",
+)
+
+
+class QueryProfile:
+    """Attribution for ONE query: per-(predicate, level) task timings,
+    packed-vs-decoded kernel counts + decoded bytes, retry/degradation
+    counter deltas, and child-server RPC fragments piggybacked on
+    responses. Thread-safe: executor workers record into the same
+    profile via the propagated context."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.level_tasks: List[dict] = []
+        self.rpc_fragments: List[dict] = []
+        self.events: Dict[str, float] = {}
+        self.kernel: Dict[str, float] = {}
+
+    def record_level_task(
+        self, attr: str, level: int, parents: int, ms: float,
+        batched: bool,
+    ) -> None:
+        with self._lock:
+            self.level_tasks.append(
+                {
+                    "attr": attr,
+                    "level": level,
+                    "parents": parents,
+                    "ms": round(ms, 3),
+                    "batched": batched,
+                }
+            )
+
+    def record_rpc_fragment(self, frag: dict) -> None:
+        with self._lock:
+            self.rpc_fragments.append(frag)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            rpc: Dict[Tuple[str, str], Dict[str, float]] = {}
+            for f in self.rpc_fragments:
+                k = (str(f.get("i", "?")), str(f.get("m", "?")))
+                agg = rpc.setdefault(k, {"calls": 0, "ms": 0.0})
+                agg["calls"] += 1
+                agg["ms"] += float(f.get("ms", 0.0))
+            return {
+                "level_tasks": list(self.level_tasks),
+                "rpc": [
+                    {
+                        "instance": i,
+                        "method": m,
+                        "calls": int(v["calls"]),
+                        "ms": round(v["ms"], 3),
+                    }
+                    for (i, m), v in sorted(rpc.items())
+                ],
+                "kernel": dict(self.kernel),
+                "events": {
+                    k: v for k, v in self.events.items() if v
+                },
+            }
+
+
+def current_profile() -> Optional[QueryProfile]:
+    return _PROFILE.get()
+
+
+@contextmanager
+def profile_scope():
+    """Collect a QueryProfile for the enclosed query. Counter deltas are
+    process-local and can overlap across concurrent queries — they
+    attribute classes of work, not exact per-query counts."""
+    prof = QueryProfile()
+    token = _PROFILE.set(prof)
+    before = {k: METRICS.value(k) for k in _PROFILE_EVENT_KEYS}
+    k0 = None
+    try:
+        from dgraph_tpu.ops import packed_setops
+
+        k0 = packed_setops.counters()
+    except Exception:
+        pass
+    try:
+        yield prof
+    finally:
+        _PROFILE.reset(token)
+        prof.events = {
+            k: METRICS.value(k) - before[k] for k in _PROFILE_EVENT_KEYS
+        }
+        if k0 is not None:
+            try:
+                from dgraph_tpu.ops import packed_setops
+
+                k1 = packed_setops.counters()
+                prof.kernel = {
+                    k: k1[k] - k0.get(k, 0)
+                    for k in k1
+                    if isinstance(k1[k], (int, float))
+                }
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Slow-query log
+# ---------------------------------------------------------------------------
+
+
+class SlowQueryLog:
+    """Bounded JSONL log: append-only until `max_records`, then the file
+    is rewritten keeping the newest `max_records // 2` lines. Trimming
+    to HALF (not to the cap) amortizes the rewrite: without hysteresis
+    every append past the cap would re-read and rewrite the whole file
+    on the query path — exactly during a slow-query burst."""
+
+    def __init__(self, path: str, max_records: int = 1000):
+        self.path = path
+        self.max_records = max(1, int(max_records))
+        self._lock = threading.Lock()
+        self._count = 0
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._count = sum(1 for _ in f)
+            except OSError:
+                self._count = 0
+
+    def append(self, record: dict) -> None:
+        with self._lock:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+            self._count += 1
+            if self._count > self.max_records:
+                keep = max(1, self.max_records // 2)
+                with open(self.path) as f:
+                    lines = f.read().splitlines()[-keep:]
+                with open(self.path, "w") as f:
+                    f.write("\n".join(lines) + "\n")
+                self._count = len(lines)
+
+
+_SLOW_LOG: Optional[SlowQueryLog] = None
+_SLOW_LOG_PATH: Optional[str] = None
+_SLOW_LOG_LOCK = threading.Lock()
+
+
+def slow_query_log() -> Optional[SlowQueryLog]:
+    """The process slow-query log, or None when DGRAPH_TPU_SLOW_QUERY_LOG
+    is unset. Re-resolved when the knob changes (tests)."""
+    global _SLOW_LOG, _SLOW_LOG_PATH
+    from dgraph_tpu.x import config
+
+    path = config.get("SLOW_QUERY_LOG")
+    if not path:
+        return None
+    with _SLOW_LOG_LOCK:
+        if _SLOW_LOG is None or _SLOW_LOG_PATH != path:
+            _SLOW_LOG = SlowQueryLog(
+                path, int(config.get("SLOW_QUERY_LOG_MAX"))
+            )
+            _SLOW_LOG_PATH = path
+        return _SLOW_LOG
+
+
+def maybe_log_slow(
+    kind: str, text: str, took_ms: float, root_span=None,
+    extra: Optional[dict] = None, tracer: Optional[Tracer] = None,
+    threshold_ms: Optional[float] = None,
+) -> bool:
+    """Slow-operation hook for the query/commit entry points: when
+    `took_ms` exceeds DGRAPH_TPU_SLOW_QUERY_MS (or the explicit
+    `threshold_ms` override), force-sample the trace (retro-export its
+    buffered spans) and append a record — query text, latency, trace
+    id, and the full LOCAL span tree — to the bounded slow-query JSONL
+    log (falls back to a logging warning when no log path is
+    configured). Returns True when the operation was slow."""
+    from dgraph_tpu.x import config
+
+    limit = (
+        float(config.get("SLOW_QUERY_MS"))
+        if threshold_ms is None
+        else float(threshold_ms)
+    )
+    if took_ms <= limit:
+        return False
+    METRICS.inc("slow_queries_total")
+    tr = tracer or TRACER
+    tid = int(getattr(root_span, "trace_id", 0) or 0)
+    if tid:
+        tr.force_sample(tid)
+    record = {
+        "ts": time.time(),
+        "kind": kind,
+        "took_ms": round(took_ms, 2),
+        "trace_id": f"{tid:032x}",
+        "query": text[:2000],
+        "spans": tr.trace_spans(tid) if tid else [],
+    }
+    if extra:
+        record.update(extra)
+    log = slow_query_log()
+    if log is not None:
+        log.append(record)
+    else:
+        import logging
+
+        logging.getLogger("dgraph_tpu.slow").warning(
+            "slow %s: %.1fms trace=%032x %s",
+            kind, took_ms, tid, text[:500].replace("\n", " "),
+        )
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Per-process debug HTTP server (/debug/prometheus_metrics, /debug/traces)
+# ---------------------------------------------------------------------------
+
+
+def start_debug_http(host: str = "127.0.0.1", port: int = 0):
+    """Serve this process's metrics + traces over HTTP — every alpha and
+    zero process runs one (the reference exposes the same paths on each
+    instance; the facade's merged endpoint scrapes them). Returns
+    (server, bound_port)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _DebugHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, data: bytes, ctype: str, code: int = 200):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/debug/prometheus_metrics":
+                self._send(METRICS.render().encode(), "text/plain")
+            elif self.path.startswith("/debug/traces"):
+                self._send(
+                    json.dumps({"spans": TRACER.recent(200)}).encode(),
+                    "application/json",
+                )
+            elif self.path == "/healthz":
+                self._send(b"ok", "text/plain")
+            else:
+                self._send(b"not found", "text/plain", 404)
+
+    srv = ThreadingHTTPServer((host, port), _DebugHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def attach_debug_surface(rpc_server):
+    """Give an alpha/zero RpcServer the observability surface: the
+    debug.metrics / debug.traces / debug.info RPC methods (what the
+    facade scrapes and merges) and — unless DGRAPH_TPU_DEBUG_HTTP=0 —
+    the per-process HTTP listener serving /debug/prometheus_metrics and
+    /debug/traces on an ephemeral port (advertised via debug.info).
+    Returns (http_server_or_None, port)."""
+    from dgraph_tpu.x import config
+
+    srv, port = (None, 0)
+    if bool(config.get("DEBUG_HTTP")):
+        srv, port = start_debug_http()
+    info = {
+        "instance": rpc_server.instance,
+        "debug_http_port": port,
+        "pid": os.getpid(),
+    }
+    rpc_server.register(
+        "debug.metrics",
+        lambda a: {
+            "text": METRICS.render(),
+            "instance": rpc_server.instance,
+        },
+    )
+    rpc_server.register(
+        "debug.traces",
+        lambda a: {"spans": TRACER.recent(int((a or {}).get("n", 200)))},
+    )
+    rpc_server.register("debug.info", lambda a: dict(info))
+    return srv, port
+
+
+# ---------------------------------------------------------------------------
+# metric declarations (one line of doc per name; keep alphabetical per
+# kind — METRICS.md is generated from this table)
+# ---------------------------------------------------------------------------
+
+declare_metric(
+    "counter", "circuit_close_total",
+    "Peer circuits closed after a successful probe/call.",
+)
+declare_metric(
+    "counter", "circuit_failfast_total",
+    "Calls refused fast because the peer's circuit was open.",
+)
+declare_metric(
+    "counter", "circuit_halfopen_probes_total",
+    "Trial calls admitted through an open circuit (half-open probes).",
+)
+declare_metric(
+    "counter", "circuit_open_total",
+    "Peer circuits opened after max_misses consecutive failures.",
+)
+declare_metric(
+    "counter", "degraded_group_reads_total",
+    "Reads answered EMPTY because the owning group was unreachable "
+    "(partial_ok query path).",
+)
+declare_metric(
+    "counter", "degraded_queries_total",
+    "Queries that returned a degraded/partial response.",
+)
+declare_metric(
+    "counter", "exec_parallel_siblings",
+    "Sibling subtrees submitted to the parallel executor pool.",
+)
+declare_metric(
+    "counter", "fault_*_total",
+    "Fault injections by action (drop/delay/dup/disconnect/partition).",
+)
+declare_metric(
+    "counter", "faults_injected_total",
+    "Total fault-plan injections across all fault points.",
+)
+declare_metric(
+    "counter", "frame_oversize_total",
+    "Frames rejected for exceeding DGRAPH_TPU_MAX_FRAME_BYTES "
+    "(send-side refusals + corrupt receive headers).",
+)
+declare_metric(
+    "counter", "group_unavailable_failfast_total",
+    "Group reads refused fast because every replica circuit was open.",
+)
+declare_metric(
+    "counter", "hedge_fired_total",
+    "Hedged reads that raced a second replica.",
+)
+declare_metric(
+    "counter", "hedge_losses_joined",
+    "Losing hedge futures reaped via done-callbacks (never abandoned).",
+)
+declare_metric(
+    "counter", "hedge_wins",
+    "Hedged reads won by the backup (second) request.",
+)
+declare_metric(
+    "counter", "idem_hits_total",
+    "Requests answered from the server idempotency LRU (retransmits).",
+)
+declare_metric(
+    "counter", "idem_inflight_waits_total",
+    "Retransmits that waited on the original in-flight execution.",
+)
+declare_metric(
+    "counter", "level_batch_read_bytes",
+    "Bytes of decoded posting data returned by batched level reads.",
+)
+declare_metric(
+    "counter", "level_task_uids",
+    "Parent uids covered by level tasks (fan-out width accounting).",
+)
+declare_metric(
+    "counter", "level_tasks_started",
+    "Vectorized (predicate, level) tasks started by the executor.",
+)
+declare_metric(
+    "counter", "metrics_scrape_errors_total",
+    "Per-instance scrape failures during cluster metrics aggregation.",
+)
+declare_metric(
+    "counter", "num_commits",
+    "Committed transactions (reference x/metrics NumMutations analog).",
+)
+declare_metric(
+    "counter", "num_queries",
+    "Queries served (reference x/metrics NumQueries analog).",
+)
+declare_metric(
+    "counter", "otlp_export_errors",
+    "OTLP/HTTP batch posts that failed (collector unreachable).",
+)
+declare_metric(
+    "counter", "otlp_spans_dropped",
+    "Spans dropped because the OTLP export queue was full.",
+)
+declare_metric(
+    "counter", "otlp_spans_exported",
+    "Spans successfully posted to the OTLP collector.",
+)
+declare_metric(
+    "counter", "rpc_giveups_total",
+    "RPC calls abandoned after exhausting retries/deadline.",
+)
+declare_metric(
+    "counter", "rpc_refused_total",
+    "RPC calls failed fast on connection refusal (peer down).",
+)
+declare_metric(
+    "counter", "rpc_retries_total",
+    "RPC attempt retries (reconnect-and-resend) across all peers.",
+)
+declare_metric(
+    "counter", "rpc_server_requests_total",
+    "Trace-context-carrying RPC requests served (rpc_server spans).",
+)
+declare_metric(
+    "counter", "rpc_stale_responses_total",
+    "Stale/duplicate responses skipped while matching request ids.",
+)
+declare_metric(
+    "counter", "setop_packed_total",
+    "Set-op pairs routed to the compressed-domain (packed) kernels.",
+)
+declare_metric(
+    "counter", "setop_pairs_total",
+    "Set-op pairs dispatched (packed + decoded); with "
+    "setop_packed_total this is the kernel-choice ratio.",
+)
+declare_metric(
+    "counter", "slow_queries_total",
+    "Operations exceeding DGRAPH_TPU_SLOW_QUERY_MS (force-sampled and "
+    "appended to the slow-query log).",
+)
+declare_metric(
+    "gauge", "cache_batch_read_keys",
+    "Keys covered by batched LocalCache reads (READ_COUNTERS mirror).",
+)
+declare_metric(
+    "gauge", "cache_batch_reads",
+    "Batched LocalCache read calls (READ_COUNTERS mirror).",
+)
+declare_metric(
+    "gauge", "cache_point_reads",
+    "Point LocalCache reads (READ_COUNTERS mirror).",
+)
+declare_metric(
+    "histogram", "commit_latency_seconds",
+    "End-to-end commit latency at the entry point.",
+)
+declare_metric(
+    "histogram", "query_latency_seconds",
+    "End-to-end query latency at the entry point.",
+)
+declare_metric(
+    "histogram", "span_*_seconds",
+    "Per-span-name duration distributions (query/commit/level_task/"
+    "rpc_server/...), fed by the tracer on every span finish.",
+)

@@ -100,8 +100,13 @@ class AlphaProcess:
         self._apply_cv = threading.Condition()
 
         host, port = cfg["rpc_addr"]
-        self.rpc = RpcServer(host, int(port))
+        self.rpc = RpcServer(
+            host, int(port), instance=f"alpha-{self.node_id}"
+        )
         self._register_handlers()
+        from dgraph_tpu.utils.observe import attach_debug_surface
+
+        self._debug_http, self.debug_port = attach_debug_surface(self.rpc)
         self._stop = threading.Event()
 
     # -- state machine --------------------------------------------------------
@@ -222,7 +227,11 @@ def main():
     with open(sys.argv[1]) as f:
         cfg = json.load(f)
     from dgraph_tpu.conn import faults
+    from dgraph_tpu.utils import observe
 
+    # per-process span sink (DGRAPH_TPU_TRACE_SINK directory inherited
+    # from the coordinator): one spans-alpha-<id>.jsonl per replica
+    observe.init_from_env(instance=f"alpha-{cfg.get('node_id')}")
     plan = faults.init_from_env()
     if plan is not None:
         # chaos runs must be auditable: announce the inherited schedule

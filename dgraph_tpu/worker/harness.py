@@ -29,7 +29,8 @@ from dgraph_tpu.conn.retry import (
 )
 from dgraph_tpu.conn.rpc import RpcError, RpcPool
 from dgraph_tpu.posting.lists import Txn
-from dgraph_tpu.utils.observe import METRICS
+from dgraph_tpu.utils import observe
+from dgraph_tpu.utils.observe import METRICS, TRACER, profile_scope
 from dgraph_tpu.schema.schema import State, parse_schema
 from dgraph_tpu.worker.groups import ClusterTxn, IntentLog, ZeroService
 from dgraph_tpu.worker.remote import RemoteGroup, RemoteKV
@@ -60,6 +61,9 @@ class ProcCluster:
         wal_sync: bool = False,  # tests: process-crash durability suffices
     ):
         self.wal_sync = wal_sync
+        # coordinator-side span sink (one file per process; replicas get
+        # theirs in their own mains via the inherited TRACE_SINK env)
+        observe.init_from_env()
         self.pool = RpcPool(heartbeat_s=0.5, timeout=5.0).start_heartbeats()
         self.procs: Dict[int, subprocess.Popen] = {}
         self._cfgs: Dict[int, dict] = {}
@@ -247,8 +251,13 @@ class ProcCluster:
         # zero.commit and every group proposal beneath it
         budget = float(config.get("COMMIT_DEADLINE_S"))
         with deadline_scope(current_deadline() or Deadline.after(budget)):
-            with self._commit_lock:
-                return self._commit_locked(txn)
+            with TRACER.span("commit"), METRICS.timer(
+                "commit_latency_seconds"
+            ):
+                with self._commit_lock:
+                    cts = self._commit_locked(txn)
+        METRICS.inc("num_commits")
+        return cts
 
     def _commit_locked(self, txn: Txn) -> int:
         from dgraph_tpu.posting.pl import encode_delta
@@ -330,7 +339,18 @@ class ProcCluster:
         deadline for the whole read fan-out, and a group whose quorum is
         unreachable yields empty reads plus a `degraded`/`partial`
         marker in the response extensions instead of an error — queries
-        touching only healthy groups are unaffected."""
+        touching only healthy groups are unaffected.
+
+        Observability: the whole fan-out runs under ONE root span whose
+        context flows over every RPC (alpha reads, zero oracle calls),
+        and the response carries reference-shaped
+        `extensions.server_latency` (parsing/assign_timestamp/
+        processing/encoding/total ns) plus an `extensions.profile`
+        block — per-(predicate, level) task timings, kernel-choice
+        counts, retry/degradation events, and per-instance RPC
+        fragments piggybacked on the responses. Queries slower than
+        DGRAPH_TPU_SLOW_QUERY_MS are force-sampled and appended to the
+        slow-query JSONL log with their local span tree."""
         from dgraph_tpu import dql
         from dgraph_tpu.posting.lists import LocalCache
         from dgraph_tpu.query.outputjson import JsonEncoder
@@ -338,24 +358,102 @@ class ProcCluster:
 
         budget = timeout_s or float(config.get("QUERY_DEADLINE_S"))
         kv = self.read_kv(partial_ok=True)
-        with deadline_scope(current_deadline() or Deadline.after(budget)):
+        t_start = time.perf_counter()
+        with deadline_scope(current_deadline() or Deadline.after(budget)), \
+                TRACER.span("query") as root, \
+                profile_scope() as prof, \
+                METRICS.timer("query_latency_seconds"):
+            with TRACER.span("parse"):
+                blocks = dql.parse(q)
+            t_parsed = time.perf_counter()
             ts = read_ts if read_ts is not None else self.zero.zero.read_ts()
+            t_ts = time.perf_counter()
             cache = LocalCache(kv, ts, mem=self.mem)
             ex = Executor(
                 cache, self.schema, vector_indexes=self.vector_indexes
             )
-            nodes = ex.process(dql.parse(q))
+            with TRACER.span("process"):
+                nodes = ex.process(blocks)
+            t_processed = time.perf_counter()
             enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
-            out = {"data": enc.encode_blocks(nodes)}
+            with TRACER.span("encode"):
+                out = {"data": enc.encode_blocks(nodes)}
+            t_done = time.perf_counter()
+        METRICS.inc("num_queries")
+        ext = out.setdefault("extensions", {})
+        ext["server_latency"] = {
+            "parsing_ns": int((t_parsed - t_start) * 1e9),
+            "assign_timestamp_ns": int((t_ts - t_parsed) * 1e9),
+            "processing_ns": int((t_processed - t_ts) * 1e9),
+            "encoding_ns": int((t_done - t_processed) * 1e9),
+            "total_ns": int((t_done - t_start) * 1e9),
+        }
+        ext["profile"] = prof.to_dict()
+        if root.trace_id:
+            ext["trace_id"] = f"{root.trace_id:032x}"
         if kv.degraded_groups:
             METRICS.inc("degraded_queries_total")
             # no cache wipe needed: RemoteKV exposes no mut_seq, so the
             # MemoryLayer revalidates every entry against kv.versions on
             # each read — an empty list cached during the outage heals
             # itself on the first read after the group returns
-            out["extensions"] = {
-                "degraded": True,
-                "partial": True,
-                "unreachable_groups": sorted(kv.degraded_groups),
-            }
+            ext["degraded"] = True
+            ext["partial"] = True
+            ext["unreachable_groups"] = sorted(kv.degraded_groups)
+        observe.maybe_log_slow(
+            "query", q, (t_done - t_start) * 1e3, root,
+            extra={"degraded": sorted(kv.degraded_groups)}
+            if kv.degraded_groups else None,
+        )
         return out
+
+    # -- cluster observability (scrape + merge) -------------------------------
+
+    def instance_labels(self) -> Dict[str, Tuple[str, int]]:
+        """{instance_label: rpc_addr} for every spawned replica process
+        (alpha-<id> / zero-<id>), coordinator excluded."""
+        out: Dict[str, Tuple[str, int]] = {}
+        for nid, cfg in self._cfgs.items():
+            kind = (
+                "zero"
+                if cfg.get("_module", "").endswith("zero_process")
+                else "alpha"
+            )
+            out[f"{kind}-{nid}"] = tuple(cfg["rpc_addr"])
+        return out
+
+    def scrape_metrics(self) -> Dict[str, str]:
+        """One Prometheus exposition text per cluster process — every
+        replica via its debug.metrics RPC plus this coordinator's own
+        registry under the "client" label. Unreachable instances are
+        skipped and counted (metrics_scrape_errors_total)."""
+        texts: Dict[str, str] = {"client": METRICS.render()}
+        for label, addr in self.instance_labels().items():
+            try:
+                got = self.pool.call(addr, "debug.metrics", timeout=2.0)
+                texts[label] = got["text"]
+            except RpcError:
+                METRICS.inc("metrics_scrape_errors_total")
+        return texts
+
+    def merged_metrics(self) -> str:
+        """The cluster-wide /debug/prometheus_metrics body: counters
+        summed, histogram buckets merged, per-instance labels kept."""
+        return observe.merge_expositions(self.scrape_metrics())
+
+    def merged_traces(self, n: int = 200) -> List[dict]:
+        """Recent spans across every cluster process, tagged with the
+        instance that emitted them (the /debug/traces aggregation)."""
+        spans = [
+            dict(s, instance="client") for s in TRACER.recent(n)
+        ]
+        for label, addr in self.instance_labels().items():
+            try:
+                got = self.pool.call(
+                    addr, "debug.traces", {"n": n}, timeout=2.0
+                )
+                spans.extend(dict(s, instance=label) for s in got["spans"])
+            except RpcError:
+                METRICS.inc("metrics_scrape_errors_total")
+        spans.sort(key=lambda s: s.get("start") or 0)
+        return spans

@@ -30,6 +30,7 @@ the whole query engine runs unchanged against OS-process alphas.
 from __future__ import annotations
 
 import concurrent.futures
+import contextvars
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -237,8 +238,12 @@ class RemoteGroup:
         if len(addrs) == 1:
             return self.pool.call(addrs[0], method, args, deadline=call_dl)
         ex = _hedge_pool()
+        # hedge futures run under a COPY of this context so the rpc
+        # layer sees the same trace parent + query profile the calling
+        # thread holds (pool workers otherwise start orphan traces)
         f1 = ex.submit(
-            self.pool.call, addrs[0], method, args, deadline=call_dl
+            contextvars.copy_context().run,
+            self.pool.call, addrs[0], method, args, deadline=call_dl,
         )
         try:
             return f1.result(timeout=dl.clamp(hedge_after))
@@ -247,7 +252,8 @@ class RemoteGroup:
         except RpcError:
             return self.pool.call(addrs[1], method, args, deadline=call_dl)
         f2 = ex.submit(
-            self.pool.call, addrs[1], method, args, deadline=call_dl
+            contextvars.copy_context().run,
+            self.pool.call, addrs[1], method, args, deadline=call_dl,
         )
         METRICS.inc("hedge_fired_total")
         pending = {f1, f2}

@@ -92,6 +92,12 @@ _define(
     "(worker/harness.py).",
 )
 _define(
+    "DEBUG_HTTP", "bool", True,
+    "Serve /debug/prometheus_metrics + /debug/traces over HTTP from "
+    "every alpha/zero replica process (ephemeral port, discoverable "
+    "via the debug.info RPC). 0 disables the per-process listener.",
+)
+_define(
     "DEVCACHE_BYTES", "int", 256 << 20,
     "LRU bound, in device bytes, for the HBM operand cache "
     "(query/dispatch.py DeviceCache).",
@@ -207,9 +213,49 @@ _define(
     "at schema-update time — air-gapped loads (graphql/resolve.py).",
 )
 _define(
+    "SLOW_QUERY_LOG", "str", "",
+    "Path of the bounded slow-query JSONL log (utils/observe.py "
+    "SlowQueryLog). Empty = slow operations fall back to a logging "
+    "warning; records carry the query text, latency, trace id, and the "
+    "force-sampled local span tree.",
+)
+_define(
+    "SLOW_QUERY_LOG_MAX", "int", 1000,
+    "Record cap on the slow-query log; once exceeded the file is "
+    "rewritten keeping the newest N/2 (hysteresis amortizes the "
+    "rewrite over bursts) (utils/observe.py).",
+)
+_define(
+    "SLOW_QUERY_MS", "float", 1000.0,
+    "Slow-operation threshold in milliseconds: queries/commits slower "
+    "than this are force-sampled (their buffered spans exported even "
+    "when the trace was unsampled) and appended to the slow-query log "
+    "(utils/observe.maybe_log_slow).",
+)
+_define(
     "STORAGE", "str", "mem",
     "Default KV backend: 'mem' (WAL-backed in-memory) or 'lsm' "
     "(spill-to-disk SSTables) (storage/kv.py).",
+)
+_define(
+    "TRACE", "bool", True,
+    "Master tracing switch. 0 = spans become allocation-only no-ops "
+    "(no ids, no ring, no histograms) — the benchmarking baseline for "
+    "BENCH_OBS.json (utils/observe.py).",
+)
+_define(
+    "TRACE_SAMPLE", "float", 1.0,
+    "Trace sampling ratio decided at the root span and propagated in "
+    "the wire context (W3C traceparent flags). Unsampled spans still "
+    "feed the in-process ring, the per-trace buffer, and latency "
+    "histograms; only JSONL/OTLP export is skipped. Slow queries are "
+    "force-sampled regardless (utils/observe.py).",
+)
+_define(
+    "TRACE_SINK", "str", "",
+    "DIRECTORY for per-process span JSONL sinks: each alpha/zero/"
+    "coordinator process writes spans-<instance>.jsonl inside it "
+    "(utils/observe.init_from_env). Inherited by spawned replicas.",
 )
 _define(
     "WIRE_COMPRESS", "bool", False,

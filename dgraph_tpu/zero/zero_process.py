@@ -65,9 +65,14 @@ class ZeroProcess:
         )
         self._req_id = 0
         host, port = cfg["rpc_addr"]
-        self.rpc = RpcServer(host, int(port))
+        self.rpc = RpcServer(
+            host, int(port), instance=f"zero-{self.node_id}"
+        )
         self.rpc.register("zero.exec", self._h_exec)
         self.rpc.register("zero.state", self._h_state)
+        from dgraph_tpu.utils.observe import attach_debug_surface
+
+        self._debug_http, self.debug_port = attach_debug_surface(self.rpc)
         self._stop = threading.Event()
 
     def _apply(self, idx: int, data):
@@ -144,7 +149,9 @@ def main():
     with open(sys.argv[1]) as f:
         cfg = json.load(f)
     from dgraph_tpu.conn import faults
+    from dgraph_tpu.utils import observe
 
+    observe.init_from_env(instance=f"zero-{cfg.get('node_id')}")
     plan = faults.init_from_env()
     if plan is not None:
         print(

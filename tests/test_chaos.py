@@ -396,7 +396,9 @@ def _seed_bank(c):
 
 def _balances(c):
     out = c.query("{ q(func: has(bal)) { uid bal } }")
-    assert "extensions" not in out, out.get("extensions")
+    # extensions now always carry server_latency/profile; degradation is
+    # signalled by the `degraded` marker, not by extensions' presence
+    assert not out["extensions"].get("degraded"), out["extensions"]
     return {int(x["uid"], 16): x["bal"] for x in out["data"]["q"]}
 
 
@@ -512,7 +514,7 @@ def test_partitioned_group_degrades_instead_of_hanging():
         out = c.query('{ q(func: eq(pa, "ha")) { pa } }')
         healthy_dt = time.perf_counter() - t0
         assert out["data"]["q"] == [{"pa": "ha"}]
-        assert "extensions" not in out
+        assert not out["extensions"].get("degraded")
         assert healthy_dt < 10.0  # well inside the query deadline
 
         t0 = time.perf_counter()
@@ -539,11 +541,11 @@ def test_partitioned_group_degrades_instead_of_hanging():
         deadline = time.time() + 10
         while time.time() < deadline:
             out = c.query('{ q(func: eq(pb, "hb")) { pb } }')
-            if "extensions" not in out and out["data"]["q"]:
+            if not out["extensions"].get("degraded") and out["data"]["q"]:
                 break
             time.sleep(0.3)
         assert out["data"]["q"] == [{"pb": "hb"}]
-        assert "extensions" not in out
+        assert not out["extensions"].get("degraded")
     finally:
         faults.reset()
         c.close()
@@ -666,7 +668,7 @@ def test_chaos_long_schedule_with_raft_faults(tmp_path, monkeypatch):
         last_ts = 0
         while any(th.is_alive() for th in threads):
             out = c.query(corpus[0])
-            if "extensions" not in out:
+            if not out["extensions"].get("degraded"):
                 bals = {
                     int(x["uid"], 16): x["bal"] for x in out["data"]["q"]
                 }
@@ -690,9 +692,11 @@ def test_chaos_long_schedule_with_raft_faults(tmp_path, monkeypatch):
             serial = c.query(q)
             monkeypatch.setenv("DGRAPH_TPU_EXEC_WORKERS", "4")
             parallel = c.query(q)
-            if "extensions" in serial or "extensions" in parallel:
+            if serial["extensions"].get("degraded") or \
+                    parallel["extensions"].get("degraded"):
                 continue
-            assert serial == parallel, q
+            # extensions carry run-specific timings; data must be equal
+            assert serial["data"] == parallel["data"], q
     finally:
         faults.reset()
         c.close()

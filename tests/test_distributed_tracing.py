@@ -1,0 +1,247 @@
+"""End-to-end distributed observability over a multi-process cluster.
+
+One query against a ProcCluster (alpha replicas AND the Zero quorum as
+OS processes) must yield ONE trace: the client's root query span, the
+alphas' rpc_server spans, and the zero's rpc_server spans all share a
+single 128-bit trace id with correct parent links, each process writing
+its own JSONL sink (DGRAPH_TPU_TRACE_SINK). The response carries
+reference-shaped extensions.server_latency and the per-query profile
+assembled from child-server fragments. The cluster metrics surface
+merges every process's /debug/prometheus_metrics (counters summed,
+per-instance labels), served behind the facade HTTP endpoint and the
+`dgraph-tpu metrics` CLI.
+"""
+
+import json
+import glob
+import os
+import urllib.request
+
+import pytest
+
+from dgraph_tpu.utils import observe
+from dgraph_tpu.worker.harness import ProcCluster
+
+
+@pytest.fixture(scope="module")
+def traced_cluster(tmp_path_factory):
+    sink_dir = str(tmp_path_factory.mktemp("trace_sinks"))
+    os.environ["DGRAPH_TPU_TRACE_SINK"] = sink_dir
+    os.environ["DGRAPH_TPU_TRACE_SAMPLE"] = "1"
+    c = ProcCluster(
+        n_groups=1, replicas=3, replicated_zero=True, zero_replicas=3
+    )
+    try:
+        c.alter("name: string @index(exact) .\nfollows: [uid] .")
+        t = c.new_txn()
+        t.mutate_rdf(
+            set_rdf=(
+                '<0x1> <name> "tr-alice" .\n'
+                '<0x2> <name> "tr-bob" .\n'
+                "<0x1> <follows> <0x2> .\n"
+            ),
+            commit_now=True,
+        )
+        yield c, sink_dir
+    finally:
+        c.close()
+        os.environ.pop("DGRAPH_TPU_TRACE_SINK", None)
+        os.environ.pop("DGRAPH_TPU_TRACE_SAMPLE", None)
+        observe.TRACER.set_sink(None)
+
+
+def _sink_spans(sink_dir):
+    """{filename: [span dicts]} across every per-process sink file."""
+    out = {}
+    for path in glob.glob(os.path.join(sink_dir, "spans-*.jsonl")):
+        with open(path) as f:
+            out[os.path.basename(path)] = [
+                json.loads(line) for line in f if line.strip()
+            ]
+    return out
+
+
+def test_one_query_one_trace_across_client_alpha_zero(traced_cluster):
+    c, sink_dir = traced_cluster
+    # force the cached ts-lease block to exhaust so THIS query's read_ts
+    # makes a real zero.exec RPC inside the root span
+    c.zero.zero.TS_BLOCK = 1
+    c.zero.zero._ts_end = -1
+    out = c.query(
+        '{ q(func: eq(name, "tr-alice")) { name follows { name } } }'
+    )
+    assert out["data"]["q"][0]["follows"][0]["name"] == "tr-bob"
+    tid = int(out["extensions"]["trace_id"], 16)
+    assert tid > 1 << 64  # random 128-bit, not a sequential counter
+
+    by_file = _sink_spans(sink_dir)
+    in_client = [
+        f for f, spans in by_file.items()
+        if f"pid{os.getpid()}" in f
+        and any(s["trace_id"] == tid for s in spans)
+    ]
+    in_alpha = [
+        f for f, spans in by_file.items()
+        if "alpha-" in f and any(s["trace_id"] == tid for s in spans)
+    ]
+    in_zero = [
+        f for f, spans in by_file.items()
+        if "zero-" in f and any(s["trace_id"] == tid for s in spans)
+    ]
+    assert in_client, f"trace missing from client sink: {list(by_file)}"
+    assert in_alpha, f"trace missing from alpha sinks: {list(by_file)}"
+    assert in_zero, f"trace missing from zero sinks: {list(by_file)}"
+
+    # parent links: exactly one root, and every other span's parent is a
+    # span of the same trace (cross-process links resolve)
+    trace = [
+        s for spans in by_file.values() for s in spans
+        if s["trace_id"] == tid
+    ]
+    ids = {s["span_id"] for s in trace}
+    roots = [s for s in trace if s["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "query"
+    for s in trace:
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in ids, s
+    names = {s["name"] for s in trace}
+    assert "rpc_server" in names and "level_task" in names
+
+
+def test_traced_commit_marks_raft_replication_hop(traced_cluster):
+    """A traced commit's proposal rides the raft TCP envelope: the
+    append broadcast that replicates it carries the proposer's
+    traceparent, and each follower emits a raft_recv span joined to the
+    same trace."""
+    import time as _t
+
+    c, sink_dir = traced_cluster
+    c.new_txn().mutate_rdf(
+        set_rdf='<0x3> <name> "tr-carol" .', commit_now=True
+    )
+    raft = []
+    deadline = _t.time() + 10
+    while _t.time() < deadline and not raft:
+        by_file = _sink_spans(sink_dir)
+        raft = [
+            s
+            for spans in by_file.values()
+            for s in spans
+            if s["name"] == "raft_recv"
+        ]
+        if not raft:
+            _t.sleep(0.2)
+    assert raft, "no raft_recv spans reached any sink"
+    commit_tids = {
+        s["trace_id"]
+        for spans in by_file.values()
+        for s in spans
+        if s["name"] in ("commit", "rpc_server")
+    }
+    joined = [s for s in raft if s["trace_id"] in commit_tids]
+    assert joined, "raft_recv spans did not join any traced proposal"
+    assert all(s["parent_id"] is not None for s in joined)
+
+
+def test_server_latency_and_profile_are_consistent(traced_cluster):
+    c, _ = traced_cluster
+    out = c.query(
+        '{ q(func: eq(name, "tr-alice")) { name follows { name } } }'
+    )
+    lat = out["extensions"]["server_latency"]
+    parts = (
+        lat["parsing_ns"] + lat["assign_timestamp_ns"]
+        + lat["processing_ns"] + lat["encoding_ns"]
+    )
+    assert lat["total_ns"] > 0
+    assert lat["processing_ns"] > 0
+    assert 0 < parts <= lat["total_ns"]
+    prof = out["extensions"]["profile"]
+    assert prof["level_tasks"], prof
+    for lt in prof["level_tasks"]:
+        assert lt["ms"] >= 0 and lt["parents"] >= 1 and lt["level"] >= 1
+    levels = {(lt["attr"], lt["level"]) for lt in prof["level_tasks"]}
+    assert ("follows", 1) in levels and ("name", 2) in levels
+    # child-server fragments piggybacked on the read RPCs
+    assert prof["rpc"], prof
+    assert any(r["instance"].startswith("alpha-") for r in prof["rpc"])
+    assert all(r["ms"] >= 0 and r["calls"] >= 1 for r in prof["rpc"])
+
+
+def test_merged_metrics_equal_sum_of_per_process_scrapes(traced_cluster):
+    c, _ = traced_cluster
+    from dgraph_tpu.utils.observe import METRICS
+
+    # per-process scrape over each replica's own debug HTTP listener
+    texts = {"client": METRICS.render()}
+    for label, addr in c.instance_labels().items():
+        info = c.pool.call(addr, "debug.info", timeout=2.0)
+        assert info["instance"] == label
+        port = info["debug_http_port"]
+        assert port > 0
+        texts[label] = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/prometheus_metrics",
+            timeout=5,
+        ).read().decode()
+
+    merged = observe.parse_exposition(c.merged_metrics())
+    # counters that only move when queries run (stable between scrapes)
+    for name in (
+        "dgraph_tpu_num_queries",
+        "dgraph_tpu_level_tasks_started",
+        "dgraph_tpu_rpc_server_requests_total",
+    ):
+        expected = sum(
+            observe.parse_exposition(t)["counter"].get(name, 0.0)
+            for t in texts.values()
+        )
+        assert merged["counter"].get(name, 0.0) == expected, name
+    assert merged["counter"]["dgraph_tpu_num_queries"] >= 1
+    assert merged["counter"]["dgraph_tpu_rpc_server_requests_total"] >= 1
+    # per-instance series survive the merge
+    assert any(
+        k.startswith('dgraph_tpu_rpc_server_requests_total{instance="')
+        for k in merged["counter"]
+    )
+
+
+def test_cli_metrics_against_running_cluster(traced_cluster, capsys):
+    c, _ = traced_cluster
+    from dgraph_tpu import cli
+    from dgraph_tpu.api.http_server import HTTPServer
+
+    srv = HTTPServer(c, port=0).start()
+    try:
+        rc = cli.main(
+            [
+                "metrics",
+                "--addr", f"http://127.0.0.1:{srv.port}",
+                "--json",
+            ]
+        )
+        assert rc == 0
+        got = json.loads(capsys.readouterr().out)
+        assert got["counters"]["dgraph_tpu_num_queries"] >= 1
+        # merged value equals the sum of the per-instance series the
+        # same scrape carries
+        per_inst = sum(
+            v
+            for k, v in got["counters"].items()
+            if k.startswith("dgraph_tpu_num_queries{")
+        )
+        assert got["counters"]["dgraph_tpu_num_queries"] == per_inst
+        # text mode exposes the raw exposition
+        rc = cli.main(
+            ["metrics", "--addr", f"http://127.0.0.1:{srv.port}"]
+        )
+        assert rc == 0
+        assert "dgraph_tpu_num_queries" in capsys.readouterr().out
+        # merged /debug/traces spans carry their instance
+        body = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/traces", timeout=5
+            ).read()
+        )
+        assert {s.get("instance") for s in body["spans"]} >= {"client"}
+    finally:
+        srv.stop()

@@ -126,6 +126,40 @@ def test_exec_workers_var_binding(golden_server, q):
 
 
 # ---------------------------------------------------------------------------
+# Span parenting: executor-pool workers must inherit the query's trace
+# context (contextvars copy), not start orphan traces.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("workers", ["1", "4"])
+def test_level_task_spans_share_query_trace(golden_server, workers):
+    from dgraph_tpu.utils.observe import TRACER
+
+    q = """{ me(func: eq(name, "Michonne")) {
+        name
+        friend { name friend { name } }
+        school { name }
+        pet { name }
+    } }"""
+    os.environ["DGRAPH_TPU_EXEC_WORKERS"] = workers
+    try:
+        golden_server.query(q)
+    finally:
+        os.environ.pop("DGRAPH_TPU_EXEC_WORKERS", None)
+    spans = TRACER.recent(400)
+    qspan = [s for s in spans if s["name"] == "query"][-1]
+    level = [
+        s
+        for s in spans
+        if s["name"] == "level_task" and s["start"] >= qspan["start"]
+    ]
+    assert len(level) >= 3, "expected level tasks across levels"
+    for s in level:
+        assert s["trace_id"] == qspan["trace_id"], s
+        assert s["parent_id"] is not None, f"orphan level_task: {s}"
+
+
+# ---------------------------------------------------------------------------
 # Randomized multi-level fuzz: random graph, random query shapes.
 # ---------------------------------------------------------------------------
 

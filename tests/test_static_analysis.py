@@ -475,3 +475,71 @@ def test_no_unregistered_dgraph_env_vars_in_package():
                                 f"{path}:{i}: {m.group(0)}"
                             )
     assert not offenders, "\n".join(offenders)
+
+
+# ---------------------------------------------------------------------------
+# metrics-registry checker (PR 5): every METRICS name is declared
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_registry_checker_flags_undeclared(tmp_path):
+    rep = _run_fixture(
+        tmp_path,
+        "mod.py",
+        """
+        from dgraph_tpu.utils.observe import METRICS
+
+        def f(x, name):
+            METRICS.inc("tootally_bogus_counter")       # typo'd name
+            METRICS.observe(f"span_{x}_oops", 1.0)      # unknown family
+            METRICS.inc(name)                           # unresolvable
+        """,
+        ["metrics-registry"],
+    )
+    codes = sorted(v.code for v in rep.violations)
+    assert codes == [
+        "dynamic-metric-name",
+        "dynamic-metric-name",
+        "unregistered-metric",
+    ], [v.render() for v in rep.violations]
+
+
+def test_metrics_registry_checker_clean_fixture(tmp_path):
+    rep = _run_fixture(
+        tmp_path,
+        "mod.py",
+        """
+        from dgraph_tpu.utils.observe import METRICS, Metrics
+
+        def f(name):
+            METRICS.inc("rpc_retries_total")
+            METRICS.inc("level_task_uids", 5)
+            METRICS.observe(f"span_{name}_seconds", 0.1)  # declared family
+            METRICS.set_gauge("cache_point_reads", 1.0)
+            with METRICS.timer("query_latency_seconds"):
+                pass
+            local = Metrics(prefix="t")
+            local.inc("anything_goes")  # local registries are exempt
+        """,
+        ["metrics-registry"],
+    )
+    assert not rep.violations, [v.render() for v in rep.violations]
+
+
+def test_metrics_md_in_sync():
+    from dgraph_tpu.utils import observe
+
+    with open(os.path.join(REPO, "METRICS.md")) as f:
+        on_disk = f.read()
+    assert on_disk == observe.metrics_reference(), (
+        "METRICS.md is stale — regenerate with "
+        "`python -m dgraph_tpu.cli metrics-ref -o METRICS.md`"
+    )
+
+
+def test_metric_declarations_are_documented():
+    from dgraph_tpu.utils.observe import METRIC_DEFS
+
+    for d in METRIC_DEFS.values():
+        assert d.kind in ("counter", "gauge", "histogram"), d
+        assert len(d.doc.split()) >= 4, f"{d.name} needs a real doc line"

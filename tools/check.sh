@@ -31,6 +31,7 @@ else
         tests/test_packed_setops.py tests/test_posting.py \
         tests/test_storage.py tests/test_raft.py \
         tests/test_replicated_zero.py tests/test_cluster_facade.py \
+        tests/test_observability.py tests/test_distributed_tracing.py \
         -q -p no:cacheprovider
 fi
 
