@@ -11,9 +11,12 @@ direct analog of IntersectCompressedWithBin, which never fully decodes):
 
   intersect_packed_10v1M_batch256  ns/op for 256 block-skip intersects
   decode_bytes_per_query           decoded vs full-decode bytes across the
-                                   selectivity ratio ladder; the dense
-                                   (ratio=1) row must show the fallback to
-                                   full decode (no packed regression)
+                                   selectivity ratio ladder, both operands
+                                   compressed; every rung reports which
+                                   block kernels ran (bitmap/probe/gallop
+                                   — the adaptive set-representation
+                                   engine keeps even the dense rungs at
+                                   zero decode)
 
 Prints one JSON line per metric:
   {"metric": ..., "value": N, "unit": "ns/op", "vs_baseline": N}
@@ -350,9 +353,14 @@ def _bench_packed(rng, big, platform):
         )
     )
 
-    # decoded-bytes ladder: per-query decode cost packed vs full decode.
-    # ratio=1 runs through the dispatcher and must FALL BACK to the dense
-    # path (packed_ops == 0) — the no-regression guard for dense ops.
+    # decoded-bytes ladder: per-query decode cost across the selectivity
+    # ratio ladder, BOTH operands offered compressed (the posting-list vs
+    # posting-list shape every traversal sees). The adaptive per-block
+    # engine keeps every rung compressed-domain — bitmap AND on dense
+    # block pairs, galloping merge on sparse ones, bitmap probes on mixed
+    # — so even the dense rungs (ratio 1/100, which used to fall back to
+    # an 8-16 MB full decode) materialize nothing. Each rung reports the
+    # per-representation kernel counts alongside the byte accounting.
     from dgraph_tpu.query.dispatch import PackedOperand, SetOpDispatcher
 
     disp = SetOpDispatcher()
@@ -360,19 +368,24 @@ def _bench_packed(rng, big, platform):
     for ratio in (1, 100, 1000, 100000):
         n_small = max(10, len(b64) // ratio)
         a = np.sort(rng.choice(b64, n_small, replace=False))
+        pack_a = uidpack.encode(a)
         packed_setops.reset_counters()
-        got = disp.run_pairs("intersect", [(a, PackedOperand(pack))])[0]
+        got = disp.run_pairs(
+            "intersect", [(PackedOperand(pack_a), PackedOperand(pack))]
+        )[0]
         c = packed_setops.counters()
-        full = pack.num_uids * 8 + a.size * 8
-        decoded = (
-            c["decoded_bytes"] + a.size * 8
-            if c["packed_ops"]
-            else full
-        )
+        full = (pack.num_uids + pack_a.num_uids) * 8
+        decoded = c["decoded_bytes"] if c["packed_ops"] else full
         ladder.append(
             {
                 "ratio": ratio,
                 "packed_path": bool(c["packed_ops"]),
+                "kernels": {
+                    "bitmap": int(c["bitmap_pairs"]),
+                    "probe": int(c["probe_pairs"]),
+                    "gallop": int(c["gallop_pairs"]),
+                },
+                "streamed_bytes": int(c["streamed_uids"]) * 8,
                 "decoded_bytes_per_query": decoded,
                 "full_decode_bytes": full,
                 "reduction_x": round(full / max(1, decoded), 1),
@@ -381,8 +394,10 @@ def _bench_packed(rng, big, platform):
         )
         print(
             f"packed ladder ratio={ratio}: packed={bool(c['packed_ops'])} "
-            f"decoded={decoded}B full={full}B "
-            f"reduction={full/max(1,decoded):.1f}x",
+            f"kernels(b/p/g)={int(c['bitmap_pairs'])}/"
+            f"{int(c['probe_pairs'])}/{int(c['gallop_pairs'])} "
+            f"decoded={decoded}B streamed={int(c['streamed_uids'])*8}B "
+            f"full={full}B reduction={full/max(1,decoded):.1f}x",
             file=sys.stderr,
         )
     headline = ladder[-1]  # the 10-vs-1M (most selective) row

@@ -29,8 +29,30 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dgraph_tpu.x import config
+
 BLOCK_SIZE = 256
 _MAGIC = b"UPK1"
+
+# Adaptive per-block container form (Roaring-style, arxiv 1907.01032): a
+# block whose uid range fits in a fixed-size bitset AND whose density
+# clears 1/8 is "bitmap-eligible" — the set kernels run word-wise
+# AND/ANDNOT over the bitset instead of merging sorted offsets, and the
+# serializer stores the bitset when it is smaller than the bit-packed
+# offsets. BITMAP_BITS is the fixed in-memory bitset size per block
+# (DGRAPH_TPU_BITMAP_BLOCK_BITS, multiple of 64; 0 disables the bitmap
+# containers entirely).
+def _sanitize_bitmap_bits(v: int) -> int:
+    if v <= 0:
+        return 0
+    return max(64, (int(v) + 63) // 64 * 64)
+
+
+BITMAP_BITS = _sanitize_bitmap_bits(int(config.get("BITMAP_BLOCK_BITS")))
+BITMAP_WORDS = BITMAP_BITS // 64
+# serialized bitmap container marker: the width byte of a block header is
+# <= 32 for bit-packed offsets; 0xFF flags "payload is a bitset"
+_BITMAP_FORM = 0xFF
 
 
 @dataclass
@@ -52,6 +74,10 @@ class UidPack:
     _maxes: Optional[np.ndarray] = field(
         default=None, repr=False, compare=False
     )
+    # lazily-built bitmap sidecar (block_bitmaps): (words, ok) where words
+    # is (nblocks, BITMAP_WORDS) uint64 (None when no block is eligible)
+    # and ok is the (nblocks,) bool eligibility mask
+    _bm: Optional[tuple] = field(default=None, repr=False, compare=False)
 
     def __len__(self) -> int:
         return self.num_uids
@@ -61,12 +87,12 @@ class UidPack:
         return self.bases.shape[0]
 
     def approx_bytes(self) -> int:
-        """On-disk size estimate (bit-packed)."""
+        """On-disk size estimate (per-block best of bit-packed/bitmap;
+        same container pick as _serialize_block)."""
         total = len(_MAGIC) + 12 + self.nblocks * 11
         for i in range(self.nblocks):
             c = int(self.counts[i])
-            w = _width_bits(self.offsets[i, :c])
-            total += (c * w + 7) // 8
+            total += _block_payload_bytes(self.offsets[i, :c], c)[0]
         return total
 
 
@@ -165,6 +191,86 @@ def block_maxes(pack: UidPack) -> np.ndarray:
                 np.arange(nb), last
             ].astype(np.uint64)
     return pack._maxes
+
+
+def bitmap_eligible(pack: UidPack) -> np.ndarray:
+    """(nblocks,) bool — True where the block's uid range fits the fixed
+    BITMAP_BITS bitset AND its density clears 1/8 (count * 8 > range).
+    The per-block cardinality metadata behind the adaptive kernel pick:
+    eligible blocks materialize as bitsets (block_bitmaps) and run the
+    word-wise AND/ANDNOT kernels; the rest stay sorted-offset form."""
+    nb = pack.nblocks
+    if nb == 0 or BITMAP_BITS == 0:
+        return np.zeros((nb,), bool)
+    rng = block_maxes(pack) - pack.bases
+    return (rng < np.uint64(BITMAP_BITS)) & (
+        pack.counts.astype(np.uint64) * np.uint64(8) > rng
+    )
+
+
+def block_bitmaps(
+    pack: UidPack,
+) -> Tuple[Optional[np.ndarray], Optional[np.ndarray], np.ndarray]:
+    """(words, rows, ok): the pack's bitmap sidecar, COMPACT — `words` is
+    a (n_eligible, BITMAP_WORDS) uint64 matrix holding only the eligible
+    blocks' fixed-size bitsets (bit j of block i's row <=> uid
+    bases[i]+j present), `rows` is the (nblocks,) int32 indirection
+    (words-row index, or -1 for offsets-only blocks), and `ok` the bool
+    eligibility mask. `words`/`rows` are None when NO block is eligible
+    (the all-sparse case: nothing allocates), and a mostly-sparse pack
+    pays only for its dense blocks. Cached on the pack like block_maxes;
+    the block arrays are immutable once encoded."""
+    if pack._bm is None:
+        ok = bitmap_eligible(pack)
+        if not ok.any():
+            pack._bm = (None, None, ok)
+            return pack._bm
+        idxs = np.flatnonzero(ok)
+        rows = np.full((pack.nblocks,), -1, np.int32)
+        rows[idxs] = np.arange(idxs.size, dtype=np.int32)
+        words = np.zeros((idxs.size, BITMAP_WORDS), np.uint64)
+        from dgraph_tpu import native
+
+        if not native.pack_build_bitmaps(
+            pack.counts, pack.offsets, rows, BITMAP_BITS, words
+        ):
+            # numpy fallback: one flat scatter over all eligible blocks
+            mat = pack.offsets[idxs]
+            valid = (
+                np.arange(mat.shape[1], dtype=np.int32)[None, :]
+                < pack.counts[idxs][:, None]
+            )
+            ri, ji = np.nonzero(valid)
+            offs = mat[ri, ji].astype(np.uint64)
+            np.bitwise_or.at(
+                words,
+                (ri, (offs >> np.uint64(6)).astype(np.int64)),
+                np.uint64(1) << (offs & np.uint64(63)),
+            )
+        pack._bm = (words, rows, ok)
+    return pack._bm
+
+
+def offsets_to_bitmap(offs: np.ndarray, nbits: int) -> np.ndarray:
+    """Conversion helper: uint32 in-block offsets (< nbits) -> uint64
+    bitset words, little-endian bit order (bit j <=> offset j)."""
+    words = np.zeros(((nbits + 63) // 64,), np.uint64)
+    o = np.asarray(offs, np.uint64)
+    np.bitwise_or.at(
+        words,
+        (o >> np.uint64(6)).astype(np.int64),
+        np.uint64(1) << (o & np.uint64(63)),
+    )
+    return words
+
+
+def bitmap_to_offsets(words: np.ndarray, nbits: int) -> np.ndarray:
+    """Inverse of offsets_to_bitmap: set bits -> sorted uint32 offsets."""
+    bits = np.unpackbits(
+        np.ascontiguousarray(words, np.uint64).view(np.uint8),
+        bitorder="little",
+    )[:nbits]
+    return np.flatnonzero(bits).astype(np.uint32)
 
 
 def decode_blocks(pack: UidPack, idxs: np.ndarray) -> np.ndarray:
@@ -289,6 +395,46 @@ def _bitunpack(data, count, width):
     return native.bitunpack(data, count, width)
 
 
+def _block_payload_bytes(offs: np.ndarray, c: int):
+    """(payload_bytes, use_bitmap, width, max_offset) — the ONE container
+    pick shared by _serialize_block and approx_bytes, so the on-disk
+    size estimate can never drift from the serializer."""
+    w = _width_bits(offs)
+    packed_nbytes = (c * w + 7) // 8
+    rng = int(offs[-1]) if c else 0
+    if BITMAP_BITS and c and rng <= 0xFFFF:
+        bm_nbytes = 2 + (rng + 8) // 8
+        if bm_nbytes < packed_nbytes:
+            return bm_nbytes, True, w, rng
+    return packed_nbytes, False, w, rng
+
+
+def _serialize_block(base: int, offs: np.ndarray, c: int) -> bytes:
+    """One block record, in whichever container form is smaller:
+
+      packed  [<QHB> base count width]  + bit-packed offsets
+      bitmap  [<QHB> base count 0xFF]   + <H> max-offset + bitset bytes
+
+    A dense block (small max offset relative to count) stores as a raw
+    little-endian bitset over its base — the on-disk face of the bitmap
+    containers (Roaring-style, arxiv 1907.01032). The 0xFF marker can
+    never collide with a real width (widths are <= 32), so old packed
+    records stay readable; records WITH bitmap blocks are not readable
+    by pre-bitmap builds (pin DGRAPH_TPU_BITMAP_BLOCK_BITS=0 to keep
+    writing the legacy form in a mixed-version store). The native bulk
+    writer (bulkload.cpp serialize_uids) emits only the packed form;
+    both forms deserialize."""
+    _, use_bitmap, w, rng = _block_payload_bytes(offs, c)
+    if use_bitmap:
+        words = offsets_to_bitmap(offs, rng + 1)
+        return (
+            struct.pack("<QHB", base, c, _BITMAP_FORM)
+            + struct.pack("<H", rng)
+            + words.view(np.uint8)[: (rng + 8) // 8].tobytes()
+        )
+    return struct.pack("<QHB", base, c, w) + _bitpack(offs, w)
+
+
 def serialize_uids(uids: np.ndarray) -> bytes:
     """Serialized pack straight from a sorted uid array — skips the
     UidPack materialization for the dominant small-list case (bulk-load
@@ -299,26 +445,28 @@ def serialize_uids(uids: np.ndarray) -> bytes:
     if n <= BLOCK_SIZE and (int(uids[-1]) >> 32) == (int(uids[0]) >> 32):
         base = int(uids[0])
         offs = (uids - uids[0]).astype(np.uint32)
-        w = _width_bits(offs)
         return (
             _MAGIC
             + struct.pack("<QI", n, 1)
-            + struct.pack("<QHB", base, n, w)
-            + _bitpack(offs, w)
+            + _serialize_block(base, offs, n)
         )
     return serialize(encode(uids))
 
 
 def serialize(pack: UidPack) -> bytes:
-    """Bit-pack each block's offsets to its max width. Ref codec.go:393 Encode
-    (group-varint there; fixed-width lanes here — see module docstring)."""
+    """Per-block container pick: bit-packed offsets at the block's max
+    width, or a raw bitset when the block is dense enough that the bitset
+    is smaller (_serialize_block). Ref codec.go:393 Encode (group-varint
+    there; fixed-width lanes / bitmap containers here — see module
+    docstring)."""
     parts = [_MAGIC, struct.pack("<QI", pack.num_uids, pack.nblocks)]
     for bi in range(pack.nblocks):
         c = int(pack.counts[bi])
-        offs = pack.offsets[bi, :c]
-        w = _width_bits(offs)
-        parts.append(struct.pack("<QHB", int(pack.bases[bi]), c, w))
-        parts.append(_bitpack(offs, w))
+        parts.append(
+            _serialize_block(
+                int(pack.bases[bi]), pack.offsets[bi, :c], c
+            )
+        )
     return b"".join(parts)
 
 
@@ -336,15 +484,36 @@ def deserialize(data: bytes) -> UidPack:
     for bi in range(nb):
         base, c, w = struct.unpack_from("<QHB", data, pos)
         pos += 11
-        if c > BLOCK_SIZE or w > 32:
+        if c > BLOCK_SIZE or (w > 32 and w != _BITMAP_FORM):
             raise ValueError(
                 f"corrupt UidPack block: count={c} width={w}"
             )
-        nbytes = (c * w + 7) // 8
-        if pos + nbytes > len(data):
-            raise ValueError("truncated UidPack block data")
-        offs = _bitunpack(data[pos : pos + nbytes], c, w)
-        pos += nbytes
+        if w == _BITMAP_FORM:
+            # bitmap container: <H> max-offset + little-endian bitset
+            if pos + 2 > len(data):
+                raise ValueError("truncated UidPack bitmap header")
+            (rng,) = struct.unpack_from("<H", data, pos)
+            pos += 2
+            nbytes = (rng + 8) // 8
+            if pos + nbytes > len(data):
+                raise ValueError("truncated UidPack block data")
+            bits = np.unpackbits(
+                np.frombuffer(data, np.uint8, nbytes, pos),
+                bitorder="little",
+            )[: rng + 1]
+            offs = np.flatnonzero(bits).astype(np.uint32)
+            if offs.size != c:
+                raise ValueError(
+                    f"corrupt UidPack bitmap block: popcount "
+                    f"{offs.size} != count {c}"
+                )
+            pos += nbytes
+        else:
+            nbytes = (c * w + 7) // 8
+            if pos + nbytes > len(data):
+                raise ValueError("truncated UidPack block data")
+            offs = _bitunpack(data[pos : pos + nbytes], c, w)
+            pos += nbytes
         bases[bi] = base
         counts[bi] = c
         offsets[bi, :c] = offs

@@ -63,6 +63,28 @@ DECLS = {
         _i64,
         [_u64p, _i32p, _u32p, _i64, _i64, _u64p, _u64p, _i64, _u64p, _i64p],
     ),
+    # codec.cpp — adaptive bitmap/packed block engine
+    "pack_build_bitmaps": (
+        None,
+        [_i32p, _u32p, _i64, _i64, _i32p, _i64, _u64p],
+    ),
+    "pack_pair_setop": (
+        _i64,
+        [
+            _int,
+            _u64p, _i32p, _u32p, _i64, _i64, _u64p, _u64p, _i32p,
+            _u64p, _i32p, _u32p, _i64, _i64, _u64p, _u64p, _i32p,
+            _i64, _u64p, _i64p,
+        ],
+    ),
+    "pack_stream_setop": (
+        _i64,
+        [
+            _int, _u64p, _i64,
+            _u64p, _i32p, _u32p, _i64, _i64, _u64p, _u64p, _i32p,
+            _i64, _u64p, _i64p,
+        ],
+    ),
     "intersect_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
     "union_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
     "difference_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
@@ -353,6 +375,121 @@ def pack_intersect_small(bases, counts, offsets, maxes, a, ptrs=None):
         ctypes.byref(touched),
     )
     return out[:n], int(touched.value)
+
+
+def pack_build_bitmaps(counts, offsets, rows, bm_bits, out_words) -> bool:
+    """Scatter eligible blocks' offsets into the zeroed COMPACT bitset
+    matrix; `rows` maps block index -> words row (or -1)
+    (codec/uidpack.block_bitmaps fast path). Returns False when the
+    native lib is unavailable (caller falls back to the numpy scatter)."""
+    if _LIB is None:
+        return False
+    counts = np.ascontiguousarray(counts, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.uint32)
+    rows = np.ascontiguousarray(rows, np.int32)
+    _LIB.pack_build_bitmaps(
+        _ptr(counts, ctypes.c_int32),
+        _ptr(offsets, ctypes.c_uint32),
+        offsets.shape[1],
+        counts.size,
+        _ptr(rows, ctypes.c_int32),
+        bm_bits,
+        _ptr(out_words, ctypes.c_uint64),
+    )
+    return True
+
+
+def _bm_arrays(words, rows, ok):
+    """(words, rows) contiguous arrays for a compact bitmap sidecar, or
+    (None, None) when no block is eligible (the kernels take the packed
+    arms only). Callers MUST bind the returns to locals so the converted
+    temporaries outlive the native call."""
+    if words is None:
+        return None, None
+    return (
+        np.ascontiguousarray(words, np.uint64),
+        np.ascontiguousarray(rows, np.int32),
+    )
+
+
+def pack_pair_setop(op, pa, pb, a_bm, b_bm, bm_bits):
+    """Compressed-domain pack x pack set op (0=intersect, 1=difference)
+    via the adaptive per-block-pair engine. `a_bm`/`b_bm` are the
+    compact (words, rows, ok) bitmap sidecars from
+    codec/uidpack.block_bitmaps.
+    Returns (result u64 array, kernel_counts int64[4]) or None when the
+    native lib is unavailable."""
+    if _LIB is None:
+        return None
+    cap = min(pa.num_uids, pb.num_uids) if op == 0 else pa.num_uids
+    out = np.empty((cap,), np.uint64)
+    kc = np.zeros((4,), np.int64)
+    if cap == 0:
+        return out, kc
+    a_b = np.ascontiguousarray(pa.bases, np.uint64)
+    a_c = np.ascontiguousarray(pa.counts, np.int32)
+    a_o = np.ascontiguousarray(pa.offsets, np.uint32)
+    b_b = np.ascontiguousarray(pb.bases, np.uint64)
+    b_c = np.ascontiguousarray(pb.counts, np.int32)
+    b_o = np.ascontiguousarray(pb.offsets, np.uint32)
+    # keep sidecar conversions alive past the call
+    a_wa, a_ra = _bm_arrays(*a_bm)
+    b_wa, b_ra = _bm_arrays(*b_bm)
+    a_words = _ptr(a_wa, ctypes.c_uint64) if a_wa is not None else None
+    a_rowsp = _ptr(a_ra, ctypes.c_int32) if a_ra is not None else None
+    b_words = _ptr(b_wa, ctypes.c_uint64) if b_wa is not None else None
+    b_rowsp = _ptr(b_ra, ctypes.c_int32) if b_ra is not None else None
+    from dgraph_tpu.codec.uidpack import block_maxes
+
+    a_m = block_maxes(pa)
+    b_m = block_maxes(pb)
+    n = _LIB.pack_pair_setop(
+        op,
+        _ptr(a_b, ctypes.c_uint64), _ptr(a_c, ctypes.c_int32),
+        _ptr(a_o, ctypes.c_uint32), a_o.shape[1], a_b.size,
+        _ptr(a_m, ctypes.c_uint64), a_words, a_rowsp,
+        _ptr(b_b, ctypes.c_uint64), _ptr(b_c, ctypes.c_int32),
+        _ptr(b_o, ctypes.c_uint32), b_o.shape[1], b_b.size,
+        _ptr(b_m, ctypes.c_uint64), b_words, b_rowsp,
+        bm_bits,
+        _ptr(out, ctypes.c_uint64),
+        _ptr(kc, ctypes.c_int64),
+    )
+    return out[:n], kc
+
+
+def pack_stream_setop(op, a, pack, bm, bm_bits):
+    """Compressed-domain sorted-array x pack set op (0=intersect,
+    1=difference): stream `a` against the pack's blocks, probing bitmap
+    containers where present. Returns (result, kernel_counts int64[4])
+    or None when the native lib is unavailable."""
+    if _LIB is None:
+        return None
+    a = np.ascontiguousarray(a, np.uint64)
+    out = np.empty((a.size,), np.uint64)
+    kc = np.zeros((4,), np.int64)
+    if a.size == 0:
+        return out, kc
+    bases = np.ascontiguousarray(pack.bases, np.uint64)
+    counts = np.ascontiguousarray(pack.counts, np.int32)
+    offsets = np.ascontiguousarray(pack.offsets, np.uint32)
+    wa, ra = _bm_arrays(*bm)
+    words = _ptr(wa, ctypes.c_uint64) if wa is not None else None
+    rowsp = _ptr(ra, ctypes.c_int32) if ra is not None else None
+    from dgraph_tpu.codec.uidpack import block_maxes
+
+    maxes = block_maxes(pack)
+    n = _LIB.pack_stream_setop(
+        op,
+        _ptr(a, ctypes.c_uint64), a.size,
+        _ptr(bases, ctypes.c_uint64), _ptr(counts, ctypes.c_int32),
+        _ptr(offsets, ctypes.c_uint32), offsets.shape[1], bases.size,
+        _ptr(maxes, ctypes.c_uint64), words, rowsp,
+        bm_bits,
+        _ptr(out, ctypes.c_uint64),
+        _ptr(kc, ctypes.c_int64),
+    )
+    return out[:n], kc
 
 
 def _setop(name: str, a: np.ndarray, b: np.ndarray, out_size: int) -> np.ndarray:

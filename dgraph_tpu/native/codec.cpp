@@ -137,6 +137,303 @@ int64_t pack_intersect_small(const uint64_t* bases, const int32_t* counts,
 }
 
 // ---------------------------------------------------------------------------
+// Adaptive set-representation engine (bitmap/packed hybrid containers).
+//
+// Blocks come in two container forms: sorted uint32 offsets (the encode
+// default) and, for dense blocks, a fixed-size bitset over the block's
+// u64 base (codec/uidpack.py block_bitmaps, Roaring-style per arxiv
+// 1907.01032). The pair kernels below pick per BLOCK PAIR among
+//   bitmap ^ bitmap    word-wise AND/ANDNOT + popcount extraction
+//   bitmap x packed    probe the bitset while streaming the packed block
+//   packed x packed    galloping/linear merge straight off the offsets
+// so neither operand ever materializes to a flat u64 array (the "SIMD
+// Compression and the Intersection of Sorted Integers" shape, arxiv
+// 1401.6399). Word loops are written for the auto-vectorizer
+// (-march=native: AVX2/NEON AND + popcount); scalar is the fallback.
+// ---------------------------------------------------------------------------
+
+// first index in row[0..n) with row[i] >= x, galloping from lo
+static int64_t gallop32(const uint32_t* row, int64_t n, int64_t lo,
+                        uint32_t x) {
+    int64_t step = 1, hi = lo + 1;
+    if (lo < n && row[lo] >= x) return lo;
+    while (hi < n && row[hi] < x) {
+        lo = hi;
+        hi += step;
+        step <<= 1;
+    }
+    if (hi > n) hi = n;
+    while (lo < hi) {
+        int64_t mid = lo + ((hi - lo) >> 1);
+        if (row[mid] < x) lo = mid + 1; else hi = mid;
+    }
+    return lo;
+}
+
+// 64 bits of bitset `bm` (nwords words) starting at bit `bitoff`
+static inline uint64_t bm_window(const uint64_t* bm, int64_t nwords,
+                                 int64_t bitoff) {
+    int64_t w = bitoff >> 6;
+    int r = (int)(bitoff & 63);
+    if (w >= nwords) return 0;
+    uint64_t lo = bm[w] >> r;
+    if (r && w + 1 < nwords) lo |= bm[w + 1] << (64 - r);
+    return lo;
+}
+
+// Scatter eligible blocks' offsets into the COMPACT (n_eligible,
+// bm_bits/64) bitset matrix. `rows[bi]` is block bi's row in out_words,
+// or -1 for offsets-only blocks (eligibility is decided in ONE place,
+// codec/uidpack.bitmap_eligible — the C++ side only scatters); out_words
+// must be zeroed by the caller.
+void pack_build_bitmaps(const int32_t* counts, const uint32_t* offsets,
+                        int64_t block_size, int64_t nblocks,
+                        const int32_t* rows, int64_t bm_bits,
+                        uint64_t* out_words) {
+    int64_t nw = bm_bits >> 6;
+    for (int64_t bi = 0; bi < nblocks; bi++) {
+        if (rows[bi] < 0) continue;
+        uint64_t* w = out_words + (int64_t)rows[bi] * nw;
+        const uint32_t* row = offsets + bi * block_size;
+        int64_t c = counts[bi];
+        for (int64_t j = 0; j < c; j++)
+            w[row[j] >> 6] |= 1ull << (row[j] & 63);
+    }
+}
+
+// kernel_counts layout shared by the engine entry points:
+//   [0] bitmap^bitmap block pairs   [1] bitmap-probe block pairs
+//   [2] packed-merge block pairs    [3] uids streamed compressed-domain
+enum { KC_BITMAP = 0, KC_PROBE = 1, KC_GALLOP = 2, KC_STREAMED = 3 };
+
+// Adaptive pack x pack set op entirely in the compressed domain.
+// op: 0 = intersect, 1 = difference (a \ b). Walks the two block-range
+// lists with a two-pointer skip (whole blocks outside the other operand's
+// ranges are never touched — the packed-skip arm), and runs the cheapest
+// kernel on each overlapping pair's window [max(bases), min(maxes)]
+// (windows of consecutive pairs are disjoint, so each result uid is
+// emitted exactly once, in order). Returns uids written to out.
+int64_t pack_pair_setop(
+    int op,
+    const uint64_t* a_bases, const int32_t* a_counts,
+    const uint32_t* a_offsets, int64_t a_block_size, int64_t a_nblocks,
+    const uint64_t* a_maxes, const uint64_t* a_bm, const int32_t* a_bm_rows,
+    const uint64_t* b_bases, const int32_t* b_counts,
+    const uint32_t* b_offsets, int64_t b_block_size, int64_t b_nblocks,
+    const uint64_t* b_maxes, const uint64_t* b_bm, const int32_t* b_bm_rows,
+    int64_t bm_bits, uint64_t* out, int64_t* kernel_counts) {
+    int64_t nw = bm_bits >> 6;
+    int64_t ai = 0, bi = 0, k = 0;
+    int64_t last_a = -1, last_b = -1;
+    int64_t ia_cur = 0;  // difference: next unemitted offset of block ai
+    // monotone in-block search hints: windows over one block ascend, so
+    // a later window's lower_bound can start where the previous ended
+    // (turns a block spanning many peer blocks into one amortized scan)
+    int64_t ja_hint = 0, jb_hint = 0;
+    while (ai < a_nblocks && bi < b_nblocks) {
+        if (a_maxes[ai] < b_bases[bi]) {
+            // a block wholly below every remaining b block
+            if (op == 1) {
+                const uint32_t* row = a_offsets + ai * a_block_size;
+                for (int64_t j = ia_cur; j < a_counts[ai]; j++)
+                    out[k++] = a_bases[ai] + row[j];
+            }
+            ai++; ia_cur = 0; ja_hint = 0;
+            continue;
+        }
+        if (b_maxes[bi] < a_bases[ai]) { bi++; jb_hint = 0; continue; }
+        uint64_t lo = a_bases[ai] > b_bases[bi] ? a_bases[ai] : b_bases[bi];
+        uint64_t hi = a_maxes[ai] < b_maxes[bi] ? a_maxes[ai] : b_maxes[bi];
+        if (ai != last_a) {
+            kernel_counts[KC_STREAMED] += a_counts[ai]; last_a = ai;
+        }
+        if (bi != last_b) {
+            kernel_counts[KC_STREAMED] += b_counts[bi]; last_b = bi;
+        }
+        const uint32_t* arow = a_offsets + ai * a_block_size;
+        const uint32_t* brow = b_offsets + bi * b_block_size;
+        int64_t ac = a_counts[ai], bc = b_counts[bi];
+        uint32_t alo = (uint32_t)(lo - a_bases[ai]);
+        uint32_t ahi = (uint32_t)(hi - a_bases[ai]);
+        if (op == 1) {
+            // flush a elements below the window (no b block can hold them)
+            while (ia_cur < ac && arow[ia_cur] < alo)
+                out[k++] = a_bases[ai] + arow[ia_cur++];
+        }
+        int abm = a_bm_rows && a_bm_rows[ai] >= 0;
+        int bbm = b_bm_rows && b_bm_rows[bi] >= 0;
+        if (abm && bbm) {
+            // bitmap ^ bitmap: word-wise AND / ANDNOT over the window
+            kernel_counts[KC_BITMAP]++;
+            int64_t span = (int64_t)(hi - lo) + 1;
+            const uint64_t* aw = a_bm + (int64_t)a_bm_rows[ai] * nw;
+            const uint64_t* bw = b_bm + (int64_t)b_bm_rows[bi] * nw;
+            int64_t aoff = (int64_t)(lo - a_bases[ai]);
+            int64_t boff = (int64_t)(lo - b_bases[bi]);
+            for (int64_t p = 0; p < span; p += 64) {
+                uint64_t wa = bm_window(aw, nw, aoff + p);
+                uint64_t wb = bm_window(bw, nw, boff + p);
+                uint64_t w = op == 0 ? (wa & wb) : (wa & ~wb);
+                if (span - p < 64) w &= (1ull << (span - p)) - 1;
+                while (w) {
+                    out[k++] = lo + p + __builtin_ctzll(w);
+                    w &= w - 1;
+                }
+            }
+            if (op == 1) {
+                while (ia_cur < ac && arow[ia_cur] <= ahi) ia_cur++;
+            }
+        } else if (bbm || (op == 0 && abm)) {
+            // bitmap x packed: stream the packed side's offsets through
+            // the window, probe the bitset (O(1) per element). For
+            // difference only b-as-bitmap streams this way (a's elements
+            // must drive the output order).
+            kernel_counts[KC_PROBE]++;
+            if (op == 0 && !bbm) {
+                // a is the bitmap: stream b's offsets, probe a's bits
+                const uint64_t* aw = a_bm + (int64_t)a_bm_rows[ai] * nw;
+                int64_t j = gallop32(brow, bc, jb_hint,
+                                     (uint32_t)(lo - b_bases[bi]));
+                uint32_t bhi = (uint32_t)(hi - b_bases[bi]);
+                for (; j < bc && brow[j] <= bhi; j++) {
+                    uint64_t off = b_bases[bi] + brow[j] - a_bases[ai];
+                    if ((aw[off >> 6] >> (off & 63)) & 1)
+                        out[k++] = b_bases[bi] + brow[j];
+                }
+                jb_hint = j;
+            } else {
+                const uint64_t* bw = b_bm + (int64_t)b_bm_rows[bi] * nw;
+                int64_t j = op == 1 ? ia_cur
+                                    : gallop32(arow, ac, ja_hint, alo);
+                for (; j < ac && arow[j] <= ahi; j++) {
+                    uint64_t off = a_bases[ai] + arow[j] - b_bases[bi];
+                    int hit = (bw[off >> 6] >> (off & 63)) & 1;
+                    if (hit == (op == 0)) out[k++] = a_bases[ai] + arow[j];
+                }
+                ja_hint = j;
+                if (op == 1) ia_cur = j;
+            }
+        } else {
+            // packed x packed: merge the two offset spans in the window
+            // without decoding; gallop the long side when skewed
+            kernel_counts[KC_GALLOP]++;
+            int64_t ja = op == 1 ? ia_cur
+                                 : gallop32(arow, ac, ja_hint, alo);
+            int64_t jb = gallop32(brow, bc, jb_hint,
+                                  (uint32_t)(lo - b_bases[bi]));
+            uint32_t bhi = (uint32_t)(hi - b_bases[bi]);
+            int64_t abase_rel = (int64_t)(a_bases[ai] - lo);
+            int64_t bbase_rel = (int64_t)(b_bases[bi] - lo);
+            while (ja < ac && jb < bc && arow[ja] <= ahi &&
+                   brow[jb] <= bhi) {
+                // compare in window-local space (bases differ per block)
+                int64_t va = abase_rel + arow[ja];
+                int64_t vb = bbase_rel + brow[jb];
+                if (va < vb) {
+                    if (op == 1) out[k++] = a_bases[ai] + arow[ja];
+                    ja++;
+                } else if (va > vb) {
+                    jb++;
+                    // skewed spans: gallop b forward to a's current value
+                    if (jb < bc &&
+                        bbase_rel + brow[jb] < abase_rel + arow[ja])
+                        jb = gallop32(brow, bc, jb,
+                                      (uint32_t)(va - bbase_rel));
+                } else {
+                    if (op == 0) out[k++] = a_bases[ai] + arow[ja];
+                    ja++; jb++;
+                }
+            }
+            if (op == 1) {
+                // remaining a elements inside the window have no b peer
+                while (ja < ac && arow[ja] <= ahi)
+                    out[k++] = a_bases[ai] + arow[ja++];
+                ia_cur = ja;
+            }
+            ja_hint = ja;
+            jb_hint = jb;
+        }
+        if (a_maxes[ai] <= b_maxes[bi]) { ai++; ia_cur = 0; ja_hint = 0; }
+        else { bi++; jb_hint = 0; }
+    }
+    if (op == 1) {
+        // b exhausted (or never overlapped): the rest of a survives
+        while (ai < a_nblocks) {
+            const uint32_t* row = a_offsets + ai * a_block_size;
+            for (int64_t j = ia_cur; j < a_counts[ai]; j++)
+                out[k++] = a_bases[ai] + row[j];
+            ai++; ia_cur = 0;
+        }
+    }
+    return k;
+}
+
+// Adaptive sorted-array x pack set op: stream `a` against the pack's
+// blocks with a monotone block cursor — per block, probe the bitset when
+// the block carries one, else merge against the sorted offsets. The pack
+// is never decoded. op: 0 = intersect, 1 = difference (a \ pack).
+int64_t pack_stream_setop(
+    int op, const uint64_t* a, int64_t na,
+    const uint64_t* bases, const int32_t* counts, const uint32_t* offsets,
+    int64_t block_size, int64_t nblocks, const uint64_t* maxes,
+    const uint64_t* bm, const int32_t* bm_rows, int64_t bm_bits,
+    uint64_t* out, int64_t* kernel_counts) {
+    int64_t nw = bm_bits >> 6;
+    int64_t ia = 0, bi = 0, k = 0;
+    while (ia < na) {
+        uint64_t x = a[ia];
+        while (bi < nblocks && maxes[bi] < x) bi++;
+        if (bi == nblocks) {
+            if (op == 1) while (ia < na) out[k++] = a[ia++];
+            break;
+        }
+        if (x < bases[bi]) {
+            if (op == 1) {
+                while (ia < na && a[ia] < bases[bi]) out[k++] = a[ia++];
+            } else {
+                // gallop a forward to the block's start
+                int64_t step = 1, hi2 = ia + 1;
+                while (hi2 < na && a[hi2] < bases[bi]) {
+                    ia = hi2; hi2 += step; step <<= 1;
+                }
+                if (hi2 > na) hi2 = na;
+                while (ia < hi2) {
+                    int64_t mid = ia + ((hi2 - ia) >> 1);
+                    if (a[mid] < bases[bi]) ia = mid + 1; else hi2 = mid;
+                }
+            }
+            continue;
+        }
+        // a run of `a` lands in block bi
+        kernel_counts[KC_STREAMED] += counts[bi];
+        const uint32_t* row = offsets + bi * block_size;
+        int64_t c = counts[bi];
+        if (bm_rows && bm_rows[bi] >= 0) {
+            kernel_counts[KC_PROBE]++;
+            const uint64_t* w = bm + (int64_t)bm_rows[bi] * nw;
+            while (ia < na && a[ia] <= maxes[bi]) {
+                uint64_t off = a[ia] - bases[bi];
+                int hit = (int)((w[off >> 6] >> (off & 63)) & 1);
+                if (hit == (op == 0)) out[k++] = a[ia];
+                ia++;
+            }
+        } else {
+            kernel_counts[KC_GALLOP]++;
+            int64_t j = 0;
+            while (ia < na && a[ia] <= maxes[bi]) {
+                uint32_t off = (uint32_t)(a[ia] - bases[bi]);
+                j = gallop32(row, c, j, off);
+                int hit = (j < c && row[j] == off);
+                if (hit == (op == 0)) out[k++] = a[ia];
+                ia++;
+            }
+        }
+        bi++;
+    }
+    return k;
+}
+
+// ---------------------------------------------------------------------------
 // Sorted u64 set algebra (ref algo/uidlist.go IntersectWith:142 adaptive
 // strategies; same linear/gallop split here).
 // ---------------------------------------------------------------------------

@@ -5,21 +5,28 @@ The host-side hot cost of every traversal is "parse -> UidPack decode"
 block-compressed posting lists to flat u64 arrays before ops/setops.py
 ever runs, even when an intersection touches a tiny fraction of blocks.
 This module mirrors the reference's compressed-domain variants
-(algo/packed.go IntersectCompressedWith / IntersectCompressedWithBin):
+(algo/packed.go IntersectCompressedWith / IntersectCompressedWithBin),
+now through the adaptive per-block set-representation engine:
 
-  1. gallop over the two operands' per-block (base, max) range arrays
-     (codec/uidpack.block_maxes) with vectorized searchsorted to find the
-     candidate blocks whose ranges overlap the other side,
-  2. partially decode ONLY those blocks (codec/uidpack.decode_blocks,
-     native fast path in codec.cpp),
-  3. run the ordinary set kernels on the (much smaller) candidate spans —
-     the caller can hand the spans to the device dispatcher's vmapped
-     kernels (query/dispatch.py) or the native host loops.
+  1. the native kernels (codec.cpp pack_pair_setop / pack_stream_setop)
+     walk the operands' per-block (base, max) range arrays with a
+     two-pointer skip — whole blocks outside the other side's ranges
+     are never touched,
+  2. each overlapping block PAIR runs the cheapest kernel for its
+     container mix: word-wise bitmap AND/ANDNOT for dense blocks
+     (codec/uidpack.block_bitmaps bitsets), bitset probes for
+     bitmap x packed pairs, and a galloping offsets merge for
+     packed x packed — neither operand ever materializes,
+  3. without the native engine, candidate blocks found by vectorized
+     searchsorted partially decode (codec/uidpack.decode_blocks) and
+     the ordinary set kernels run on the spans — via the device
+     dispatcher's vmapped kernels (query/dispatch.py) or host loops.
 
-The technique is the block-skip intersection of Lemire & Boytsov (SIMD
-Compression and the Intersection of Sorted Integers, arxiv 1401.6399) and
-the per-block skip pipelines of arxiv 1907.01032: intersections are
-fastest against block-compressed layouts with skippable block metadata.
+The technique combines the block-skip intersection of Lemire & Boytsov
+(SIMD Compression and the Intersection of Sorted Integers, arxiv
+1401.6399) with the bitmap/slice container hybrid of arxiv 1907.01032:
+intersections are fastest against block-compressed layouts with
+skippable block metadata and density-matched container forms.
 
 32-bit segment rule: UidPack blocks never span a hi-32 boundary
 (codec.go:117 split rule, enforced by uidpack.encode), so every candidate
@@ -59,17 +66,27 @@ class _Counters(threading.local):
     def reset(self):
         self.decoded_uids = 0  # UIDs actually materialized
         self.skipped_uids = 0  # UIDs left compressed by block skipping
+        self.streamed_uids = 0  # UIDs compared compressed-domain (no
+        #                         materialization: bitmap/probe/gallop)
         self.packed_ops = 0
+        # per-representation kernel counts (block pairs, adaptive engine)
+        self.bitmap_pairs = 0
+        self.probe_pairs = 0
+        self.gallop_pairs = 0
 
     def snapshot(self) -> dict:
-        full = self.decoded_uids + self.skipped_uids
+        full = self.decoded_uids + self.skipped_uids + self.streamed_uids
         return {
             "decoded_uids": self.decoded_uids,
             "skipped_uids": self.skipped_uids,
+            "streamed_uids": self.streamed_uids,
             "full_decode_uids": full,
             "decoded_bytes": self.decoded_uids * 8,
             "full_decode_bytes": full * 8,
             "packed_ops": self.packed_ops,
+            "bitmap_pairs": self.bitmap_pairs,
+            "probe_pairs": self.probe_pairs,
+            "gallop_pairs": self.gallop_pairs,
         }
 
 
@@ -88,6 +105,92 @@ def _account(pack: UidPack, idxs: np.ndarray):
     dec = int(pack.counts[idxs].sum()) if idxs.size else 0
     COUNTERS.decoded_uids += dec
     COUNTERS.skipped_uids += pack.num_uids - dec
+
+
+# ---------------------------------------------------------------------------
+# Adaptive per-block engine (bitmap/packed hybrid containers).
+#
+# Native kernels (codec.cpp pack_pair_setop / pack_stream_setop) pick per
+# BLOCK PAIR among {bitmap AND/ANDNOT, bitmap probe, packed galloping
+# merge} using the per-block cardinality metadata (uidpack.block_bitmaps
+# eligibility); whole blocks outside the other operand's ranges are
+# skipped without a touch. Neither operand ever materializes, so the
+# engine wins at EVERY selectivity — it replaced the old whole-operand
+# PACKED_MIN_RATIO cliff that fell back to full decode at dense ratios.
+# ---------------------------------------------------------------------------
+
+
+def engine_available() -> bool:
+    """True when the native adaptive block engine is compiled in. Without
+    it the packed ops fall back to candidate-block decode (exact, but
+    only profitable at selective ratios — dispatchers re-apply the old
+    ratio cliff in that case, see dispatch.packed_min_ratio)."""
+    from dgraph_tpu import native
+
+    return native.NATIVE_AVAILABLE
+
+
+def _note_kernels(kc) -> None:
+    """Fold a kernel_counts vector into the per-thread counters and the
+    cluster metrics (per-representation kernel accounting)."""
+    COUNTERS.bitmap_pairs += int(kc[0])
+    COUNTERS.probe_pairs += int(kc[1])
+    COUNTERS.gallop_pairs += int(kc[2])
+    COUNTERS.streamed_uids += int(kc[3])
+    try:
+        from dgraph_tpu.utils.observe import METRICS
+
+        if kc[0]:
+            METRICS.inc("setop_block_bitmap_total", int(kc[0]))
+        if kc[1]:
+            METRICS.inc("setop_block_probe_total", int(kc[1]))
+        if kc[2]:
+            METRICS.inc("setop_block_gallop_total", int(kc[2]))
+    except Exception:
+        pass
+
+
+def _pair_engine(op_code: int, pa: UidPack, pb: UidPack):
+    """pack x pack through the native per-block engine; None -> caller
+    falls back to the candidate-block decode path."""
+    from dgraph_tpu import native
+
+    if not native.NATIVE_AVAILABLE:
+        return None
+    got = native.pack_pair_setop(
+        op_code,
+        pa,
+        pb,
+        uidpack.block_bitmaps(pa),
+        uidpack.block_bitmaps(pb),
+        uidpack.BITMAP_BITS,
+    )
+    if got is None:
+        return None
+    out, kc = got
+    _note_kernels(kc)
+    COUNTERS.skipped_uids += max(
+        0, pa.num_uids + pb.num_uids - int(kc[3])
+    )
+    return out
+
+
+def _stream_engine(op_code: int, a: np.ndarray, pack: UidPack):
+    """sorted array x pack through the native streaming engine; None ->
+    caller falls back to the candidate-block decode path."""
+    from dgraph_tpu import native
+
+    if not native.NATIVE_AVAILABLE:
+        return None
+    got = native.pack_stream_setop(
+        op_code, a, pack, uidpack.block_bitmaps(pack), uidpack.BITMAP_BITS
+    )
+    if got is None:
+        return None
+    out, kc = got
+    _note_kernels(kc)
+    COUNTERS.skipped_uids += max(0, pack.num_uids - int(kc[3]))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -246,6 +349,11 @@ def intersect_packed(
             _account(a, all_a)
             a = (decode_a or decode_blocks)(a, all_a)
         else:
+            # pack x pack: the adaptive per-block engine keeps BOTH sides
+            # compressed (bitmap AND / probe / galloping merge per pair)
+            got = _pair_engine(0, a, pack_b)
+            if got is not None:
+                return got
             a_idx, b_idx = candidate_block_pairs(a, pack_b)
             _account(a, a_idx)
             _account(pack_b, b_idx)
@@ -264,6 +372,11 @@ def intersect_packed(
         if got is not None:
             return got
         return a[_member_mask_direct(a, pack_b)]
+    # wide frontier: stream it against the pack's blocks (bitmap probe /
+    # in-block merge), still zero decode
+    got = _stream_engine(0, a, pack_b)
+    if got is not None:
+        return got
     b_idx = candidate_blocks_for_array(a, pack_b)
     _account(pack_b, b_idx)
     if b_idx.size == 0:
@@ -279,11 +392,16 @@ def difference_packed(
     runner=None,
 ) -> np.ndarray:
     """a \\ b with b kept packed: only b blocks overlapping a's range can
-    remove elements, so the rest never decode. `a` must be materialized
-    (every surviving element appears in the output)."""
+    remove elements, so the rest never touch. A packed `a` runs the
+    per-block pair engine (bitmap ANDNOT / probe / galloping merge) with
+    BOTH sides compressed; an array `a` streams against b's blocks."""
     decode_b = decode_b or decode_blocks
     COUNTERS.packed_ops += 1
     if isinstance(a, UidPack):
+        if a.num_uids and pack_b.nblocks and a.num_uids > _SMALL_DIRECT:
+            got = _pair_engine(1, a, pack_b)
+            if got is not None:
+                return got
         a = uidpack.decode(a)
     a = np.asarray(a, np.uint64)
     if a.size == 0:
@@ -292,6 +410,9 @@ def difference_packed(
         return a
     if a.size <= _SMALL_DIRECT:
         return a[~_member_mask_direct(a, pack_b)]
+    got = _stream_engine(1, a, pack_b)
+    if got is not None:
+        return got
     b_idx = candidate_blocks_for_array(a, pack_b)
     _account(pack_b, b_idx)
     if b_idx.size == 0:

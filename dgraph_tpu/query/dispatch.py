@@ -54,19 +54,21 @@ _DEVICE_MIN_TOTAL = (
 # A shared operand at/above this size is row-sharded over the device mesh
 # (multi-part list data plane) when >1 device is visible.
 _SHARD_MIN_B = int(config.get("SHARD_MIN_B"))
-# Packed-vs-decode crossover: a pair takes the compressed-domain block-skip
-# path (ops/packed_setops.py) when |big| >= ratio * |small| — i.e. the op
-# is selective enough that skipping non-candidate blocks beats one full
-# decode. Measured by benchmarks/tune_thresholds.py --packed-only on this
-# box (TUNE_PACKED_CPU.json, worst case: uniformly-spread 100%-hit small
-# side against a fresh 1M pack): packed holds break-even-or-better from
-# ratio 256 (1095us vs 1174us), is 2.7x faster at 1024, and 28-78x once
-# the tiny-frontier native block probe engages (ratio 1e4: 26us vs
-# 732us). Below the crossover the small side covers most blocks, so
-# skipping saves nothing and full decode + the dense kernels win — the
-# packed path falls back there. Re-tune on TPU (device dispatch shifts
-# the decoded path's cost) and pin per-deploy via env, like _min_total.
+# Packed-vs-decode crossover: an array x pack pair takes the
+# compressed-domain path (ops/packed_setops.py) when |big| >= ratio *
+# |small|. With the native adaptive block engine (bitmap/packed hybrid
+# containers, codec.cpp pack_pair_setop/pack_stream_setop) the tuned
+# crossover is 8 (TUNE_PACKED_CPU.json rows, down from the pre-engine
+# 256); pack x pack pairs bypass the gate entirely — the pair engine
+# streams BOTH operands compressed and holds break-even-or-better at
+# every ratio (pair_rows: 1.5x over decode-both even at ratio 1, with
+# ZERO decoded bytes), the per-BLOCK kernel pick inside it replacing
+# the old whole-operand cliff. Without the engine the packed path
+# decodes candidate blocks in Python, which only pays when selective:
+# packed_min_ratio() re-applies the old cliff (256) there unless the
+# env pins a value.
 _PACKED_MIN_RATIO = int(config.get("PACKED_MIN_RATIO"))
+_PACKED_FALLBACK_RATIO = 256
 _FORCE_DEVICE = bool(config.get("FORCE_DEVICE"))
 # opt-in Pallas compare-all sweep for small-side intersect buckets
 _USE_PALLAS = bool(config.get("PALLAS"))
@@ -219,16 +221,26 @@ class SetOpDispatcher:
         self._device_state: Optional[bool] = None  # None=unknown
 
     def packed_min_ratio(self) -> int:
-        """big/small size ratio above which a pair runs compressed-domain
-        (block-skip over UidPack) instead of full-decode + dense kernels.
-        Pinned from the tune_thresholds.py --packed sweep; env override
-        DGRAPH_TPU_PACKED_MIN_RATIO."""
-        return _PACKED_MIN_RATIO
+        """big/small size ratio above which an array x pack pair runs
+        compressed-domain instead of full-decode + dense kernels (tuned
+        crossover 8 with the native adaptive block engine; pack x pack
+        pairs skip the gate — the per-block kernel pick (bitmap AND /
+        bitmap probe / galloping merge / block skip) inside
+        ops/packed_setops.py subsumes the whole-operand decision there).
+        Without the engine, candidate blocks decode in Python and only
+        selective pairs pay: the pre-engine cliff (256) re-applies unless
+        DGRAPH_TPU_PACKED_MIN_RATIO is pinned explicitly."""
+        if packed_setops.engine_available() or config.is_set(
+            "PACKED_MIN_RATIO"
+        ):
+            return _PACKED_MIN_RATIO
+        return max(_PACKED_MIN_RATIO, _PACKED_FALLBACK_RATIO)
 
     def _try_packed(self, op: str, a, b) -> Optional[np.ndarray]:
         """Run one (a, b) pair compressed-domain when an operand is packed
-        and the pair clears the selectivity crossover; None -> caller takes
-        the decoded dense path. Candidate spans route back through
+        and the pair clears the selectivity crossover (ratio 1 — always —
+        when the native block engine is in); None -> caller takes the
+        decoded dense path. Fallback candidate spans route back through
         run_pairs, so big spans still hit the vmapped device kernels."""
         if all(
             not isinstance(x, PackedOperand) or x._uids is not None
@@ -239,17 +251,43 @@ class SetOpDispatcher:
             # so the dense kernels win regardless of selectivity
             return None
         r = self.packed_min_ratio()
+        # both sides compressed: the pair engine skips BOTH decodes —
+        # break-even-or-better at every ratio with zero decoded bytes
+        # (TUNE_PACKED_CPU.json pair_rows: 1.5x over decode-both even at
+        # ratio 1) — so no ratio gate when it's available
+        both = isinstance(a, PackedOperand) and isinstance(b, PackedOperand)
         if op in ("intersect", "difference") and isinstance(b, PackedOperand):
-            if len(b) >= r * max(1, len(a)):
-                if op == "intersect" and isinstance(a, PackedOperand):
-                    # both packed: forward BOTH block-cached decoders so
-                    # hot lists decode each candidate block once
-                    return packed_setops.intersect_packed(
-                        a.pack,
+            if (
+                both and packed_setops.engine_available()
+            ) or len(b) >= r * max(1, len(a)):
+                if isinstance(a, PackedOperand):
+                    # both packed: the pair engine runs block-pair kernels
+                    # with BOTH sides compressed. Intersect's fallback
+                    # forwards both block-cached decoders so hot lists
+                    # decode each candidate block once; difference needs
+                    # all of `a` materialized on the fallback, so without
+                    # the engine it goes through the operand's memoized
+                    # decode instead of a.pack (a fresh full decode).
+                    if op == "intersect":
+                        return packed_setops.intersect_packed(
+                            a.pack,
+                            b.pack,
+                            decode_b=b.decode_fn,
+                            runner=self.run_pairs,
+                            decode_a=a.decode_fn,
+                        )
+                    if packed_setops.engine_available():
+                        return packed_setops.difference_packed(
+                            a.pack,
+                            b.pack,
+                            decode_b=b.decode_fn,
+                            runner=self.run_pairs,
+                        )
+                    return packed_setops.difference_packed(
+                        _as_array(a),
                         b.pack,
                         decode_b=b.decode_fn,
                         runner=self.run_pairs,
-                        decode_a=a.decode_fn,
                     )
                 fn = (
                     packed_setops.intersect_packed

@@ -69,12 +69,15 @@ class FuncRunner:
         self, attr: str, token: bytes, src: np.ndarray
     ) -> np.ndarray:
         """index-posting-list ∩ src with the index list kept COMPRESSED
-        when the op is selective (the filter hot path: small candidate set
-        vs a huge index list, e.g. type(Person) at 1M scale). The packed-
-        vs-decoded choice is fed by StatsHolder selectivity estimates —
-        when stats say the list is below the packed crossover the decoded
-        path runs without any packed plumbing; cold stats (estimate 0)
-        defer to the actual pack size, which the dispatcher re-checks."""
+        when the op clears the (engine-tuned, now ratio-8) crossover —
+        the filter hot path: a candidate set vs a huge index list, e.g.
+        type(Person) at 1M scale. StatsHolder selectivity picks the
+        whole-operand route cheaply — when stats say the list is below
+        the crossover the decoded path runs without any packed plumbing;
+        cold stats (estimate 0) defer to the actual pack size, which the
+        dispatcher re-checks. Once packed, the adaptive engine picks per
+        BLOCK among {skip, bitmap op, probe, galloping merge} from the
+        per-block cardinality metadata (ops/packed_setops.py)."""
         if len(src) == 0:
             return EMPTY
         from dgraph_tpu.query.dispatch import DISPATCHER

@@ -1219,6 +1219,21 @@ declare_metric(
     "Stale/duplicate responses skipped while matching request ids.",
 )
 declare_metric(
+    "counter", "setop_block_bitmap_total",
+    "Block pairs run through the word-wise bitmap AND/ANDNOT kernel "
+    "(adaptive set-representation engine, ops/packed_setops.py).",
+)
+declare_metric(
+    "counter", "setop_block_gallop_total",
+    "Block pairs merged by the packed x packed galloping kernel "
+    "(neither block bitmap-eligible; offsets merged without decode).",
+)
+declare_metric(
+    "counter", "setop_block_probe_total",
+    "Block pairs where a packed block (or array run) streamed against "
+    "a bitmap container (O(1) membership probes).",
+)
+declare_metric(
     "counter", "setop_packed_total",
     "Set-op pairs routed to the compressed-domain (packed) kernels.",
 )

@@ -80,6 +80,16 @@ def _define(name: str, type_: str, default: Any, doc: str) -> Knob:
 # ---------------------------------------------------------------------------
 
 _define(
+    "BITMAP_BLOCK_BITS", "int", 2048,
+    "Fixed bitset size (bits, rounded up to a multiple of 64) for the "
+    "per-block bitmap containers: a UidPack block whose uid range fits "
+    "and whose density clears 1/8 materializes as a bitset and runs the "
+    "word-wise AND/ANDNOT kernels (codec/uidpack.py, native/codec.cpp); "
+    "dense blocks also serialize as raw bitsets. 0 disables bitmap "
+    "containers entirely — use in a mixed-version store, since records "
+    "holding bitmap blocks are unreadable by pre-bitmap builds.",
+)
+_define(
     "BULK_NATIVE", "bool", True,
     "Use the native C++ map/reduce pipeline for offline bulk loads when "
     "the compiled library is available (loaders/bulk2.py). Disable to "
@@ -182,10 +192,14 @@ _define(
     "separate cache key; empty = plain -O3 (native/__init__.py).",
 )
 _define(
-    "PACKED_MIN_RATIO", "int", 256,
-    "Packed-vs-decode crossover: an intersect takes the compressed-"
-    "domain block-skip path when |big| >= ratio * |small| "
-    "(query/dispatch.py; tuned via TUNE_PACKED_CPU.json).",
+    "PACKED_MIN_RATIO", "int", 8,
+    "Packed-vs-decode crossover for array x pack pairs: the op takes "
+    "the compressed-domain path when |big| >= ratio * |small| (query/"
+    "dispatch.py; tuned via TUNE_PACKED_CPU.json — 8 with the native "
+    "adaptive block engine, down from the pre-engine 256). Pack x pack "
+    "pairs bypass the gate entirely (the pair engine holds break-even-"
+    "or-better at every ratio with zero decode); without the native "
+    "engine an unset knob falls back to the pre-engine cliff of 256.",
 )
 _define(
     "PALLAS", "bool", False,

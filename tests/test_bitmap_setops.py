@@ -1,0 +1,321 @@
+"""Randomized equivalence suite for the adaptive set-representation
+engine (bitmap/packed hybrid containers).
+
+The per-block-pair kernels (native/codec.cpp pack_pair_setop /
+pack_stream_setop) must be byte-identical to the decoded reference path
+across every container mix: bitmap ^ bitmap (word-wise AND/ANDNOT),
+bitmap x packed probes, and packed x packed galloping merges — including
+32-bit segment boundaries, UINT32_MAX as a legal UID, all-dense blocks,
+adversarial packed/bitmap mixes inside one operand, and container
+conversion round-trips (in-memory sidecar and on-disk bitset form).
+Mirrors tests/test_packed_setops.py for the bitmap paths; re-run under
+UBSan by tests/test_native_san.py.
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.codec import uidpack
+from dgraph_tpu.ops import packed_setops as ps
+from dgraph_tpu.query.dispatch import PackedOperand, SetOpDispatcher
+
+
+def _dense_run(rng, hi, max_len=3000):
+    start = int(rng.integers(0, max(1, hi - max_len - 1)))
+    n = int(rng.integers(1, max_len))
+    run = np.arange(start, start + n, dtype=np.uint64)
+    if rng.integers(0, 2):
+        # punch random holes: still dense enough for bitmap eligibility
+        keep = rng.random(n) > 0.3
+        run = run[keep] if keep.any() else run[:1]
+    return run
+
+
+def _sparse(rng, hi, n):
+    return np.unique(rng.integers(1, hi, size=max(1, n), dtype=np.uint64))
+
+
+def _mixed(rng, hi, n):
+    """Adversarial operand: dense runs (bitmap blocks) interleaved with
+    sparse spans (packed blocks) in ONE uid set."""
+    parts = [_sparse(rng, hi, n)]
+    for _ in range(int(rng.integers(1, 4))):
+        parts.append(_dense_run(rng, hi))
+    return np.unique(np.concatenate(parts))
+
+
+def _check_all(a, b):
+    """Engine results (pack x pack, array x pack, both ops + membership)
+    == numpy exact, regardless of which per-block kernels fire."""
+    pa, pb = uidpack.encode(a), uidpack.encode(b)
+    want_i = np.intersect1d(a, b, assume_unique=True)
+    want_d = np.setdiff1d(a, b, assume_unique=True)
+    np.testing.assert_array_equal(ps.intersect_packed(a, pb), want_i)
+    np.testing.assert_array_equal(ps.intersect_packed(pa, pb), want_i)
+    np.testing.assert_array_equal(ps.difference_packed(a, pb), want_d)
+    np.testing.assert_array_equal(ps.difference_packed(pa, pb), want_d)
+    np.testing.assert_array_equal(
+        ps.membership_packed(a, pb), np.isin(a, b, assume_unique=True)
+    )
+    if ps.engine_available():
+        # drive the pair/stream engines directly too: the public entry
+        # points take the small-frontier path for tiny operands, which
+        # would leave the block kernels uncovered on small inputs
+        got = ps._pair_engine(0, pa, pb)
+        np.testing.assert_array_equal(got, want_i)
+        np.testing.assert_array_equal(ps._pair_engine(1, pa, pb), want_d)
+        np.testing.assert_array_equal(ps._stream_engine(0, a, pb), want_i)
+        np.testing.assert_array_equal(ps._stream_engine(1, a, pb), want_d)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_randomized_container_mixes(seed):
+    rng = np.random.default_rng(seed)
+    hi = int(rng.choice([1 << 14, 1 << 20, 1 << 32, 1 << 34, 1 << 45]))
+    a = _mixed(rng, hi, int(rng.integers(0, 20000)))
+    b = _mixed(rng, hi, int(rng.integers(0, 20000)))
+    if seed % 2 and len(b):
+        # force heavy overlap so results are non-trivial
+        a = np.unique(
+            np.concatenate(
+                [a, rng.choice(b, min(len(b), 500), replace=False)]
+            )
+        )
+    _check_all(a, b)
+
+
+def test_all_dense_blocks_use_bitmap_kernel():
+    """Two fully dense operands: every overlapping block pair must run
+    the bitmap AND kernel — zero decoded bytes, zero gallop merges."""
+    rng = np.random.default_rng(42)
+    base = 7 << 32
+    pool = np.arange(base, base + 100_000, dtype=np.uint64)
+    a = np.sort(rng.choice(pool, 80_000, replace=False))
+    b = np.sort(rng.choice(pool, 75_000, replace=False))
+    pa, pb = uidpack.encode(a), uidpack.encode(b)
+    assert uidpack.bitmap_eligible(pa).all()
+    assert uidpack.bitmap_eligible(pb).all()
+    if not ps.engine_available():
+        pytest.skip("native engine unavailable")
+    ps.reset_counters()
+    got = ps.intersect_packed(pa, pb)
+    np.testing.assert_array_equal(
+        got, np.intersect1d(a, b, assume_unique=True)
+    )
+    c = ps.counters()
+    assert c["bitmap_pairs"] > 0 and c["gallop_pairs"] == 0, c
+    assert c["decoded_bytes"] == 0, c
+    # ANDNOT: same pairs, difference op
+    ps.reset_counters()
+    got = ps.difference_packed(pa, pb)
+    np.testing.assert_array_equal(
+        got, np.setdiff1d(a, b, assume_unique=True)
+    )
+    assert ps.counters()["bitmap_pairs"] > 0
+
+
+def test_sparse_blocks_use_gallop_kernel():
+    rng = np.random.default_rng(43)
+    a = _sparse(rng, 1 << 33, 50_000)
+    b = _sparse(rng, 1 << 33, 60_000)
+    pa, pb = uidpack.encode(a), uidpack.encode(b)
+    assert not uidpack.bitmap_eligible(pb).any()
+    if not ps.engine_available():
+        pytest.skip("native engine unavailable")
+    ps.reset_counters()
+    got = ps.intersect_packed(pa, pb)
+    np.testing.assert_array_equal(
+        got, np.intersect1d(a, b, assume_unique=True)
+    )
+    c = ps.counters()
+    assert c["gallop_pairs"] > 0 and c["bitmap_pairs"] == 0, c
+    assert c["decoded_bytes"] == 0, c
+
+
+def test_mixed_operand_runs_probe_kernel():
+    """Dense operand vs sparse operand over the same range: overlapping
+    pairs mix containers, so the bitmap-probe kernel must fire."""
+    rng = np.random.default_rng(44)
+    dense = np.arange(1 << 20, (1 << 20) + 60_000, dtype=np.uint64)
+    sparse = np.unique(
+        rng.integers(1 << 20, (1 << 20) + 60_000, 2000, dtype=np.uint64)
+    )
+    pd, psp = uidpack.encode(dense), uidpack.encode(sparse)
+    if not ps.engine_available():
+        pytest.skip("native engine unavailable")
+    ps.reset_counters()
+    got = ps._pair_engine(0, psp, pd)
+    np.testing.assert_array_equal(
+        got, np.intersect1d(sparse, dense, assume_unique=True)
+    )
+    assert ps.counters()["probe_pairs"] > 0, ps.counters()
+
+
+def test_segment_boundaries_and_sentinels():
+    """Hi-32 boundary straddles, UINT32_MAX lo words, the all-ones UID,
+    and dense runs hugging those boundaries are all exact."""
+    m = 0xFFFFFFFF
+    edge = np.array(
+        [1, m, 1 << 32, (1 << 32) | m, 2 << 32, (1 << 64) - 1], np.uint64
+    )
+    run_at_boundary = np.arange(
+        (1 << 32) - 1000, (1 << 32) + 1000, dtype=np.uint64
+    )
+    top_run = np.arange(
+        (1 << 64) - 2000, (1 << 64) - 1, dtype=np.uint64
+    )
+    a = np.unique(np.concatenate([edge, run_at_boundary]))
+    b = np.unique(np.concatenate([run_at_boundary[::2], top_run, edge[:3]]))
+    _check_all(a, b)
+    _check_all(b, a)
+    _check_all(top_run, np.unique(np.concatenate([top_run[::3], edge])))
+
+
+def test_empty_singleton_and_disjoint():
+    empty = np.zeros((0,), np.uint64)
+    one = np.array([7], np.uint64)
+    run = np.arange(100, 400, dtype=np.uint64)
+    _check_all(empty, run)
+    _check_all(run, empty)
+    _check_all(one, run)
+    _check_all(run, one)
+    # fully disjoint dense runs: block ranges never overlap -> pure skip
+    _check_all(run, run + np.uint64(10_000))
+
+
+def test_adversarial_block_alignment():
+    """Block-boundary elements, interleaved evens/odds (every block
+    overlaps, nothing matches), and runs that straddle the bitmap
+    eligibility threshold exactly."""
+    bs = uidpack.BLOCK_SIZE
+    b = np.arange(1, 10 * bs + 1, dtype=np.uint64)
+    _check_all(b[::bs].copy(), b)
+    evens = np.arange(0, 4 * bs, 2, dtype=np.uint64)
+    odds = np.arange(1, 4 * bs, 2, dtype=np.uint64)
+    _check_all(evens, odds)
+    if uidpack.BITMAP_BITS:
+        # stride exactly at the eligibility edge: range == BITMAP_BITS-1
+        # (eligible) vs range == BITMAP_BITS (not)
+        step = max(1, (uidpack.BITMAP_BITS - 1) // (bs - 1))
+        at_edge = np.arange(0, bs, dtype=np.uint64) * np.uint64(step)
+        over_edge = at_edge.copy()
+        over_edge[-1] = np.uint64(uidpack.BITMAP_BITS)
+        _check_all(at_edge, over_edge)
+
+
+def test_sidecar_conversion_roundtrip():
+    """block_bitmaps <-> offsets conversions are exact, the sidecar is
+    cached on the pack, and the compact layout only pays for eligible
+    blocks."""
+    rng = np.random.default_rng(45)
+    u = _mixed(rng, 1 << 34, 5000)
+    p = uidpack.encode(u)
+    words, rows, ok = uidpack.block_bitmaps(p)
+    assert uidpack.block_bitmaps(p) is p._bm  # cached
+    np.testing.assert_array_equal(ok, uidpack.bitmap_eligible(p))
+    if words is None:
+        assert rows is None and not ok.any()
+        return
+    # compact: one row per eligible block, indirection covers the rest
+    assert words.shape == (int(ok.sum()), uidpack.BITMAP_WORDS)
+    np.testing.assert_array_equal(rows >= 0, ok)
+    for bi in np.flatnonzero(ok):
+        c = int(p.counts[bi])
+        offs = p.offsets[bi, :c]
+        row = words[int(rows[bi])]
+        np.testing.assert_array_equal(
+            uidpack.bitmap_to_offsets(row, uidpack.BITMAP_BITS), offs
+        )
+        np.testing.assert_array_equal(
+            uidpack.offsets_to_bitmap(offs, uidpack.BITMAP_BITS), row
+        )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_serialized_bitmap_container_roundtrip(seed):
+    """Dense blocks serialize as raw bitsets (smaller than bit-packed
+    offsets) and deserialize byte-exactly; sparse blocks keep the packed
+    form in the same record."""
+    rng = np.random.default_rng(seed + 77)
+    u = _mixed(rng, 1 << 34, 4000)
+    p = uidpack.encode(u)
+    data = uidpack.serialize(p)
+    back = uidpack.deserialize(data)
+    np.testing.assert_array_equal(uidpack.decode(back), u)
+    # a fully dense list must beat the packed-only encoding clearly
+    dense = np.arange(1 << 20, (1 << 20) + 10_000, dtype=np.uint64)
+    blob = uidpack.serialize(uidpack.encode(dense))
+    if uidpack.BITMAP_BITS:
+        assert len(blob) < len(dense)  # < 1 byte/uid (packed form is >= 1)
+    np.testing.assert_array_equal(
+        uidpack.decode(uidpack.deserialize(blob)), dense
+    )
+    # serialize_uids stays wire-compatible for the single-block fast path
+    small_dense = np.arange(500, 700, dtype=np.uint64)
+    np.testing.assert_array_equal(
+        uidpack.decode(
+            uidpack.deserialize(uidpack.serialize_uids(small_dense))
+        ),
+        small_dense,
+    )
+
+
+def test_deserialize_rejects_corrupt_bitmap_block():
+    dense = np.arange(0, 2000, dtype=np.uint64)
+    data = bytearray(uidpack.serialize(uidpack.encode(dense)))
+    # flip a payload bit: popcount no longer matches the block count
+    data[-1] ^= 0x01
+    with pytest.raises(ValueError):
+        uidpack.deserialize(bytes(data))
+
+
+def test_python_fallback_equivalence(monkeypatch):
+    """With the native lib masked out, the packed ops fall back to the
+    candidate-block decode path (and the numpy sidecar builder) and stay
+    element-exact."""
+    from dgraph_tpu import native
+
+    rng = np.random.default_rng(46)
+    a = _mixed(rng, 1 << 33, 3000)
+    b = _mixed(rng, 1 << 33, 8000)
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "NATIVE_AVAILABLE", False)
+    assert not ps.engine_available()
+    _check_all(a, b)
+    # numpy bitmap builder matches the native one bit-for-bit
+    p = uidpack.encode(np.arange(10, 3000, 3, dtype=np.uint64))
+    words_py, rows_py, ok_py = uidpack.block_bitmaps(p)
+    monkeypatch.undo()
+    p2 = uidpack.encode(np.arange(10, 3000, 3, dtype=np.uint64))
+    words_nat, rows_nat, ok_nat = uidpack.block_bitmaps(p2)
+    np.testing.assert_array_equal(ok_py, ok_nat)
+    if words_py is not None:
+        np.testing.assert_array_equal(rows_py, rows_nat)
+        np.testing.assert_array_equal(words_py, words_nat)
+
+
+def test_dispatcher_dense_pair_stays_compressed():
+    """The old whole-operand PACKED_MIN_RATIO cliff is gone for
+    pack x pack pairs: a ratio~1 dense pair runs the per-block engine
+    with ZERO decoded bytes instead of falling back to full decode."""
+    if not ps.engine_available():
+        pytest.skip("native engine unavailable")
+    rng = np.random.default_rng(47)
+    base = 3 << 33
+    pool = np.arange(base, base + 200_000, dtype=np.uint64)
+    a = np.sort(rng.choice(pool, 90_000, replace=False))
+    b = np.sort(rng.choice(pool, 100_000, replace=False))
+    d = SetOpDispatcher()
+    for op, want in (
+        ("intersect", np.intersect1d(a, b, assume_unique=True)),
+        ("difference", np.setdiff1d(a, b, assume_unique=True)),
+    ):
+        ps.reset_counters()
+        got = d.run_pairs(
+            op,
+            [(PackedOperand(uidpack.encode(a)), PackedOperand(uidpack.encode(b)))],
+        )[0]
+        np.testing.assert_array_equal(got, want)
+        c = ps.counters()
+        assert c["packed_ops"] == 1 and c["decoded_bytes"] == 0, (op, c)
+        assert c["bitmap_pairs"] > 0, (op, c)

@@ -66,7 +66,11 @@ def test_packed_setops_corpus_under_ubsan():
     r = subprocess.run(
         [
             sys.executable, "-m", "pytest",
+            # test_bitmap_setops drives the adaptive-engine kernels
+            # (bitmap AND/ANDNOT windows, probes, galloping merges)
+            # through the same adversarial corpus
             "tests/test_packed_setops.py", "tests/test_uidpack.py",
+            "tests/test_bitmap_setops.py",
             "-q", "-m", "not slow", "-p", "no:cacheprovider",
         ],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=900,

@@ -188,8 +188,11 @@ def test_dispatcher_packed_chain_and_pairs():
 
 
 def test_dispatcher_packed_fallback_below_crossover():
-    """Dense (ratio ~1) pairs must take the full-decode path — the packed
-    counters stay at zero packed ops."""
+    """A dense (ratio ~1) ARRAY x pack pair must take the full-decode
+    path — the packed counters stay at zero packed ops. (Pack x pack
+    pairs have no such cliff: the per-block engine keeps both sides
+    compressed at every ratio — tests/test_bitmap_setops.py
+    test_dispatcher_dense_pair_stays_compressed.)"""
     rng = np.random.default_rng(10)
     a = _rand(rng, 5000, hi=1 << 30)
     b = _rand(rng, 5000, hi=1 << 30)

@@ -370,6 +370,70 @@ def test_abi_catches_undeclared_and_stale():
     assert all("helper" not in v.message for v in _abi(decls))
 
 
+_SYN_BITMAP_CPP = """
+extern "C" {
+
+int64_t bitmap_and_block(const uint64_t* a_words, const uint64_t* b_words,
+                         int64_t nwords, int64_t bm_bits, uint64_t* out) {
+    return 0;
+}
+
+}  // extern "C"
+"""
+
+
+def test_abi_catches_bitmap_kernel_width_mismatch():
+    """Seeded violation for the adaptive-engine kernel class: a bitmap
+    kernel whose word-count parameter is declared c_int32 against the
+    C++ int64_t must be flagged (on a >2^31-bit operand the truncated
+    width silently corrupts the word loop's bounds)."""
+    i64 = ctypes.c_int64
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    good = {"bitmap_and_block": (i64, [u64p, u64p, i64, i64, u64p])}
+    assert (
+        check_ctypes_abi.check_abi(
+            {"native/syn_bitmap.cpp": _SYN_BITMAP_CPP},
+            good,
+            "native/__init__.py",
+        )
+        == []
+    )
+    bad = {
+        "bitmap_and_block": (
+            i64,
+            [u64p, u64p, ctypes.c_int32, i64, u64p],
+        )
+    }
+    out = check_ctypes_abi.check_abi(
+        {"native/syn_bitmap.cpp": _SYN_BITMAP_CPP},
+        bad,
+        "native/__init__.py",
+    )
+    assert [v.code for v in out] == ["arg-type-mismatch"]
+    assert "bitmap_and_block" in out[0].message and "arg 2" in out[0].message
+
+
+def test_abi_covers_adaptive_engine_exports():
+    """The real adaptive-engine entry points are parsed from codec.cpp
+    and covered by DECLS (regression guard for the new kernels)."""
+    from dgraph_tpu import native
+
+    with open(
+        os.path.join(REPO, "dgraph_tpu", "native", "codec.cpp")
+    ) as f:
+        exports = check_ctypes_abi.parse_cpp_exports(f.read())
+    for name in (
+        "pack_build_bitmaps",
+        "pack_pair_setop",
+        "pack_stream_setop",
+    ):
+        assert name in exports, name
+        assert name in native.DECLS, name
+        # arity agrees (full width/signedness equality is the analyzer's
+        # job — test_abi_real_package_is_clean keeps it at zero findings)
+        assert len(exports[name][1]) == len(native.DECLS[name][1]), name
+
+
 def test_abi_real_package_is_clean():
     # re-derive from the real sources; independent of the full gate so a
     # regression pinpoints here

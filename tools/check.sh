@@ -28,7 +28,8 @@ else
     echo "== tier-1 smoke subset =="
     python -m pytest \
         tests/test_setops.py tests/test_uidpack.py \
-        tests/test_packed_setops.py tests/test_posting.py \
+        tests/test_packed_setops.py tests/test_bitmap_setops.py \
+        tests/test_posting.py \
         tests/test_storage.py tests/test_raft.py \
         tests/test_replicated_zero.py tests/test_cluster_facade.py \
         tests/test_observability.py tests/test_distributed_tracing.py \
