@@ -1,0 +1,345 @@
+"""Closed-loop multi-client QPS harness for the serving front.
+
+Models the north-star workload — thousands of concurrent *small*
+queries — against one in-process engine: C closed-loop clients each
+issue the next query the moment the previous one returns (offered load
+rises with C), over a small pool of hot query shapes with rotating
+literals (the plan cache's serving regime). Each point reports achieved
+QPS, p50/p99 latency of accepted executions, and the serving-front
+counters (coalesced tasks, plan-cache hits, sheds, degrades).
+
+Modes swept per client count:
+
+  batch_off  — BATCH_WINDOW_US=0, ADMISSION off: the pre-serving-front
+               path (PR 2/6 per-query machinery only).
+  batch_on   — the micro-batcher coalescing cross-query level tasks.
+  admission  — batching + admission control with a deliberately small
+               in-flight budget, driven PAST saturation: sheds are
+               retried client-side with backoff (conn/retry
+               .retrying_call); p99 of accepted work must stay bounded
+               instead of collapsing with the queue.
+
+Usage:
+  python benchmarks/qps_loadgen.py                 # full sweep -> BENCH_QPS.json
+  python benchmarks/qps_loadgen.py --seconds 5
+  python benchmarks/qps_loadgen.py --sanity        # ~5s smoke (CI gate)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import stamp  # noqa: E402
+
+N_ENTITIES = 4000
+HOT_LITERALS = 256  # entity names the clients rotate over
+
+
+def build_server(memlayer_entries: int = 512, n_entities: int = N_ENTITIES):
+    """In-process engine in the at-scale serving regime: the working
+    set deliberately EXCEEDS the decoded-list cache (MEMLAYER_ENTRIES),
+    so level reads pay real decode work per dispatch — a store serving
+    millions of users never has every posting list decoded in RAM. A
+    fully cache-resident store makes level reads ~µs and cross-query
+    batching rationally a no-op (the behind-running batcher adds no
+    idle latency there, but has nothing to win either)."""
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.x import config
+
+    if memlayer_entries:
+        config.set_env("MEMLAYER_ENTRIES", memlayer_entries)
+    s = Server()
+    s.alter(
+        "name: string @index(exact) .\n"
+        "age: int @index(int) .\n"
+        "knows: [uid] @reverse .\n"
+        "city: string .\n"
+    )
+    lines = []
+    for u in range(1, n_entities + 1):
+        # unique names: each query roots at ONE entity — the small-query
+        # serving regime the front exists for (thousands of concurrent
+        # point-ish queries, not a handful of giant scans)
+        lines.append(f'<{hex(u)}> <name> "user{u}" .')
+        lines.append(f'<{hex(u)}> <age> "{u % 70}"^^<xs:int> .')
+        lines.append(f'<{hex(u)}> <city> "city{u % 12}" .')
+        for k in range(1, 5):
+            v = (u * 7 + k * 131) % n_entities + 1
+            if v != u:
+                lines.append(f"<{hex(u)}> <knows> <{hex(v)}> .")
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf="\n".join(lines), commit_now=True)
+    return s
+
+
+QUERY_SHAPES = [
+    # 2-level expansion off an exact-index root: the hot serving shape
+    '{{ q(func: eq(name, "user{i}")) {{ name age knows {{ name }} }} }}',
+    # 3-level traversal
+    '{{ q(func: eq(name, "user{i}")) '
+    "{{ name knows {{ name knows {{ name }} }} }} }}",
+    # filter + count
+    '{{ q(func: eq(name, "user{i}")) @filter(lt(age, 50)) '
+    "{{ name cnt: count(knows) }} }}",
+]
+
+
+def client_queries(rng_state: int):
+    """Deterministic per-client query stream over the hot shapes."""
+    i = rng_state
+    while True:
+        shape = QUERY_SHAPES[i % len(QUERY_SHAPES)]
+        yield shape.format(i=(i * 13 + rng_state) % HOT_LITERALS + 1)
+        i += 1
+
+
+def run_point(server, clients: int, seconds: float, warmup: float):
+    """One closed-loop measurement point. Returns the row dict."""
+    from dgraph_tpu.conn.retry import RetryPolicy, retrying_call
+    from dgraph_tpu.serving import TooManyRequestsError
+    from dgraph_tpu.utils.observe import METRICS
+
+    counters = (
+        "batch_coalesced_total", "plan_cache_hit_total",
+        "plan_cache_miss_total", "admission_shed_total",
+        "admission_degraded_total",
+    )
+    lat_lock = threading.Lock()
+    lats: list = []
+    sheds = [0]
+    stop = threading.Event()
+    go = threading.Event()
+    started = threading.Barrier(clients + 1)
+
+    def client(cid: int):
+        stream = client_queries(cid)
+        started.wait()
+        go.wait()
+        policy = RetryPolicy(base=0.002, cap=0.05, max_attempts=6)
+        while not stop.is_set():
+            q = next(stream)
+            t0 = time.perf_counter()
+
+            def attempt():
+                try:
+                    return server.query(q)
+                except TooManyRequestsError:
+                    sheds[0] += 1
+                    t_shed = time.perf_counter()  # restart the clock:
+                    # p50/p99 measure ACCEPTED executions; the shed
+                    # count reports refused offered load separately
+                    nonlocal_t0[0] = t_shed
+                    raise
+
+            nonlocal_t0 = [t0]
+            try:
+                retrying_call(
+                    attempt, policy=policy,
+                    retryable=(TooManyRequestsError,),
+                )
+            except TooManyRequestsError:
+                continue  # retries exhausted: offered load refused
+            except Exception:
+                continue
+            took = (time.perf_counter() - nonlocal_t0[0]) * 1e3
+            with lat_lock:
+                lats.append(took)
+
+    threads = [
+        threading.Thread(target=client, args=(c,)) for c in range(clients)
+    ]
+    for th in threads:
+        th.start()
+    started.wait()
+    go.set()
+    time.sleep(warmup)
+    with lat_lock:
+        lats.clear()
+    base = {k: METRICS.value(k) for k in counters}
+    shed0 = sheds[0]
+    t_start = time.perf_counter()
+    time.sleep(seconds)
+    stop.set()
+    elapsed = time.perf_counter() - t_start
+    for th in threads:
+        th.join()
+    with lat_lock:
+        done = sorted(lats)
+    row = {
+        "clients": clients,
+        "completed": len(done),
+        "qps": round(len(done) / elapsed, 1),
+        "p50_ms": round(done[len(done) // 2], 3) if done else None,
+        "p99_ms": (
+            round(done[min(len(done) - 1, int(len(done) * 0.99))], 3)
+            if done
+            else None
+        ),
+        "shed": sheds[0] - shed0,
+    }
+    for k in counters:
+        row[k.replace("_total", "")] = int(METRICS.value(k) - base[k])
+    return row
+
+
+def sweep(args) -> dict:
+    from dgraph_tpu.x import config
+
+    server = build_server(args.memlayer_entries, args.entities)
+    # prime caches/JIT so mode points compare steady states
+    for q in (s.format(i=0) for s in QUERY_SHAPES):
+        server.query(q)
+
+    modes = [
+        ("batch_off", {"BATCH_WINDOW_US": 0, "ADMISSION": 0}),
+        (
+            "batch_on",
+            {"BATCH_WINDOW_US": args.window_us, "ADMISSION": 0},
+        ),
+        (
+            "admission",
+            {
+                "BATCH_WINDOW_US": args.window_us,
+                "ADMISSION": 1,
+                "MAX_INFLIGHT": args.max_inflight,
+            },
+        ),
+    ]
+    # modes INTERLEAVED per point and medianed over repetitions: this
+    # box shows minute-scale load variance far larger than the effects
+    # under test, so sequential per-mode sweeps compare weather, not
+    # code. Interleaving puts every mode in the same weather.
+    import statistics
+
+    samples = {name: {c: [] for c in args.clients} for name, _ in modes}
+    for rep in range(args.reps):
+        for clients in args.clients:
+            for name, env in modes:
+                for k, v in env.items():
+                    config.set_env(k, v)
+                row = run_point(
+                    server, clients, args.seconds, args.warmup
+                )
+                for k in env:
+                    config.unset_env(k)
+                samples[name][clients].append(row)
+                print(
+                    f"[rep{rep} {name}] c={clients:3d} "
+                    f"qps={row['qps']:8.1f} p50={row['p50_ms']}ms "
+                    f"p99={row['p99_ms']}ms shed={row['shed']} "
+                    f"coalesced={row['batch_coalesced']}",
+                    flush=True,
+                )
+
+    def median_row(rows):
+        out = dict(rows[0])
+        for k in ("qps", "p50_ms", "p99_ms"):
+            vals = [r[k] for r in rows if r[k] is not None]
+            out[k] = round(statistics.median(vals), 3) if vals else None
+        for k in rows[0]:
+            if k.startswith(("batch_", "plan_", "admission_")) or k in (
+                "shed", "completed"
+            ):
+                out[k] = int(statistics.median([r[k] for r in rows]))
+        out["reps"] = len(rows)
+        return out
+
+    results = {}
+    for name, _ in modes:
+        rows = []
+        for clients in args.clients:
+            row = median_row(samples[name][clients])
+            row["mode"] = name
+            rows.append(row)
+        results[name] = rows
+
+    # headline: the KNEE is the highest sustainable offered load — the
+    # concurrency point where batching-on throughput peaks; beyond it
+    # closed-loop clients only oversubscribe the scheduler (on a 1-core
+    # box, thread-scheduling luck dominates both modes there, and
+    # admission — not batching — is what keeps p99 bounded). The top
+    # (most oversubscribed) point is reported alongside.
+    top = args.clients[-1]
+
+    def at(m, c):
+        return next(r for r in results[m] if r["clients"] == c)
+
+    multi = [r for r in results["batch_on"] if r["clients"] > 1]
+    knee = (
+        max(multi, key=lambda r: r["qps"])["clients"] if multi else top
+    )
+    headline = {
+        "knee_clients": knee,
+        "qps_batch_off_at_knee": at("batch_off", knee)["qps"],
+        "qps_batch_on_at_knee": at("batch_on", knee)["qps"],
+        "p99_batch_off_at_knee_ms": at("batch_off", knee)["p99_ms"],
+        "p99_batch_on_at_knee_ms": at("batch_on", knee)["p99_ms"],
+        "clients_at_top": top,
+        "p99_batch_off_at_top_ms": at("batch_off", top)["p99_ms"],
+        "p99_batch_on_at_top_ms": at("batch_on", top)["p99_ms"],
+        "p99_admission_at_top_ms": at("admission", top)["p99_ms"],
+        "shed_at_top_admission": at("admission", top)["shed"],
+        "window_us": args.window_us,
+    }
+    return {"rows": results, "headline": headline}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--warmup", type=float, default=0.5)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--window-us", type=int, default=500)
+    ap.add_argument("--max-inflight", type=int, default=8)
+    ap.add_argument(
+        "--memlayer-entries", type=int, default=512,
+        help="decoded-list cache bound; the default keeps the working "
+        "set larger than the cache (the at-scale regime; 0 = engine "
+        "default)",
+    )
+    ap.add_argument(
+        "--clients", type=int, nargs="+", default=[1, 4, 8, 16]
+    )
+    ap.add_argument("--entities", type=int, default=N_ENTITIES)
+    ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--sanity", action="store_true",
+        help="~5s smoke run (CI gate): no artifact written",
+    )
+    args = ap.parse_args(argv)
+    if args.sanity:
+        args.seconds, args.warmup, args.reps = 0.6, 0.15, 1
+        args.clients = [2, 4]
+        args.entities = 600
+    out = sweep(args)
+    if args.sanity:
+        top = out["headline"]
+        ok = (
+            all(r["completed"] > 0 for rows in out["rows"].values()
+                for r in rows)
+        )
+        print(f"sanity: {'OK' if ok else 'FAIL'} {top}")
+        return 0 if ok else 1
+    import jax
+
+    path = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_QPS.json",
+    )
+    written = stamp.guarded_write(path, out, jax.default_backend())
+    print(f"wrote {written}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,6 +22,7 @@ from dgraph_tpu.acl.jwt import JwtError
 from dgraph_tpu.dql.parser import ParseError
 from dgraph_tpu.query.functions import QueryError
 from dgraph_tpu.api.server import Server, TxnHandle
+from dgraph_tpu.serving import TooManyRequestsError
 from dgraph_tpu.zero.zero import TxnConflictError
 
 
@@ -342,6 +343,22 @@ class _Handler(BaseHTTPRequestHandler):
                 ).start()
             else:
                 self._error(f"no route {path}", 404)
+        except TooManyRequestsError as e:
+            # admission shed: retryable — clients back off and resend
+            self._reply(
+                {
+                    "errors": [
+                        {
+                            "message": str(e),
+                            "extensions": {
+                                "code": TooManyRequestsError.code,
+                                "retryable": True,
+                            },
+                        }
+                    ]
+                },
+                429,
+            )
         except TxnConflictError as e:
             self._error(f"Transaction has been aborted. Please retry. {e}", 409)
         except (AclError, JwtError) as e:

@@ -246,6 +246,19 @@ class Server:
         from dgraph_tpu.utils.cmsketch import StatsHolder
 
         self.stats = StatsHolder()  # selectivity stats (auto-fed on commit)
+        from dgraph_tpu.serving import ServingFront
+
+        # high-QPS serving front: plan cache + cross-query micro-batcher
+        # + admission control (serving/). _snapshot_ts is the batcher's
+        # snapshot watermark: the last commit made VISIBLE (published
+        # before zero.applied, the barrier read_ts waits on), so two
+        # fresh read timestamps covering the same watermark coalesce.
+        self._snapshot_ts = 0
+        self.serving = ServingFront(
+            stats=self.stats,
+            schema_fn=lambda: self.schema,
+            last_commit_fn=lambda: self._snapshot_ts,
+        )
         self._bootstrap_schema()
         if data_dir is not None:
             self._load_persisted_state()
@@ -398,6 +411,17 @@ class Server:
     # -- alter (ref edgraph/server.go:355) -----------------------------------
 
     def alter(self, schema_text: str = "", drop_attr: str = "", drop_all: bool = False):
+        self.serving.on_commit()  # schema changes invalidate cached plans
+        try:
+            return self._alter_inner(schema_text, drop_attr, drop_all)
+        finally:
+            # alters write outside the txn/applied barrier: advance the
+            # batcher watermark past every read_ts allocated during the
+            # alter, so queries that raced the (non-transactional)
+            # schema writes never coalesce with post-alter traffic
+            self._snapshot_ts = self.zero.next_ts()
+
+    def _alter_inner(self, schema_text, drop_attr, drop_all):
         with self._lock:
             if drop_all:
                 # wipe every key (data + persisted schema/types) so a
@@ -500,9 +524,14 @@ class Server:
             try:
                 txn.write_deltas(self.kv, commit_ts)
             finally:
+                # watermark BEFORE the apply barrier: any read_ts
+                # allocated after this commit becomes visible observes
+                # the advanced watermark (micro-batcher snapshot key)
+                self._snapshot_ts = commit_ts
                 self.zero.applied(commit_ts)
         METRICS.inc("num_commits")
         self.mem.invalidate(txn.cache.deltas.keys())
+        self.serving.on_commit()  # commit-epoch plan invalidation
         self._feed_stats(txn.cache.deltas)
         cdc = getattr(self, "_cdc", None)
         if cdc is not None:
@@ -525,13 +554,9 @@ class Server:
     def _feed_stats(self, deltas):
         """Count index-key postings into the cm-sketch (ref posting/stats
         collection feeding planForEqFilter)."""
-        for key, posts in deltas.items():
-            try:
-                pk = keys.parse_key(key)
-            except Exception:
-                continue
-            if pk.is_index and posts:
-                self.stats.record(pk.attr, pk.term, len(posts))
+        from dgraph_tpu.utils.cmsketch import feed_stats
+
+        feed_stats(self.stats, deltas)
 
     # -- mutations -------------------------------------------------------------
 
@@ -803,72 +828,144 @@ class Server:
         import time as _time
 
         t_begin = _time.monotonic()
-        ts = read_ts if read_ts is not None else self.zero.read_ts()
-        t_assigned = _time.monotonic()
-        blocks = dql.parse(q, variables)
+        # plan cache: repeated query shapes skip parse entirely
+        blocks, shape = self.serving.parse(q, variables)
         t_parsed = _time.monotonic()
-        ns = keys.GALAXY_NS
-        allowed = None
-        user = ""
-        if self.acl is not None:
-            from dgraph_tpu.acl.acl import READ, AclError
+        # admission gate BEFORE the read-ts allocation: a shed must be
+        # FAST and side-effect-free — under overload the oracle's
+        # applied-barrier wait is exactly where queries queue, and a
+        # request that will be refused must neither join that queue
+        # nor lease a timestamp
+        ticket = self.serving.admit(shape, blocks)
+        slow = False
+        completed = False  # clean, untruncated execution
+        try:
+            ns = keys.GALAXY_NS
+            allowed = None
+            user = ""
+            if self.acl is not None:
+                from dgraph_tpu.acl.acl import READ, AclError
 
-            try:
-                if access_jwt is None:
-                    raise AclError("no access token (ACL enabled)")
-                claims = self.acl.claims(access_jwt)
-                user = claims.get("userid", "")
-                ns = int(claims.get("namespace", 0))
-                self.acl.authorize_preds(
-                    access_jwt, _query_preds(blocks), READ, claims=claims
-                )
-                allowed = self.acl.readable_preds(claims)
-            except Exception:
-                self._audit("query", user=user, body=q, status="DENIED")
-                raise
-        self._audit("query", user=user, ns=ns, body=q)
-        from dgraph_tpu.utils import observe
-        from dgraph_tpu.utils.observe import METRICS, TRACER, profile_scope
-
-        t0 = _time.monotonic()
-        deadline = (
-            _time.monotonic() + timeout_ms / 1e3
-            if timeout_ms is not None
-            else None
-        )
-        with TRACER.span("query", ns=ns) as root, profile_scope() as prof, \
-                METRICS.timer("query_latency_seconds"):
-            out = self._query_parsed(
-                blocks,
-                LocalCache(self.kv, ts, mem=self.mem),
-                ns,
-                allowed,
-                deadline=deadline,
+                try:
+                    if access_jwt is None:
+                        raise AclError("no access token (ACL enabled)")
+                    claims = self.acl.claims(access_jwt)
+                    user = claims.get("userid", "")
+                    ns = int(claims.get("namespace", 0))
+                    self.acl.authorize_preds(
+                        access_jwt, _query_preds(blocks), READ,
+                        claims=claims,
+                    )
+                    allowed = self.acl.readable_preds(claims)
+                except Exception:
+                    self._audit("query", user=user, body=q, status="DENIED")
+                    raise
+            self._audit("query", user=user, ns=ns, body=q)
+            from dgraph_tpu.query.functions import QueryBudgetError
+            from dgraph_tpu.utils import observe
+            from dgraph_tpu.utils.observe import (
+                METRICS,
+                TRACER,
+                profile_scope,
             )
-        METRICS.inc("num_queries")
-        t_done = _time.monotonic()
-        took_ms = (t_done - t_begin) * 1e3
-        ext = out.setdefault("extensions", {})
-        ext["server_latency"] = {
-            "assign_timestamp_ns": int((t_assigned - t_begin) * 1e9),
-            "parsing_ns": int((t_parsed - t_assigned) * 1e9),
-            # everything after parse (ACL/audit + execution) so the
-            # components sum to total_ns with no unattributed gap
-            "processing_ns": int((t_done - t_parsed) * 1e9),
-            "encoding_ns": 0,  # encoding happens inside _query_parsed
-            "total_ns": int((t_done - t_begin) * 1e9),
-        }
-        ext["profile"] = prof.to_dict()
-        if root.trace_id:
-            ext["trace_id"] = f"{root.trace_id:032x}"
-        # structured slow-query log (ref x/log.go LogSlowOperation,
-        # edgraph/server.go:1448): force-sample + bounded JSONL
-        observe.maybe_log_slow(
-            "query", q, took_ms, root,
-            extra={"ns": ns},
-            threshold_ms=self.slow_query_ms,
-        )
-        return out
+
+            deadline = (
+                _time.monotonic() + timeout_ms / 1e3
+                if timeout_ms is not None
+                else None
+            )
+            degrade_deadline = None
+            if ticket.degrade:
+                # saturated: run under a bounded budget and return a
+                # partial/degraded response on exhaustion instead of
+                # queueing at full budget (PR 3's partial-result shape)
+                degrade_deadline = (
+                    _time.monotonic() + self.serving.degrade_budget_s()
+                )
+                deadline = (
+                    degrade_deadline
+                    if deadline is None
+                    else min(deadline, degrade_deadline)
+                )
+            truncated = False
+            ts = read_ts if read_ts is not None else self.zero.read_ts()
+            t_assigned = _time.monotonic()
+            with TRACER.span("query", ns=ns) as root, \
+                    profile_scope() as prof, \
+                    METRICS.timer("query_latency_seconds"):
+                try:
+                    cache = LocalCache(self.kv, ts, mem=self.mem)
+                    # caller-pinned read_ts never coalesces: the
+                    # snapshot-watermark argument only covers fresh
+                    # engine-allocated timestamps (which waited on the
+                    # applied barrier)
+                    out = self._query_parsed(
+                        blocks,
+                        cache,
+                        ns,
+                        allowed,
+                        deadline=deadline,
+                        batcher=(
+                            self.serving.batcher_for(cache)
+                            if read_ts is None
+                            else None
+                        ),
+                    )
+                except QueryBudgetError:
+                    # only the degraded-admission budget converts a
+                    # deadline trip into a partial result; semantic
+                    # errors (different type) and a tighter CLIENT
+                    # timeout (trips before the degrade budget) raise
+                    if (
+                        degrade_deadline is None
+                        or _time.monotonic() < degrade_deadline
+                    ):
+                        raise
+                    out = {"data": {}}
+                    truncated = True
+            METRICS.inc("num_queries")
+            t_done = _time.monotonic()
+            took_ms = (t_done - t_begin) * 1e3
+            ext = out.setdefault("extensions", {})
+            ext["server_latency"] = {
+                # new order: parse -> admission/ACL/ts -> execute; the
+                # admission + ACL + audit time rides in the assign
+                # component so the parts still sum to total_ns with no
+                # unattributed gap
+                "parsing_ns": int((t_parsed - t_begin) * 1e9),
+                "assign_timestamp_ns": int((t_assigned - t_parsed) * 1e9),
+                "processing_ns": int((t_done - t_assigned) * 1e9),
+                "encoding_ns": 0,  # encoding happens inside _query_parsed
+                "total_ns": int((t_done - t_begin) * 1e9),
+            }
+            ext["profile"] = prof.to_dict()
+            if root.trace_id:
+                ext["trace_id"] = f"{root.trace_id:032x}"
+            if ticket.degrade:
+                ext["degraded_admission"] = True
+            if truncated:
+                METRICS.inc("degraded_queries_total")
+                ext["degraded"] = True
+                ext["partial"] = True
+            # structured slow-query log (ref x/log.go LogSlowOperation,
+            # edgraph/server.go:1448): force-sample + bounded JSONL
+            slow = observe.maybe_log_slow(
+                "query", q, took_ms, root,
+                extra={"ns": ns},
+                threshold_ms=self.slow_query_ms,
+            )
+            completed = not truncated
+            return out
+        finally:
+            # only clean completions feed the shape cost EWMA: a
+            # truncated/denied/failed run's latency describes the
+            # failure, not the shape
+            self.serving.finish(
+                ticket,
+                shape if completed else None,
+                (_time.monotonic() - t_begin) * 1e3,
+                slow=slow,
+            )
 
     def query_rdf(
         self,
@@ -948,6 +1045,7 @@ class Server:
         ns: int,
         allowed_preds=None,
         deadline=None,
+        batcher=None,
     ) -> dict:
         if len(blocks) == 1 and blocks[0].attr == "__schema__":
             return self._schema_query(blocks[0])
@@ -959,6 +1057,7 @@ class Server:
             allowed_preds=allowed_preds,
             stats=self.stats,
             deadline=deadline,
+            batcher=batcher,
         )
         nodes = ex.process(blocks)
         enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)

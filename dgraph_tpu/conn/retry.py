@@ -96,6 +96,41 @@ class RetryPolicy:
         return d
 
 
+def retrying_call(
+    fn,
+    policy: Optional[RetryPolicy] = None,
+    deadline: Optional[Deadline] = None,
+    retryable: tuple = (),
+):
+    """Run `fn()` with backoff-retry on `retryable` exception types —
+    the client-side contract of the serving front's admission gate
+    (TooManyRequestsError is retryable: shed fast, retry with backoff).
+    Also retries any exception whose `retryable` attribute is true.
+    Always bounded: the default policy caps attempts, and a policy with
+    max_attempts=0 MUST come with a deadline (an unbounded retry loop
+    against a persistently-shedding server would never return)."""
+    policy = policy or RetryPolicy(base=0.005, cap=0.25, max_attempts=8)
+    if not policy.max_attempts and deadline is None:
+        raise ValueError(
+            "retrying_call needs a bounded policy (max_attempts) or a "
+            "deadline"
+        )
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as exc:
+            is_retryable = isinstance(exc, retryable) or bool(
+                getattr(exc, "retryable", False)
+            )
+            attempt += 1
+            if not is_retryable or policy.exhausted(attempt) or (
+                deadline is not None and deadline.expired()
+            ):
+                raise
+            policy.sleep(attempt, deadline)
+
+
 def poll_policy(interval_s: float) -> RetryPolicy:
     """Jittered fixed-cadence poll: every attempt sleeps
     uniform(0, 2*interval), so the MEAN period equals `interval_s` (the

@@ -1,1 +1,8 @@
-from dgraph_tpu.dql.parser import parse, GraphQuery, FilterTree, FuncSpec, ParseError
+from dgraph_tpu.dql.parser import (
+    FilterTree,
+    FuncSpec,
+    GraphQuery,
+    ParseError,
+    parse,
+    tokenize,  # the serving-front plan cache normalizes over raw tokens
+)

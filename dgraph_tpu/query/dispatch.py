@@ -217,6 +217,10 @@ class SetOpDispatcher:
 
     def __init__(self):
         self._jit_cache: Dict[Tuple[str, int, int], object] = {}
+        # serializes first-compilation per (op, shape) key: under
+        # concurrent high-QPS traffic two queries hitting the same
+        # cold bucket must not both pay the XLA compile
+        self._jit_lock = threading.Lock()
         self.device_cache = DeviceCache()
         self._device_state: Optional[bool] = None  # None=unknown
 
@@ -637,11 +641,15 @@ class SetOpDispatcher:
         key = (op + "#chain", k, pad)
         fn = self._jit_cache.get(key)
         if fn is None:
-            base = (
-                setops.intersect_many if op == "intersect" else setops.merge_sorted
-            )
-            fn = jax.jit(base)
-            self._jit_cache[key] = fn
+            with self._jit_lock:
+                fn = self._jit_cache.get(key)
+                if fn is None:
+                    base = (
+                        setops.intersect_many
+                        if op == "intersect"
+                        else setops.merge_sorted
+                    )
+                    fn = self._jit_cache[key] = jax.jit(base)
         return fn
 
     def _run_rows_sharded(self, op, rows, b, b_token):
@@ -706,13 +714,17 @@ class SetOpDispatcher:
         key = (op + "#shared", pa, pb)
         fn = self._jit_cache.get(key)
         if fn is None:
-            base = {
-                "intersect": setops.intersect,
-                "difference": setops.difference,
-                "union": setops.union,
-            }[op]
-            fn = jax.jit(jax.vmap(base, in_axes=(0, 0, None, None)))
-            self._jit_cache[key] = fn
+            with self._jit_lock:
+                fn = self._jit_cache.get(key)
+                if fn is None:
+                    base = {
+                        "intersect": setops.intersect,
+                        "difference": setops.difference,
+                        "union": setops.union,
+                    }[op]
+                    fn = self._jit_cache[key] = jax.jit(
+                        jax.vmap(base, in_axes=(0, 0, None, None))
+                    )
         return fn
 
     # -- public API ---------------------------------------------------------
@@ -808,21 +820,24 @@ class SetOpDispatcher:
         key = (op, pa, pb)
         fn = self._jit_cache.get(key)
         if fn is None:
-            base = {
-                "intersect": setops.intersect,
-                "difference": setops.difference,
-                "union": setops.union,
-            }[op]
-            if _USE_PALLAS and op == "intersect" and pa <= 128:
-                from dgraph_tpu.ops import pallas_setops
+            with self._jit_lock:
+                fn = self._jit_cache.get(key)
+                if fn is None:
+                    base = {
+                        "intersect": setops.intersect,
+                        "difference": setops.difference,
+                        "union": setops.union,
+                    }[op]
+                    if _USE_PALLAS and op == "intersect" and pa <= 128:
+                        from dgraph_tpu.ops import pallas_setops
 
-                # batch-aware pallas entry point — do NOT vmap a
-                # single-example pallas kernel (TPU lowering rejects the
-                # Squeezed SMEM blocks vmap produces)
-                fn = jax.jit(pallas_setops.intersect_batch)
-            else:
-                fn = jax.jit(jax.vmap(base))
-            self._jit_cache[key] = fn
+                        # batch-aware pallas entry point — do NOT vmap a
+                        # single-example pallas kernel (TPU lowering
+                        # rejects the Squeezed SMEM blocks vmap produces)
+                        fn = jax.jit(pallas_setops.intersect_batch)
+                    else:
+                        fn = jax.jit(jax.vmap(base))
+                    self._jit_cache[key] = fn
         return fn
 
     def _run_bucket(self, op, pa, pb, jobs):

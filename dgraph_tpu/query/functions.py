@@ -27,6 +27,13 @@ class QueryError(Exception):
     pass
 
 
+class QueryBudgetError(QueryError):
+    """The query exceeded its execution time budget (deadline trip) —
+    distinct from semantic QueryErrors so the degraded-admission path
+    can convert ONLY budget trips into partial responses, never mask a
+    genuine execution error."""
+
+
 def _as_uids(xs) -> np.ndarray:
     return np.array(sorted(set(int(x) for x in xs)), dtype=np.uint64)
 

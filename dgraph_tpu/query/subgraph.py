@@ -52,10 +52,56 @@ from dgraph_tpu.x import config, keys
 _EXPAND_POOLS: Dict[int, ThreadPoolExecutor] = {}
 _EXPAND_POOL_LOCK = threading.Lock()
 _EXPAND_TLS = threading.local()
+# tasks submitted to a pool but not yet running — the pool's REAL
+# backpressure (guarded by _EXPAND_POOL_LOCK, published as the
+# exec_pool_queue_depth gauge). A submit that would push the backlog
+# past workers * _POOL_QUEUE_BOUND is refused and the caller expands
+# inline instead, so the queue can never grow without bound.
+_POOL_QUEUED = 0
+_POOL_QUEUE_BOUND = 4
 
 
 def _exec_workers() -> int:
     return int(config.get("EXEC_WORKERS"))
+
+
+def pool_backpressure() -> Tuple[int, int]:
+    """(queued_not_started_tasks, configured_workers) — what admission
+    control reads instead of guessing saturation from query counts."""
+    with _EXPAND_POOL_LOCK:
+        return _POOL_QUEUED, _exec_workers()
+
+
+def _publish_pool_depth_locked() -> None:
+    METRICS.set_gauge("exec_pool_queue_depth", float(_POOL_QUEUED))
+
+
+def _submit_bounded(pool: ThreadPoolExecutor, workers: int, call, *args):
+    """Bounded pool submit: returns a Future, or None when the pool's
+    backlog is at the bound (the caller runs the task inline). The
+    queued count drops when the task STARTS, so the gauge measures
+    waiting work, not running work."""
+    global _POOL_QUEUED
+    with _EXPAND_POOL_LOCK:
+        if _POOL_QUEUED >= workers * _POOL_QUEUE_BOUND:
+            return None
+        _POOL_QUEUED += 1
+        _publish_pool_depth_locked()
+
+    def _run():
+        global _POOL_QUEUED
+        with _EXPAND_POOL_LOCK:
+            _POOL_QUEUED -= 1
+            _publish_pool_depth_locked()
+        return call(*args)
+
+    try:
+        return pool.submit(_run)
+    except BaseException:
+        with _EXPAND_POOL_LOCK:
+            _POOL_QUEUED -= 1
+            _publish_pool_depth_locked()
+        raise
 
 
 def _expand_pool(workers: int) -> ThreadPoolExecutor:
@@ -111,11 +157,16 @@ class Executor:
         allowed_preds=None,
         stats=None,
         deadline: Optional[float] = None,
+        batcher=None,
     ):
         self.cache = cache
         self.st = st
         self.ns = ns
         self.stats = stats
+        # cross-query micro-batcher (serving/microbatch.py): when set,
+        # level-task reads may coalesce with other in-flight queries at
+        # the same read snapshot; None = today's direct path
+        self.batcher = batcher
         # absolute time.monotonic() budget (ref x/limits query timeout);
         # checked at block and expansion boundaries
         self.deadline = deadline
@@ -160,7 +211,9 @@ class Executor:
             import time as _time
 
             if _time.monotonic() > self.deadline:
-                raise QueryError("query exceeded its time budget")
+                from dgraph_tpu.query.functions import QueryBudgetError
+
+                raise QueryBudgetError("query exceeded its time budget")
 
     def process(self, blocks: List[GraphQuery]) -> List[ExecNode]:
         pending = list(blocks)
@@ -593,18 +646,22 @@ class Executor:
                 pool = _expand_pool(workers)
                 # each subtree runs under a COPY of this context so
                 # worker threads inherit the query's span parent and
-                # profile instead of starting orphan traces
-                futs = [
-                    (
-                        cgq,
-                        pool.submit(
-                            contextvars.copy_context().run,
-                            self._expand_one_worker, node, cgq, depth,
-                        ),
+                # profile instead of starting orphan traces; a full
+                # pool backlog refuses the submit (fut None) and the
+                # subtree expands inline on the serial path below
+                futs = []
+                for cgq in par:
+                    fut = _submit_bounded(
+                        pool, workers,
+                        contextvars.copy_context().run,
+                        self._expand_one_worker, node, cgq, depth,
                     )
-                    for cgq in par
-                ]
+                    if fut is not None:
+                        futs.append((cgq, fut))
                 METRICS.inc("exec_parallel_siblings", len(futs))
+                prof = current_profile()
+                if prof is not None:
+                    prof.note_queue_depth(pool_backpressure()[0])
                 for cgq, fut in futs:
                     try:
                         results[id(cgq)] = ("ok", fut.result())
@@ -796,7 +853,17 @@ class Executor:
                 METRICS.inc("level_tasks_started")
                 METRICS.inc("level_task_uids", len(level_keys))
                 if self.level_batch:
-                    flat, offs, row_toks = self.cache.uids_many(level_keys)
+                    if self.batcher is not None:
+                        # cross-query coalescing: this level read may
+                        # ride one combined dispatch with same-shape
+                        # tasks from other in-flight queries
+                        flat, offs, row_toks = self.batcher.read_uids(
+                            attr, self.cache, level_keys
+                        )
+                    else:
+                        flat, offs, row_toks = self.cache.uids_many(
+                            level_keys
+                        )
                 else:
                     self.cache.prefetch(level_keys)
                     rows = []
@@ -898,7 +965,12 @@ class Executor:
                 METRICS.inc("level_tasks_started")
                 METRICS.inc("level_task_uids", len(dkeys))
                 if self.level_batch:
-                    all_posts = self.cache.values_many(dkeys)
+                    if self.batcher is not None:
+                        all_posts = self.batcher.read_values(
+                            attr, self.cache, dkeys
+                        )
+                    else:
+                        all_posts = self.cache.values_many(dkeys)
                 else:
                     self.cache.prefetch(dkeys)
                     all_posts = [self.cache.values(k) for k in dkeys]

@@ -1,0 +1,112 @@
+"""ServingFront: the per-engine bundle of plan cache, micro-batcher,
+and admission controller.
+
+One instance per engine (api/server.Server, worker/harness.ProcCluster).
+The entry points drive it in four places:
+
+    blocks, shape = front.parse(q, variables)   # plan cache
+    ticket = front.admit(shape, blocks)         # admission gate (raises)
+    ...execute with batcher=front.batcher_for(cache)...
+    front.finish(ticket, shape, took_ms, slow)  # stats + release
+
+`on_commit()` hooks the engine's commit/alter paths: it bumps the plan
+cache epoch so no cached plan survives a commit unrevalidated.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from dgraph_tpu.serving.admission import AdmissionController, Ticket
+from dgraph_tpu.serving.microbatch import MicroBatcher, window_us
+from dgraph_tpu.serving.plancache import PlanCache, normalize
+
+
+class ServingFront:
+    def __init__(self, stats=None, schema_fn=None, last_commit_fn=None):
+        self.plan_cache = PlanCache()
+        # schema_fn: a getter, so engines that rebind their schema
+        # wholesale (drop_all) are always read fresh
+        self.admission = AdmissionController(
+            plan_cache=self.plan_cache, stats=stats, schema_fn=schema_fn
+        )
+        # last_commit_fn: the engine's last-commit watermark (published
+        # before the commit's apply barrier) — the batcher's snapshot
+        # identity; None = exact-ts grouping (never coalesces)
+        self.batcher = MicroBatcher(
+            inflight_fn=self.admission.inflight_count,
+            last_commit_fn=last_commit_fn,
+        )
+
+    # -- plan cache -----------------------------------------------------------
+
+    def parse(self, q: str, variables=None) -> Tuple[list, Optional[str]]:
+        """dql.parse through the plan cache. Returns (blocks, shape);
+        shape is None when the query doesn't lex (parse raises the real
+        error) — such queries bypass the cache. With the cache disabled
+        (PLAN_CACHE_SIZE=0) the normalization pass — a second full
+        tokenize per query — is skipped outright (the shape would feed
+        nothing: cost stats are disabled with the cache)."""
+        from dgraph_tpu import dql
+
+        if self.plan_cache.capacity() == 0:
+            return dql.parse(q, variables), None
+        norm = normalize(q)
+        if norm is None:
+            return dql.parse(q, variables), None
+        shape, literals = norm
+        blocks = self.plan_cache.get(shape, literals, variables)
+        if blocks is None:
+            blocks = dql.parse(q, variables)
+            self.plan_cache.put(shape, literals, blocks, variables)
+        return blocks, shape
+
+    # -- admission ------------------------------------------------------------
+
+    def admit(self, shape: Optional[str], blocks=None) -> Ticket:
+        return self.admission.admit(shape, blocks)
+
+    def finish(
+        self,
+        ticket: Optional[Ticket],
+        shape: Optional[str],
+        took_ms: float,
+        slow: bool = False,
+    ) -> None:
+        """End-of-query bookkeeping. Callers pass shape=None for
+        anything that did NOT run to clean completion (shed, error,
+        budget-truncated) — those latencies describe the failure mode,
+        not the shape, and would decay the cost EWMA exactly when
+        admission depends on it. A degraded-admission query's slowness
+        likewise must not refresh the saturation signal that degraded
+        it (self-latch), so its `slow` is suppressed."""
+        if shape is not None:
+            self.plan_cache.observe_cost(shape, took_ms)
+        if slow and (ticket is None or not ticket.degrade):
+            self.admission.note_slow()
+        if ticket is not None:
+            self.admission.release(ticket)
+
+    def degrade_budget_s(self) -> float:
+        """The bounded time budget a degraded-admission query runs
+        under: the slow-query threshold (a degraded query must never
+        itself become a slow query)."""
+        from dgraph_tpu.x import config
+
+        return max(0.01, float(config.get("SLOW_QUERY_MS")) / 1e3)
+
+    # -- micro-batcher --------------------------------------------------------
+
+    def batcher_for(self, cache) -> Optional[MicroBatcher]:
+        """The batcher, or None when batching is off or this cache is
+        ineligible (txn-local deltas make its reads private). Window 0
+        must restore today's exact path, so the executor sees no
+        batcher at all then."""
+        if window_us() <= 0 or cache.deltas:
+            return None
+        return self.batcher
+
+    # -- invalidation ----------------------------------------------------------
+
+    def on_commit(self) -> None:
+        self.plan_cache.invalidate()

@@ -68,3 +68,19 @@ class StatsHolder:
     def plan_eq_order(self, attr: str, tokens) -> list:
         """Cheapest-first token order for multi-value eq scans."""
         return sorted(tokens, key=lambda t: self.estimate(attr, t))
+
+
+def feed_stats(stats: "StatsHolder", deltas) -> None:
+    """Count a commit's index-key postings into the sketch — ONE
+    implementation for every engine (api/server.Server and
+    worker/harness.ProcCluster both feed their StatsHolder from commit
+    deltas; the eq planner and the admission cost model read it)."""
+    from dgraph_tpu.x import keys
+
+    for key, posts in deltas.items():
+        try:
+            pk = keys.parse_key(key)
+        except Exception:
+            continue
+        if pk.is_index and posts:
+            stats.record(pk.attr, pk.term, len(posts))

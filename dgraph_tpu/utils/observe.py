@@ -819,6 +819,7 @@ class QueryProfile:
         self.rpc_fragments: List[dict] = []
         self.events: Dict[str, float] = {}
         self.kernel: Dict[str, float] = {}
+        self.max_queue_depth = 0  # exec-pool backlog seen by this query
 
     def record_level_task(
         self, attr: str, level: int, parents: int, ms: float,
@@ -838,6 +839,13 @@ class QueryProfile:
     def record_rpc_fragment(self, frag: dict) -> None:
         with self._lock:
             self.rpc_fragments.append(frag)
+
+    def note_queue_depth(self, depth: int) -> None:
+        """Record the exec-pool backlog observed at a fan-out point;
+        the profile keeps the query's maximum (its saturation view)."""
+        with self._lock:
+            if depth > self.max_queue_depth:
+                self.max_queue_depth = int(depth)
 
     def to_dict(self) -> dict:
         with self._lock:
@@ -861,6 +869,9 @@ class QueryProfile:
                 "kernel": dict(self.kernel),
                 "events": {
                     k: v for k, v in self.events.items() if v
+                },
+                "exec_pool": {
+                    "max_queue_depth": self.max_queue_depth
                 },
             }
 
@@ -1097,6 +1108,23 @@ def attach_debug_surface(rpc_server):
 # ---------------------------------------------------------------------------
 
 declare_metric(
+    "counter", "admission_degraded_total",
+    "Queries admitted in degraded mode (bounded budget, partial "
+    "response) because the slow-query signal or exec-pool backpressure "
+    "said the server was saturated (serving/admission.py).",
+)
+declare_metric(
+    "counter", "admission_shed_total",
+    "Queries refused fast with too_many_requests because the in-flight "
+    "cost budget (DGRAPH_TPU_MAX_INFLIGHT) was exhausted.",
+)
+declare_metric(
+    "counter", "batch_coalesced_total",
+    "Member (predicate, level) tasks coalesced into multi-query "
+    "micro-batch dispatches (serving/microbatch.py); solo dispatches "
+    "do not count.",
+)
+declare_metric(
     "counter", "circuit_close_total",
     "Peer circuits closed after a successful probe/call.",
 )
@@ -1199,6 +1227,16 @@ declare_metric(
     "Spans successfully posted to the OTLP collector.",
 )
 declare_metric(
+    "counter", "plan_cache_hit_total",
+    "Queries whose parsed plan was served from the plan cache "
+    "(normalized-shape + literal-binding hit; parse skipped).",
+)
+declare_metric(
+    "counter", "plan_cache_miss_total",
+    "Plan-cache lookups that had to parse (new shape, new literal "
+    "binding, epoch-invalidated entry, or cache disabled).",
+)
+declare_metric(
     "counter", "rpc_giveups_total",
     "RPC calls abandoned after exhausting retries/deadline.",
 )
@@ -1248,6 +1286,11 @@ declare_metric(
     "appended to the slow-query log).",
 )
 declare_metric(
+    "gauge", "admission_inflight_queries",
+    "Queries currently in flight past the admission gate (tracked even "
+    "with DGRAPH_TPU_ADMISSION=0; the micro-batcher's idle signal).",
+)
+declare_metric(
     "gauge", "cache_batch_read_keys",
     "Keys covered by batched LocalCache reads (READ_COUNTERS mirror).",
 )
@@ -1258,6 +1301,13 @@ declare_metric(
 declare_metric(
     "gauge", "cache_point_reads",
     "Point LocalCache reads (READ_COUNTERS mirror).",
+)
+declare_metric(
+    "gauge", "exec_pool_queue_depth",
+    "Sibling-expansion tasks submitted to the bounded exec-worker pool "
+    "but not yet running — the pool's real backpressure, read by "
+    "admission control and surfaced in the per-query profile "
+    "(query/subgraph.py).",
 )
 declare_metric(
     "histogram", "commit_latency_seconds",

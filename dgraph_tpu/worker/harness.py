@@ -113,6 +113,20 @@ class ProcCluster:
 
         self.mem = MemoryLayer()
         self.vector_indexes: Dict[str, object] = {}
+        from dgraph_tpu.serving import ServingFront
+        from dgraph_tpu.utils.cmsketch import StatsHolder
+
+        self.stats = StatsHolder()
+        # high-QPS serving front: plan cache + cross-query micro-batcher
+        # + admission control at the cluster query entry point.
+        # _snapshot_ts: last commit made visible (published before the
+        # zero applied barrier) — the batcher's snapshot watermark.
+        self._snapshot_ts = 0
+        self.serving = ServingFront(
+            stats=self.stats,
+            schema_fn=lambda: self.schema,
+            last_commit_fn=lambda: self._snapshot_ts,
+        )
         self.remote_groups: Dict[int, RemoteGroup] = {}
         self._commit_lock = threading.Lock()
         self.intents: Optional[IntentLog] = None
@@ -233,6 +247,7 @@ class ProcCluster:
             self.schema.set(su)
 
     def alter(self, schema_text: str):
+        self.serving.on_commit()  # schema changes invalidate cached plans
         preds, types = parse_schema(schema_text)
         for su in preds:
             self.schema.set(su)
@@ -241,7 +256,14 @@ class ProcCluster:
             self.schema.set_type(tu)
 
     def read_kv(self, partial_ok: bool = False):
-        return RemoteKV(self, partial_ok=partial_ok)
+        kv = RemoteKV(self, partial_ok=partial_ok)
+        # stable identity for the micro-batcher: a fresh RemoteKV is
+        # built per query, but any two over this cluster (same
+        # partial_ok) read identically at equal snapshots — without
+        # this the batcher's id(kv) group key could never match and
+        # cluster-side coalescing would be dead code
+        kv.coalesce_key = ("cluster", id(self), partial_ok)
+        return kv
 
     def new_txn(self) -> ClusterTxn:
         return ClusterTxn(self)
@@ -257,7 +279,16 @@ class ProcCluster:
                 with self._commit_lock:
                     cts = self._commit_locked(txn)
         METRICS.inc("num_commits")
+        self.serving.on_commit()  # commit-epoch plan invalidation
+        self._feed_stats(txn.cache.deltas)
         return cts
+
+    def _feed_stats(self, deltas):
+        """Index-key posting counts into the selectivity sketch — the
+        admission controller's cost model (shared with Server)."""
+        from dgraph_tpu.utils.cmsketch import feed_stats
+
+        feed_stats(self.stats, deltas)
 
     def _commit_locked(self, txn: Txn) -> int:
         from dgraph_tpu.posting.pl import encode_delta
@@ -282,6 +313,8 @@ class ProcCluster:
             if self.intents is not None:
                 self.intents.mark_done(commit_ts)
         finally:
+            # watermark BEFORE the apply barrier (batcher snapshot key)
+            self._snapshot_ts = commit_ts
             self.zero.zero.applied(commit_ts)
             self.mem.invalidate(txn.cache.deltas.keys())
         return commit_ts
@@ -332,6 +365,9 @@ class ProcCluster:
             src.propose(("drop", keys.PredicatePrefix(pred)))
             src.propose(("drop", keys.SplitPredicatePrefix(pred)))
             self.mem.clear()
+            # routing changed outside the applied barrier: advance the
+            # batcher watermark past every in-flight read_ts
+            self._snapshot_ts = self.zero.zero.next_ts()
 
     def query(self, q: str, read_ts: Optional[int] = None,
               timeout_s: Optional[float] = None) -> dict:
@@ -351,61 +387,127 @@ class ProcCluster:
         fragments piggybacked on the responses. Queries slower than
         DGRAPH_TPU_SLOW_QUERY_MS are force-sampled and appended to the
         slow-query JSONL log with their local span tree."""
-        from dgraph_tpu import dql
         from dgraph_tpu.posting.lists import LocalCache
+        from dgraph_tpu.query.functions import QueryBudgetError
         from dgraph_tpu.query.outputjson import JsonEncoder
         from dgraph_tpu.query.subgraph import Executor
 
         budget = timeout_s or float(config.get("QUERY_DEADLINE_S"))
         kv = self.read_kv(partial_ok=True)
         t_start = time.perf_counter()
-        with deadline_scope(current_deadline() or Deadline.after(budget)), \
-                TRACER.span("query") as root, \
-                profile_scope() as prof, \
-                METRICS.timer("query_latency_seconds"):
-            with TRACER.span("parse"):
-                blocks = dql.parse(q)
-            t_parsed = time.perf_counter()
-            ts = read_ts if read_ts is not None else self.zero.zero.read_ts()
-            t_ts = time.perf_counter()
-            cache = LocalCache(kv, ts, mem=self.mem)
-            ex = Executor(
-                cache, self.schema, vector_indexes=self.vector_indexes
+        truncated = False
+        degrade_deadline = None
+        ticket = None
+        shape = None
+        slow = False
+        completed = False  # clean, untruncated execution
+        try:
+            with deadline_scope(
+                current_deadline() or Deadline.after(budget)
+            ), \
+                    TRACER.span("query") as root, \
+                    profile_scope() as prof, \
+                    METRICS.timer("query_latency_seconds"):
+                with TRACER.span("parse"):
+                    # plan cache: repeated shapes skip parse entirely
+                    blocks, shape = self.serving.parse(q)
+                # admission gate: shed fast past the in-flight budget,
+                # degrade (bounded budget + partial response) under
+                # saturation — a shed raises out through the root span
+                ticket = self.serving.admit(shape, blocks)
+                if ticket.degrade:
+                    degrade_deadline = (
+                        time.monotonic() + self.serving.degrade_budget_s()
+                    )
+                t_parsed = time.perf_counter()
+                ts = (
+                    read_ts
+                    if read_ts is not None
+                    else self.zero.zero.read_ts()
+                )
+                t_ts = time.perf_counter()
+                cache = LocalCache(kv, ts, mem=self.mem)
+                ex = Executor(
+                    cache,
+                    self.schema,
+                    vector_indexes=self.vector_indexes,
+                    stats=self.stats,
+                    deadline=(
+                        degrade_deadline
+                        if degrade_deadline is not None
+                        else None
+                    ),
+                    # caller-pinned read_ts never coalesces (the
+                    # watermark argument covers only fresh timestamps
+                    # that waited on the applied barrier)
+                    batcher=(
+                        self.serving.batcher_for(cache)
+                        if read_ts is None
+                        else None
+                    ),
+                )
+                with TRACER.span("process"):
+                    try:
+                        nodes = ex.process(blocks)
+                    except QueryBudgetError:
+                        # only the degraded-admission budget converts a
+                        # deadline trip into a partial result
+                        if degrade_deadline is None:
+                            raise
+                        nodes = None
+                        truncated = True
+                t_processed = time.perf_counter()
+                if truncated:
+                    out = {"data": {}}
+                else:
+                    enc = JsonEncoder(
+                        val_vars=ex.val_vars, schema=self.schema
+                    )
+                    with TRACER.span("encode"):
+                        out = {"data": enc.encode_blocks(nodes)}
+                t_done = time.perf_counter()
+            METRICS.inc("num_queries")
+            ext = out.setdefault("extensions", {})
+            ext["server_latency"] = {
+                "parsing_ns": int((t_parsed - t_start) * 1e9),
+                "assign_timestamp_ns": int((t_ts - t_parsed) * 1e9),
+                "processing_ns": int((t_processed - t_ts) * 1e9),
+                "encoding_ns": int((t_done - t_processed) * 1e9),
+                "total_ns": int((t_done - t_start) * 1e9),
+            }
+            ext["profile"] = prof.to_dict()
+            if root.trace_id:
+                ext["trace_id"] = f"{root.trace_id:032x}"
+            if ticket.degrade:
+                ext["degraded_admission"] = True
+            if kv.degraded_groups or truncated:
+                METRICS.inc("degraded_queries_total")
+                # no cache wipe needed: RemoteKV exposes no mut_seq, so
+                # the MemoryLayer revalidates every entry against
+                # kv.versions on each read — an empty list cached during
+                # the outage heals itself on the first read after the
+                # group returns
+                ext["degraded"] = True
+                ext["partial"] = True
+                ext["unreachable_groups"] = sorted(kv.degraded_groups)
+            slow = observe.maybe_log_slow(
+                "query", q, (t_done - t_start) * 1e3, root,
+                extra={"degraded": sorted(kv.degraded_groups)}
+                if kv.degraded_groups else None,
             )
-            with TRACER.span("process"):
-                nodes = ex.process(blocks)
-            t_processed = time.perf_counter()
-            enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
-            with TRACER.span("encode"):
-                out = {"data": enc.encode_blocks(nodes)}
-            t_done = time.perf_counter()
-        METRICS.inc("num_queries")
-        ext = out.setdefault("extensions", {})
-        ext["server_latency"] = {
-            "parsing_ns": int((t_parsed - t_start) * 1e9),
-            "assign_timestamp_ns": int((t_ts - t_parsed) * 1e9),
-            "processing_ns": int((t_processed - t_ts) * 1e9),
-            "encoding_ns": int((t_done - t_processed) * 1e9),
-            "total_ns": int((t_done - t_start) * 1e9),
-        }
-        ext["profile"] = prof.to_dict()
-        if root.trace_id:
-            ext["trace_id"] = f"{root.trace_id:032x}"
-        if kv.degraded_groups:
-            METRICS.inc("degraded_queries_total")
-            # no cache wipe needed: RemoteKV exposes no mut_seq, so the
-            # MemoryLayer revalidates every entry against kv.versions on
-            # each read — an empty list cached during the outage heals
-            # itself on the first read after the group returns
-            ext["degraded"] = True
-            ext["partial"] = True
-            ext["unreachable_groups"] = sorted(kv.degraded_groups)
-        observe.maybe_log_slow(
-            "query", q, (t_done - t_start) * 1e3, root,
-            extra={"degraded": sorted(kv.degraded_groups)}
-            if kv.degraded_groups else None,
-        )
-        return out
+            completed = not truncated
+            return out
+        finally:
+            # only clean completions feed the shape cost EWMA: a shed,
+            # error, or budget-truncated run's latency describes the
+            # failure mode, not the shape — feeding it would decay the
+            # estimated cost exactly when the gate depends on it
+            self.serving.finish(
+                ticket,
+                shape if (ticket is not None and completed) else None,
+                (time.perf_counter() - t_start) * 1e3,
+                slow=slow,
+            )
 
     # -- cluster observability (scrape + merge) -------------------------------
 

@@ -80,6 +80,27 @@ def _define(name: str, type_: str, default: Any, doc: str) -> Knob:
 # ---------------------------------------------------------------------------
 
 _define(
+    "ADMISSION", "bool", False,
+    "Admission control at the query entry points (serving/admission.py): "
+    "estimated query cost is charged against DGRAPH_TPU_MAX_INFLIGHT "
+    "tokens; over-budget arrivals are shed fast with a retryable "
+    "too_many_requests error (HTTP 429), and arrivals during saturation "
+    "(slow-query signal or exec-pool backpressure) run degraded — "
+    "bounded budget, partial response — instead of queueing. Off by "
+    "default; the in-flight gauge is tracked regardless.",
+)
+_define(
+    "BATCH_WINDOW_US", "int", 0,
+    "Cross-query micro-batching (serving/microbatch.py): same-shape "
+    "(predicate, level) tasks from different in-flight queries that "
+    "arrive DURING an in-flight same-shape dispatch coalesce into the "
+    "next combined level read, demuxed per query on return (natural "
+    "batching: an idle shape dispatches immediately with zero added "
+    "latency). The value caps, in microseconds, how long a forming "
+    "batch waits for the dispatch ahead of it. 0 (default) disables "
+    "the batcher entirely — the executor takes the direct path.",
+)
+_define(
     "BITMAP_BLOCK_BITS", "int", 2048,
     "Fixed bitset size (bits, rounded up to a multiple of 64) for the "
     "per-block bitmap containers: a UidPack block whose uid range fits "
@@ -167,6 +188,14 @@ _define(
     "(conn/frame.py, matches the reference's 256MB gRPC cap).",
 )
 _define(
+    "MAX_INFLIGHT", "int", 64,
+    "Admission-control in-flight budget, in cost tokens (one token ~ "
+    "10ms of observed shape latency; selectivity and pool backpressure "
+    "add more). Arrivals that would push the in-flight cost past this "
+    "are shed with too_many_requests when DGRAPH_TPU_ADMISSION is on "
+    "(serving/admission.py).",
+)
+_define(
     "MAX_PART_UIDS", "int", 1 << 20,
     "Multi-part posting list threshold: a rollup whose uid set exceeds "
     "this splits into part records. ONE default shared by the runtime "
@@ -205,6 +234,15 @@ _define(
     "PALLAS", "bool", False,
     "Opt-in Pallas compare-all sweep for small-side intersect buckets "
     "(query/dispatch.py, ops/pallas_setops.py).",
+)
+_define(
+    "PLAN_CACHE_SIZE", "int", 512,
+    "Plan-cache capacity in distinct normalized query shapes (serving/"
+    "plancache.py); each shape holds a bounded set of literal-binding "
+    "variants whose parsed trees skip parse entirely on a hit. Entries "
+    "are invalidated by commit epoch (no plan survives a commit "
+    "unrevalidated). 0 disables plan caching; per-shape cost stats for "
+    "admission are disabled with it.",
 )
 _define(
     "QUERY_DEADLINE_S", "float", 15.0,

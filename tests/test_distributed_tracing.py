@@ -205,6 +205,95 @@ def test_merged_metrics_equal_sum_of_per_process_scrapes(traced_cluster):
     )
 
 
+def test_microbatch_links_member_traces_one_trace_per_query():
+    """PR 5's one-trace-per-query invariant must survive cross-query
+    micro-batching: each member query keeps its own single trace (its
+    level_task spans parent under its own root), and the coalesced
+    batch_dispatch span carries every member's traceparent as span
+    links — the attribution seam between the per-query traces and the
+    shared dispatch."""
+    import threading
+    import time as _t
+
+    from dgraph_tpu.serving.microbatch import MicroBatcher
+    from dgraph_tpu.utils.observe import TRACER, parse_traceparent
+
+    first_started = threading.Event()
+    release_first = threading.Event()
+
+    class StubCache:
+        kv = object()
+        mem = object()
+        read_ts = 11
+        calls = 0
+
+        def uids_many(self, keys_list):
+            import numpy as np
+
+            StubCache.calls += 1
+            if StubCache.calls == 1:
+                first_started.set()
+                release_first.wait(5)
+            rows = [np.arange(3, dtype=np.uint64) for _ in keys_list]
+            offs = np.zeros(len(rows) + 1, dtype=np.int64)
+            offs[1:] = np.cumsum([len(r) for r in rows])
+            return np.concatenate(rows), offs, [None] * len(rows)
+
+    cache = StubCache()
+    b = MicroBatcher(inflight_fn=lambda: 3)
+    os.environ["DGRAPH_TPU_BATCH_WINDOW_US"] = "1000000"
+    trace_ids = {}
+    try:
+
+        def member(name):
+            # each member is its own query: its own root span/trace
+            with TRACER.span("query") as root:
+                trace_ids[name] = root.trace_id
+                with TRACER.span("level_task", attr="knows"):
+                    b.read_uids("knows", cache, [b"k1", b"k2"])
+
+        # member z dispatches immediately and blocks in the read;
+        # a and b pile up behind it and coalesce into the next batch
+        t0 = threading.Thread(target=member, args=("z",))
+        t1 = threading.Thread(target=member, args=("a",))
+        t2 = threading.Thread(target=member, args=("b",))
+        t0.start()
+        first_started.wait(5)
+        t1.start()
+        _t.sleep(0.05)
+        t2.start()
+        _t.sleep(0.05)
+        release_first.set()
+        for th in (t0, t1, t2):
+            th.join(10)
+    finally:
+        os.environ.pop("DGRAPH_TPU_BATCH_WINDOW_US", None)
+        release_first.set()
+
+    spans = TRACER.recent(50)
+    assert trace_ids["a"] != trace_ids["b"], "queries must not share a trace"
+    # every member's level_task stays inside its own query's trace
+    for name in ("a", "b"):
+        lt = [
+            s
+            for s in spans
+            if s["name"] == "level_task"
+            and s["trace_id"] == trace_ids[name]
+        ]
+        assert lt, f"member {name} lost its level_task span"
+        assert all(s["parent_id"] is not None for s in lt)
+    # the coalesced dispatch links BOTH members via traceparent attrs
+    batch = [s for s in spans if s["name"] == "batch_dispatch"]
+    assert batch, "no batch_dispatch span for the coalesced read"
+    links = [
+        parse_traceparent(v).trace_id
+        for s in batch
+        for k, v in s["attrs"].items()
+        if k.startswith("link.")
+    ]
+    assert {trace_ids["a"], trace_ids["b"]} <= set(links)
+
+
 def test_cli_metrics_against_running_cluster(traced_cluster, capsys):
     c, _ = traced_cluster
     from dgraph_tpu import cli

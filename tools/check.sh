@@ -33,7 +33,11 @@ else
         tests/test_storage.py tests/test_raft.py \
         tests/test_replicated_zero.py tests/test_cluster_facade.py \
         tests/test_observability.py tests/test_distributed_tracing.py \
+        tests/test_serving_front.py \
         -q -p no:cacheprovider
+
+    echo "== qps loadgen sanity (~5s) =="
+    python benchmarks/qps_loadgen.py --sanity
 fi
 
 echo "check.sh: all stages passed"
