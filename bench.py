@@ -513,6 +513,120 @@ def _bench_obs(platform, fanout=100, pool=200_000):
     )
 
 
+def _build_flat_graph(n=1_000_000):
+    """One hub with n uid-pred followers — the result-size ladder for
+    the encoder bench (pagination slices the SAME level buffers, so
+    every rung measures encoding over identical executor work)."""
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.loaders.bulk2 import ParallelBulkLoader
+
+    s = Server()
+    s.alter("follow: [uid] .")
+    lines = [f"<0x1> <follow> <{hex(0x10 + i)}> ." for i in range(n)]
+    t0 = time.perf_counter()
+    ParallelBulkLoader(s).load_text("\n".join(lines))
+    load_s = time.perf_counter() - t0
+    print(f"flat graph: {n} edges loaded in {load_s:.1f}s", file=sys.stderr)
+    return s, load_s
+
+
+def _encode_rung(s, q, reps=3):
+    """Best-of-reps (encoding_ns, total_ns, bytes, share) for q through
+    the PUBLIC query path with `want='raw'` (the serving surface — no
+    dict parse-back in the loop)."""
+    s.query(q, want="raw")  # warm decoded-list caches + plan cache
+    best = None
+    for _ in range(reps):
+        res = s.query(q, want="raw")
+        lat = res["extensions"]["server_latency"]
+        enc = res["extensions"]["profile"]["encode"]
+        row = (
+            int(lat["encoding_ns"]),
+            int(lat["total_ns"]),
+            int(enc["bytes"]),
+            float(enc.get("share", 0.0)),
+        )
+        if best is None or row[0] < best[0]:
+            best = row
+    return best
+
+
+def _bench_encode(platform, sanity=False):
+    """Streaming arena encoder ladder (BENCH_ENCODE.json):
+
+      encode_share_ladder   encoding_ns (from extensions.server_latency)
+                            and encode share of total at 1k/100k/1M-uid
+                            results, dict encoder
+                            (DGRAPH_TPU_STREAM_ENCODER=0) vs streaming
+                            arena (=1) over the same warm server — the
+                            A/B rides the registered escape hatch, both
+                            paths producing the SAME wire bytes
+
+    --encode-sanity: one small rung, assert byte-identity + print the
+    numbers, no stamping (the tools/check.sh smoke gate).
+    """
+    import os
+
+    from benchmarks import stamp
+
+    n_max = 100_000 if sanity else 1_000_000
+    rungs = [100_000] if sanity else [1_000, 100_000, 1_000_000]
+    s, load_s = _build_flat_graph(n_max)
+
+    ladder = []
+    for n in rungs:
+        q = "{ q(func: uid(0x1)) { follow(first: %d) { uid } } }" % n
+        row = {"uids": n}
+        raws = {}
+        for flag, key in (("0", "dict"), ("1", "stream")):
+            os.environ["DGRAPH_TPU_STREAM_ENCODER"] = flag
+            enc_ns, total_ns, nbytes, share = _encode_rung(
+                s, q, reps=1 if sanity else 3
+            )
+            raws[key] = s.query(q, want="raw")["data"].raw
+            row[key] = {
+                "encoding_ns": enc_ns,
+                "total_ns": total_ns,
+                "bytes": nbytes,
+                "encode_share": round(share, 4),
+            }
+        os.environ.pop("DGRAPH_TPU_STREAM_ENCODER", None)
+        assert raws["dict"] == raws["stream"], (
+            f"byte-identity violated at {n} uids"
+        )
+        row["reduction_x"] = round(
+            row["dict"]["encoding_ns"]
+            / max(1, row["stream"]["encoding_ns"]),
+            2,
+        )
+        ladder.append(row)
+        print(
+            json.dumps(
+                {
+                    "metric": "encoding_ns",
+                    "uids": n,
+                    "dict": row["dict"]["encoding_ns"],
+                    "stream": row["stream"]["encoding_ns"],
+                    "reduction_x": row["reduction_x"],
+                    "encode_share_dict": row["dict"]["encode_share"],
+                    "encode_share_stream": row["stream"]["encode_share"],
+                    "platform": platform,
+                }
+            )
+        )
+    if sanity:
+        print("encode sanity: byte-identity + ladder ok", file=sys.stderr)
+        return
+    stamp.guarded_write(
+        "BENCH_ENCODE.json",
+        {
+            "encode_share_ladder": ladder,
+            "graph": {"edges": n_max, "load_seconds": round(load_s, 1)},
+        },
+        platform,
+    )
+
+
 def _bench_chaos(platform):
     """Retry-storm visibility (BENCH_CHAOS.json): a fixed-seed fault
     schedule (drops + delays + disconnects + lost acks) over an
@@ -604,6 +718,17 @@ if __name__ == "__main__":
         import jax as _jax
 
         _bench_fanout(_jax.default_backend())
+    elif "--encode-only" in sys.argv or "--encode-sanity" in sys.argv:
+        # encoder-path capture (BENCH_ENCODE.json); host-path only
+        from dgraph_tpu.devsetup import maybe_force_cpu
+
+        maybe_force_cpu()
+        import jax as _jax
+
+        _bench_encode(
+            _jax.default_backend(),
+            sanity="--encode-sanity" in sys.argv,
+        )
     elif "--obs-only" in sys.argv:
         # tracing-overhead capture (BENCH_OBS.json); host-path only
         from dgraph_tpu.devsetup import maybe_force_cpu

@@ -94,7 +94,9 @@ class DgraphServicer:
                 resp.latency.total_ns = time.monotonic_ns() - t0
                 return resp
             if request.read_only:
-                out = self.engine.query(request.query, variables=variables)
+                out = self.engine.query(
+                    request.query, variables=variables, want="raw"
+                )
                 resp.txn.start_ts = 0
             else:
                 h = self._txn_for(request.start_ts)
@@ -105,9 +107,15 @@ class DgraphServicer:
                     h.txn.cache,
                     0,
                     None,
+                    want="raw",
                 )
                 resp.txn.start_ts = h.start_ts
-            resp.json = json.dumps(out["data"]).encode()
+            d = out["data"]
+            # pre-encoded arena bytes splice straight into the proto
+            # Json field (query/streamjson.py); plain dicts (schema
+            # blocks, the txn path) dump as before
+            rawb = getattr(d, "raw", None)
+            resp.json = rawb if rawb is not None else json.dumps(d).encode()
         except Exception as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         resp.latency.total_ns = time.monotonic_ns() - t0

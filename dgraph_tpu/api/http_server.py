@@ -20,6 +20,7 @@ from urllib.parse import parse_qs, urlparse
 from dgraph_tpu.acl.acl import AclError
 from dgraph_tpu.acl.jwt import JwtError
 from dgraph_tpu.dql.parser import ParseError
+from dgraph_tpu.query import streamjson
 from dgraph_tpu.query.functions import QueryError
 from dgraph_tpu.api.server import Server, TxnHandle
 from dgraph_tpu.serving import TooManyRequestsError
@@ -43,7 +44,15 @@ class _Handler(BaseHTTPRequestHandler):
         return self.rfile.read(n) if n else b""
 
     def _reply(self, obj, code=200):
-        data = json.dumps(obj).encode("utf-8")
+        # responses whose `data` carries pre-encoded wire bytes (the
+        # streaming arena encoder, query/streamjson.py) are SPLICED —
+        # the result tree never runs through json.dumps a second time
+        raw = (
+            streamjson.response_bytes(obj)
+            if isinstance(obj, dict)
+            else None
+        )
+        data = raw if raw is not None else json.dumps(obj).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
@@ -217,6 +226,9 @@ class _Handler(BaseHTTPRequestHandler):
                     access_jwt=token,
                     variables=variables,
                     timeout_ms=timeout_ms,
+                    # serving surface: data stays wire bytes end-to-end
+                    # (no dict parse-back; _reply splices the arena)
+                    want="raw",
                 )
                 # keep the engine's server_latency/profile/trace_id and
                 # stamp the HTTP-layer total on top (reference envelope)

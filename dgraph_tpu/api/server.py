@@ -23,11 +23,12 @@ from dgraph_tpu.loaders.rdf import NQuad, parse_rdf
 from dgraph_tpu.posting.lists import LocalCache, Txn
 from dgraph_tpu.posting.mutation import DirectedEdge, apply_edge, delete_entity_attr
 from dgraph_tpu.posting.pl import OP_DEL, OP_SET
-from dgraph_tpu.query.outputjson import JsonEncoder
+from dgraph_tpu.query.streamjson import encode_response_data
 from dgraph_tpu.query.subgraph import Executor
 from dgraph_tpu.schema.schema import State, parse_schema
 from dgraph_tpu.storage.kv import KV, open_kv
 from dgraph_tpu.types.types import TypeID, Val
+from dgraph_tpu.utils import observe
 from dgraph_tpu.x import keys
 from dgraph_tpu.zero.zero import TxnConflictError, ZeroLite
 
@@ -818,13 +819,18 @@ class Server:
         access_jwt: Optional[str] = None,
         variables: Optional[Dict[str, str]] = None,
         timeout_ms: Optional[float] = None,
+        want: str = "dict",
     ) -> dict:
         """Run a read-only query at a fresh (or given) read ts.
         timeout_ms bounds execution (ref x/limits --query timeout).
         The response carries reference-shaped extensions.server_latency
         plus the per-query profile; slow queries are force-sampled and
         appended to the slow-query JSONL log (DGRAPH_TPU_SLOW_QUERY_MS,
-        DGRAPH_TPU_SLOW_QUERY_LOG)."""
+        DGRAPH_TPU_SLOW_QUERY_LOG).
+
+        `want="raw"` skips the dict-API parse-back: `data` comes back
+        as a streamjson.RawJson byte shell for response assembly to
+        splice (the HTTP/gRPC serving surface)."""
         import time as _time
 
         t_begin = _time.monotonic()
@@ -910,6 +916,7 @@ class Server:
                             if read_ts is None
                             else None
                         ),
+                        want=want,
                     )
                 except QueryBudgetError:
                     # only the degraded-admission budget converts a
@@ -927,17 +934,28 @@ class Server:
             t_done = _time.monotonic()
             took_ms = (t_done - t_begin) * 1e3
             ext = out.setdefault("extensions", {})
+            # encoding happens inside _query_parsed; it reports the
+            # wire-bytes production time through the profile and the
+            # processing component gives it up so the parts still sum
+            # to total_ns with no unattributed gap (the dict-API
+            # parse-back, when present, stays inside processing and is
+            # itemized as profile.encode.parse_ns)
+            enc_ns = int(prof.encode.get("encode_ns", 0))
+            total_ns = int((t_done - t_begin) * 1e9)
             ext["server_latency"] = {
                 # new order: parse -> admission/ACL/ts -> execute; the
                 # admission + ACL + audit time rides in the assign
-                # component so the parts still sum to total_ns with no
-                # unattributed gap
+                # component
                 "parsing_ns": int((t_parsed - t_begin) * 1e9),
                 "assign_timestamp_ns": int((t_assigned - t_parsed) * 1e9),
-                "processing_ns": int((t_done - t_assigned) * 1e9),
-                "encoding_ns": 0,  # encoding happens inside _query_parsed
-                "total_ns": int((t_done - t_begin) * 1e9),
+                "processing_ns": max(
+                    int((t_done - t_assigned) * 1e9) - enc_ns, 0
+                ),
+                "encoding_ns": enc_ns,
+                "total_ns": total_ns,
             }
+            if total_ns > 0 and prof.encode:
+                prof.encode["share"] = round(enc_ns / total_ns, 4)
             ext["profile"] = prof.to_dict()
             if root.trace_id:
                 ext["trace_id"] = f"{root.trace_id:032x}"
@@ -1046,6 +1064,7 @@ class Server:
         allowed_preds=None,
         deadline=None,
         batcher=None,
+        want: str = "dict",
     ) -> dict:
         if len(blocks) == 1 and blocks[0].attr == "__schema__":
             return self._schema_query(blocks[0])
@@ -1060,8 +1079,13 @@ class Server:
             batcher=batcher,
         )
         nodes = ex.process(blocks)
-        enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
-        return {"data": enc.encode_blocks(nodes)}
+        data, enc_stats = encode_response_data(
+            nodes, val_vars=ex.val_vars, schema=self.schema, want=want
+        )
+        prof = observe.current_profile()
+        if prof is not None:
+            prof.encode.update(enc_stats)
+        return {"data": data}
 
 
 def _query_preds(blocks) -> list:

@@ -85,6 +85,9 @@ DECLS = {
             _i64, _u64p, _i64p,
         ],
     ),
+    # codec.cpp — streaming arena result encoder
+    "enc_uid_objs": (_i64, [_u64p, _i64, _u8p, _i64, _u8p, _i64, _u8p]),
+    "enc_int_objs": (_i64, [_i64p, _i64, _u8p, _i64, _u8p, _i64, _u8p]),
     "intersect_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
     "union_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
     "difference_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
@@ -490,6 +493,45 @@ def pack_stream_setop(op, a, pack, bm, bm_bits):
         _ptr(kc, ctypes.c_int64),
     )
     return out[:n], kc
+
+
+def _enc_objs(fn_name, vals, ctype, per_item, pre: bytes, post: bytes):
+    """Shared driver for the arena encoder kernels: one native call
+    emits the whole run into a fresh scratch buffer; the returned
+    uint8 view is appended to the arena zero-copy (the final join is
+    the only copy). Returns None when the native lib is unavailable
+    (caller takes the byte-identical Python fallback)."""
+    if _LIB is None:
+        return None
+    n = vals.size
+    if n == 0:
+        return np.zeros((0,), np.uint8)
+    cap = n * (len(pre) + len(post) + per_item + 1)
+    out = np.empty((cap,), np.uint8)
+    preb = np.frombuffer(pre, np.uint8) if pre else np.zeros(1, np.uint8)
+    postb = np.frombuffer(post, np.uint8) if post else np.zeros(1, np.uint8)
+    got = getattr(_LIB, fn_name)(
+        _ptr(vals, ctype), n,
+        _ptr(preb, ctypes.c_uint8), len(pre),
+        _ptr(postb, ctypes.c_uint8), len(post),
+        _ptr(out, ctypes.c_uint8),
+    )
+    return out[:got]
+
+
+def enc_uid_objs(uids: np.ndarray, pre: bytes, post: bytes):
+    """`pre + hex(uid) + post` per uid, comma-joined — the
+    `{"uid":"0x1"},{"uid":"0x2"}` bulk emitter (query/streamjson.py).
+    Returns a uint8 array view or None without the native lib."""
+    uids = np.ascontiguousarray(uids, np.uint64)
+    return _enc_objs("enc_uid_objs", uids, ctypes.c_uint64, 16, pre, post)
+
+
+def enc_int_objs(vals: np.ndarray, pre: bytes, post: bytes):
+    """`pre + str(val) + post` per int64, comma-joined — the
+    `{"c":5},{"c":3}` count-object bulk emitter."""
+    vals = np.ascontiguousarray(vals, np.int64)
+    return _enc_objs("enc_int_objs", vals, ctypes.c_int64, 20, pre, post)
 
 
 def _setop(name: str, a: np.ndarray, b: np.ndarray, out_size: int) -> np.ndarray:

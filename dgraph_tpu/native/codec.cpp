@@ -653,4 +653,96 @@ int64_t sst_scan(const uint8_t* buf, int64_t end, int64_t off,
     return n;
 }
 
+// ---------------------------------------------------------------------------
+// Streaming arena result encoder (query/streamjson.py): emit the bulk JSON
+// row shapes — hex-uid entity arrays and count-object arrays — straight from
+// the ragged level buffers into the caller's byte buffer, one call per
+// contiguous run instead of one Python object per row. `pre`/`post` carry
+// the constant object framing (e.g. {"uid":"0x ... "}), so one kernel
+// serves every key/alias. Output formats are pinned to Python's: lowercase
+// unpadded hex (hex(u) minus the 0x that rides in `pre`) and decimal int64
+// (str(n)) — the byte-identity contract with json.dumps of the dict
+// encoder's output lives or dies on these two formats.
+// ---------------------------------------------------------------------------
+
+static inline int64_t put_u64_hex(uint64_t v, uint8_t* out) {
+    // lowercase, no leading zeros; "0" for 0 (python hex() semantics)
+    static const char digits[] = "0123456789abcdef";
+    if (v == 0) {
+        out[0] = '0';
+        return 1;
+    }
+    uint8_t tmp[16];
+    int n = 0;
+    while (v) {
+        tmp[n++] = (uint8_t)digits[v & 0xF];
+        v >>= 4;
+    }
+    for (int i = 0; i < n; i++) out[i] = tmp[n - 1 - i];
+    return n;
+}
+
+static inline int64_t put_i64_dec(int64_t v, uint8_t* out) {
+    uint8_t tmp[20];
+    int n = 0;
+    uint64_t u;
+    uint8_t* p = out;
+    if (v < 0) {
+        *p++ = '-';
+        u = (uint64_t)(-(v + 1)) + 1;  // INT64_MIN-safe negation
+    } else {
+        u = (uint64_t)v;
+    }
+    if (u == 0) tmp[n++] = '0';
+    while (u) {
+        tmp[n++] = (uint8_t)('0' + (u % 10));
+        u /= 10;
+    }
+    for (int i = 0; i < n; i++) p[i] = tmp[n - 1 - i];
+    return (p - out) + n;
+}
+
+// `{"uid":"0x1"},{"uid":"0x2"},...` — comma-separated, no enclosing
+// brackets (the caller owns list framing). Caller sizes `out` at
+// n * (pre_len + post_len + 17) bytes. Returns bytes written.
+int64_t enc_uid_objs(const uint64_t* uids, int64_t n, const uint8_t* pre,
+                     int64_t pre_len, const uint8_t* post, int64_t post_len,
+                     uint8_t* out) {
+    uint8_t* p = out;
+    for (int64_t i = 0; i < n; i++) {
+        if (i) *p++ = ',';
+        if (pre_len) {
+            memcpy(p, pre, (size_t)pre_len);
+            p += pre_len;
+        }
+        p += put_u64_hex(uids[i], p);
+        if (post_len) {
+            memcpy(p, post, (size_t)post_len);
+            p += post_len;
+        }
+    }
+    return p - out;
+}
+
+// `{"c":5},{"c":3},...` — the count-leaf analog. Caller sizes `out` at
+// n * (pre_len + post_len + 21) bytes. Returns bytes written.
+int64_t enc_int_objs(const int64_t* vals, int64_t n, const uint8_t* pre,
+                     int64_t pre_len, const uint8_t* post, int64_t post_len,
+                     uint8_t* out) {
+    uint8_t* p = out;
+    for (int64_t i = 0; i < n; i++) {
+        if (i) *p++ = ',';
+        if (pre_len) {
+            memcpy(p, pre, (size_t)pre_len);
+            p += pre_len;
+        }
+        p += put_i64_dec(vals[i], p);
+        if (post_len) {
+            memcpy(p, post, (size_t)post_len);
+            p += post_len;
+        }
+    }
+    return p - out;
+}
+
 }  // extern "C"

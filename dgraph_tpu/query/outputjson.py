@@ -9,41 +9,42 @@ leaves (outputnode.go normalize handling).
 
 from __future__ import annotations
 
+import base64 as _base64
 import datetime as _dt
+import sys as _sys
+from decimal import Decimal as _Decimal
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from dgraph_tpu.query.subgraph import MAXUID, ExecNode
+from dgraph_tpu.query.valuefmt import rfc3339, uid_hex
 from dgraph_tpu.types.types import TypeID, Val
+
+# module scope, NOT per-call: _json_val runs once per scalar value on
+# the hot encode path, and a function-local import re-executes the
+# import machinery (sys.modules lookup + frame setup) every time
+_MAXFLOAT = _sys.float_info.max
 
 
 def _json_val(v: Val) -> Any:
     x = v.value
     if isinstance(x, _dt.datetime):
-        # RFC3339 like the reference (outputnode.go -> time.Time.MarshalJSON):
-        # naive datetimes are UTC and print with the Z suffix
-        s = x.isoformat()
-        return s + "Z" if x.tzinfo is None else s.replace("+00:00", "Z")
+        # RFC3339 like the reference (outputnode.go -> time.Time.MarshalJSON)
+        return rfc3339(x)
     if v.tid == TypeID.VFLOAT:
         return [float(f) for f in x]
     if isinstance(x, bytes):
-        import base64
-
-        return base64.b64encode(x).decode()
+        return _base64.b64encode(x).decode()
     if isinstance(x, np.floating):
         x = float(x)
     if isinstance(x, np.integer):
         return int(x)
-    from decimal import Decimal
-
-    if isinstance(x, Decimal):
+    if isinstance(x, _Decimal):
         x = float(x)
     if isinstance(x, float) and (x == float("inf") or x == float("-inf")):
         # Go json marshals ±Inf as ±MaxFloat64 (ref outputnode floats)
-        import sys as _sys
-
-        return _sys.float_info.max if x > 0 else -_sys.float_info.max
+        return _MAXFLOAT if x > 0 else -_MAXFLOAT
     return x
 
 
@@ -69,7 +70,7 @@ def _display_name(c: ExecNode) -> str:
 
 
 def encode_uid(u: int) -> str:
-    return hex(int(u))
+    return uid_hex(u)
 
 
 class JsonEncoder:

@@ -15,6 +15,7 @@ from typing import List
 import numpy as np
 
 from dgraph_tpu.query.outputjson import encode_uid
+from dgraph_tpu.query.valuefmt import float_lit, rfc3339
 from dgraph_tpu.types.types import TypeID
 
 
@@ -23,11 +24,18 @@ def _literal(v) -> str:
     if v.tid == TypeID.INT:
         return f'"{int(val)}"^^<xs:int>'
     if v.tid == TypeID.FLOAT:
-        return f'"{float(val)}"^^<xs:float>'
+        return f'"{float_lit(val)}"^^<xs:float>'
     if v.tid == TypeID.BOOL:
         return f'"{"true" if val else "false"}"^^<xs:boolean>'
     if v.tid == TypeID.DATETIME:
-        s = val.isoformat() if isinstance(val, datetime.datetime) else str(val)
+        # the SAME RFC3339 form the JSON encoders emit (valuefmt) — a
+        # result exported as RDF round-trips through the loader with
+        # the zone explicit instead of dropped
+        s = (
+            rfc3339(val)
+            if isinstance(val, datetime.datetime)
+            else str(val)
+        )
         return f'"{s}"^^<xs:dateTime>'
     if v.tid == TypeID.VFLOAT:
         arr = np.asarray(val).tolist()

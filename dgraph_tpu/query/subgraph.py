@@ -936,6 +936,16 @@ class Executor:
                 pu = [int(u) for u in parent.dest_uids]
                 cs = [int(c) for c in lens]
                 cnode.counts = dict(zip(pu, cs))
+                # the level's length vector SURVIVES to encode time: the
+                # streaming encoder gathers per-row counts with one
+                # searchsorted over (parent dest_uids, lens) instead of
+                # len(row) dict lookups; keyed by identity on the parent
+                # array so cascade pruning (which reassigns dest_uids)
+                # invalidates it automatically (query/streamjson.py)
+                cnode.counts_vec = (
+                    parent.dest_uids,
+                    np.asarray(lens, np.int64),
+                )
             if cgq.var_name:
                 if cgq.is_count:
                     # `c as count(follow)`: a VALUE var keyed by the parent

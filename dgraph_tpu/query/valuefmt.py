@@ -1,0 +1,31 @@
+"""Shared value formatters for the result encoders.
+
+ONE formatter per wire shape — RFC3339 datetimes, hex uids, float
+literals — consumed by the dict JSON encoder (outputjson.py), the
+streaming arena encoder (streamjson.py), and the RDF encoder
+(outputrdf.py). Before this module each encoder carried its own copy
+and the copies were free to drift (outputrdf printed naive datetimes
+without the Z suffix the JSON path emits).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+
+def rfc3339(x: _dt.datetime) -> str:
+    """RFC3339 like the reference (outputnode.go -> time.Time.MarshalJSON):
+    naive datetimes are UTC and print with the Z suffix."""
+    s = x.isoformat()
+    return s + "Z" if x.tzinfo is None else s.replace("+00:00", "Z")
+
+
+def uid_hex(u: int) -> str:
+    """Lowercase 0x-prefixed hex, no zero padding (ref fmt.Sprintf %#x)."""
+    return hex(int(u))
+
+
+def float_lit(f: float) -> str:
+    """Shortest round-trip float literal (Python repr — what both the
+    RDF encoder and json.dumps emit for finite floats)."""
+    return repr(float(f))

@@ -820,6 +820,11 @@ class QueryProfile:
         self.events: Dict[str, float] = {}
         self.kernel: Dict[str, float] = {}
         self.max_queue_depth = 0  # exec-pool backlog seen by this query
+        # result-encoding attribution (query/streamjson.py): encode_ns
+        # (wire-bytes production), bytes, stream (which path), parse_ns
+        # (dict-API compat parse-back), and the share of total latency
+        # stamped by the server at response assembly
+        self.encode: Dict[str, float] = {}
 
     def record_level_task(
         self, attr: str, level: int, parents: int, ms: float,
@@ -870,6 +875,7 @@ class QueryProfile:
                 "events": {
                     k: v for k, v in self.events.items() if v
                 },
+                "encode": dict(self.encode),
                 "exec_pool": {
                     "max_queue_depth": self.max_queue_depth
                 },
@@ -1284,6 +1290,19 @@ declare_metric(
     "counter", "slow_queries_total",
     "Operations exceeding DGRAPH_TPU_SLOW_QUERY_MS (force-sampled and "
     "appended to the slow-query log).",
+)
+declare_metric(
+    "counter", "stream_encode_fallback_nodes_total",
+    "Result blocks the streaming arena encoder handed back to the dict "
+    "encoder (shapes the streaming composer does not replicate: "
+    "@groupby, @normalize, facets, shortest-path, language fan-out) "
+    "(query/streamjson.py).",
+)
+declare_metric(
+    "counter", "stream_encode_native_bytes_total",
+    "Response bytes emitted block-at-a-time by the native arena "
+    "encoder kernels (enc_uid_objs/enc_int_objs in native/codec.cpp) "
+    "instead of per-entity Python objects (query/streamjson.py).",
 )
 declare_metric(
     "gauge", "admission_inflight_queries",

@@ -37,7 +37,7 @@ class _TxnFace(ClusterTxn):
 
     def query(self, q: str, access_jwt: Optional[str] = None) -> dict:
         from dgraph_tpu import dql
-        from dgraph_tpu.query.outputjson import JsonEncoder
+        from dgraph_tpu.query.streamjson import encode_response_data
         from dgraph_tpu.query.subgraph import Executor
 
         ex = Executor(
@@ -46,8 +46,10 @@ class _TxnFace(ClusterTxn):
             vector_indexes=self.cluster.vector_indexes,
         )
         nodes = ex.process(dql.parse(q))
-        enc = JsonEncoder(val_vars=ex.val_vars, schema=self.cluster.schema)
-        return {"data": enc.encode_blocks(nodes)}
+        data, _ = encode_response_data(
+            nodes, val_vars=ex.val_vars, schema=self.cluster.schema
+        )
+        return {"data": data}
 
     def mutate_json(
         self, set_obj=None, del_obj=None, commit_now=False, access_jwt=None
@@ -176,12 +178,13 @@ class ClusterFacade:
         access_jwt: Optional[str] = None,
         variables: Optional[Dict[str, str]] = None,
         timeout_ms: Optional[float] = None,
+        want: str = "dict",
     ) -> dict:
         import time as _time
 
         from dgraph_tpu import dql
         from dgraph_tpu.posting.lists import LocalCache
-        from dgraph_tpu.query.outputjson import JsonEncoder
+        from dgraph_tpu.query.streamjson import encode_response_data
         from dgraph_tpu.query.subgraph import Executor
 
         ts = read_ts if read_ts is not None else self.cluster.zero.zero.read_ts()
@@ -198,8 +201,11 @@ class ClusterFacade:
             ),
         )
         nodes = ex.process(dql.parse(q, variables))
-        enc = JsonEncoder(val_vars=ex.val_vars, schema=self.cluster.schema)
-        return {"data": enc.encode_blocks(nodes)}
+        data, _ = encode_response_data(
+            nodes, val_vars=ex.val_vars, schema=self.cluster.schema,
+            want=want,
+        )
+        return {"data": data}
 
     def query_rdf(self, q, read_ts=None, variables=None) -> str:
         from dgraph_tpu import dql

@@ -696,15 +696,17 @@ class DistributedCluster:
 
     def query(self, q: str, read_ts: Optional[int] = None) -> dict:
         from dgraph_tpu import dql
-        from dgraph_tpu.query.outputjson import JsonEncoder
+        from dgraph_tpu.query.streamjson import encode_response_data
         from dgraph_tpu.query.subgraph import Executor
 
         ts = read_ts if read_ts is not None else self.zero.zero.read_ts()
         cache = LocalCache(RoutingKV(self), ts, mem=self.mem)
         ex = Executor(cache, self.schema, vector_indexes=self.vector_indexes)
         nodes = ex.process(dql.parse(q))
-        enc = JsonEncoder(val_vars=ex.val_vars, schema=self.schema)
-        return {"data": enc.encode_blocks(nodes)}
+        data, _ = encode_response_data(
+            nodes, val_vars=ex.val_vars, schema=self.schema
+        )
+        return {"data": data}
 
     # -- tablet move / rebalance (ref zero/tablet.go, predicate_move.go) --------
 

@@ -290,6 +290,16 @@ _define(
     "(spill-to-disk SSTables) (storage/kv.py).",
 )
 _define(
+    "STREAM_ENCODER", "bool", True,
+    "Streaming arena result encoder (query/streamjson.py): response "
+    "JSON streams straight from the ragged (flat_uids, offsets) level "
+    "buffers into byte buffers, with native block-at-a-time emission "
+    "of hex-uid and count-object arrays — byte-identical to the dict "
+    "encoder by contract. 0 is the escape hatch back to the "
+    "ExecNode->dict->json.dumps path (query/outputjson.py) for A/B "
+    "benchmarking (BENCH_ENCODE.json) and triage.",
+)
+_define(
     "TRACE", "bool", True,
     "Master tracing switch. 0 = spans become allocation-only no-ops "
     "(no ids, no ring, no histograms) — the benchmarking baseline for "

@@ -68,9 +68,12 @@ def test_packed_setops_corpus_under_ubsan():
             sys.executable, "-m", "pytest",
             # test_bitmap_setops drives the adaptive-engine kernels
             # (bitmap AND/ANDNOT windows, probes, galloping merges)
-            # through the same adversarial corpus
+            # through the same adversarial corpus; test_stream_encoder
+            # covers the arena encoder entry points (enc_uid_objs /
+            # enc_int_objs) incl. the INT64_MIN negation and 0xfff...
+            # hex edge values
             "tests/test_packed_setops.py", "tests/test_uidpack.py",
-            "tests/test_bitmap_setops.py",
+            "tests/test_bitmap_setops.py", "tests/test_stream_encoder.py",
             "-q", "-m", "not slow", "-p", "no:cacheprovider",
         ],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=900,

@@ -52,6 +52,35 @@ def test_rdf_round_trips_through_loader(server):
     assert q["age"] == 30 and q["friend"][0]["name"] == "Bob"
 
 
+def test_rdf_shares_json_value_formats(server):
+    """RDF literals come from the SAME valuefmt formatters the JSON
+    encoders use — pin the golden forms so the copies can't drift
+    again (before valuefmt, RDF printed naive datetimes without the Z
+    suffix the JSON path emits, so an exported result re-imported with
+    a shifted zone)."""
+    t = server.new_txn()
+    t.mutate_rdf(
+        set_rdf=(
+            '<0x7> <name> "Tick" .\n'
+            '<0x7> <when> "1980-05-01T10:30:00Z"^^<xs:dateTime> .\n'
+            '<0x7> <score> "2.5"^^<xs:float> .'
+        ),
+        commit_now=True,
+    )
+    rdf = server.query_rdf(
+        '{ q(func: eq(name, "Tick")) { name when score } }'
+    )
+    lines = set(rdf.strip().splitlines())
+    # naive-stored datetime prints RFC3339 with the Z suffix (JSON form)
+    assert '<0x7> <when> "1980-05-01T10:30:00Z"^^<xs:dateTime> .' in lines
+    assert '<0x7> <score> "2.5"^^<xs:float> .' in lines
+    # and the JSON path emits the identical scalar text
+    out = server.query('{ q(func: eq(name, "Tick")) { when score } }')
+    assert out["data"].raw is not None
+    assert b'"when":"1980-05-01T10:30:00Z"' in out["data"].raw
+    assert b'"score":2.5' in out["data"].raw
+
+
 def test_grpc_resp_format_rdf(server):
     from dgraph_tpu.api.grpc_server import pb, serve
 

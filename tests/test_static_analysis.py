@@ -413,6 +413,86 @@ def test_abi_catches_bitmap_kernel_width_mismatch():
     assert "bitmap_and_block" in out[0].message and "arg 2" in out[0].message
 
 
+_SYN_ENCODER_CPP = """
+extern "C" {
+
+int64_t enc_uid_objs(const uint64_t* uids, int64_t n, const uint8_t* pre,
+                     int64_t pre_len, const uint8_t* post, int64_t post_len,
+                     uint8_t* out) {
+    return 0;
+}
+
+}  // extern "C"
+"""
+
+
+def test_abi_catches_encoder_width_mismatch():
+    """Seeded violation for the arena-encoder kernel class: the uid
+    pointer declared c_uint32* against the C++ uint64_t* must be
+    flagged (the kernel would read half-width uids and emit garbage
+    hex — silently, since the call still 'works')."""
+    i64 = ctypes.c_int64
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    good = {
+        "enc_uid_objs": (i64, [u64p, i64, u8p, i64, u8p, i64, u8p])
+    }
+    assert (
+        check_ctypes_abi.check_abi(
+            {"native/syn_enc.cpp": _SYN_ENCODER_CPP},
+            good,
+            "native/__init__.py",
+        )
+        == []
+    )
+    bad = {
+        "enc_uid_objs": (
+            i64,
+            [
+                ctypes.POINTER(ctypes.c_uint32),
+                i64, u8p, i64, u8p, i64, u8p,
+            ],
+        )
+    }
+    out = check_ctypes_abi.check_abi(
+        {"native/syn_enc.cpp": _SYN_ENCODER_CPP},
+        bad,
+        "native/__init__.py",
+    )
+    assert [v.code for v in out] == ["arg-type-mismatch"]
+    assert "enc_uid_objs" in out[0].message and "arg 0" in out[0].message
+    # the length parameter truncated to c_int32 is the other silent
+    # corruption class (a >2^31-row run would wrap negative)
+    bad_n = {
+        "enc_uid_objs": (
+            i64,
+            [u64p, ctypes.c_int32, u8p, i64, u8p, i64, u8p],
+        )
+    }
+    out = check_ctypes_abi.check_abi(
+        {"native/syn_enc.cpp": _SYN_ENCODER_CPP},
+        bad_n,
+        "native/__init__.py",
+    )
+    assert [v.code for v in out] == ["arg-type-mismatch"]
+
+
+def test_abi_covers_encoder_exports():
+    """The real arena-encoder entry points are parsed from codec.cpp and
+    covered by DECLS (the ctypes-abi analyzer then enforces full
+    width/signedness equality on every run)."""
+    from dgraph_tpu import native
+
+    with open(
+        os.path.join(REPO, "dgraph_tpu", "native", "codec.cpp")
+    ) as f:
+        exports = check_ctypes_abi.parse_cpp_exports(f.read())
+    for name in ("enc_uid_objs", "enc_int_objs"):
+        assert name in exports, name
+        assert name in native.DECLS, name
+        assert len(exports[name][1]) == len(native.DECLS[name][1]), name
+
+
 def test_abi_covers_adaptive_engine_exports():
     """The real adaptive-engine entry points are parsed from codec.cpp
     and covered by DECLS (regression guard for the new kernels)."""

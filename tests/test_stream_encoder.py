@@ -1,0 +1,356 @@
+"""Streaming arena encoder byte-identity (query/streamjson.py).
+
+The streaming encoder — native kernels AND pure-Python fallback — must
+be byte-identical to the dict encoder (``encode_blocks`` +
+``json.dumps``) on every query: the DQL golden corpus (smoke subset in
+tier-1, the full 535-case sweep slow-marked), plus the value shapes the
+composer hand-formats or splices (RFC3339 datetimes, ±Inf→MaxFloat64,
+base64 bytes, @normalize, facet keys, count(pred) forms). The
+DGRAPH_TPU_STREAM_ENCODER escape hatch must route the whole response
+path and the spliced response assembly must parse back to the dict
+API's view.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "ref_golden")
+CASES = json.load(open(os.path.join(HERE, "cases.json")))
+
+# every ~9th case: wide coverage across the query0..4/facets/math suites
+# without stalling tier-1 on the 1-core box
+SMOKE_CASES = CASES[::9]
+
+
+def _exec(server, q):
+    """Run q through the executor once; encoding variants then compare
+    over the SAME executed tree (isolates the encoder from any
+    execution nondeterminism)."""
+    from dgraph_tpu import dql
+    from dgraph_tpu.posting.lists import LocalCache
+    from dgraph_tpu.query.subgraph import Executor
+
+    cache = LocalCache(server.kv, server.zero.read_ts(), mem=server.mem)
+    ex = Executor(
+        cache,
+        server.schema,
+        vector_indexes=server.vector_indexes,
+        stats=server.stats,
+    )
+    nodes = ex.process(dql.parse(q))
+    return nodes, ex
+
+
+def _three_ways(server, q):
+    """(dict-path bytes, streaming native bytes, streaming python
+    bytes) for one query — or the error repr when execution fails
+    (every encoder variant must then be unreachable the same way)."""
+    from dgraph_tpu.query.streamjson import encode_data_bytes
+
+    try:
+        nodes, ex = _exec(server, q)
+    except Exception as exc:
+        e = f"{type(exc).__name__}: {exc}"
+        return e, e, e
+    kw = dict(val_vars=ex.val_vars, schema=server.schema)
+    want = encode_data_bytes(nodes, stream=False, **kw).to_bytes()
+    native = encode_data_bytes(
+        nodes, stream=True, native_ok=True, **kw
+    ).to_bytes()
+    py = encode_data_bytes(
+        nodes, stream=True, native_ok=False, **kw
+    ).to_bytes()
+    return want, native, py
+
+
+@pytest.fixture(scope="module")
+def golden_server():
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter(open(os.path.join(HERE, "schema.txt")).read())
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf=open(os.path.join(HERE, "triples.rdf")).read(),
+        commit_now=True,
+    )
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf=open(os.path.join(HERE, "triples_facets.rdf")).read(),
+        commit_now=True,
+    )
+    return s
+
+
+@pytest.mark.parametrize(
+    "case", SMOKE_CASES, ids=[c["id"] for c in SMOKE_CASES]
+)
+def test_golden_corpus_smoke(golden_server, case):
+    want, native, py = _three_ways(golden_server, case["query"])
+    assert want == native
+    assert want == py
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("case", CASES, ids=[c["id"] for c in CASES])
+def test_golden_corpus_full(golden_server, case):
+    want, native, py = _three_ways(golden_server, case["query"])
+    assert want == native
+    assert want == py
+
+
+# ---------------------------------------------------------------------------
+# Value shapes the streaming composer hand-formats or must fall back on.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def shape_server():
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter(
+        "name: string @index(exact) .\n"
+        "friend: [uid] @count .\n"
+        "boss: uid .\n"
+        "dob: datetime .\n"
+        "score: float .\n"
+        "blob: binary .\n"
+        "tags: [string] .\n"
+    )
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf=(
+            '<0x1> <name> "Alice" .\n'
+            '<0x1> <dob> "1910-01-01T07:30:00Z"^^<xs:dateTime> .\n'
+            '<0x1> <score> "inf"^^<xs:float> .\n'
+            '<0x2> <score> "-inf"^^<xs:float> .\n'
+            '<0x1> <tags> "a" .\n'
+            '<0x1> <tags> "b" .\n'
+            '<0x2> <name> "Bob" .\n'
+            '<0x3> <name> "Chan" .\n'
+            "<0x1> <friend> <0x2> .\n"
+            "<0x1> <friend> <0x3> .\n"
+            "<0x2> <friend> <0x3> .\n"
+            "<0x1> <boss> <0x2> (since=2006-01-02T15:04:05) .\n"
+        ),
+        commit_now=True,
+    )
+    return s
+
+
+SHAPE_QUERIES = [
+    # RFC3339 datetimes + ±Inf -> ±MaxFloat64 + string lists
+    '{ q(func: has(name)) { name dob score tags } }',
+    # count(pred) leaf per entity and count(uid) block form
+    '{ q(func: has(name)) { name cnt: count(friend) } }',
+    '{ q(func: has(name)) { count(uid) } }',
+    # pure-uid child rows (the native enc_uid_objs shape)
+    '{ q(func: has(name)) { friend { uid } } }',
+    # count-object child rows under a uid pred
+    '{ q(func: has(name)) { friend { c: count(friend) } } }',
+    # non-list uid pred encodes as ONE object, with facet fallback
+    '{ q(func: has(name)) { boss @facets { name } } }',
+    '{ q(func: has(name)) { boss @facets(since) { name } } }',
+    # @normalize falls back to the dict encoder for that block
+    '{ q(func: has(name)) @normalize { n: name friend { fn: name } } }',
+    # aggregates + math at block level
+    '{ var(func: has(name)) { s as score } '
+    '  q() { mx: max(val(s)) mn: min(val(s)) } }',
+    # empty result block
+    '{ q(func: eq(name, "Nobody")) { name } }',
+]
+
+
+@pytest.mark.parametrize("q", SHAPE_QUERIES)
+def test_shape_identity(shape_server, q):
+    want, native, py = _three_ways(shape_server, q)
+    assert want == native
+    assert want == py
+
+
+def test_ordered_root_count_rows(shape_server):
+    """Root orderasc/orderdesc reorders dest_uids by VALUE — the
+    count-gather must not binary-search the now-unsorted level key
+    vector (regression: searchsorted over value-ordered parents
+    returned 0 for every row)."""
+    for order in ("orderasc", "orderdesc"):
+        q = (
+            "{ q(func: has(name), %s: name) "
+            "{ name c: count(friend) } }" % order
+        )
+        want, native, py = _three_ways(shape_server, q)
+        assert want == native == py
+        # the regression emitted 0 for EVERY row; Alice/Bob have friends
+        assert b'"c":2' in want and b'"c":1' in want
+
+
+def test_bytes_value_b64(shape_server):
+    """binary values serialize base64 — through a JSON mutation (the
+    RDF path has no binary literal form)."""
+    t = shape_server.new_txn()
+    t.mutate_json(
+        set_obj={"uid": "0x4", "name": "Blobby", "blob": "aGVsbG8="},
+        commit_now=True,
+    )
+    want, native, py = _three_ways(
+        shape_server, '{ q(func: eq(name, "Blobby")) { blob } }'
+    )
+    # binary stores the literal value bytes; output re-base64s them
+    assert b'"blob":"YUdWc2JHOD0="' in want
+    assert want == native
+    assert want == py
+
+
+def test_inf_is_maxfloat(shape_server):
+    """Go json marshals ±Inf as ±MaxFloat64 (ref outputnode floats) —
+    pin the literal so both encoders keep matching it."""
+    want, native, py = _three_ways(
+        shape_server, '{ q(func: has(score), orderasc: score) { score } }'
+    )
+    assert b"1.7976931348623157e+308" in want
+    assert b"-1.7976931348623157e+308" in want
+    assert want == native == py
+
+
+def test_datetime_rfc3339(shape_server):
+    want, native, py = _three_ways(
+        shape_server, '{ q(func: eq(name, "Alice")) { dob } }'
+    )
+    assert b'"1910-01-01T07:30:00Z"' in want
+    assert want == native == py
+
+
+# ---------------------------------------------------------------------------
+# Bulk emitters at native width (> 32 rows triggers the kernels) and the
+# response-path escape hatch.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def wide_server():
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter("name: string @index(exact) .\nfollow: [uid] @count .")
+    rows = ['<0x1> <name> "hub" .']
+    for i in range(2, 203):
+        rows.append(f"<0x1> <follow> <{hex(i)}> .")
+        rows.append(f'<{hex(i)}> <name> "n{i}" .')
+        rows.append(f"<{hex(i)}> <follow> <0x1> .")
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf="\n".join(rows), commit_now=True)
+    return s
+
+
+def test_wide_uid_rows_native(wide_server):
+    from dgraph_tpu.utils.observe import METRICS
+
+    before = METRICS.value("stream_encode_native_bytes_total")
+    want, native, py = _three_ways(
+        wide_server, '{ q(func: eq(name, "hub")) { follow { uid } } }'
+    )
+    assert want == native == py
+    assert want.count(b'"uid"') == 201
+    from dgraph_tpu import native as native_mod
+
+    if native_mod.NATIVE_AVAILABLE:
+        assert (
+            METRICS.value("stream_encode_native_bytes_total") > before
+        )
+
+
+def test_wide_count_rows_native(wide_server):
+    want, native, py = _three_ways(
+        wide_server,
+        '{ q(func: eq(name, "hub")) { follow { c: count(follow) } } }',
+    )
+    assert want == native == py
+    assert want.count(b'"c":') == 201
+
+
+def test_escape_hatch_roundtrip(wide_server, monkeypatch):
+    """DGRAPH_TPU_STREAM_ENCODER ∈ {0, 1} through the PUBLIC query
+    path: identical dict view, identical raw bytes, and the spliced
+    response envelope parses back to the same object."""
+    from dgraph_tpu.query import streamjson
+
+    q = '{ q(func: has(name), first: 40) { uid name follow { uid } } }'
+    outs = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("DGRAPH_TPU_STREAM_ENCODER", flag)
+        res = wide_server.query(q)
+        outs[flag] = res
+        assert isinstance(res["data"], dict)  # dict API intact
+        assert res["data"].raw is not None
+        body = streamjson.response_bytes(res)
+        parsed = json.loads(body)
+        assert parsed["data"] == res["data"]
+        assert res["extensions"]["server_latency"]["encoding_ns"] > 0
+        enc_prof = res["extensions"]["profile"]["encode"]
+        assert enc_prof["stream"] == int(flag)
+        assert enc_prof["bytes"] == len(res["data"].raw)
+    assert outs["0"]["data"] == outs["1"]["data"]
+    assert outs["0"]["data"].raw == outs["1"]["data"].raw
+
+
+def test_want_raw_skips_parse_back(wide_server):
+    from dgraph_tpu.query.streamjson import RawJson
+
+    res = wide_server.query(
+        "{ q(func: has(name), first: 3) { uid } }", want="raw"
+    )
+    assert isinstance(res["data"], RawJson)
+    assert json.loads(res["data"].raw) == {
+        "q": [{"uid": "0x1"}, {"uid": "0x2"}, {"uid": "0x3"}]
+    }
+    assert "parse_ns" not in res["extensions"]["profile"]["encode"]
+
+
+def test_fallback_counter_ticks(shape_server):
+    from dgraph_tpu.utils.observe import METRICS
+
+    before = METRICS.value("stream_encode_fallback_nodes_total")
+    want, native, py = _three_ways(
+        shape_server,
+        '{ q(func: has(name)) @normalize { n: name } }',
+    )
+    assert want == native == py
+    assert METRICS.value("stream_encode_fallback_nodes_total") > before
+
+
+def test_arena_mark_truncate():
+    from dgraph_tpu.query.streamjson import Arena
+
+    a = Arena()
+    a.write(b"abc")
+    m = a.mark()
+    a.write(b"defg")
+    a.write(memoryview(b"hi"))
+    assert a.length == 9
+    a.truncate(m)
+    assert a.to_bytes() == b"abc" and a.length == 3
+
+
+def test_enc_kernels_match_python():
+    """Native emitters vs the Python fallback formats, including the
+    edge values the hex/decimal formatters hand-roll."""
+    from dgraph_tpu import native
+
+    if not native.NATIVE_AVAILABLE:
+        pytest.skip("native lib unavailable")
+    uids = np.array(
+        [0, 1, 9, 15, 16, 255, 2**32 - 1, 2**63, 2**64 - 1], np.uint64
+    )
+    got = bytes(native.enc_uid_objs(uids, b'{"uid":"0x', b'"}'))
+    want = b",".join(b'{"uid":"0x%x"}' % u for u in uids.tolist())
+    assert got == want
+    vals = np.array(
+        [0, 1, -1, 10, -(2**63), 2**63 - 1, 12345678901234], np.int64
+    )
+    got = bytes(native.enc_int_objs(vals, b'{"c":', b"}"))
+    want = b",".join(b'{"c":%d}' % v for v in vals.tolist())
+    assert got == want

@@ -34,10 +34,14 @@ else
         tests/test_replicated_zero.py tests/test_cluster_facade.py \
         tests/test_observability.py tests/test_distributed_tracing.py \
         tests/test_serving_front.py \
+        tests/test_stream_encoder.py \
         -q -p no:cacheprovider
 
     echo "== qps loadgen sanity (~5s) =="
     python benchmarks/qps_loadgen.py --sanity
+
+    echo "== encode microbench sanity (~5s) =="
+    python bench.py --encode-sanity
 fi
 
 echo "check.sh: all stages passed"
