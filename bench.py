@@ -627,6 +627,169 @@ def _bench_encode(platform, sanity=False):
     )
 
 
+def _bench_vector(platform, sanity=False):
+    """Quantized vector engine A/B (BENCH_VECTOR.json, ISSUE 9):
+
+      float_brute        the jitted float32 batched scan, forced via the
+                         DGRAPH_TPU_VEC_QUANT=0 escape hatch — the exact
+                         baseline AND the recall ground truth
+      quant_brute        the int8 scan kernels, full corpus (exact after
+                         the float32 rerank — recall should be ~1.0)
+      quant_ivf          the incremental quantized IVF tier (sampled
+                         mini-batch k-means + top-2 cell assignment);
+                         reports build seconds vs the r5 255s sync train
+      incremental        inserts + removes against the built IVF index:
+                         asserts NO rebuild ran and results stay correct
+
+    All tiers run in the SAME process over the SAME corpus (same-run
+    A/B). --vector-sanity shrinks the corpus to a ~5s gate that asserts
+    exact A/B top-k equality + recall floors, and stamps nothing.
+    """
+    import gc
+    import os
+
+    from benchmarks import stamp
+    from dgraph_tpu.models import vector as vecmod
+    from dgraph_tpu.models.vector import VectorIndex
+
+    n, d = (20_000, 64) if sanity else (1_000_000, 768)
+    k, qb = 10, 64
+    nq = 64 if sanity else 256
+    if sanity:
+        # the quantized engine normally wants >= _QUANT_MIN live rows
+        vecmod._QUANT_MIN = 1
+    rng = np.random.default_rng(1)
+    # mixture-of-gaussians corpus: real embedding sets cluster; pure
+    # isotropic gaussian is IVF's pathological worst case (distance
+    # concentration) and misrepresents production recall
+    n_clusters = 256
+    centers = rng.standard_normal((n_clusters, d)).astype(np.float32) * 4.0
+    V = (
+        centers[rng.integers(0, n_clusters, n)]
+        + rng.standard_normal((n, d)).astype(np.float32)
+    )
+    Qs = (
+        centers[rng.integers(0, n_clusters, nq)]
+        + rng.standard_normal((nq, d))
+    ).astype(np.float32)
+    uids = np.arange(1, n + 1, dtype=np.uint64)
+
+    def timed_batches(ix):
+        ix.search_batch(Qs[:qb], k)  # warm (compile / quantize view)
+        t0 = time.perf_counter()
+        rows = [
+            ix.search_batch(Qs[i : i + qb], k) for i in range(0, nq, qb)
+        ]
+        dt = time.perf_counter() - t0
+        return np.concatenate(rows, axis=0), nq / dt
+
+    def recall(got, exact):
+        hits = sum(
+            len(set(map(int, got[i])) & set(map(int, exact[i])))
+            for i in range(nq)
+        )
+        return hits / (nq * k)
+
+    out = {"n_vectors": n, "dim": d, "query_batch": qb, "k": k}
+
+    # -- A: float32 jit brute (escape hatch) — baseline + ground truth
+    os.environ["DGRAPH_TPU_VEC_QUANT"] = "0"
+    idx = VectorIndex("emb", ivf_threshold=1 << 62)
+    idx.bulk_load(uids, V)
+    exact, float_qps = timed_batches(idx)
+    assert not idx._use_quant()
+    idx._device = None
+    del idx
+    gc.collect()
+    out["float_brute_qps"] = round(float_qps, 1)
+
+    # -- B: quantized int8 brute (native kernels + float32 rerank)
+    os.environ["DGRAPH_TPU_VEC_QUANT"] = "1"
+    idxq = VectorIndex("emb", ivf_threshold=1 << 62)
+    idxq.bulk_load(uids, V)
+    t0 = time.perf_counter()
+    idxq._quant_view()  # quantize the corpus (no IVF at this threshold)
+    out["quantize_seconds"] = round(time.perf_counter() - t0, 1)
+    assert idxq._use_quant(), "quantized engine must engage for the A/B"
+    qgot, quant_qps = timed_batches(idxq)
+    out["quant_brute_qps"] = round(quant_qps, 1)
+    out["quant_brute_recall_at_10"] = round(recall(qgot, exact), 3)
+    del idxq
+    gc.collect()
+
+    # -- C: quantized incremental IVF (build + serve)
+    idx2 = VectorIndex("emb2", ivf_threshold=1)
+    idx2.bulk_load(uids, V)
+    t0 = time.perf_counter()
+    idx2._quant_view()  # quantize + centroid train + cell assignment
+    build_s = time.perf_counter() - t0
+    out["ivf_build_seconds"] = round(build_s, 1)
+    igot, ivf_qps = timed_batches(idx2)
+    out["quant_ivf_qps"] = round(ivf_qps, 1)
+    out["quant_ivf_recall_at_10"] = round(recall(igot, exact), 3)
+
+    idx2.search(Qs[0], k)  # warm the single-query path
+    t0 = time.perf_counter()
+    for q in Qs[:10]:
+        idx2.search(q, k)
+    out["ivf_latency_ms_single"] = round(
+        (time.perf_counter() - t0) / 10 * 1e3, 2
+    )
+
+    # -- D: incremental mutations serve correct results, NO rebuild
+    builds_before = (idx2.build_count, idx2.repartition_count)
+    new_vecs = centers[rng.integers(0, n_clusters, 64)] + rng.standard_normal(
+        (64, d)
+    ).astype(np.float32)
+    t0 = time.perf_counter()
+    for j in range(64):
+        idx2.insert(n + 1 + j, new_vecs[j])
+    for u in rng.choice(uids, 64, replace=False):
+        idx2.remove(int(u))
+    res = idx2.search_batch(new_vecs[:16], k)
+    mut_ms = (time.perf_counter() - t0) * 1e3
+    assert (idx2.build_count, idx2.repartition_count) == builds_before, (
+        "mutation triggered a rebuild/repartition"
+    )
+    assert all(int(res[j][0]) == n + 1 + j for j in range(16)), (
+        "inserted vectors not served as their own nearest neighbor"
+    )
+    out["incremental_64ins_64del_plus_16q_ms"] = round(mut_ms, 1)
+
+    best_qps = max(out["quant_brute_qps"], out["quant_ivf_qps"])
+    out["speedup_x_vs_float_brute"] = round(best_qps / max(float_qps, 1e-9), 1)
+    out["build_speedup_x_vs_r5_sync"] = round(255.0 / max(build_s, 1e-9), 1)
+    out["native_kernels"] = __import__(
+        "dgraph_tpu.native", fromlist=["NATIVE_AVAILABLE"]
+    ).NATIVE_AVAILABLE
+    os.environ.pop("DGRAPH_TPU_VEC_QUANT", None)
+
+    for metric in (
+        "float_brute_qps", "quant_brute_qps", "quant_ivf_qps",
+        "quant_brute_recall_at_10", "quant_ivf_recall_at_10",
+        "ivf_build_seconds", "speedup_x_vs_float_brute",
+    ):
+        print(
+            json.dumps(
+                {"metric": metric, "value": out[metric],
+                 "platform": platform}
+            )
+        )
+
+    if sanity:
+        # exact A/B identity: both brute tiers are exact, so each row's
+        # top-k SET must match (ordering of ulp-close neighbors may
+        # differ between the XLA matmul and the rerank dot)
+        assert np.array_equal(np.sort(qgot, 1), np.sort(exact, 1)), (
+            "quant/float brute A/B differ"
+        )
+        assert out["quant_ivf_recall_at_10"] >= 0.95, out
+        print("vector sanity: A/B identity + recall + no-rebuild ok",
+              file=sys.stderr)
+        return
+    stamp.guarded_write("BENCH_VECTOR.json", out, platform)
+
+
 def _bench_chaos(platform):
     """Retry-storm visibility (BENCH_CHAOS.json): a fixed-seed fault
     schedule (drops + delays + disconnects + lost acks) over an
@@ -728,6 +891,17 @@ if __name__ == "__main__":
         _bench_encode(
             _jax.default_backend(),
             sanity="--encode-sanity" in sys.argv,
+        )
+    elif "--vector-only" in sys.argv or "--vector-sanity" in sys.argv:
+        # quantized-vector-engine capture (BENCH_VECTOR.json); host-path
+        from dgraph_tpu.devsetup import maybe_force_cpu
+
+        maybe_force_cpu()
+        import jax as _jax
+
+        _bench_vector(
+            _jax.default_backend(),
+            sanity="--vector-sanity" in sys.argv,
         )
     elif "--obs-only" in sys.argv:
         # tracing-overhead capture (BENCH_OBS.json); host-path only

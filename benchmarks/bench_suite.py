@@ -102,11 +102,10 @@ def bench_vector(scale: str) -> dict:
         + rng.standard_normal((nq, d))
     ).astype(np.float32)
 
-    uids = list(range(1, n + 1))
-    rows = {u: u - 1 for u in uids}
+    uids = np.arange(1, n + 1, dtype=np.uint64)
 
     idx = VectorIndex("emb", ivf_threshold=1 << 62)  # brute force tier
-    idx._uids, idx._rows, idx._vecs, idx._n, idx._dirty = uids, rows, V, n, True
+    idx.bulk_load(uids, V)
 
     idx.search_batch(Qs[:qb], k)  # compile + upload
     t0 = time.time()
@@ -126,12 +125,13 @@ def bench_vector(scale: str) -> dict:
     del idx
     gc.collect()
 
-    idx2 = VectorIndex("emb2", ivf_threshold=1)  # auto nprobe (~12% cells)
-    idx2._uids, idx2._rows, idx2._vecs, idx2._n, idx2._dirty = (
-        uids, rows, V, n, True,
-    )
+    idx2 = VectorIndex("emb2", ivf_threshold=1)  # auto nprobe
+    idx2.bulk_load(uids, V)
     t0 = time.time()
-    idx2._sync_device()  # includes the corpus device upload + IVF train
+    if idx2._use_quant():
+        idx2._quant_view()  # quantize + centroid train + cell assignment
+    else:
+        idx2._sync_device()  # corpus device upload + slab IVF train
     ivf_sync_build_s = time.time() - t0
 
     idx2.search_batch(Qs[:qb], k)  # compile

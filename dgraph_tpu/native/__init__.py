@@ -39,11 +39,15 @@ NATIVE_AVAILABLE = False
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 _u32p = ctypes.POINTER(ctypes.c_uint32)
 _u64p = ctypes.POINTER(ctypes.c_uint64)
+_i8p = ctypes.POINTER(ctypes.c_int8)
+_i32 = ctypes.c_int32
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _i64 = ctypes.c_int64
 _i64p = ctypes.POINTER(ctypes.c_int64)
 _u64 = ctypes.c_uint64
 _int = ctypes.c_int
+_f32 = ctypes.c_float
+_f32p = ctypes.POINTER(ctypes.c_float)
 _vp = ctypes.c_void_p
 _cp = ctypes.c_char_p
 
@@ -88,6 +92,36 @@ DECLS = {
     # codec.cpp — streaming arena result encoder
     "enc_uid_objs": (_i64, [_u64p, _i64, _u8p, _i64, _u8p, _i64, _u8p]),
     "enc_int_objs": (_i64, [_i64p, _i64, _u8p, _i64, _u8p, _i64, _u8p]),
+    # codec.cpp — quantized vector scoring (models/vector.py)
+    "vec_qi8_topk": (
+        _i64,
+        [
+            _i8p, _i64, _i64, _f32p, _f32p, _i32p, _f32p, _u8p,
+            _i8p, _f32p, _f32p, _i32p, _f32p,
+            _i64, _int, _i64, _i64p, _f32p,
+        ],
+    ),
+    "vec_qi8_topk_idx": (
+        _i64,
+        [
+            _i8p, _i64, _f32p, _f32p, _i32p, _f32p, _u8p,
+            _i32p, _i64, _i8p, _f32, _f32, _i32, _f32,
+            _int, _i64, _i64p, _f32p,
+        ],
+    ),
+    "vec_qi8_topk_lists": (
+        _i64,
+        [
+            _i8p, _i64, _f32p, _f32p, _i32p, _f32p, _u8p,
+            _i32p, _i64p, _i64p,
+            _i8p, _f32p, _f32p, _i32p, _f32p,
+            _i64, _int, _i64, _i64, _i64p, _f32p,
+        ],
+    ),
+    "vec_qi8_quantize": (
+        _i64,
+        [_f32p, _i64, _i64, _i64, _i8p, _f32p, _f32p, _i32p, _f32p],
+    ),
     "intersect_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
     "union_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
     "difference_u64": (_i64, [_u64p, _i64, _u64p, _i64, _u64p]),
@@ -167,7 +201,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     if not os.path.exists(so_path):
         tmp = so_path + f".tmp{os.getpid()}"
         cmd = [
-            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
             *san_flags, "-o", tmp, *srcs,
         ]
         # -march=native unlocks SIMD; retry without it if unsupported
@@ -532,6 +566,157 @@ def enc_int_objs(vals: np.ndarray, pre: bytes, post: bytes):
     `{"c":5},{"c":3}` count-object bulk emitter."""
     vals = np.ascontiguousarray(vals, np.int64)
     return _enc_objs("enc_int_objs", vals, ctypes.c_int64, 20, pre, post)
+
+
+def vec_qi8_topk(
+    codes, scales, offsets, csums, sqnorms, valid,
+    qcodes, qscales, qoffsets, qcsums, qstats, metric: int, k: int,
+):
+    """Batched quantized full-corpus top-k (models/vector.py brute
+    tier): nq queries scored against every valid row in one corpus
+    pass, per-query fused top-k heaps, ascending (dist, row) with
+    deterministic low-index tie-break. Returns (idx (nq, k) int64 with
+    -1 padding, dist (nq, k) float32, n_valid) or None when the native
+    lib is unavailable (caller takes the numpy fallback)."""
+    if _LIB is None:
+        return None
+    codes = np.ascontiguousarray(codes, np.int8)
+    qcodes = np.ascontiguousarray(qcodes, np.int8)
+    nq = qcodes.shape[0]
+    n, d = codes.shape
+    # bind conversions to locals so temporaries outlive the call
+    scales = np.ascontiguousarray(scales, np.float32)
+    offsets = np.ascontiguousarray(offsets, np.float32)
+    csums = np.ascontiguousarray(csums, np.int32)
+    sqnorms = np.ascontiguousarray(sqnorms, np.float32)
+    valid = np.ascontiguousarray(valid, np.uint8)
+    qscales = np.ascontiguousarray(qscales, np.float32)
+    qoffsets = np.ascontiguousarray(qoffsets, np.float32)
+    qcsums = np.ascontiguousarray(qcsums, np.int32)
+    qstats = np.ascontiguousarray(qstats, np.float32)
+    out_idx = np.empty((nq, k), np.int64)
+    out_dist = np.empty((nq, k), np.float32)
+    nvalid = _LIB.vec_qi8_topk(
+        _ptr(codes, ctypes.c_int8), n, d,
+        _ptr(scales, ctypes.c_float), _ptr(offsets, ctypes.c_float),
+        _ptr(csums, ctypes.c_int32), _ptr(sqnorms, ctypes.c_float),
+        _ptr(valid, ctypes.c_uint8),
+        _ptr(qcodes, ctypes.c_int8),
+        _ptr(qscales, ctypes.c_float), _ptr(qoffsets, ctypes.c_float),
+        _ptr(qcsums, ctypes.c_int32), _ptr(qstats, ctypes.c_float),
+        nq, metric, k,
+        _ptr(out_idx, ctypes.c_int64), _ptr(out_dist, ctypes.c_float),
+    )
+    return out_idx, out_dist, int(nvalid)
+
+
+def vec_qi8_topk_idx(
+    codes, scales, offsets, csums, sqnorms, valid, rows,
+    qc, qscale, qoffset, qcsum, qstat, metric: int, k: int,
+):
+    """Quantized candidate-list top-k (the IVF probe): one query
+    against the probed cells' concatenated row ids. Returns
+    (idx (k,) int64 with -1 padding, dist (k,) float32, written) or
+    None when the native lib is unavailable."""
+    if _LIB is None:
+        return None
+    codes = np.ascontiguousarray(codes, np.int8)
+    d = codes.shape[1]
+    scales = np.ascontiguousarray(scales, np.float32)
+    offsets = np.ascontiguousarray(offsets, np.float32)
+    csums = np.ascontiguousarray(csums, np.int32)
+    sqnorms = np.ascontiguousarray(sqnorms, np.float32)
+    valid = np.ascontiguousarray(valid, np.uint8)
+    rows = np.ascontiguousarray(rows, np.int32)
+    qc = np.ascontiguousarray(qc, np.int8)
+    out_idx = np.empty((k,), np.int64)
+    out_dist = np.empty((k,), np.float32)
+    wrote = _LIB.vec_qi8_topk_idx(
+        _ptr(codes, ctypes.c_int8), d,
+        _ptr(scales, ctypes.c_float), _ptr(offsets, ctypes.c_float),
+        _ptr(csums, ctypes.c_int32), _ptr(sqnorms, ctypes.c_float),
+        _ptr(valid, ctypes.c_uint8),
+        _ptr(rows, ctypes.c_int32), rows.size,
+        _ptr(qc, ctypes.c_int8),
+        ctypes.c_float(float(qscale)), ctypes.c_float(float(qoffset)),
+        int(qcsum), ctypes.c_float(float(qstat)),
+        metric, k,
+        _ptr(out_idx, ctypes.c_int64), _ptr(out_dist, ctypes.c_float),
+    )
+    return out_idx, out_dist, int(wrote)
+
+
+def vec_qi8_topk_lists(
+    codes, scales, offsets, csums, sqnorms, valid,
+    rows, begs, ends,
+    qcodes, qscales, qoffsets, qcsums, qstats,
+    metric: int, k: int, nthreads: int = 1,
+):
+    """Batched quantized candidate-list top-k (the IVF probe batch and
+    the top-2 cell-assignment fan): query q scores rows[begs[q]:ends[q]]
+    of a shared candidate array — slices may alias. Scoring and
+    tie-break identical to vec_qi8_topk_idx (a batch row is byte-equal
+    to the solo call); threaded over queries. Returns (idx (nq, k)
+    int64 with -1 padding, dist (nq, k) float32, candidates scanned)
+    or None when the native lib is unavailable."""
+    if _LIB is None:
+        return None
+    codes = np.ascontiguousarray(codes, np.int8)
+    qcodes = np.ascontiguousarray(qcodes, np.int8)
+    nq = qcodes.shape[0]
+    d = codes.shape[1]
+    scales = np.ascontiguousarray(scales, np.float32)
+    offsets = np.ascontiguousarray(offsets, np.float32)
+    csums = np.ascontiguousarray(csums, np.int32)
+    sqnorms = np.ascontiguousarray(sqnorms, np.float32)
+    valid = np.ascontiguousarray(valid, np.uint8)
+    rows = np.ascontiguousarray(rows, np.int32)
+    begs = np.ascontiguousarray(begs, np.int64)
+    ends = np.ascontiguousarray(ends, np.int64)
+    qscales = np.ascontiguousarray(qscales, np.float32)
+    qoffsets = np.ascontiguousarray(qoffsets, np.float32)
+    qcsums = np.ascontiguousarray(qcsums, np.int32)
+    qstats = np.ascontiguousarray(qstats, np.float32)
+    out_idx = np.empty((nq, k), np.int64)
+    out_dist = np.empty((nq, k), np.float32)
+    scanned = _LIB.vec_qi8_topk_lists(
+        _ptr(codes, ctypes.c_int8), d,
+        _ptr(scales, ctypes.c_float), _ptr(offsets, ctypes.c_float),
+        _ptr(csums, ctypes.c_int32), _ptr(sqnorms, ctypes.c_float),
+        _ptr(valid, ctypes.c_uint8),
+        _ptr(rows, ctypes.c_int32),
+        _ptr(begs, ctypes.c_int64), _ptr(ends, ctypes.c_int64),
+        _ptr(qcodes, ctypes.c_int8),
+        _ptr(qscales, ctypes.c_float), _ptr(qoffsets, ctypes.c_float),
+        _ptr(qcsums, ctypes.c_int32), _ptr(qstats, ctypes.c_float),
+        nq, metric, k, max(1, int(nthreads)),
+        _ptr(out_idx, ctypes.c_int64), _ptr(out_dist, ctypes.c_float),
+    )
+    return out_idx, out_dist, int(scanned)
+
+
+def vec_qi8_quantize(V, nthreads: int = 1):
+    """Threaded int8 row quantizer (models/vector.py sidecar store):
+    returns (codes i8, scales f32, offsets f32, csums i32, sqnorms f32)
+    or None when the native lib is unavailable. Codes and sidecars are
+    bit-identical to the numpy mirror; sqnorms agree to float32
+    accumulation order."""
+    if _LIB is None:
+        return None
+    V = np.ascontiguousarray(V, np.float32)
+    n, d = V.shape
+    codes = np.empty((n, d), np.int8)
+    scales = np.empty((n,), np.float32)
+    offsets = np.empty((n,), np.float32)
+    csums = np.empty((n,), np.int32)
+    sqnorms = np.empty((n,), np.float32)
+    _LIB.vec_qi8_quantize(
+        _ptr(V, ctypes.c_float), n, d, max(1, int(nthreads)),
+        _ptr(codes, ctypes.c_int8), _ptr(scales, ctypes.c_float),
+        _ptr(offsets, ctypes.c_float), _ptr(csums, ctypes.c_int32),
+        _ptr(sqnorms, ctypes.c_float),
+    )
+    return codes, scales, offsets, csums, sqnorms
 
 
 def _setop(name: str, a: np.ndarray, b: np.ndarray, out_size: int) -> np.ndarray:

@@ -13,6 +13,13 @@
 
 #include <cstdint>
 #include <cstring>
+#include <cmath>
+#include <thread>
+#include <vector>
+
+#if defined(__AVX512VNNI__) || defined(__AVX512BW__) || defined(__AVX2__)
+#include <immintrin.h>
+#endif
 
 extern "C" {
 
@@ -516,6 +523,370 @@ int64_t merge_sorted_u64(const uint64_t* flat, const int64_t* lens,
         off += n;
     }
     return acc;
+}
+
+// ---------------------------------------------------------------------------
+// Quantized vector scoring (models/vector.py quantized engine).
+//
+// Corpus rows are stored as per-row asymmetric int8: v_ij ~= s_i*c_ij + o_i
+// (scale/offset sidecars, plus the EXACT float32 sqnorm of the original
+// row). The query is quantized once per call the same way
+// (q_j ~= sq*qc_j + oq), so the reconstructed dot product is
+//
+//   dot(v_i, q) ~= sq*(s_i*dot8(c_i,qc) + o_i*qcsum) + oq*(s_i*csum_i + d*o_i)
+//
+// where dot8 is the int8 x int8 -> int32 inner product (the only O(d)
+// term — it auto-vectorizes to the wide integer-multiply-add forms under
+// -march=native, and a row costs 1 byte/component of memory traffic
+// instead of the float path's 4). csum_i / qcsum are precomputed code
+// sums. Distances reconstructed per metric (0 = squared euclidean,
+// 1 = cosine, 2 = negated dot) use the exact sqnorm sidecar, so only
+// the dot term carries quantization error — the caller reranks the
+// surviving pool in float32 (models/vector.py) to recover exact order.
+//
+// Both kernels fuse a partial top-k: a per-query max-heap of size k
+// (worst kept at the root) lives directly in the caller's output slabs,
+// and is heap-sorted ascending before return. Ties break toward the
+// LOWER row index — deterministic output for duplicate vectors, which
+// the solo-vs-coalesced byte-identity contract relies on.
+// ---------------------------------------------------------------------------
+
+// "worse" ordering for the heaps: larger distance, then larger index
+static inline int vq_worse(float da, int64_t ia, float db, int64_t ib) {
+    return da > db || (da == db && ia > ib);
+}
+
+// replace the root with (dv, iv) and sift down over [0, len)
+static void vq_sift(float* hd, int64_t* hi, int64_t len, float dv,
+                    int64_t iv) {
+    int64_t p = 0;
+    for (;;) {
+        int64_t c = 2 * p + 1;
+        if (c >= len) break;
+        if (c + 1 < len && vq_worse(hd[c + 1], hi[c + 1], hd[c], hi[c]))
+            c++;
+        if (!vq_worse(hd[c], hi[c], dv, iv)) break;
+        hd[p] = hd[c];
+        hi[p] = hi[c];
+        p = c;
+    }
+    hd[p] = dv;
+    hi[p] = iv;
+}
+
+// heap-sort the k slots ascending (dist, then index); empty slots
+// (+inf, -1) end up trailing
+static void vq_heapsort(float* hd, int64_t* hi, int64_t k) {
+    for (int64_t end = k - 1; end > 0; end--) {
+        float dv = hd[end];
+        int64_t iv = hi[end];
+        hd[end] = hd[0];
+        hi[end] = hi[0];
+        vq_sift(hd, hi, end, dv, iv);
+    }
+}
+
+// int8 x int8 -> int32 inner product between the query codes `q` and a
+// corpus row `c` whose code sum is `csum_c`. All paths produce the SAME
+// integer result (products and sums are exact), so kernel output does
+// not depend on which SIMD tier the build machine has.
+//
+// The VNNI path uses vpdpbusd, which wants unsigned x signed: the query
+// side is biased to unsigned on the fly (q + 128 == q ^ 0x80 on int8)
+// and the bias is removed with the row's precomputed code sum:
+// sum((q+128)*c) - 128*sum(c) == sum(q*c).
+static inline int32_t vq_dot8(const int8_t* q, const int8_t* c, int64_t d,
+                              int32_t csum_c) {
+#if defined(__AVX512VNNI__)
+    __m512i acc = _mm512_setzero_si512();
+    const __m512i bias = _mm512_set1_epi8((char)0x80);
+    int64_t j = 0;
+    for (; j + 64 <= d; j += 64) {
+        __m512i vq = _mm512_xor_si512(
+            _mm512_loadu_si512((const void*)(q + j)), bias);
+        __m512i vc = _mm512_loadu_si512((const void*)(c + j));
+        acc = _mm512_dpbusd_epi32(acc, vq, vc);
+    }
+    int32_t r = _mm512_reduce_add_epi32(acc);
+    // tail stays in biased space so one correction covers everything
+    for (; j < d; j++)
+        r += ((int32_t)q[j] + 128) * (int32_t)c[j];
+    return r - 128 * csum_c;
+#elif defined(__AVX512BW__)
+    (void)csum_c;
+    __m512i acc = _mm512_setzero_si512();
+    int64_t j = 0;
+    for (; j + 32 <= d; j += 32) {
+        __m512i vq = _mm512_cvtepi8_epi16(
+            _mm256_loadu_si256((const __m256i*)(q + j)));
+        __m512i vc = _mm512_cvtepi8_epi16(
+            _mm256_loadu_si256((const __m256i*)(c + j)));
+        acc = _mm512_add_epi32(acc, _mm512_madd_epi16(vq, vc));
+    }
+    int32_t r = _mm512_reduce_add_epi32(acc);
+    for (; j < d; j++) r += (int32_t)q[j] * (int32_t)c[j];
+    return r;
+#elif defined(__AVX2__)
+    (void)csum_c;
+    __m256i acc = _mm256_setzero_si256();
+    int64_t j = 0;
+    for (; j + 16 <= d; j += 16) {
+        __m256i vq = _mm256_cvtepi8_epi16(
+            _mm_loadu_si128((const __m128i*)(q + j)));
+        __m256i vc = _mm256_cvtepi8_epi16(
+            _mm_loadu_si128((const __m128i*)(c + j)));
+        acc = _mm256_add_epi32(acc, _mm256_madd_epi16(vq, vc));
+    }
+    __m128i lo = _mm256_castsi256_si128(acc);
+    __m128i hi = _mm256_extracti128_si256(acc, 1);
+    __m128i s = _mm_add_epi32(lo, hi);
+    s = _mm_add_epi32(s, _mm_srli_si128(s, 8));
+    s = _mm_add_epi32(s, _mm_srli_si128(s, 4));
+    int32_t r = _mm_cvtsi128_si32(s);
+    for (; j < d; j++) r += (int32_t)q[j] * (int32_t)c[j];
+    return r;
+#else
+    (void)csum_c;
+    int32_t acc = 0;
+    for (int64_t j = 0; j < d; j++)
+        acc += (int32_t)q[j] * (int32_t)c[j];
+    return acc;
+#endif
+}
+
+static inline float vq_dist(int metric, float dot, float sqn, float vn,
+                            float qstat) {
+    if (metric == 0) return sqn - 2.0f * dot + qstat;  // squared euclidean
+    if (metric == 1) {                                 // cosine
+        float denom = vn * qstat;                      // qstat = |q|
+        if (denom < 1e-12f) denom = 1e-12f;
+        return 1.0f - dot / denom;
+    }
+    return -dot;                                       // dotproduct
+}
+
+// Batched full-corpus scan: nq queries against n rows in ONE pass (the
+// corpus is read once per batch — the 768-byte row stays in L1 across
+// the query loop). valid[i] == 0 skips tombstoned rows. Per query q,
+// out_idx/out_dist rows q*k..q*k+k hold the top-k ascending; unused
+// slots are (-1, +inf). qstats[q] is the exact q.q (euclidean) or |q|
+// (cosine). Returns the number of valid rows scanned.
+int64_t vec_qi8_topk(
+    const int8_t* codes, int64_t n, int64_t d,
+    const float* scales, const float* offsets, const int32_t* csums,
+    const float* sqnorms, const uint8_t* valid,
+    const int8_t* qcodes, const float* qscales, const float* qoffsets,
+    const int32_t* qcsums, const float* qstats,
+    int64_t nq, int metric, int64_t k,
+    int64_t* out_idx, float* out_dist) {
+    const float inf = __builtin_inff();
+    for (int64_t t = 0; t < nq * k; t++) {
+        out_idx[t] = -1;
+        out_dist[t] = inf;
+    }
+    int64_t nvalid = 0;
+    for (int64_t i = 0; i < n; i++) {
+        if (valid && !valid[i]) continue;
+        nvalid++;
+        const int8_t* row = codes + i * d;
+        float s = scales[i];
+        float o = offsets[i];
+        int32_t cs_i = csums[i];
+        float cs = (float)cs_i;
+        float sqn = sqnorms[i];
+        float vn = metric == 1 ? __builtin_sqrtf(sqn) : 0.0f;
+        for (int64_t q = 0; q < nq; q++) {
+            int32_t d8 = vq_dot8(qcodes + q * d, row, d, cs_i);
+            float dot = qscales[q] * (s * (float)d8 + o * (float)qcsums[q])
+                      + qoffsets[q] * (s * cs + (float)d * o);
+            float dist = vq_dist(metric, dot, sqn, vn, qstats[q]);
+            float* hd = out_dist + q * k;
+            int64_t* hi = out_idx + q * k;
+            if (vq_worse(hd[0], hi[0], dist, i))
+                vq_sift(hd, hi, k, dist, i);
+        }
+    }
+    for (int64_t q = 0; q < nq; q++)
+        vq_heapsort(out_dist + q * k, out_idx + q * k, k);
+    return nvalid;
+}
+
+// Candidate-list scan (the IVF probe): one query against an explicit
+// row-id list (the probed cells' concatenated ids). Same scoring,
+// heap, and tie-break as the full scan. Returns entries written
+// (min(k, valid candidates)).
+int64_t vec_qi8_topk_idx(
+    const int8_t* codes, int64_t d,
+    const float* scales, const float* offsets, const int32_t* csums,
+    const float* sqnorms, const uint8_t* valid,
+    const int32_t* rows, int64_t nrows,
+    const int8_t* qc, float qscale, float qoffset, int32_t qcsum,
+    float qstat, int metric, int64_t k,
+    int64_t* out_idx, float* out_dist) {
+    const float inf = __builtin_inff();
+    for (int64_t t = 0; t < k; t++) {
+        out_idx[t] = -1;
+        out_dist[t] = inf;
+    }
+    int64_t nvalid = 0;
+    for (int64_t t = 0; t < nrows; t++) {
+        int64_t i = rows[t];
+        // candidate rows are scattered through the code matrix (the
+        // scan is DRAM-latency-bound at ~3 GB/s without this); pull the
+        // row a few candidates ahead into L2 while scoring this one
+        if (t + 12 < nrows) {
+            const int8_t* pr = codes + (int64_t)rows[t + 12] * d;
+            for (int64_t pj = 0; pj < d; pj += 64)
+                __builtin_prefetch(pr + pj, 0, 1);
+        }
+        if (valid && !valid[i]) continue;
+        nvalid++;
+        const int8_t* row = codes + i * d;
+        int32_t d8 = vq_dot8(qc, row, d, csums[i]);
+        float s = scales[i];
+        float o = offsets[i];
+        float dot = qscale * (s * (float)d8 + o * (float)qcsum)
+                  + qoffset * (s * (float)csums[i] + (float)d * o);
+        float sqn = sqnorms[i];
+        float vn = metric == 1 ? __builtin_sqrtf(sqn) : 0.0f;
+        float dist = vq_dist(metric, dot, sqn, vn, qstat);
+        if (vq_worse(out_dist[0], out_idx[0], dist, i))
+            vq_sift(out_dist, out_idx, k, dist, i);
+    }
+    vq_heapsort(out_dist, out_idx, k);
+    return nvalid < k ? nvalid : k;
+}
+
+}  // extern "C"
+
+// Run fn(t) on nt threads (nt==1 stays inline — no spawn cost on the
+// small-corpus paths and under sanitizers that dislike short threads).
+template <typename F>
+static void vq_parallel(int64_t nt, F fn) {
+    if (nt <= 1) {
+        fn(0);
+        return;
+    }
+    std::vector<std::thread> ths;
+    ths.reserve((size_t)(nt - 1));
+    for (int64_t t = 1; t < nt; t++) ths.emplace_back(fn, t);
+    fn(0);
+    for (auto& th : ths) th.join();
+}
+
+extern "C" {
+
+// Batched candidate-list scan: nq queries, each against its OWN slice
+// rows[begs[q]..ends[q]) of a shared candidate-id array (the probed IVF
+// cells in CSR form; slices may alias — the top-2 cell assignment path
+// points many queries at one shared per-group centroid list). Scoring,
+// heap, and (dist, row) tie-break identical to vec_qi8_topk_idx, so a
+// batch row is byte-identical to the solo call — the coalescing
+// contract. Threaded over queries (each query's heap lives in its own
+// out slab — no sharing); returns total valid candidates scored.
+int64_t vec_qi8_topk_lists(
+    const int8_t* codes, int64_t d,
+    const float* scales, const float* offsets, const int32_t* csums,
+    const float* sqnorms, const uint8_t* valid,
+    const int32_t* rows, const int64_t* begs, const int64_t* ends,
+    const int8_t* qcodes, const float* qscales, const float* qoffsets,
+    const int32_t* qcsums, const float* qstats,
+    int64_t nq, int metric, int64_t k, int64_t nthreads,
+    int64_t* out_idx, float* out_dist) {
+    const float inf = __builtin_inff();
+    int64_t nt = nthreads < 1 ? 1 : nthreads;
+    if (nt > nq) nt = nq < 1 ? 1 : nq;
+    if (nt > 64) nt = 64;
+    std::vector<int64_t> scanned((size_t)nt, 0);
+    vq_parallel(nt, [&](int64_t t) {
+        int64_t lo = nq * t / nt, hi = nq * (t + 1) / nt;
+        int64_t nvalid = 0;
+        for (int64_t q = lo; q < hi; q++) {
+            float* hd = out_dist + q * k;
+            int64_t* hi_ = out_idx + q * k;
+            for (int64_t s = 0; s < k; s++) {
+                hi_[s] = -1;
+                hd[s] = inf;
+            }
+            const int8_t* qc = qcodes + q * d;
+            float qscale = qscales[q], qoffset = qoffsets[q];
+            float qcsum = (float)qcsums[q], qstat = qstats[q];
+            for (int64_t s = begs[q]; s < ends[q]; s++) {
+                int64_t i = rows[s];
+                // same scattered-row prefetch as vec_qi8_topk_idx
+                if (s + 12 < ends[q]) {
+                    const int8_t* pr = codes + (int64_t)rows[s + 12] * d;
+                    for (int64_t pj = 0; pj < d; pj += 64)
+                        __builtin_prefetch(pr + pj, 0, 1);
+                }
+                if (valid && !valid[i]) continue;
+                nvalid++;
+                int32_t d8 = vq_dot8(qc, codes + i * d, d, csums[i]);
+                float sc = scales[i], o = offsets[i];
+                float dot = qscale * (sc * (float)d8 + o * qcsum)
+                          + qoffset * (sc * (float)csums[i] + (float)d * o);
+                float sqn = sqnorms[i];
+                float vn = metric == 1 ? __builtin_sqrtf(sqn) : 0.0f;
+                float dist = vq_dist(metric, dot, sqn, vn, qstat);
+                if (vq_worse(hd[0], hi_[0], dist, i))
+                    vq_sift(hd, hi_, k, dist, i);
+            }
+            vq_heapsort(hd, hi_, k);
+        }
+        scanned[(size_t)t] = nvalid;
+    });
+    int64_t total = 0;
+    for (int64_t t = 0; t < nt; t++) total += scanned[(size_t)t];
+    return total;
+}
+
+// Row quantizer for the int8 sidecar store: per-row asymmetric
+// v ~= scale*code + offset with codes in [-127, 127], plus the code sum
+// and exact float32 squared norm. Bit-identical codes/scales/offsets/
+// csums to the numpy mirror in models/vector.py _quantize (same f32 op
+// order; rintf under the default round-to-nearest-even mode matches
+// np.rint); sqnorms may differ in final ulps (sequential vs pairwise
+// accumulation) — consumers rerank in float32, so ordering is immune.
+// Threaded over row ranges; returns n.
+int64_t vec_qi8_quantize(
+    const float* V, int64_t n, int64_t d, int64_t nthreads,
+    int8_t* codes, float* scales, float* offsets, int32_t* csums,
+    float* sqnorms) {
+    int64_t nt = nthreads < 1 ? 1 : nthreads;
+    if (nt > n) nt = n < 1 ? 1 : n;
+    if (nt > 64) nt = 64;
+    vq_parallel(nt, [&](int64_t t) {
+        int64_t lo = n * t / nt, hi = n * (t + 1) / nt;
+        for (int64_t i = lo; i < hi; i++) {
+            const float* row = V + i * d;
+            float mn = row[0], mx = row[0];
+            float sq = 0.0f;
+            for (int64_t j = 0; j < d; j++) {
+                float v = row[j];
+                if (v < mn) mn = v;
+                if (v > mx) mx = v;
+                sq += v * v;
+            }
+            float o = (mx + mn) * 0.5f;
+            float s = (mx - mn) / 254.0f;
+            if (s < 1e-20f) s = 1e-20f;
+            int8_t* crow = codes + i * d;
+            int32_t cs = 0;
+            for (int64_t j = 0; j < d; j++) {
+                float c = rintf((row[j] - o) / s);
+                if (c < -127.0f) c = -127.0f;
+                if (c > 127.0f) c = 127.0f;
+                int32_t ci = (int32_t)c;
+                crow[j] = (int8_t)ci;
+                cs += ci;
+            }
+            scales[i] = s;
+            offsets[i] = o;
+            csums[i] = cs;
+            sqnorms[i] = sq;
+        }
+    });
+    return n;
 }
 
 }  // extern "C"

@@ -50,7 +50,7 @@ class FuncRunner:
 
     def __init__(self, cache: LocalCache, st: State, ns: int = keys.GALAXY_NS,
                  vector_indexes=None, uid_vars=None, val_vars=None,
-                 stats=None, ordered_uid_vars=None):
+                 stats=None, ordered_uid_vars=None, batcher=None):
         self.cache = cache
         self.st = st
         self.ns = ns
@@ -60,6 +60,9 @@ class FuncRunner:
         self.stats = stats  # StatsHolder: selectivity-ordered index scans
         # vars whose array order is meaningful (shortest-path vars)
         self.ordered_uid_vars = ordered_uid_vars or set()
+        # cross-query micro-batcher (serving/microbatch.py): plain
+        # similar_to searches may coalesce with other in-flight queries
+        self.batcher = batcher
 
     # -- helpers -------------------------------------------------------------
 
@@ -897,6 +900,28 @@ class FuncRunner:
             qvec = np.asarray(got.value, dtype=np.float32)
         else:
             qvec = np.asarray(qarg, dtype=np.float32)
+        plain = (
+            src is None
+            and fn.options.get("ef") is None
+            and fn.options.get("distance_threshold") is None
+        )
+        if plain and idx.dim is not None and qvec.size == idx.dim:
+            # plain top-k: the batch-row form of the search (search_one
+            # == row 0 of search_batch), so concurrent queries can
+            # coalesce into one search_batch dispatch (serving/
+            # microbatch.read_similar) with per-row demux — padding uid
+            # 0 marks absent slots either way
+            from dgraph_tpu.x import config as _config
+
+            if self.batcher is not None and bool(
+                _config.get("VEC_COALESCE")
+            ):
+                uids = self.batcher.read_similar(
+                    attr, self.cache, idx, qvec, k
+                )
+            else:
+                uids = idx.search_one(qvec, k)
+            return _as_uids(uids[uids != 0])
         uids = idx.search(
             qvec,
             k,

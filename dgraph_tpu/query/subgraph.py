@@ -200,6 +200,7 @@ class Executor:
             val_vars=self.val_vars,
             stats=self.stats,
             ordered_uid_vars=self.ordered_uid_vars,
+            batcher=self.batcher,
         )
 
     # ------------------------------------------------------------------

@@ -70,6 +70,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from dgraph_tpu.utils.observe import METRICS, TRACER, format_traceparent
 from dgraph_tpu.x import config
 
@@ -171,6 +173,30 @@ class MicroBatcher:
         return self._submit(
             key, cache, keys_list, self._run_values, self._split_values
         )
+
+    def read_similar(self, attr: str, cache, index, qvec, k: int):
+        """Coalesced plain (unfiltered) `similar_to`: concurrent vector
+        searches against the same index, same k, same snapshot become
+        ONE `index.search_batch` dispatch; each member gets its own row.
+        Rows of a batch are scored independently by the same kernels
+        (models/vector.py search_one), so the demuxed row is
+        byte-identical to the member's solo search — the same argument
+        read_uids makes for level reads. k joins the group key (a
+        combined dispatch has one k); the snapshot token binds members
+        to one store state, which covers the index too: vector-index
+        mutations happen at commit apply, behind the same watermark."""
+        key = (
+            "similar", attr, self._kv_identity(cache), id(index), int(k),
+            self._snapshot_token(cache),
+        )
+
+        def run(_cache, all_vecs):
+            return index.search_batch(np.stack(all_vecs), k)
+
+        def split(combined, spans):
+            return [combined[r0:r1] for r0, r1 in spans]
+
+        return self._submit(key, cache, [qvec], run, split)[0]
 
     # -- combined executors (leader only, lock NOT held) ----------------------
 

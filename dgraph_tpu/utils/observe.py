@@ -895,10 +895,17 @@ def profile_scope():
     token = _PROFILE.set(prof)
     before = {k: METRICS.value(k) for k in _PROFILE_EVENT_KEYS}
     k0 = None
+    v0 = None
     try:
         from dgraph_tpu.ops import packed_setops
 
         k0 = packed_setops.counters()
+    except Exception:
+        pass
+    try:
+        from dgraph_tpu.models import vector as _vec
+
+        v0 = _vec.counters()
     except Exception:
         pass
     try:
@@ -918,6 +925,20 @@ def profile_scope():
                     for k in k1
                     if isinstance(k1[k], (int, float))
                 }
+            except Exception:
+                pass
+        if v0 is not None:
+            # vector kernel timings itemized next to the setop counters
+            # (same per-thread-delta caveat as above)
+            try:
+                from dgraph_tpu.models import vector as _vec
+
+                v1 = _vec.counters()
+                for k in v1:
+                    if isinstance(v1[k], (int, float)):
+                        d = v1[k] - v0.get(k, 0)
+                        if d:
+                            prof.kernel[f"vec_{k}"] = d
             except Exception:
                 pass
 
@@ -1303,6 +1324,28 @@ declare_metric(
     "Response bytes emitted block-at-a-time by the native arena "
     "encoder kernels (enc_uid_objs/enc_int_objs in native/codec.cpp) "
     "instead of per-entity Python objects (query/streamjson.py).",
+)
+declare_metric(
+    "counter", "vector_probe_cells_total",
+    "IVF cells probed across vector similar_to searches "
+    "(models/vector.py).",
+)
+declare_metric(
+    "counter", "vector_rerank_pool_total",
+    "Candidates re-scored exactly in float32 after the quantized int8 "
+    "scan (models/vector.py _rerank; pool size is VEC_RERANK * k).",
+)
+declare_metric(
+    "counter", "vector_search_total",
+    "Vector similar_to queries served by the vector engine, any tier "
+    "(quantized or jitted, brute or IVF) (models/vector.py).",
+)
+declare_metric(
+    "gauge", "vector_index_build_seconds",
+    "Wall seconds of the last vector index build on this process "
+    "(centroid train + assignment + layout) — incremental mutations "
+    "never restamp it, so movement here means a real rebuild "
+    "(models/vector.py).",
 )
 declare_metric(
     "gauge", "admission_inflight_queries",

@@ -320,6 +320,63 @@ _define(
     "(utils/observe.init_from_env). Inherited by spawned replicas.",
 )
 _define(
+    "VEC_COALESCE", "bool", True,
+    "Coalesce concurrent plain (unfiltered) similar_to tasks from "
+    "different in-flight queries into ONE vector search_batch dispatch "
+    "through the serving micro-batcher (query/functions.py + serving/"
+    "microbatch.py read_similar). Only active when the batcher itself "
+    "is on (DGRAPH_TPU_BATCH_WINDOW_US > 0); results are byte-identical "
+    "to solo execution by construction (rows are scored independently).",
+)
+_define(
+    "VEC_NLIST", "int", 0,
+    "IVF cell count for vector indexes without an explicit constructor "
+    "value; 0 = auto (2*sqrt(n), the FAISS rule of thumb) "
+    "(models/vector.py).",
+)
+_define(
+    "VEC_NPROBE", "int", 0,
+    "IVF cells probed per vector search for indexes without an explicit "
+    "constructor value; 0 = auto (nlist/128, floor 8, on the quantized "
+    "engine — top-2 cell multi-assignment already doubles coverage and "
+    "serve cost scales ~linearly with the probed pool — and nlist/32, "
+    "floor 8, on the jitted float path) (models/vector.py).",
+)
+_define(
+    "VEC_QUANT", "bool", True,
+    "Scalar-quantized vector engine: corpus stored as per-row int8 with "
+    "scale/offset sidecars, scored by the native qint8 kernels "
+    "(codec.cpp vec_qi8_topk*) with a float32 rerank of the surviving "
+    "pool (models/vector.py). Applies on CPU-backend hosts above the "
+    "small-corpus cutoff; 0 is the A/B escape hatch back to the jitted "
+    "float32 paths (BENCH_VECTOR.json).",
+)
+_define(
+    "VEC_REBUILD_IMBALANCE", "float", 4.0,
+    "Deferred-repartition trigger for the incremental quantized IVF: "
+    "repartition when the max/avg cell ratio GROWS past this multiple "
+    "of its post-build baseline (mutation skew — centroids retrained "
+    "on a sample, since the old ones would reproduce the same hot "
+    "cells), or when tombstoned entries exceed a quarter of the live "
+    "corpus (cells reassigned, centroids kept). Mutations themselves "
+    "never trigger inline work — inserts append to their nearest "
+    "cells, removes tombstone in place (models/vector.py).",
+)
+_define(
+    "VEC_RERANK", "int", 4,
+    "Float32 rerank pool as a multiple of k for quantized vector "
+    "searches: the qint8 scan keeps rerank*k candidates, which are "
+    "re-scored exactly against the float corpus so quantization error "
+    "cannot reorder the final top-k (models/vector.py).",
+)
+_define(
+    "VEC_THREADS", "int", 0,
+    "Worker threads for the threaded native quantized-vector kernels "
+    "(batched candidate-list scan vec_qi8_topk_lists, corpus "
+    "quantization vec_qi8_quantize, and the int8 top-2 cell "
+    "assignment); 0 = auto, one per core (models/vector.py).",
+)
+_define(
     "WIRE_COMPRESS", "bool", False,
     "zlib-compress bulk wire blobs; default OFF because zlib-1 is "
     "slower than LAN/ICI-class links — enable for DCN-class links "

@@ -71,9 +71,14 @@ def test_packed_setops_corpus_under_ubsan():
             # through the same adversarial corpus; test_stream_encoder
             # covers the arena encoder entry points (enc_uid_objs /
             # enc_int_objs) incl. the INT64_MIN negation and 0xfff...
-            # hex edge values
+            # hex edge values; test_vector_quant drives the quantized
+            # vector kernels (vec_qi8_topk / vec_qi8_topk_idx, the
+            # threaded vec_qi8_topk_lists CSR scan, and the
+            # vec_qi8_quantize row quantizer) through adversarial
+            # scales, duplicates, tombstones, empty/aliased slices
             "tests/test_packed_setops.py", "tests/test_uidpack.py",
             "tests/test_bitmap_setops.py", "tests/test_stream_encoder.py",
+            "tests/test_vector_quant.py",
             "-q", "-m", "not slow", "-p", "no:cacheprovider",
         ],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=900,

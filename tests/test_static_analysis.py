@@ -514,6 +514,142 @@ def test_abi_covers_adaptive_engine_exports():
         assert len(exports[name][1]) == len(native.DECLS[name][1]), name
 
 
+_SYN_VEC_CPP = """
+extern "C" {
+
+int64_t vec_qi8_topk_idx(const int8_t* codes, int64_t d,
+                         const float* scales, const int32_t* rows,
+                         int64_t nrows, float qscale, int metric,
+                         int64_t k, int64_t* out_idx, float* out_dist) {
+    return 0;
+}
+
+}  // extern "C"
+"""
+
+_SYN_VEC_LISTS_CPP = """
+extern "C" {
+
+int64_t vec_qi8_topk_lists(const int8_t* codes, int64_t d,
+                           const int32_t* rows, const int64_t* begs,
+                           const int64_t* ends, int64_t nq, int64_t k,
+                           int64_t* out_idx, float* out_dist) {
+    return 0;
+}
+
+}  // extern "C"
+"""
+
+
+def test_abi_catches_vector_kernel_width_mismatch():
+    """Seeded violations for the quantized-vector kernel class: (a) the
+    candidate row-id pointer declared c_int64* against the C++ int32_t*
+    (the probe would stride double-width through the cell lists and
+    score garbage rows — silently); (b) the code-matrix pointer widened
+    to c_int16* (every dot product reads interleaved halves of two
+    rows)."""
+    i64 = ctypes.c_int64
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32 = ctypes.c_float
+    f32p = ctypes.POINTER(ctypes.c_float)
+    good = {
+        "vec_qi8_topk_idx": (
+            i64, [i8p, i64, f32p, i32p, i64, f32, ctypes.c_int, i64,
+                  i64p, f32p],
+        )
+    }
+    assert (
+        check_ctypes_abi.check_abi(
+            {"native/syn_vec.cpp": _SYN_VEC_CPP},
+            good,
+            "native/__init__.py",
+        )
+        == []
+    )
+    bad_rows = {
+        "vec_qi8_topk_idx": (
+            i64, [i8p, i64, f32p, i64p, i64, f32, ctypes.c_int, i64,
+                  i64p, f32p],
+        )
+    }
+    out = check_ctypes_abi.check_abi(
+        {"native/syn_vec.cpp": _SYN_VEC_CPP}, bad_rows,
+        "native/__init__.py",
+    )
+    assert [v.code for v in out] == ["arg-type-mismatch"]
+    assert "vec_qi8_topk_idx" in out[0].message and "arg 3" in out[0].message
+    bad_codes = {
+        "vec_qi8_topk_idx": (
+            i64, [ctypes.POINTER(ctypes.c_int16), i64, f32p, i32p, i64,
+                  f32, ctypes.c_int, i64, i64p, f32p],
+        )
+    }
+    out = check_ctypes_abi.check_abi(
+        {"native/syn_vec.cpp": _SYN_VEC_CPP}, bad_codes,
+        "native/__init__.py",
+    )
+    assert [v.code for v in out] == ["arg-type-mismatch"]
+    assert "arg 0" in out[0].message
+
+
+def test_abi_catches_lists_kernel_csr_width_mismatch():
+    """Seeded violation for the batched CSR scan kernel: the begs/ends
+    slice-bound pointers declared c_int32* against the C++ int64_t* —
+    every query after the first would read garbage slice bounds and
+    scan (or skip) the wrong candidates, silently."""
+    i64 = ctypes.c_int64
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    good = {
+        "vec_qi8_topk_lists": (
+            i64, [i8p, i64, i32p, i64p, i64p, i64, i64, i64p, f32p],
+        )
+    }
+    assert (
+        check_ctypes_abi.check_abi(
+            {"native/syn_vec.cpp": _SYN_VEC_LISTS_CPP}, good,
+            "native/__init__.py",
+        )
+        == []
+    )
+    bad_begs = {
+        "vec_qi8_topk_lists": (
+            i64, [i8p, i64, i32p, i32p, i64p, i64, i64, i64p, f32p],
+        )
+    }
+    out = check_ctypes_abi.check_abi(
+        {"native/syn_vec.cpp": _SYN_VEC_LISTS_CPP}, bad_begs,
+        "native/__init__.py",
+    )
+    assert [v.code for v in out] == ["arg-type-mismatch"]
+    assert (
+        "vec_qi8_topk_lists" in out[0].message and "arg 3" in out[0].message
+    )
+
+
+def test_abi_covers_vector_exports():
+    """The real quantized-vector entry points are parsed from codec.cpp
+    and covered by DECLS (the analyzer then enforces full width and
+    signedness equality on every run)."""
+    from dgraph_tpu import native
+
+    with open(
+        os.path.join(REPO, "dgraph_tpu", "native", "codec.cpp")
+    ) as f:
+        exports = check_ctypes_abi.parse_cpp_exports(f.read())
+    for name in (
+        "vec_qi8_topk", "vec_qi8_topk_idx",
+        "vec_qi8_topk_lists", "vec_qi8_quantize",
+    ):
+        assert name in exports, name
+        assert name in native.DECLS, name
+        assert len(exports[name][1]) == len(native.DECLS[name][1]), name
+
+
 def test_abi_real_package_is_clean():
     # re-derive from the real sources; independent of the full gate so a
     # regression pinpoints here

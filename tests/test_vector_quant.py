@@ -1,0 +1,798 @@
+"""Quantized vector engine: recall, exact equivalence, incremental IVF.
+
+The quantized engine's contract (models/vector.py): the int8 scan may
+only ever *narrow* the candidate pool — the float32 rerank re-scores
+survivors exactly, so the final top-k ordering is float-exact whenever
+the true neighbors survive the scan. These tests drive that contract
+through adversarial row scales, duplicate vectors, and tombstones; pin
+the incremental-IVF "no full rebuild on mutation" invariant against
+fresh builds; pin the per-call brute-vs-IVF crossover on both sides of
+the r5 inversion (VECTOR_1M_CPU.json: batched IVF 5.8 qps losing to
+brute 12.2); and hold the solo == batch-row identity the serving-front
+coalescing of similar_to (serving/microbatch.read_similar) relies on.
+
+This module is part of the UBSan corpus (test_native_san.py): the
+native kernels vec_qi8_topk / vec_qi8_topk_idx / vec_qi8_topk_lists /
+vec_qi8_quantize run every case here under -fsanitize=undefined in
+that gate.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.models import vector
+from dgraph_tpu.models.vector import VectorIndex
+
+
+@pytest.fixture(autouse=True)
+def _quant_on_small_corpora(monkeypatch):
+    """The quantized engine only engages above _QUANT_MIN live rows
+    (below it the jitted float scan is already sub-ms and exact);
+    force it on for test-sized corpora."""
+    monkeypatch.setattr(vector, "_QUANT_MIN", 1)
+
+
+def _exact_topk(V, uids, q, k, metric="euclidean"):
+    if metric == "euclidean":
+        d = ((V - q[None, :]) ** 2).sum(axis=1)
+    elif metric == "cosine":
+        d = 1 - (V @ q) / (
+            np.linalg.norm(V, axis=1) * np.linalg.norm(q) + 1e-12
+        )
+    else:
+        d = -(V @ q)
+    idx = np.argsort(d, kind="stable")[:k]
+    return [int(uids[i]) for i in idx]
+
+
+def _mk(V, uids=None, metric="euclidean", **kw):
+    if uids is None:
+        uids = np.arange(1, len(V) + 1, dtype=np.uint64)
+    idx = VectorIndex("emb", metric=metric, **kw)
+    idx.bulk_load(np.asarray(uids, np.uint64), np.ascontiguousarray(V))
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# Quantized-vs-float: exact equivalence and recall
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "cosine", "dotproduct"])
+def test_quant_brute_matches_exact_float(metric):
+    """Quantized brute tier == exact float ordering: the int8 scan keeps
+    VEC_RERANK*k candidates and the rerank is float-exact, so on
+    well-separated data the full top-k matches the exact scan."""
+    rng = np.random.default_rng(0)
+    n, d, k = 6000, 48, 10
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    uids = np.arange(1, n + 1, dtype=np.uint64)
+    idx = _mk(V, uids, metric=metric, ivf_threshold=1 << 62)
+    assert idx._use_quant(), "quantized engine must engage"
+    for qi in range(8):
+        q = rng.standard_normal(d).astype(np.float32)
+        got = [int(u) for u in idx.search(q, k)]
+        assert got == _exact_topk(V, uids, q, k, metric), f"query {qi}"
+    assert vector.counters()["path_quant_brute"] > 0
+
+
+@pytest.mark.parametrize("quant_env", ["1", "0"])
+def test_quant_vs_float_escape_hatch_same_results(monkeypatch, quant_env):
+    """DGRAPH_TPU_VEC_QUANT is a pure A/B switch: both engines return
+    the same top-k on the same corpus (both exact on the brute tier)."""
+    monkeypatch.setenv("DGRAPH_TPU_VEC_QUANT", quant_env)
+    rng = np.random.default_rng(1)
+    n, d, k = 4000, 32, 10
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    uids = np.arange(1, n + 1, dtype=np.uint64)
+    idx = _mk(V, uids, ivf_threshold=1 << 62)
+    assert idx._use_quant() == (quant_env == "1")
+    q = rng.standard_normal(d).astype(np.float32)
+    got = [int(u) for u in idx.search(q, k)]
+    assert got == _exact_topk(V, uids, q, k)
+
+
+def test_quant_adversarial_row_scales():
+    """Per-row asymmetric quantization is scale-invariant per row: rows
+    spanning 12 orders of magnitude, constant rows, and all-zero rows
+    must neither crash nor displace the true neighbors."""
+    rng = np.random.default_rng(2)
+    n, d, k = 3000, 24, 10
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    mags = (10.0 ** rng.uniform(-6, 6, size=n)).astype(np.float32)
+    V *= mags[:, None]
+    V[100] = 0.0                       # all-zero row
+    V[101] = 3.25                      # constant row
+    V[102] = np.float32(1e-30)         # denormal-scale row
+    uids = np.arange(1, n + 1, dtype=np.uint64)
+    idx = _mk(V, uids, ivf_threshold=1 << 62)
+    assert idx._use_quant()
+    hits = total = 0
+    for _ in range(16):
+        q = rng.standard_normal(d).astype(np.float32) * float(
+            10.0 ** rng.uniform(-3, 3)
+        )
+        got = set(int(u) for u in idx.search(q, k))
+        want = set(_exact_topk(V, uids, q, k))
+        hits += len(got & want)
+        total += k
+    assert hits / total >= 0.95, hits / total
+    # the degenerate rows themselves are findable exactly
+    assert int(idx.search(np.zeros(d, np.float32), 1)[0]) == 101
+
+
+def test_quant_duplicate_vectors_deterministic():
+    """Duplicate vectors tie exactly (same codes -> same integer dot ->
+    same float32 distance); the kernels pin the tie-break to the LOWER
+    row index, so repeated searches — native or numpy mirror — return
+    the identical uid list (what solo-vs-coalesced byte-identity needs
+    for duplicate corpora)."""
+    rng = np.random.default_rng(3)
+    n, d, k = 2000, 16, 12
+    base = rng.standard_normal((50, d)).astype(np.float32)
+    V = base[rng.integers(0, 50, n)]  # every vector duplicated ~40x
+    uids = np.arange(1, n + 1, dtype=np.uint64)
+    idx = _mk(V, uids, ivf_threshold=1 << 62)
+    q = base[7] + np.float32(1e-3)
+    first = [int(u) for u in idx.search(q, k)]
+    for _ in range(3):
+        assert [int(u) for u in idx.search(q, k)] == first
+    # numpy mirror agrees with the native kernel on the tie-break
+    view = idx._quant_view()
+    qc, qs, qo, qcs, qstat = vector._quantize_queries(
+        q.reshape(1, -1), "euclidean"
+    )
+    rows_py, _ = vector._qi8_scan_py(
+        view["codes"], view["scales"], view["offsets"], view["csums"],
+        view["sqnorms"], view["valid"], qc[0], qs[0], qo[0], qcs[0],
+        qstat[0], "euclidean", k,
+    )
+    from dgraph_tpu import native
+
+    if native.NATIVE_AVAILABLE:
+        got = native.vec_qi8_topk(
+            view["codes"], view["scales"], view["offsets"],
+            view["csums"], view["sqnorms"], view["valid"],
+            qc, qs, qo, qcs, qstat, 0, k,
+        )
+        assert got is not None
+        np.testing.assert_array_equal(got[0][0], rows_py)
+
+
+def test_quant_tombstones_never_surface():
+    """Removed uids must never appear in results, and the survivors'
+    ordering must match a fresh index built from only the survivors
+    (both brute tiers are exact)."""
+    rng = np.random.default_rng(4)
+    n, d, k = 3000, 24, 15
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    uids = np.arange(1, n + 1, dtype=np.uint64)
+    idx = _mk(V, uids, ivf_threshold=1 << 62)
+    dead = set(range(1, n + 1, 3))  # remove every third uid
+    for u in dead:
+        idx.remove(u)
+    keep = np.array([u for u in uids if int(u) not in dead], np.uint64)
+    fresh = _mk(V[[int(u) - 1 for u in keep]], keep, ivf_threshold=1 << 62)
+    for _ in range(6):
+        q = rng.standard_normal(d).astype(np.float32)
+        got = [int(u) for u in idx.search(q, k)]
+        assert not (set(got) & dead), "tombstoned uid surfaced"
+        assert got == [int(u) for u in fresh.search(q, k)]
+
+
+def test_quant_ivf_recall_clustered():
+    """IVF tier recall on clustered data (the embedding-corpus regime
+    the index contract assumes): recall@10 >= 0.95 vs exact scan."""
+    rng = np.random.default_rng(5)
+    nclust, per, d, k = 64, 120, 32, 10
+    cents = 12.0 * rng.standard_normal((nclust, d)).astype(np.float32)
+    V = (
+        cents[np.repeat(np.arange(nclust), per)]
+        + rng.standard_normal((nclust * per, d)).astype(np.float32)
+    )
+    n = len(V)
+    uids = np.arange(1, n + 1, dtype=np.uint64)
+    idx = _mk(V, uids, ivf_threshold=1000)
+    queries = (
+        cents[rng.integers(0, nclust, 30)]
+        + rng.standard_normal((30, d)).astype(np.float32)
+    )
+    got = idx.search_batch(queries, k)
+    assert vector.counters()["path_quant_ivf"] > 0, "IVF tier not engaged"
+    hits = total = 0
+    for i, q in enumerate(queries):
+        want = set(_exact_topk(V, uids, q, k))
+        hits += len(set(int(u) for u in got[i]) & want)
+        total += k
+    assert hits / total >= 0.95, hits / total
+
+
+def test_native_quantize_matches_numpy_mirror():
+    """vec_qi8_quantize == the numpy _quantize mirror bit-for-bit on
+    codes/scales/offsets/csums (same f32 op order, rintf == np.rint
+    under round-to-nearest-even), across adversarial row scales,
+    constant rows, and zero rows; sqnorms agree to accumulation-order
+    float32 tolerance."""
+    from dgraph_tpu import native
+
+    if not native.NATIVE_AVAILABLE:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(20)
+    n, d = 1500, 67  # odd dim: exercises the SIMD tail loop
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    V *= (10.0 ** rng.uniform(-6, 6, size=n)).astype(np.float32)[:, None]
+    V[7] = 0.0
+    V[8] = -2.5
+    codes, scales, offsets, csums = vector._quantize(V)
+    sqn = (V * V).sum(axis=1, dtype=np.float32)
+    for nt in (1, 3):
+        got = native.vec_qi8_quantize(V, nt)
+        assert got is not None
+        nc, ns, no, ncs, nsq = got
+        np.testing.assert_array_equal(nc, codes)
+        np.testing.assert_array_equal(ns, scales)
+        np.testing.assert_array_equal(no, offsets)
+        np.testing.assert_array_equal(ncs, csums)
+        np.testing.assert_allclose(nsq, sqn, rtol=1e-5)
+
+
+def test_lists_kernel_rows_match_solo_idx_kernel():
+    """Every row of a vec_qi8_topk_lists batch is byte-identical to the
+    solo vec_qi8_topk_idx call on the same candidate slice — the kernel-
+    level form of the solo == coalesced contract — across metrics,
+    thread counts, empty slices, aliased slices, and tombstones."""
+    from dgraph_tpu import native
+
+    if not native.NATIVE_AVAILABLE:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(21)
+    n, d, nq, k = 4000, 32, 9, 8
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    codes, scales, offsets, csums = vector._quantize(V)
+    sqn = (V * V).sum(axis=1, dtype=np.float32)
+    valid = np.ones((n,), np.uint8)
+    valid[rng.choice(n, 400, replace=False)] = 0
+    Q = rng.standard_normal((nq, d)).astype(np.float32)
+    cand = [
+        np.sort(
+            rng.choice(n, int(rng.integers(1, 700)), replace=False)
+        ).astype(np.int32)
+        for _ in range(nq)
+    ]
+    cand[4] = np.zeros((0,), np.int32)   # empty slice
+    cand[6] = cand[2]                     # aliased slice
+    lens = np.array([c.size for c in cand], np.int64)
+    ends = np.cumsum(lens)
+    begs = ends - lens
+    cat = np.concatenate(cand)
+    for metric in ("euclidean", "cosine", "dotproduct"):
+        qc, qs, qo, qcs, qstat = vector._quantize_queries(Q, metric)
+        mid = vector._METRIC_ID[metric]
+        for nt in (1, 2):
+            got = native.vec_qi8_topk_lists(
+                codes, scales, offsets, csums, sqn, valid,
+                cat, begs, ends, qc, qs, qo, qcs, qstat, mid, k, nt,
+            )
+            assert got is not None
+            li, ld, _scanned = got
+            for i in range(nq):
+                si, sd, _w = native.vec_qi8_topk_idx(
+                    codes, scales, offsets, csums, sqn, valid,
+                    cand[i], qc[i], qs[i], qo[i], qcs[i], qstat[i],
+                    mid, k,
+                )
+                np.testing.assert_array_equal(li[i], si, err_msg=metric)
+                np.testing.assert_array_equal(ld[i], sd, err_msg=metric)
+
+
+def test_native_assignment_path_serves_same_recall(monkeypatch):
+    """The int8 coarse-to-fine cell assignment (the 1Mx768 build path,
+    forced here by zeroing its MAC threshold) must serve the same
+    recall class as the exact numpy assignment, and keep the
+    incremental no-rebuild invariant."""
+    from dgraph_tpu import native
+
+    if not native.NATIVE_AVAILABLE:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(22)
+    nclust, per, d, k = 32, 120, 24, 10
+    cents = 10.0 * rng.standard_normal((nclust, d)).astype(np.float32)
+    V = (
+        cents[np.repeat(np.arange(nclust), per)]
+        + rng.standard_normal((nclust * per, d)).astype(np.float32)
+    )
+    uids = np.arange(1, len(V) + 1, dtype=np.uint64)
+    queries = (
+        cents[rng.integers(0, nclust, 25)]
+        + rng.standard_normal((25, d)).astype(np.float32)
+    )
+
+    def recall(ix):
+        hits = 0
+        for q in queries:
+            want = set(_exact_topk(V, uids, q, k))
+            hits += len(set(int(u) for u in ix.search(q, k)) & want)
+        return hits / (25 * k)
+
+    monkeypatch.setattr(vector, "_ASSIGN_NATIVE_MIN_MACS", 0)
+    nat = _mk(V, uids, ivf_threshold=500)
+    nat.search(cents[0], k)
+    monkeypatch.setattr(vector, "_ASSIGN_NATIVE_MIN_MACS", float("inf"))
+    ref = _mk(V, uids, ivf_threshold=500)
+    ref.search(cents[0], k)
+    r_nat, r_ref = recall(nat), recall(ref)
+    assert r_nat >= r_ref - 0.03, (r_nat, r_ref)
+
+    # incremental growth through the native path: no rebuild, inserted
+    # vectors findable, assignment stays deterministic
+    monkeypatch.setattr(vector, "_ASSIGN_NATIVE_MIN_MACS", 0)
+    for j in range(40):
+        u = len(V) + 1 + j
+        v = cents[int(rng.integers(0, nclust))] + rng.standard_normal(
+            d
+        ).astype(np.float32)
+        nat.insert(u, v)
+        assert int(nat.search(v, 1)[0]) == u
+    assert nat.build_count == 1 and nat.repartition_count == 0
+
+
+# ---------------------------------------------------------------------------
+# Incremental IVF: mutations never rebuild
+# ---------------------------------------------------------------------------
+
+
+def test_incremental_insert_remove_no_rebuild():
+    """Inserts append to nearest cells, removes tombstone in place:
+    after heavy mutation the centroids have NOT retrained
+    (build_count pinned), no repartition ran below the thresholds, and
+    served results are correct — inserted vectors findable, removed
+    uids gone (equivalence vs exact scan on the mutated corpus)."""
+    rng = np.random.default_rng(6)
+    nclust, per, d, k = 32, 100, 24, 10
+    cents = 10.0 * rng.standard_normal((nclust, d)).astype(np.float32)
+    V = (
+        cents[np.repeat(np.arange(nclust), per)]
+        + rng.standard_normal((nclust * per, d)).astype(np.float32)
+    )
+    n = len(V)
+    uids = np.arange(1, n + 1, dtype=np.uint64)
+    idx = _mk(V, uids, ivf_threshold=500)
+    idx.search(cents[0], k)  # trigger the initial build
+    assert idx.build_count == 1 and idx.repartition_count == 0
+
+    # mutate: 200 inserts near existing clusters, 150 removes
+    new_uids, new_vecs = [], []
+    for j in range(200):
+        u = n + 1 + j
+        v = cents[int(rng.integers(0, nclust))] + rng.standard_normal(
+            d
+        ).astype(np.float32)
+        idx.insert(u, v)
+        new_uids.append(u)
+        new_vecs.append(v)
+    removed = set(int(u) for u in rng.choice(uids, 150, replace=False))
+    for u in removed:
+        idx.remove(u)
+
+    res = idx.search_batch(np.stack(new_vecs[:20]), k)
+    assert idx.build_count == 1, "mutation triggered a centroid retrain"
+    assert idx.repartition_count == 0, "mutation triggered a repartition"
+    for j in range(20):
+        assert int(res[j][0]) == new_uids[j], "inserted vector not nearest"
+    got = idx.search(cents[1], 2 * k)
+    assert not (set(int(u) for u in got) & removed)
+
+
+def test_repartition_triggers_on_garbage_and_stays_correct(monkeypatch):
+    """Tombstone garbage past live/4 triggers ONE deferred repartition
+    (cells reassigned, centroids kept — build_count still 1) and the
+    probe stops scanning dead rows."""
+    rng = np.random.default_rng(7)
+    n, d, k = 4000, 16, 5
+    V = rng.standard_normal((n, d)).astype(np.float32) + 5.0
+    uids = np.arange(1, n + 1, dtype=np.uint64)
+    idx = _mk(V, uids, ivf_threshold=500)
+    idx.search(V[0], k)
+    assert idx.build_count == 1
+    for u in range(1, n // 2):  # ~50% garbage >> live/4
+        idx.remove(u)
+    got = idx.search(V[n - 1], k)
+    assert idx.repartition_count == 1
+    assert idx.build_count == 1, "repartition must keep centroids"
+    assert int(got[0]) == n
+    assert all(int(u) >= n // 2 for u in got)
+
+
+def test_incremental_matches_fresh_build_recall():
+    """An index grown incrementally to corpus X serves the same recall
+    class as one built fresh on X (the layout differs; the answers must
+    not degrade): recall gap vs exact <= 3 points over 20 queries."""
+    rng = np.random.default_rng(8)
+    nclust, per, d, k = 24, 80, 16, 10
+    cents = 8.0 * rng.standard_normal((nclust, d)).astype(np.float32)
+    V = (
+        cents[np.repeat(np.arange(nclust), per)]
+        + rng.standard_normal((nclust * per, d)).astype(np.float32)
+    )
+    half = len(V) // 2
+    uids = np.arange(1, len(V) + 1, dtype=np.uint64)
+
+    inc = _mk(V[:half], uids[:half], ivf_threshold=400)
+    inc.search(cents[0], k)  # build on the first half
+    for i in range(half, len(V)):  # grow incrementally to full X
+        inc.insert(int(uids[i]), V[i])
+    fresh = _mk(V, uids, ivf_threshold=400)
+
+    def recall(ix):
+        hits = 0
+        for qi in range(20):
+            q = cents[qi % nclust] + rng.standard_normal(d).astype(
+                np.float32
+            )
+            want = set(_exact_topk(V, uids, q, k))
+            hits += len(set(int(u) for u in ix.search(q, k)) & want)
+        return hits / (20 * k)
+
+    r_inc, r_fresh = recall(inc), recall(fresh)
+    assert inc.build_count == 1, "incremental growth retrained"
+    assert r_inc >= r_fresh - 0.03, (r_inc, r_fresh)
+
+
+# ---------------------------------------------------------------------------
+# Per-call brute-vs-IVF crossover (the r5 inversion, both sides)
+# ---------------------------------------------------------------------------
+
+
+def test_ivf_pick_both_sides_of_the_crossover():
+    pick = VectorIndex._ivf_pick
+    n = 1_000_000
+    # r5 inversion regression (VECTOR_1M_CPU.json): a batched jit probe
+    # pooling ~3% of the corpus STILL loses to brute at batch 64 —
+    # the old static choice picked IVF here and lost 5.8-vs-12.2 qps
+    assert pick(64, 30_000, n, quant=False) is False
+    # ...while a single query at the same pool picks IVF
+    assert pick(1, 30_000, n, quant=False) is True
+    # jit single-query crossover flips when the probe nears corpus/3
+    assert pick(1, n // 2, n, quant=False) is False
+    # quantized engine: probe and brute share the scan kernel, so the
+    # pick flips right around probed ~ corpus (10/13 ratio)
+    assert pick(8, int(n * 0.5), n, quant=True) is True
+    assert pick(8, int(n * 0.9), n, quant=True) is False
+    # a probe covering the corpus can never win
+    assert pick(1, n, n, quant=True) is False
+    assert pick(1, n, n, quant=False) is False
+
+
+def test_crossover_routes_real_searches(monkeypatch):
+    """Integration: the same quantized index routes batched searches
+    brute (pool ~ corpus after multi-assignment) or IVF per CALL as
+    nprobe moves the estimated pool across the crossover."""
+    rng = np.random.default_rng(9)
+    n, d, k = 5000, 16, 5
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    idx = _mk(V, ivf_threshold=500, nlist=64, nprobe=2)
+    Q = rng.standard_normal((4, d)).astype(np.float32)
+    vector.reset_counters()
+    idx.search_batch(Q, k)  # nprobe 2/64 -> tiny pool -> IVF
+    assert vector.counters()["path_quant_ivf"] == 4
+    idx2 = _mk(V, ivf_threshold=500, nlist=64, nprobe=64)
+    vector.reset_counters()
+    idx2.search_batch(Q, k)  # full probe: pool ~ 2x corpus -> brute
+    assert vector.counters()["path_quant_brute"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Solo == batch row (the coalescing identity) + serving integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("quant_env", ["1", "0"])
+def test_search_one_is_batch_row(monkeypatch, quant_env):
+    monkeypatch.setenv("DGRAPH_TPU_VEC_QUANT", quant_env)
+    rng = np.random.default_rng(10)
+    n, d, k = 3000, 24, 7
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    for thr in (1 << 62, 400):  # brute tier and IVF tier
+        idx = _mk(V, ivf_threshold=thr)
+        Q = rng.standard_normal((5, d)).astype(np.float32)
+        batch = idx.search_batch(Q, k)
+        for i in range(len(Q)):
+            np.testing.assert_array_equal(
+                idx.search_one(Q[i], k), batch[i]
+            )
+
+
+def test_read_similar_coalesces_and_demuxes_identically():
+    """Concurrent plain similar_to tasks coalesce into ONE search_batch
+    dispatch through the micro-batcher; every member's row is byte-
+    identical to its solo search, and the batch_dispatch span links
+    every member's trace."""
+    from dgraph_tpu.serving.microbatch import MicroBatcher
+    from dgraph_tpu.utils.observe import METRICS, TRACER, parse_traceparent
+
+    rng = np.random.default_rng(11)
+    n, d, k = 4000, 16, 6
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    idx = _mk(V, ivf_threshold=1 << 62)
+
+    first_started = threading.Event()
+    release_first = threading.Event()
+    calls = []
+    real_batch = idx.search_batch
+
+    def gated_batch(Q, kk):
+        calls.append(len(Q))
+        if len(calls) == 1:
+            first_started.set()
+            release_first.wait(5)
+        return real_batch(Q, kk)
+
+    idx.search_batch = gated_batch
+
+    class StubCache:
+        kv = object()
+        mem = object()
+        read_ts = 11
+
+    cache = StubCache()
+    b = MicroBatcher(inflight_fn=lambda: 3)
+    os.environ["DGRAPH_TPU_BATCH_WINDOW_US"] = "1000000"
+    queries = rng.standard_normal((3, d)).astype(np.float32)
+    solo = [real_batch(q.reshape(1, -1), k)[0] for q in queries]
+    results = {}
+    trace_ids = {}
+    before = METRICS.value("batch_coalesced_total")
+    try:
+
+        def member(i):
+            with TRACER.span("query") as root:
+                trace_ids[i] = root.trace_id
+                results[i] = b.read_similar(
+                    "emb", cache, idx, queries[i], k
+                )
+
+        t0 = threading.Thread(target=member, args=(0,))
+        t0.start()
+        first_started.wait(5)
+        t1 = threading.Thread(target=member, args=(1,))
+        t2 = threading.Thread(target=member, args=(2,))
+        t1.start()
+        time.sleep(0.05)
+        t2.start()
+        time.sleep(0.05)
+        release_first.set()
+        for th in (t0, t1, t2):
+            th.join(10)
+    finally:
+        os.environ.pop("DGRAPH_TPU_BATCH_WINDOW_US", None)
+        release_first.set()
+        idx.search_batch = real_batch
+
+    # members 1+2 coalesced into ONE combined dispatch of 2 rows
+    assert sorted(calls) == [1, 2], calls
+    assert METRICS.value("batch_coalesced_total") == before + 2
+    for i in range(3):
+        np.testing.assert_array_equal(results[i], solo[i])
+    batch = [
+        s for s in TRACER.recent(50) if s["name"] == "batch_dispatch"
+    ]
+    assert batch, "no batch_dispatch span for the coalesced search"
+    links = [
+        parse_traceparent(v).trace_id
+        for s in batch
+        for a, v in s["attrs"].items()
+        if a.startswith("link.")
+    ]
+    assert {trace_ids[1], trace_ids[2]} <= set(links)
+
+
+def _vector_server(n=300, d=8, seed=12):
+    from dgraph_tpu.api.server import Server
+
+    rng = np.random.default_rng(seed)
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    s = Server()
+    s.alter(
+        'emb: float32vector @index(hnsw(metric:"euclidean")) .\n'
+        "name: string @index(exact) ."
+    )
+    t = s.new_txn()
+    objs = [
+        {"uid": f"0x{i+1:x}", "name": f"v{i+1}", "emb": V[i].tolist()}
+        for i in range(n)
+    ]
+    t.mutate_json(set_obj=objs, commit_now=True)
+    return s, V
+
+
+def test_similar_to_coalesced_golden_equivalence(monkeypatch):
+    """End-to-end: concurrent similar_to queries through the server
+    coalesce (batch_coalesced_total moves) and serve byte-identical
+    payloads to the solo baseline, at window 0 and window on, with
+    VEC_COALESCE=0 as the per-feature escape hatch."""
+    from dgraph_tpu.utils.observe import METRICS
+
+    s, V = _vector_server()
+    qs = [
+        "{ q(func: similar_to(emb, 3, \"%s\")) { name } }"
+        % ("[" + ", ".join(f"{x:.6f}" for x in V[i]) + "]")
+        for i in range(6)
+    ]
+    base = [json.dumps(s.query(q)["data"], sort_keys=False) for q in qs]
+
+    # slow the index's batch search so concurrent arrivals pile up
+    # behind the in-flight dispatch (the coalescing trigger)
+    idx = s.vector_indexes["emb"]
+    real_batch = idx.search_batch
+
+    def slow_batch(Q, kk):
+        time.sleep(0.002)
+        return real_batch(Q, kk)
+
+    monkeypatch.setattr(idx, "search_batch", slow_batch)
+    monkeypatch.setenv("DGRAPH_TPU_BATCH_WINDOW_US", "20000")
+    before = METRICS.value("batch_coalesced_total")
+    results = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(4)
+
+    def worker(wid):
+        barrier.wait()
+        for r in range(10):
+            qi = (wid + r) % len(qs)
+            got = json.dumps(s.query(qs[qi])["data"], sort_keys=False)
+            with lock:
+                results.append((qi, got))
+
+    ths = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    assert all(got == base[qi] for qi, got in results)
+    assert METRICS.value("batch_coalesced_total") > before, (
+        "no similar_to coalescing under 4-way concurrency"
+    )
+
+    # escape hatch: VEC_COALESCE=0 must keep results identical and
+    # never consult the batcher for vector searches
+    from dgraph_tpu.serving.microbatch import MicroBatcher
+
+    monkeypatch.setenv("DGRAPH_TPU_VEC_COALESCE", "0")
+
+    def boom(*a, **kw):
+        raise AssertionError("read_similar engaged at VEC_COALESCE=0")
+
+    monkeypatch.setattr(MicroBatcher, "read_similar", boom)
+    assert json.dumps(s.query(qs[0])["data"], sort_keys=False) == base[0]
+
+
+def test_similar_to_filtered_paths_unchanged(monkeypatch):
+    """ef / distance_threshold / filtered similar_to must never route
+    through the batcher (only plain top-k coalesces)."""
+    from dgraph_tpu.serving.microbatch import MicroBatcher
+
+    s, V = _vector_server(n=50)
+    monkeypatch.setenv("DGRAPH_TPU_BATCH_WINDOW_US", "200")
+
+    def boom(*a, **kw):
+        raise AssertionError("filtered similar_to reached read_similar")
+
+    monkeypatch.setattr(MicroBatcher, "read_similar", boom)
+    vec = "[" + ", ".join(f"{x:.6f}" for x in V[3]) + "]"
+    out = s.query(
+        '{ q(func: similar_to(emb, 2, "%s", ef: 8)) { name } }' % vec
+    )
+    assert out["data"]["q"][0]["name"] == "v4"
+
+
+# ---------------------------------------------------------------------------
+# Observability: metrics + per-query profile attribution
+# ---------------------------------------------------------------------------
+
+
+def test_vector_metrics_and_profile(monkeypatch):
+    from dgraph_tpu.utils.observe import METRICS, profile_scope
+
+    s, V = _vector_server(n=100)
+    monkeypatch.setattr(vector, "_QUANT_MIN", 1)
+    before = METRICS.value("vector_search_total")
+    vec = "[" + ", ".join(f"{x:.6f}" for x in V[0]) + "]"
+    with profile_scope() as prof:
+        s.query('{ q(func: similar_to(emb, 3, "%s")) { name } }' % vec)
+    assert METRICS.value("vector_search_total") == before + 1
+    vec_keys = [k for k in prof.kernel if k.startswith("vec_")]
+    assert "vec_searches" in vec_keys, prof.kernel
+
+
+# ---------------------------------------------------------------------------
+# Mutation-lifecycle hardening (post-review regressions)
+# ---------------------------------------------------------------------------
+
+
+def test_empty_bulk_load_then_insert():
+    """A zero-row bulk_load (an empty loader shard) leaves a (0, d)
+    store; the next insert must grow from cap 0 instead of hanging."""
+    idx = VectorIndex("emb")
+    idx.bulk_load(
+        np.zeros((0,), np.uint64), np.zeros((0, 8), np.float32)
+    )
+    idx.insert(1, np.ones(8, np.float32))
+    q = np.ones(8, np.float32)
+    assert [int(u) for u in idx.search(q, 1)] == [1]
+
+
+def test_compaction_bounds_store_growth_and_stays_correct():
+    """Update-heavy workload: every write is tombstone + append, so the
+    host store must compact back to O(live) instead of growing with
+    total writes — and answers must stay float-exact across the row
+    renumbering."""
+    rng = np.random.default_rng(3)
+    n, d = 400, 16
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    idx = _mk(V)
+    for _ in range(6):
+        for i in range(n):
+            V[i] = rng.standard_normal(d).astype(np.float32)
+            idx.insert(i + 1, V[i])
+        # a search is the sync point that may compact
+        idx.search(V[0], 3)
+    assert idx._n == n, (idx._n, n)
+    assert len(idx) == n
+    q = V[17]
+    got = [int(u) for u in idx.search(q, 5)]
+    assert got == _exact_topk(V, np.arange(1, n + 1), q, 5)
+    # uid identity survived the renumbering
+    assert [int(u) for u in idx.search_with_uid(17 + 1, 2)][:1] != [18]
+
+
+def test_ivf_maintained_below_build_threshold(monkeypatch):
+    """ivf_threshold gates BUILDING only: once an index exists, rows
+    inserted while live sits below the threshold must still be assigned
+    to cells — before the fix they were categorically unreachable
+    through the probe path until live re-crossed the threshold."""
+    rng = np.random.default_rng(4)
+    n, d = 300, 12
+    V = rng.standard_normal((n, d)).astype(np.float32)
+    idx = _mk(V, ivf_threshold=n, nlist=16, nprobe=16)
+    idx._quant_view()
+    assert idx._qivf is not None
+    for u in range(1, 20):  # live dips below the build threshold
+        idx.remove(u)
+    newv = rng.standard_normal(d).astype(np.float32)
+    idx.insert(1000, newv)
+    view = idx._quant_view()
+    assert view["ivf"] is not None
+    assert view["ivf"]["assigned"] == idx._n, "fresh row left unassigned"
+    # pin the probe path and assert the fresh row is actually served
+    monkeypatch.setattr(
+        VectorIndex, "_ivf_pick", staticmethod(lambda *a, **kw: True)
+    )
+    assert [int(u) for u in idx.search(newv, 1)] == [1000]
+
+
+def test_filtered_search_widens_ivf_probe(monkeypatch):
+    """The widening loop must widen the PROBE, not just the kept pool:
+    an allowed set whose uids all live outside the query's top-nprobe
+    cells is unreachable at any pool width unless the probe escalates
+    (the quant analog of the jitted path's pool-scaled _probe_plan)."""
+    rng = np.random.default_rng(9)
+    d = 8
+    A = rng.standard_normal((200, d)).astype(np.float32) * 0.05
+    B = rng.standard_normal((200, d)).astype(np.float32) * 0.05 + 50.0
+    V = np.vstack([A, B])
+    idx = _mk(V, ivf_threshold=100, nlist=8, nprobe=1)
+    idx._quant_view()
+    assert idx._qivf is not None
+    monkeypatch.setattr(
+        VectorIndex, "_ivf_pick", staticmethod(lambda *a, **kw: True)
+    )
+    q = A[0]  # query sits in cluster A; only cluster-B uids allowed
+    allowed = np.arange(201, 401, dtype=np.uint64)
+    got = [int(u) for u in idx.search(q, 3, allowed=allowed)]
+    assert got == _exact_topk(B, np.arange(201, 401), q, 3)

@@ -35,6 +35,7 @@ else
         tests/test_observability.py tests/test_distributed_tracing.py \
         tests/test_serving_front.py \
         tests/test_stream_encoder.py \
+        tests/test_vector_quant.py \
         -q -p no:cacheprovider
 
     echo "== qps loadgen sanity (~5s) =="
@@ -42,6 +43,9 @@ else
 
     echo "== encode microbench sanity (~5s) =="
     python bench.py --encode-sanity
+
+    echo "== vector engine sanity (~5s) =="
+    python bench.py --vector-sanity
 fi
 
 echo "check.sh: all stages passed"
