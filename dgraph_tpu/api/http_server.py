@@ -24,6 +24,7 @@ from dgraph_tpu.query import streamjson
 from dgraph_tpu.query.functions import QueryError
 from dgraph_tpu.api.server import Server, TxnHandle
 from dgraph_tpu.serving import TooManyRequestsError
+from dgraph_tpu.worker.tabletmove import TabletFencedError
 from dgraph_tpu.zero.zero import TxnConflictError
 
 
@@ -370,6 +371,23 @@ class _Handler(BaseHTTPRequestHandler):
                     ]
                 },
                 429,
+            )
+        except TabletFencedError as e:
+            # tablet move fence: the window is bounded (or awaiting
+            # recovery) — retryable, never wrong data
+            self._reply(
+                {
+                    "errors": [
+                        {
+                            "message": str(e),
+                            "extensions": {
+                                "code": TabletFencedError.code,
+                                "retryable": True,
+                            },
+                        }
+                    ]
+                },
+                503,
             )
         except TxnConflictError as e:
             self._error(f"Transaction has been aborted. Please retry. {e}", 409)

@@ -13,8 +13,13 @@ well-defined points —
              double-apply trap the idempotency LRU exists for)
   raft_send  raft/tcp.py TcpNetwork.send, per remote peer
   raft_recv  raft/tcp.py listener, per remote sender
+  move.*     named in-code sync points at the tablet-move phase
+             boundaries (worker/tabletmove.py via `syncpoint`): crash
+             rules simulate coordinator death at exactly that boundary
+             (InjectedCrash), delay rules stretch a phase
 
-Actions: drop | delay | dup | disconnect | partition. `partition` is a
+Actions: drop | delay | dup | disconnect | partition | crash.
+`crash` only fires at named sync points. `partition` is a
 deterministic directional block (see `FaultPlan.partition`); the rest
 fire probabilistically but DETERMINISTICALLY: each (point, peer) pair
 is a stream with its own monotonic counter, and the n-th decision of a
@@ -41,8 +46,17 @@ from typing import Dict, List, Optional, Tuple
 
 from dgraph_tpu.utils.observe import METRICS
 
-_ACTIONS = ("drop", "delay", "dup", "disconnect", "partition")
+_ACTIONS = ("drop", "delay", "dup", "disconnect", "partition", "crash")
 _OUTBOUND = ("send", "raft_send")
+
+
+class InjectedCrash(RuntimeError):
+    """A `crash` rule fired at a named sync point: the in-process
+    simulation of the coordinator dying at exactly that boundary (the
+    tablet-move chaos suite drives one of these at every journaled
+    phase transition). Callers must NOT catch this to clean up — a real
+    SIGKILL would not have run the cleanup either; recovery code has to
+    heal from the durable journal alone."""
 
 
 def _peer_str(peer) -> str:
@@ -277,6 +291,40 @@ def init_from_env(force: bool = False) -> Optional[FaultPlan]:
             return _ACTIVE
         _ACTIVE = _plan_from_env()
         return _ACTIVE
+
+
+def syncpoint(point: str, peer="coordinator"):
+    """Named in-code fault point (the tablet-move phase boundaries:
+    `move.begin`, `move.copy`, `move.chunk`, `move.fence`, `move.delta`,
+    `move.flip`, `move.drop`). Consults the active plan's deterministic
+    per-(point, peer) stream like any transport hook:
+
+      crash  -> raises InjectedCrash (simulated coordinator death at
+                exactly this boundary; the caller must not clean up)
+      delay  -> sleeps delay_ms (stretches a phase deterministically so
+                concurrency tests can observe it in flight)
+
+    Other actions are transport-only and ignored here. Plans with no
+    rule matching the point leave its stream untouched, so installing a
+    move-point schedule never perturbs the RPC/raft stream draws."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    peer_s = _peer_str(peer)
+    if not any(
+        r.matches(point, peer_s, "") and r.action in ("crash", "delay")
+        for r in plan.rules
+    ):
+        return
+    r = plan.decide(point, peer, "")
+    if r is None:
+        return
+    if r.action == "crash":
+        raise InjectedCrash(f"{point} ({peer_s})")
+    if r.action == "delay" and r.delay_s > 0:
+        import time as _time
+
+        _time.sleep(r.delay_s)  # injected latency, not a retry backoff
 
 
 # child processes inherit the harness env: pick the plan up at import so

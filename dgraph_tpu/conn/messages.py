@@ -195,9 +195,12 @@ class KV(Message):
 
 
 class KVList(Message):
-    """pb.KVS analog: a streamed record batch."""
+    """pb.KVS analog: a streamed record batch. `more` marks a paged
+    iterate_versions response truncated at a key boundary by the
+    request's max_bytes cap — the caller resumes with after=<last key>
+    (the tablet-move copy stream; old decoders skip the field)."""
 
-    FIELDS = {"kv": (1, ("rep", ("msg", KV)))}
+    FIELDS = {"kv": (1, ("rep", ("msg", KV))), "more": (2, "bool")}
 
 
 class HealthInfo(Message):
@@ -222,7 +225,21 @@ class GetResponse(Message):
 
 
 class IterateRequest(Message):
-    FIELDS = {"prefix": (1, "bytes"), "ts": (2, "uint")}
+    """Prefix scan. The optional fields page and filter a versions scan
+    so one response frame stays bounded (tablet moves stream tablets
+    far larger than DGRAPH_TPU_MAX_FRAME_BYTES in chunks):
+      since     only versions with ts > since (delta-phase catch-up)
+      after     resume strictly after this key (page cursor)
+      max_bytes stop at the first key boundary past this many record
+                bytes and set KVList.more (0 = unpaged)."""
+
+    FIELDS = {
+        "prefix": (1, "bytes"),
+        "ts": (2, "uint"),
+        "since": (3, "uint"),
+        "after": (4, "bytes"),
+        "max_bytes": (5, "uint"),
+    }
 
 
 class Proposal(Message):

@@ -171,6 +171,23 @@ class MemoryLayer:
 
         DISPATCHER.device_cache.invalidate(keys)
 
+    def invalidate_prefix(self, prefixes: Iterable[bytes]):
+        """Drop every cached entry whose key starts with any prefix —
+        the tablet-move/drop-attr invalidation: only the moved
+        predicate's data/split/index entries go; an unrelated
+        predicate's decoded lists survive (the old movers cleared the
+        whole layer)."""
+        pfx = tuple(bytes(p) for p in prefixes)
+        if not pfx:
+            return
+        with self._lock:
+            hit = [k for k in self._cache if k.startswith(pfx)]
+            for k in hit:
+                del self._cache[k]
+        from dgraph_tpu.query.dispatch import DISPATCHER
+
+        DISPATCHER.device_cache.invalidate_prefix(pfx)
+
     def clear(self):
         with self._lock:
             self._cache.clear()

@@ -155,6 +155,23 @@ class DeviceCache:
                     if got is not None:
                         self._bytes -= got[1]
 
+    def invalidate_prefix(self, prefixes) -> None:
+        """Drop cached operands for every key under any prefix (the
+        MemoryLayer's tablet-move invalidation, mirrored in HBM)."""
+        pfx = tuple(bytes(p) for p in prefixes)
+        if not pfx:
+            return
+        with self._lock:
+            hit = [
+                k for k in self._by_key
+                if isinstance(k, (bytes, bytearray)) and bytes(k).startswith(pfx)
+            ]
+            for k in hit:
+                for tok in self._by_key.pop(k, ()):
+                    got = self._entries.pop(tok, None)
+                    if got is not None:
+                        self._bytes -= got[1]
+
     def clear(self):
         with self._lock:
             self._entries.clear()

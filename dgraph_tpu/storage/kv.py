@@ -197,11 +197,19 @@ class MemKV(KV):
         return iter(out)
 
     def iterate_versions(
-        self, prefix: bytes, read_ts: int
+        self, prefix: bytes, read_ts: int, after: bytes = b""
     ) -> Iterator[Tuple[bytes, List[Tuple[int, bytes]]]]:
-        """All versions per key (newest first) — rebuilds & backups."""
+        """All versions per key (newest first) — rebuilds & backups.
+        `after` seeks the scan strictly past a key (the tablet mover's
+        page cursor: resuming a paged scan bisects instead of
+        re-walking every already-sent key)."""
         keys = self._sorted_keys()
-        i = bisect.bisect_left(keys, prefix)
+        start = prefix
+        if after:
+            nxt = after + b"\x00"
+            if nxt > start:
+                start = nxt
+        i = bisect.bisect_left(keys, start)
         while i < len(keys):
             k = keys[i]
             if not k.startswith(prefix):

@@ -1326,6 +1326,39 @@ declare_metric(
     "instead of per-entity Python objects (query/streamjson.py).",
 )
 declare_metric(
+    "counter", "tablet_fence_rejected_total",
+    "Commits bounced with the retryable TabletFencedError because they "
+    "touched a predicate inside a tablet move's Phase-2 fence "
+    "(worker/tabletmove.py check_fences).",
+)
+declare_metric(
+    "counter", "tablet_move_bytes_total",
+    "Record bytes streamed into destination groups by tablet-move "
+    "copy/delta chunks (worker/tabletmove.py).",
+)
+declare_metric(
+    "counter", "tablet_move_chunks_total",
+    "Bounded ('delta', chunk) proposals shipped by tablet moves "
+    "(chunk size DGRAPH_TPU_MOVE_CHUNK_BYTES).",
+)
+declare_metric(
+    "counter", "tablet_move_failed_total",
+    "Tablet moves that aborted and rolled back (fence deadline "
+    "overrun, unreachable group, ...); the journal guarantees the "
+    "rollback completes even if the abort path itself dies.",
+)
+declare_metric(
+    "counter", "tablet_move_recovered_total",
+    "Journaled in-flight moves resolved by crash recovery "
+    "(recover_moves): copy/fence phases rolled back, drop phase "
+    "rolled forward to completion.",
+)
+declare_metric(
+    "counter", "tablet_move_total",
+    "Tablet moves completed end-to-end (copy + fence + flip + source "
+    "drop + journal clear).",
+)
+declare_metric(
     "counter", "vector_probe_cells_total",
     "IVF cells probed across vector similar_to searches "
     "(models/vector.py).",
@@ -1378,6 +1411,12 @@ declare_metric(
 declare_metric(
     "histogram", "query_latency_seconds",
     "End-to-end query latency at the entry point.",
+)
+declare_metric(
+    "histogram", "tablet_move_fence_seconds",
+    "Duration of tablet-move Phase-2 fences (moving state + delta "
+    "catch-up + flip, under the commit lock) — the only window a move "
+    "blocks commits, bounded by DGRAPH_TPU_MOVE_FENCE_DEADLINE_S.",
 )
 declare_metric(
     "histogram", "span_*_seconds",

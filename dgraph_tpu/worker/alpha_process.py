@@ -137,6 +137,7 @@ class AlphaProcess:
         r("kv.versions", self._h_versions)
         r("kv.iterate", self._h_iterate)
         r("kv.iterate_versions", self._h_iterate_versions)
+        r("kv.prefix_size", self._h_prefix_size)
         r("propose", self._h_propose)
         from dgraph_tpu.conn.messages import Ack
 
@@ -176,12 +177,43 @@ class AlphaProcess:
 
     def _h_iterate_versions(self, a: IterateRequest):
         # flat KVList; consecutive same-key runs group client-side
-        # (the stream shape of pb.KVS)
+        # (the stream shape of pb.KVS). Paging (after/max_bytes) and
+        # the since-ts filter bound one response frame — the tablet
+        # mover streams tablets larger than the frame cap in chunks.
+        # The cursor SEEKS (bisect in MemKV) so N pages cost one scan
+        # total, not N re-scans of everything already sent.
         out = []
-        for k, vers in self.kv.iterate_versions(a.prefix, a.ts):
+        size = 0
+        more = False
+        try:
+            it = self.kv.iterate_versions(a.prefix, a.ts, after=a.after)
+        except TypeError:  # backend without seek support
+            it = self.kv.iterate_versions(a.prefix, a.ts)
+        for k, vers in it:
+            if a.after and k <= a.after:
+                continue
+            if a.since:
+                vers = [(ts, v) for ts, v in vers if ts > a.since]
+                if not vers:
+                    continue
+            if a.max_bytes and size >= a.max_bytes:
+                more = True  # truncated at a key boundary; resume here
+                break
             for ts, v in vers:
                 out.append(KV(key=k, ts=ts, value=v))
-        return KVList(kv=out)
+                size += len(k) + len(v) + 16
+        return KVList(kv=out, more=more)
+
+    def _h_prefix_size(self, a: IterateRequest):
+        """Record bytes under a prefix, summed server-side — the
+        rebalancer's tablet-size signal (ref draft.go
+        calculateTabletSizes). One small reply instead of streaming
+        the whole tablet over the wire just to count it."""
+        total = 0
+        for _k, vers in self.kv.iterate_versions(a.prefix, a.ts):
+            for _ts, v in vers:
+                total += len(v)
+        return {"bytes": total}
 
     def _h_propose(self, a: Proposal):
         """Leader-only append + wait-for-apply (proposeAndWait). Non-leaders

@@ -22,8 +22,6 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
-import struct
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -33,6 +31,7 @@ from dgraph_tpu.posting.lists import LocalCache, Txn
 from dgraph_tpu.raft.raft import InProcNetwork, RaftNode
 from dgraph_tpu.schema.schema import State, parse_schema
 from dgraph_tpu.storage.kv import KV, MemKV
+from dgraph_tpu.worker.tabletmove import AppendLog
 from dgraph_tpu.x import keys
 from dgraph_tpu.zero.zero import TxnConflictError, ZeroLite
 
@@ -51,6 +50,17 @@ class ZeroService:
         self._tablets: Dict[str, int] = {}  # predicate -> group id
         self._lock = threading.Lock()
         self.members: Dict[int, dict] = {}  # node_id -> info
+        # tablet-move journal (worker/tabletmove.py): pred -> entry with
+        # {src, dst, phase, read_ts}. Durable through the replicated
+        # Zero state machine when raft-backed, else through the
+        # optional MoveJournal file the cluster attaches.
+        self._moves: Dict[str, dict] = {}
+        self.journal = None  # Optional[tabletmove.MoveJournal]
+        # coordinator-local fence mirror: commits check this set per
+        # predicate on the hot path instead of an RPC to Zero (the
+        # mover and recovery — both on this coordinator — keep it in
+        # sync with the journal)
+        self._fenced: set = set()
 
     @property
     def tablets(self) -> Dict[str, int]:
@@ -83,6 +93,107 @@ class ZeroService:
         with self._lock:
             self._tablets[pred] = dst_group
 
+    # -- tablet-move journal (ref predicate_move.go phases) -----------------
+    #
+    # Each transition is durable BEFORE its in-memory effect: proposed
+    # through the replicated Zero state machine, or appended to the
+    # MoveJournal file. `move_flip` is the atomic ownership change —
+    # tablets[pred]=dst and journal phase->"drop" land in one step.
+
+    def moves(self) -> Dict[str, dict]:
+        """LINEARIZABLE journal read — drives destructive recovery
+        decisions, so with a raft-backed Zero it rides the raft log.
+        Advisory checks (drop_attr guard, state(), rebalance busy set)
+        use the free local `moves_hint()` instead."""
+        if self._repl is not None:
+            return {p: dict(m) for p, m in self._repl.moves.items()}
+        with self._lock:
+            return {p: dict(m) for p, m in self._moves.items()}
+
+    def moves_hint(self) -> Dict[str, dict]:
+        """Coordinator-local journal mirror (no consensus round): kept
+        in sync by the move_* calls, which all flow through this
+        coordinator; seeded from the linearizable read at startup
+        (refresh_fences). May lag only across coordinator restarts —
+        fine for advisory checks, never for recovery."""
+        with self._lock:
+            return {p: dict(m) for p, m in self._moves.items()}
+
+    def fenced(self, pred: str) -> bool:
+        return pred in self._fenced
+
+    def move_begin(self, pred: str, src: int, dst: int, read_ts: int):
+        entry = {
+            "src": int(src), "dst": int(dst),
+            "phase": "copy", "read_ts": int(read_ts),
+        }
+        if self._repl is not None:
+            self._repl.move_begin(pred, int(src), int(dst), int(read_ts))
+        else:
+            if self.journal is not None:
+                self.journal.record(pred, entry)
+        with self._lock:
+            self._moves[pred] = entry
+
+    def move_fence(self, pred: str):
+        with self._lock:
+            m = self._moves.get(pred)
+            if m is None:
+                raise RuntimeError(f"no move journaled for {pred!r}")
+            m = dict(m, phase="fence")
+        if self._repl is not None:
+            self._repl.move_fence(pred)
+        else:
+            if self.journal is not None:
+                self.journal.record(pred, m)
+        with self._lock:
+            self._moves[pred] = m
+        self._fenced.add(pred)
+
+    def move_flip(self, pred: str):
+        with self._lock:
+            m = self._moves.get(pred)
+            if m is None:
+                raise RuntimeError(f"no move journaled for {pred!r}")
+            m = dict(m, phase="drop")
+        if self._repl is not None:
+            self._repl.move_flip(pred)
+        else:
+            if self.journal is not None:
+                self.journal.record(pred, m)
+        with self._lock:
+            self._moves[pred] = m
+            if self._repl is None:
+                self._tablets[pred] = m["dst"]
+        self._fenced.discard(pred)
+
+    def move_done(self, pred: str):
+        self._move_clear(pred)
+
+    def move_abort(self, pred: str):
+        self._move_clear(pred)
+
+    def _move_clear(self, pred: str):
+        if self._repl is not None:
+            self._repl.move_clear(pred)
+        else:
+            if self.journal is not None:
+                self.journal.clear(pred)
+        with self._lock:
+            self._moves.pop(pred, None)
+        self._fenced.discard(pred)
+
+    def refresh_fences(self):
+        """Seed the local fence + journal mirrors from the durable
+        journal (recovery: a fresh coordinator must bounce commits to
+        a predicate a dead coordinator left fenced)."""
+        moves = self.moves()
+        with self._lock:
+            self._moves = {p: dict(m) for p, m in moves.items()}
+        self._fenced = {
+            p for p, m in moves.items() if m.get("phase") == "fence"
+        }
+
     def connect(self, node_id: int, group: int):
         self.members[node_id] = {"group": group, "last_seen": time.time()}
 
@@ -109,6 +220,7 @@ class ZeroService:
             "tablets": dict(self.tablets),
             "members": dict(self.members),
             "maxTxnTs": self.zero.max_assigned,
+            "moves": self.moves_hint(),
         }
 
 
@@ -273,65 +385,37 @@ class RoutingKV(KV):
         raise RuntimeError("RoutingKV is read-only; commit via cluster txns")
 
 
-class IntentLog:
+class IntentLog(AppendLog):
     """Durable commit-intent journal (ref zero/oracle.go:185 delta stream
     as the recovery model): an intent is appended BEFORE deltas are
     proposed to the owning groups and marked done after every group
     applied them. Restart replays unfinished intents, so a crash between
-    groups can no longer tear a commit."""
+    groups can no longer tear a commit. Shares the AppendLog record
+    format with the tablet MoveJournal (torn tails truncate to the last
+    complete record at open; flush-only — process-crash durability)."""
 
-    _HDR = struct.Struct("<BI")  # kind, len
     _K_INTENT = 1
     _K_DONE = 2
 
     def __init__(self, path: str):
-        self.path = path
-        self._f = open(path, "ab")
-        self._lock = threading.Lock()
+        super().__init__(path, kinds=(self._K_INTENT, self._K_DONE))
 
     def append_intent(self, commit_ts: int, per_group: Dict[int, list]):
-        blob = pickle.dumps((commit_ts, per_group))
-        with self._lock:
-            self._f.write(self._HDR.pack(self._K_INTENT, len(blob)))
-            self._f.write(blob)
-            self._f.flush()
+        self._append(self._K_INTENT, (commit_ts, per_group))
 
     def mark_done(self, commit_ts: int):
-        blob = pickle.dumps(commit_ts)
-        with self._lock:
-            self._f.write(self._HDR.pack(self._K_DONE, len(blob)))
-            self._f.write(blob)
-            self._f.flush()
+        self._append(self._K_DONE, commit_ts)
 
     def pending(self) -> Dict[int, Dict[int, list]]:
         """commit_ts -> per_group writes for unfinished intents."""
         out: Dict[int, Dict[int, list]] = {}
-        try:
-            with open(self.path, "rb") as f:
-                data = f.read()
-        except FileNotFoundError:
-            return out
-        pos, n = 0, len(data)
-        while pos + self._HDR.size <= n:
-            kind, plen = self._HDR.unpack_from(data, pos)
-            if pos + self._HDR.size + plen > n:
-                break
-            blob = data[pos + self._HDR.size : pos + self._HDR.size + plen]
-            pos += self._HDR.size + plen
-            try:
-                obj = pickle.loads(blob)
-            except Exception:
-                break
+        for kind, obj in self._scan():
             if kind == self._K_INTENT:
                 cts, pg = obj
                 out[cts] = pg
-            elif kind == self._K_DONE:
+            else:
                 out.pop(obj, None)
         return out
-
-    def close(self):
-        with self._lock:
-            self._f.close()
 
 
 class PartialCommitError(RuntimeError):
@@ -419,7 +503,18 @@ class DistributedCluster:
             self._load_zero_state()
         self._stop = False
         self._pump_ms = pump_ms
-        self.auto_rebalance = False  # enable_auto_rebalance() turns on
+        self._zero_state_lock = threading.Lock()
+        self._rebalance_stop = None
+        self._rebalance_thread = None
+        if data_dir is not None and not self.zero_nodes:
+            # non-replicated Zero: the move journal durability backend
+            # is a file (raft-backed Zeros journal in the state machine)
+            from dgraph_tpu.worker.tabletmove import MoveJournal
+
+            self.zero.journal = MoveJournal(
+                os.path.join(data_dir, "moves.journal")
+            )
+            self.zero._moves.update(self.zero.journal.pending())
         self._pump_thread = threading.Thread(target=self._pump_loop, daemon=True)
         self._pump_thread.start()
         self._wait_for_leaders()
@@ -439,6 +534,12 @@ class DistributedCluster:
                 poll.sleep(1)
         if data_dir is not None:
             self.recover_intents()
+        # heal any move a dead coordinator left journaled (rolls back
+        # copy/fence phases, rolls the drop phase forward) and restore
+        # the fence mirror for anything still mid-recovery
+        self.zero.refresh_fences()
+        if self.zero.moves():
+            self.recover_moves()
 
     # -- durable Zero state (tablets/leases/schema; ref zero raft state) ------
 
@@ -448,6 +549,13 @@ class DistributedCluster:
     def _save_zero_state(self):
         if self.data_dir is None:
             return
+        with self._zero_state_lock:
+            self._save_zero_state_locked()
+
+    def _save_zero_state_locked(self):
+        # serialized: the mover's flip-time persist and a concurrent
+        # alter/commit/close share one fixed .tmp path — interleaved
+        # writers would os.replace torn JSON into zero.json
         if self.zero_nodes:
             # leases/tablets are raft-durable; only schema text needs a file
             state = {"schemas": getattr(self, "_schema_texts", [])}
@@ -491,13 +599,19 @@ class DistributedCluster:
 
     def recover_intents(self) -> int:
         """Re-propose every unfinished commit intent (crash replay).
-        Proposals are idempotent (same-ts puts). Returns #replayed."""
+        Proposals are idempotent (same-ts puts). Writes re-shard
+        against the CURRENT tablet map — a move completed since the
+        intent was journaled invalidates the recorded group ids, and
+        replaying to the old owner would strand them on a dropped
+        tablet. Returns #replayed."""
         if self.intents is None:
             return 0
+        from dgraph_tpu.worker.tabletmove import reshard_intent
+
         replayed = 0
         for cts, per_group in sorted(self.intents.pending().items()):
-            for gid, writes in per_group.items():
-                self._propose_and_wait(int(gid), ("delta", writes))
+            for gid, writes in reshard_intent(self.zero, per_group).items():
+                self._propose_and_wait(gid, ("delta", writes))
             self.intents.mark_done(cts)
             replayed += 1
         return replayed
@@ -527,11 +641,6 @@ class DistributedCluster:
                     z.raft.tick(now)
             if ticks % 100 == 0:
                 self.zero.prune_dead(max_age_s=5.0)
-                if self.auto_rebalance:
-                    try:
-                        self.rebalance_by_size()
-                    except Exception:
-                        pass  # next tick retries
             time.sleep(self._pump_ms / 1000.0)
 
     def _wait_for_leaders(self, timeout: float = 10.0):
@@ -547,10 +656,19 @@ class DistributedCluster:
         raise TimeoutError("raft groups failed to elect leaders")
 
     def close(self):
+        # join the rebalance thread BEFORE stopping the raft-tick pump:
+        # a mid-tick move must finish (or fail) while proposals can
+        # still make progress — an unjoined mover would race the
+        # journal/zero-state writes below
+        if self._rebalance_stop is not None:
+            self._rebalance_stop.set()
+            self._rebalance_thread.join(timeout=15)
         self._stop = True
         self._pump_thread.join(timeout=2)
         if self.intents is not None:
             self.intents.close()
+        if self.zero.journal is not None:
+            self.zero.journal.close()
         if self.data_dir is not None:
             self._save_zero_state()
         for g in self.groups.values():
@@ -587,6 +705,13 @@ class DistributedCluster:
     def drop_attr(self, pred: str):
         """Drop one predicate cluster-wide (ref alter DropAttr: data +
         split parts + schema on the owning group)."""
+        if self.zero.fenced(pred) or pred in self.zero.moves_hint():
+            from dgraph_tpu.worker.tabletmove import TabletFencedError
+
+            # a drop racing a move would be resurrected by the copy
+            raise TabletFencedError(
+                f"tablet {pred!r} is moving; retry the drop"
+            )
         gid = self.zero.belongs_to(pred)
         if gid is not None:
             with self._commit_lock:
@@ -598,7 +723,9 @@ class DistributedCluster:
                 )
         self.schema.delete(pred)
         self.vector_indexes.pop(pred, None)
-        self.mem.clear()
+        self.mem.invalidate_prefix(
+            (keys.PredicatePrefix(pred), keys.SplitPredicatePrefix(pred))
+        )
 
     def drop_all(self):
         """DropAll: wipe every group's data and reset schema."""
@@ -623,6 +750,7 @@ class DistributedCluster:
             return self._commit_locked(txn)
 
     def _commit_locked(self, txn: Txn) -> int:
+        self._check_fences(txn)
         commit_ts = self.zero.zero.commit(txn.start_ts, txn.conflict_keys, track=True)
         # shard deltas by owning group (populateMutationMap analog)
         per_group: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
@@ -709,90 +837,118 @@ class DistributedCluster:
         return {"data": data}
 
     # -- tablet move / rebalance (ref zero/tablet.go, predicate_move.go) --------
+    #
+    # The phase driver lives in worker/tabletmove.py (shared verbatim
+    # with the multi-process ProcCluster so the two paths cannot
+    # drift); this cluster only supplies the read/propose primitives.
+
+    def _check_fences(self, txn: Txn):
+        from dgraph_tpu.worker.tabletmove import check_fences
+
+        check_fences(self.zero, txn.cache.deltas)
+
+    def _move_leader_kv(self, gid: int, timeout: float = 5.0) -> KV:
+        """The LEADER's KV, for move reads: _propose_and_wait only
+        waits for the leader's apply, so a follower may lag — a
+        committed version missed by the copy stream would be LOST
+        after the source drop (queries tolerate follower staleness,
+        a move must not). No-leader windows raise; the move rolls
+        back through the journal."""
+        deadline = time.time() + timeout
+        poll = poll_policy(0.01)
+        while time.time() < deadline:
+            lead = self.groups[gid].leader()
+            if lead is not None:
+                return lead.kv
+            poll.sleep(1)
+        raise TimeoutError(f"group {gid}: no leader for move read")
+
+    def _move_iter(self, gid, prefix, ts, since_ts, page_bytes):
+        kv = self._move_leader_kv(gid)
+        for key, vers in kv.iterate_versions(prefix, ts):
+            if since_ts:
+                vers = [(t, v) for t, v in vers if t > since_ts]
+            if vers:
+                yield key, vers
+
+    def _move_propose(self, gid: int, data):
+        # honor the mover's ambient fence deadline: _propose_and_wait
+        # budgets with a fixed timeout and never reads deadline_scope,
+        # so an in-flight proposal during the Phase-2 delta would
+        # otherwise overrun the fence with the commit lock held
+        from dgraph_tpu.conn.retry import current_deadline
+
+        dl = current_deadline()
+        if dl is not None:
+            self._propose_and_wait(
+                gid, data, timeout=max(0.1, min(10.0, dl.remaining()))
+            )
+        else:
+            self._propose_and_wait(gid, data)
+
+    def _move_persist_zero(self):
+        # flush the flipped tablet map to zero.json before the journal
+        # entry clears (no-op without a data_dir; with zero_nodes the
+        # map is raft-durable and this only rewrites schema text)
+        self._save_zero_state()
+
+    def _move_prefix_size(self, gid: int, prefix: bytes) -> int:
+        kv = self._move_leader_kv(gid)
+        return sum(
+            len(v)
+            for _k, vers in kv.iterate_versions(prefix, 1 << 62)
+            for _ts, v in vers
+        )
+
+    def _move_group_ids(self):
+        return list(self.groups)
 
     def move_tablet(self, pred: str, dst_group: int):
-        with self._commit_lock:  # fence writes for the whole move
-            self._move_tablet_locked(pred, dst_group)
+        """Phased live move: chunked background copy at a pinned ts
+        (writes keep flowing), bounded Phase-2 fence (replicated moving
+        state, delta catch-up, atomic flip), deferred source drop —
+        every transition journaled, recoverable at any boundary."""
+        from dgraph_tpu.worker.tabletmove import TabletMover
 
-    def _move_tablet_locked(self, pred: str, dst_group: int):
-        src_group = self.zero.belongs_to(pred)
-        if src_group is None or src_group == dst_group:
-            return
-        src = self.groups[src_group].any_replica().kv
-        prefix = keys.PredicatePrefix(pred)
-        split_prefix = keys.SplitPredicatePrefix(pred)
-        writes: List[Tuple[bytes, int, bytes]] = []
-        for pfx in (prefix, split_prefix):  # parts travel with the tablet
-            for key, vers in src.iterate_versions(pfx, (1 << 62)):
-                for ts, val in reversed(vers):  # oldest first
-                    writes.append((key, ts, val))
-        # phase 1: copy into destination group via its raft log
-        if writes:
-            self._propose_and_wait(dst_group, ("delta", writes))
-        # phase 2: flip tablet ownership, then drop from source
-        self.zero.move_tablet(pred, dst_group)
-        self._propose_and_wait(src_group, ("drop", prefix))
-        self._propose_and_wait(src_group, ("drop", split_prefix))
-        self.mem.clear()  # routing changed for the whole tablet
+        return TabletMover(self).move(pred, dst_group)
 
-    def rebalance(self):
-        """Move tablets from the most- to the least-loaded group
-        (count-based variant)."""
-        load: Dict[int, List[str]] = {g: [] for g in self.groups}
-        for pred, g in self.zero.tablets.items():
-            load[g].append(pred)
-        big = max(load, key=lambda g: len(load[g]))
-        small = min(load, key=lambda g: len(load[g]))
-        if len(load[big]) - len(load[small]) >= 2:
-            self.move_tablet(load[big][0], small)
+    def recover_moves(self) -> int:
+        """Resolve every journaled move whose coordinator died (moves
+        in flight in this process are skipped, not rolled back)."""
+        from dgraph_tpu.worker.tabletmove import recover_all
 
-    def enable_auto_rebalance(self):
-        self.auto_rebalance = True
+        return recover_all(self)
+
+    def rebalance(self, min_move_bytes: int = 1):
+        """One size-based rebalance step (the count-based picker is
+        retired: it depended on dict insertion order)."""
+        return self.rebalance_by_size(min_move_bytes=min_move_bytes)
+
+    def enable_auto_rebalance(self, interval_s: Optional[float] = None):
+        """Jittered background rebalance loop (ref zero/tablet.go Run);
+        interval defaults to DGRAPH_TPU_REBALANCE_INTERVAL_S."""
+        from dgraph_tpu.worker.tabletmove import start_rebalance_loop
+
+        if self._rebalance_stop is None:
+            self._rebalance_stop, self._rebalance_thread = (
+                start_rebalance_loop(self, interval_s)
+            )
         return self
 
     def tablet_size_bytes(self, pred: str) -> int:
         """Approximate on-disk size of one tablet (record bytes of the
         predicate's data+split regions; ref zero/tablet.go size stream)."""
-        gid = self.zero.belongs_to(pred)
-        if gid is None:
-            return 0
-        kv = self.groups[gid].any_replica().kv
-        total = 0
-        for prefix in (
-            keys.PredicatePrefix(pred),
-            keys.SplitPredicatePrefix(pred),
-        ):
-            for _, vers in kv.iterate_versions(prefix, 1 << 62):
-                for _, rec in vers:
-                    total += len(rec)
-        return total
+        from dgraph_tpu.worker.tabletmove import tablet_size
+
+        return tablet_size(self, pred)
 
     def rebalance_by_size(self, min_move_bytes: int = 1 << 10):
-        """Size-based rebalancing (ref zero/tablet.go:53 rebalanceTablets):
-        move the biggest tablet from the most-loaded group (by bytes) to
-        the least-loaded one when it narrows the gap."""
-        sizes: Dict[str, int] = {
-            p: self.tablet_size_bytes(p) for p in self.zero.tablets
-        }
-        load: Dict[int, int] = {g: 0 for g in self.groups}
-        for p, sz in sizes.items():
-            load[self.zero.tablets[p]] += sz
-        big = max(load, key=lambda g: load[g])
-        small = min(load, key=lambda g: load[g])
-        gap = load[big] - load[small]
-        if gap < min_move_bytes:
-            return None
-        # biggest tablet on the loaded group whose move narrows the gap
-        cands = sorted(
-            (p for p, g in self.zero.tablets.items() if g == big),
-            key=lambda p: -sizes[p],
-        )
-        for p in cands:
-            new_gap = abs((load[big] - sizes[p]) - (load[small] + sizes[p]))
-            if sizes[p] > 0 and new_gap < gap:
-                self.move_tablet(p, small)
-                return p
-        return None
+        """Size-based rebalancing (ref zero/tablet.go:53
+        rebalanceTablets): deterministically move the tablet that best
+        narrows the byte-load gap. Returns the moved predicate."""
+        from dgraph_tpu.worker.tabletmove import run_rebalance
+
+        return run_rebalance(self, min_move_bytes=min_move_bytes)
 
     # -- failure handling ---------------------------------------------------------
 

@@ -129,10 +129,36 @@ class ProcCluster:
         )
         self.remote_groups: Dict[int, RemoteGroup] = {}
         self._commit_lock = threading.Lock()
+        self._rebalance_stop = None
+        self._rebalance_thread = None
+        self._tablets_path: Optional[str] = None
+        self._tablets_persist_lock = threading.Lock()
         self.intents: Optional[IntentLog] = None
         if data_dir is not None:
             os.makedirs(data_dir, exist_ok=True)
             self.intents = IntentLog(os.path.join(data_dir, "intents.log"))
+            if zero_impl is None:
+                # non-replicated Zero: the move journal's durability
+                # backend is a file (a raft-backed Zero quorum journals
+                # moves in its replicated state machine instead)
+                from dgraph_tpu.worker.tabletmove import MoveJournal
+
+                self.zero.journal = MoveJournal(
+                    os.path.join(data_dir, "moves.journal")
+                )
+                self.zero._moves.update(self.zero.journal.pending())
+                # the flipped tablet map persists alongside the journal
+                # (written at flip time, BEFORE the journal clears): a
+                # restarted coordinator must not reassign a moved
+                # predicate back to its dropped former source
+                self._tablets_path = os.path.join(
+                    data_dir, "zero_tablets.json"
+                )
+                if os.path.exists(self._tablets_path):
+                    with open(self._tablets_path) as f:
+                        self.zero._tablets.update(
+                            {p: int(g) for p, g in json.load(f).items()}
+                        )
 
         nid = 0
         for g in range(1, n_groups + 1):
@@ -168,6 +194,11 @@ class ProcCluster:
         self._wait_healthy()
         if self.intents is not None:
             self.recover_intents()
+        # heal any move a dead coordinator left journaled (in the Zero
+        # quorum's state machine or the MoveJournal file)
+        self.zero.refresh_fences()
+        if self.zero.moves():
+            self.recover_moves()
 
     # -- process control ------------------------------------------------------
 
@@ -231,11 +262,18 @@ class ProcCluster:
                 raise TimeoutError(f"group {g.gid} never elected a leader")
 
     def close(self):
+        if self._rebalance_stop is not None:
+            self._rebalance_stop.set()
+            # let a mid-tick move finish before its replicas vanish —
+            # an unjoined mover would race the journal close below
+            self._rebalance_thread.join(timeout=15)
         for nid in list(self.procs):
             self.kill(nid)
         self.pool.close()
         if self.intents is not None:
             self.intents.close()
+        if self.zero.journal is not None:
+            self.zero.journal.close()
 
     # -- coordinator surface (mirrors DistributedCluster) ---------------------
 
@@ -292,7 +330,12 @@ class ProcCluster:
 
     def _commit_locked(self, txn: Txn) -> int:
         from dgraph_tpu.posting.pl import encode_delta
+        from dgraph_tpu.worker.tabletmove import check_fences
 
+        # a commit into a move's Phase-2 fence bounces RETRYABLE before
+        # the oracle burns a verdict (never wrong data, never a write
+        # the source drop would destroy)
+        check_fences(self.zero, txn.cache.deltas)
         commit_ts = self.zero.zero.commit(
             txn.start_ts, txn.conflict_keys, track=True
         )
@@ -322,52 +365,140 @@ class ProcCluster:
     def recover_intents(self) -> int:
         if self.intents is None:
             return 0
+        from dgraph_tpu.worker.tabletmove import reshard_intent
+
         replayed = 0
         for cts, per_group in sorted(self.intents.pending().items()):
-            for gid, writes in per_group.items():
-                writes = [(bytes(k), int(ts), bytes(v)) for k, ts, v in writes]
-                self.remote_groups[int(gid)].propose(("delta", writes))
+            for gid, writes in reshard_intent(self.zero, per_group).items():
+                self.remote_groups[gid].propose(("delta", writes))
             self.intents.mark_done(cts)
             replayed += 1
         return replayed
 
-    def move_tablet(self, pred: str, dst_group: int):
-        """Cross-process predicate move (ref worker/predicate_move.go:120):
-        stream every version of the tablet (data + split parts) out of the
-        source group over the read RPC, propose them into the destination
-        group's raft log, flip ownership, then drop at the source. The
-        commit lock fences writes for the duration (the reference's
-        blocking phase)."""
-        with self._commit_lock:
-            src_gid = self.zero.belongs_to(pred)
-            if src_gid is None or src_gid == dst_group:
-                return
-            src = self.remote_groups[src_gid]
-            writes = []
-            for prefix in (
-                keys.PredicatePrefix(pred),
-                keys.SplitPredicatePrefix(pred),
-            ):
-                from dgraph_tpu.conn.messages import IterateRequest
+    # -- tablet move / rebalance (ref predicate_move.go, zero/tablet.go) ------
+    #
+    # The phased driver is shared with the in-process DistributedCluster
+    # (worker/tabletmove.py); this harness supplies only the paged RPC
+    # read stream and the leader-routed proposal primitive.
 
-                by_key = {}
-                for r in src.read(
-                    "kv.iterate_versions",
-                    IterateRequest(prefix=prefix, ts=1 << 62),
-                ).kv:
-                    by_key.setdefault(r.key, []).append((r.ts, r.value))
-                for k, vers in by_key.items():
-                    for ts, val in reversed(vers):  # oldest first
-                        writes.append((bytes(k), int(ts), bytes(val)))
-            if writes:
-                self.remote_groups[dst_group].propose(("delta", writes))
-            self.zero.move_tablet(pred, dst_group)
-            src.propose(("drop", keys.PredicatePrefix(pred)))
-            src.propose(("drop", keys.SplitPredicatePrefix(pred)))
-            self.mem.clear()
-            # routing changed outside the applied barrier: advance the
-            # batcher watermark past every in-flight read_ts
-            self._snapshot_ts = self.zero.zero.next_ts()
+    def _move_iter(self, gid, prefix, ts, since_ts, page_bytes):
+        """Paged kv.iterate_versions over the source group: each
+        response frame is bounded by max_bytes (a whole tablet can be
+        far larger than the frame cap), resumed by key cursor. Yields
+        (key, versions newest-first), keys ascending."""
+        from dgraph_tpu.conn.messages import IterateRequest
+
+        g = self.remote_groups[gid]
+        after = b""
+        while True:
+            # leader-only: a follower may lag the leader's applied
+            # index, and a copy stream — unlike a query — must never
+            # miss a committed write (the source drop would destroy
+            # it); leader failures retry via re-discovery
+            got = g.read(
+                "kv.iterate_versions",
+                IterateRequest(
+                    prefix=prefix, ts=ts, since=since_ts,
+                    after=after, max_bytes=page_bytes,
+                ),
+                leader_only=True,
+                timeout=30.0,
+            )
+            cur, vers = None, []
+            for r in got.kv:
+                k = bytes(r.key)
+                if k != cur:
+                    if cur is not None:
+                        yield cur, vers
+                    cur, vers = k, []
+                vers.append((int(r.ts), bytes(r.value)))
+            if cur is not None:
+                yield cur, vers
+                after = cur
+            if not got.more:
+                break
+
+    def _move_propose(self, gid: int, data):
+        self.remote_groups[int(gid)].propose(data)
+
+    def _move_persist_zero(self):
+        """Flush the tablet map next to the file journal (called by the
+        phase driver right after a flip, before the journal entry
+        clears). No-op without a data_dir; with a Zero quorum the map
+        is raft-durable and no file is configured."""
+        if self._tablets_path is None:
+            return
+        with self._tablets_persist_lock:  # flips of two preds can race
+            tmp = self._tablets_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(dict(self.zero.tablets), f)
+            os.replace(tmp, self._tablets_path)
+
+    def _move_prefix_size(self, gid: int, prefix: bytes) -> int:
+        """Server-side tablet sizing (kv.prefix_size RPC): one small
+        reply per prefix instead of streaming the tablet to count it."""
+        from dgraph_tpu.conn.messages import IterateRequest
+
+        got = self.remote_groups[gid].read(
+            "kv.prefix_size",
+            IterateRequest(prefix=prefix, ts=1 << 62),
+            timeout=30.0,
+        )
+        return int(got["bytes"])
+
+    def _move_group_ids(self):
+        return list(self.remote_groups)
+
+    def _move_bump_snapshot(self):
+        # routing changed outside the applied barrier: advance the
+        # batcher watermark past every in-flight read_ts
+        self._snapshot_ts = self.zero.zero.next_ts()
+
+    def move_tablet(self, pred: str, dst_group: int):
+        """Cross-process phased predicate move (ref
+        worker/predicate_move.go): chunked background copy at a pinned
+        read_ts (writes keep flowing to the source; commits on other
+        predicates never block), bounded Phase-2 fence (replicated
+        moving state + delta catch-up + atomic ownership flip through
+        Zero), deferred source drop. Every transition is journaled;
+        recover_moves() heals a coordinator death at any boundary."""
+        from dgraph_tpu.worker.tabletmove import TabletMover
+
+        return TabletMover(self).move(pred, dst_group)
+
+    def recover_moves(self) -> int:
+        """Resolve every journaled move whose coordinator died:
+        copy/fence phases roll back (partial destination copy dropped,
+        fence lifted), the drop phase rolls forward (flip re-asserted,
+        source drop completed). Moves in flight in this process are
+        skipped, not rolled back. Returns the number resolved."""
+        from dgraph_tpu.worker.tabletmove import recover_all
+
+        return recover_all(self)
+
+    def tablet_size_bytes(self, pred: str) -> int:
+        from dgraph_tpu.worker.tabletmove import tablet_size
+
+        return tablet_size(self, pred)
+
+    def rebalance_by_size(self, min_move_bytes: int = 1 << 10):
+        """One deterministic size-based rebalance step (ref
+        zero/tablet.go:53); returns the moved predicate or None."""
+        from dgraph_tpu.worker.tabletmove import run_rebalance
+
+        return run_rebalance(self, min_move_bytes=min_move_bytes)
+
+    def enable_auto_rebalance(self, interval_s: Optional[float] = None):
+        """Jittered background auto-rebalance loop (poll_policy over
+        DGRAPH_TPU_REBALANCE_INTERVAL_S): heals journaled half-moves,
+        then takes one size-based move per tick."""
+        from dgraph_tpu.worker.tabletmove import start_rebalance_loop
+
+        if self._rebalance_stop is None:
+            self._rebalance_stop, self._rebalance_thread = (
+                start_rebalance_loop(self, interval_s)
+            )
+        return self
 
     def query(self, q: str, read_ts: Optional[int] = None,
               timeout_s: Optional[float] = None,

@@ -186,12 +186,19 @@ class RemoteGroup:
         raise TimeoutError(f"proposal to group {self.gid} failed: {last}")
 
     def read(self, method: str, args: dict, hedge_after: float = 0.15,
-             deadline: Optional[Deadline] = None, timeout: float = 5.0):
+             deadline: Optional[Deadline] = None, timeout: float = 5.0,
+             leader_only: bool = False):
         """Hedged read (worker/task.go:60) with replica rotation: single
         attempts fail fast (refusals, open circuits), and this loop
         re-discovers the leader and retries with jittered backoff until
         the deadline — so one dead/rebooting replica costs milliseconds,
-        not a stacked per-layer timeout."""
+        not a stacked per-layer timeout.
+
+        `leader_only=True` (the tablet-move copy stream) never touches
+        a follower: a follower may lag the leader's applied index, and
+        a missed committed version there would be LOST after the source
+        drop — queries tolerate that staleness, a move must not. Leader
+        failures still rotate via this loop's re-discovery."""
         dl = deadline or effective_deadline(timeout)
         attempt = 0
         last: Optional[Exception] = None
@@ -202,7 +209,9 @@ class RemoteGroup:
                     self.gid, f"every replica circuit is open ({last})"
                 )
             try:
-                return self._read_once(method, args, hedge_after, dl)
+                return self._read_once(
+                    method, args, hedge_after, dl, leader_only=leader_only
+                )
             except GroupUnavailableError:
                 raise
             except RpcError as e:
@@ -220,16 +229,24 @@ class RemoteGroup:
         )
 
     def _read_once(self, method: str, args: dict, hedge_after: float,
-                   dl: Deadline):
+                   dl: Deadline, leader_only: bool = False):
         """One hedged attempt: leader first; if it hasn't answered within
         `hedge_after`, race a follower and take whichever returns first.
-        Losing futures are cancelled/reaped, never abandoned."""
+        Losing futures are cancelled/reaped, never abandoned. With
+        `leader_only` the follower fallback/hedge is disabled entirely
+        (a no-leader window raises for the outer loop to retry)."""
         addrs = self.healthy_addrs()
         lead = self.leader_addr(
             deadline=Deadline.after(dl.clamp(2.0))
         )
         if lead is not None:
             addrs = [lead] + [a for a in addrs if a != lead]
+        if leader_only:
+            if lead is None:
+                raise RpcError(
+                    f"group {self.gid}: no leader for leader-only read"
+                )
+            addrs = [lead]
         if dl.expired():
             raise GroupUnavailableError(self.gid, "deadline exhausted")
         # one attempt never gets the whole read budget — the outer retry

@@ -209,6 +209,21 @@ _define(
     "thrashes (posting/memlayer.py).",
 )
 _define(
+    "MOVE_CHUNK_BYTES", "int", 4 << 20,
+    "Byte bound on one ('delta', chunk) proposal — and on one paged "
+    "source-read response — during a phased tablet move "
+    "(worker/tabletmove.py): a tablet of any size streams in bounded "
+    "chunks instead of one frame-cap-tripping proposal. Must stay "
+    "under DGRAPH_TPU_MAX_FRAME_BYTES.",
+)
+_define(
+    "MOVE_FENCE_DEADLINE_S", "float", 10.0,
+    "Budget for a tablet move's Phase-2 fence (moving state + delta "
+    "catch-up + ownership flip, under the commit lock). A delta stream "
+    "that overruns it aborts and rolls the move back, so the fence can "
+    "never wedge writers indefinitely (worker/tabletmove.py).",
+)
+_define(
     "NATIVE_CACHE", "str", None,
     "Directory holding the compiled native kernel library "
     "(native/__init__.py); keyed by source hash + sanitizer mode. "
@@ -248,6 +263,15 @@ _define(
     "QUERY_DEADLINE_S", "float", 15.0,
     "Budget stamped on a query at the ProcCluster entry point; flows "
     "through every remote read beneath it (worker/harness.py).",
+)
+_define(
+    "REBALANCE_INTERVAL_S", "float", 480.0,
+    "Mean period of the jittered auto-rebalance loop "
+    "(enable_auto_rebalance: each tick heals journaled half-moves, "
+    "then takes one size-based tablet move when it narrows the "
+    "byte-load gap; uniform(0, 2i) jitter de-synchronizes a fleet). "
+    "Matches the reference Zero's ~8-minute rebalance cadence "
+    "(zero/tablet.go).",
 )
 _define(
     "SHARD_MIN_B", "int", 1 << 22,

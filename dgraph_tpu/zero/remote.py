@@ -185,6 +185,29 @@ class RemoteZero:
     def move_tablet(self, pred: str, gid: int):
         self._exec("move_tablet", pred, int(gid))
 
+    # -- move journal (worker/tabletmove.py phase driver) --------------------
+
+    def move_begin(self, pred: str, src: int, dst: int, read_ts: int):
+        self._exec("move_begin", pred, int(src), int(dst), int(read_ts))
+
+    def move_fence(self, pred: str):
+        self._exec("move_fence", pred)
+
+    def move_flip(self, pred: str):
+        self._exec("move_flip", pred)
+
+    def move_clear(self, pred: str):
+        self._exec("move_clear", pred)
+
+    @property
+    def moves(self) -> Dict[str, dict]:
+        # linearizable (leader-routed raft op): journal reads drive
+        # destructive recovery — a follower's stale state could roll
+        # back a move whose flip already committed
+        return {
+            p: dict(m) for p, m in self._exec("moves").items()
+        }
+
     @property
     def tablets(self) -> Dict[str, int]:
         for addr in self.addrs:

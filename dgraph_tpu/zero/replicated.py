@@ -36,6 +36,11 @@ class ZeroStateMachine:
         self.commits: Dict[int, int] = {}  # conflict fp -> commit_ts
         self.aborted: Set[int] = set()
         self.tablets: Dict[str, int] = {}
+        # in-flight tablet moves: pred -> {src, dst, phase, read_ts}.
+        # The durable move journal (worker/tabletmove.py): every phase
+        # transition is a raft op, so a coordinator death at any
+        # boundary leaves a recoverable record in the quorum.
+        self.moves: Dict[str, dict] = {}
         self.n_groups = 1
         # proposal results keyed by (proposer, req_id): the proposing
         # node's wrapper reads its own result after apply
@@ -114,6 +119,39 @@ class ZeroStateMachine:
             pred, gid = args
             self.tablets[pred] = int(gid)
             return ("ok",)
+        if kind == "move_begin":
+            pred, src, dst, read_ts = args
+            self.moves[pred] = {
+                "src": int(src), "dst": int(dst),
+                "phase": "copy", "read_ts": int(read_ts),
+            }
+            return ("ok",)
+        if kind == "move_fence":
+            (pred,) = args
+            m = self.moves.get(pred)
+            if m is not None and m["phase"] == "copy":
+                self.moves[pred] = dict(m, phase="fence")
+            return ("ok",)
+        if kind == "move_flip":
+            # the atomic ownership change: tablets[pred]=dst and the
+            # journal advancing to the drop phase land in ONE apply
+            # (idempotent: recovery re-asserts it)
+            (pred,) = args
+            m = self.moves.get(pred)
+            if m is not None:
+                self.tablets[pred] = int(m["dst"])
+                self.moves[pred] = dict(m, phase="drop")
+            return ("ok",)
+        if kind == "move_clear":
+            (pred,) = args
+            self.moves.pop(pred, None)
+            return ("ok",)
+        if kind == "moves":
+            # linearizable journal read: riding the raft log means the
+            # answer reflects every committed transition — recovery
+            # decisions from a lagging follower's state could roll
+            # back a move whose flip already committed
+            return {p: dict(m) for p, m in self.moves.items()}
         if kind == "gc":
             (floor,) = args
             for ck in [c for c, ts in self.commits.items() if ts <= floor]:
@@ -150,6 +188,7 @@ class ZeroStateMachine:
                 self.tablets,
                 self.n_groups,
                 self.txn_verdicts,
+                self.moves,
             )
         )
 
@@ -165,8 +204,10 @@ class ZeroStateMachine:
             self.tablets,
             self.n_groups,
         ) = state[:6]
-        # snapshots from before verdict dedup carry 6 fields
+        # snapshots from before verdict dedup carry 6 fields; before
+        # the move journal, 7
         self.txn_verdicts = state[6] if len(state) > 6 else {}
+        self.moves = state[7] if len(state) > 7 else {}
         self.results = {}
 
 
@@ -361,6 +402,27 @@ class ReplicatedZero:
 
     def move_tablet(self, pred: str, gid: int):
         self._propose("move_tablet", pred, gid)
+
+    # -- move journal (worker/tabletmove.py phase driver) --------------------
+
+    def move_begin(self, pred: str, src: int, dst: int, read_ts: int):
+        self._propose("move_begin", pred, int(src), int(dst), int(read_ts))
+
+    def move_fence(self, pred: str):
+        self._propose("move_fence", pred)
+
+    def move_flip(self, pred: str):
+        self._propose("move_flip", pred)
+
+    def move_clear(self, pred: str):
+        self._propose("move_clear", pred)
+
+    @property
+    def moves(self) -> Dict[str, dict]:
+        # journal reads drive DESTRUCTIVE recovery decisions, so they
+        # go through consensus like the writes — never a follower's
+        # possibly-stale state machine
+        return dict(self._propose("moves"))
 
     @property
     def tablets(self) -> Dict[str, int]:

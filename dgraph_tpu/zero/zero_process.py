@@ -91,6 +91,7 @@ class ZeroProcess:
                     "max_ts": self.sm.max_ts,
                     "max_uid": self.sm.max_uid,
                     "tablets": self.sm.tablets,
+                    "moves": self.sm.moves,
                 }
             ).encode()
         )

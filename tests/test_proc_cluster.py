@@ -180,6 +180,81 @@ def test_proc_cluster_with_zero_quorum_processes(tmp_path):
         c.close()
 
 
+def test_proc_cluster_move_recovery_at_each_journaled_phase(cluster):
+    """Coordinator death mid-move at every journaled phase: the move
+    journal + recover_moves() resolve to exactly-once placement (the
+    in-process analog restarts the whole cluster; here the same
+    coordinator recovers after a simulated crash at the boundary)."""
+    import pytest as _pytest
+
+    from dgraph_tpu.conn import faults
+    from dgraph_tpu.conn.faults import FaultPlan, InjectedCrash
+
+    cluster.alter("crashy: string @index(exact) .")
+    cluster.new_txn().mutate_rdf(
+        set_rdf="\n".join(
+            f'<0x{i:x}> <crashy> "c{i}" .' for i in range(0x80, 0x8c)
+        ),
+        commit_now=True,
+    )
+    try:
+        for point in (
+            "move.begin", "move.copy", "move.fence",
+            "move.delta", "move.flip", "move.drop",
+        ):
+            src = cluster.zero.belongs_to("crashy")
+            dst = next(g for g in cluster.remote_groups if g != src)
+            faults.install(FaultPlan(seed=5, rules=[
+                dict(point=point, action="crash", p=1.0, max=1)
+            ]))
+            with _pytest.raises(InjectedCrash):
+                cluster.move_tablet("crashy", dst)
+            faults.reset()
+            assert cluster.zero.moves(), point  # journal survived
+            cluster.recover_moves()
+            assert cluster.zero.moves() == {}, point
+            where = cluster.zero.belongs_to("crashy")
+            # copy/fence phases roll back; post-flip phases roll forward
+            assert where == (
+                dst if point in ("move.flip", "move.drop") else src
+            ), point
+            out = cluster.query("{ q(func: has(crashy)) { uid } }")
+            assert len(out["data"]["q"]) == 12, point
+            out = cluster.query('{ q(func: eq(crashy, "c130")) { crashy } }')
+            assert out["data"]["q"] == [{"crashy": "c130"}], point
+    finally:
+        faults.reset()
+
+
+def test_proc_cluster_chunked_move_larger_than_frame_chunk(
+    cluster, monkeypatch
+):
+    """A tablet bigger than one chunk streams in multiple bounded
+    ('delta', chunk) proposals and paged source reads — the old mover
+    shipped ONE proposal and hard-failed at the frame cap."""
+    from dgraph_tpu.utils.observe import METRICS
+
+    monkeypatch.setenv("DGRAPH_TPU_MOVE_CHUNK_BYTES", "4096")
+    cluster.alter("bigmv: string @index(exact) .")
+    pad = "y" * 180
+    cluster.new_txn().mutate_rdf(
+        set_rdf="\n".join(
+            f'<0x{0x900 + i:x}> <bigmv> "b{i}{pad}" .' for i in range(120)
+        ),
+        commit_now=True,
+    )
+    src = cluster.zero.belongs_to("bigmv")
+    dst = next(g for g in cluster.remote_groups if g != src)
+    chunks0 = METRICS.value("tablet_move_chunks_total")
+    assert cluster.move_tablet("bigmv", dst) is True
+    assert METRICS.value("tablet_move_chunks_total") >= chunks0 + 3
+    assert cluster.zero.belongs_to("bigmv") == dst
+    out = cluster.query("{ q(func: has(bigmv)) { uid } }")
+    assert len(out["data"]["q"]) == 120
+    out = cluster.query(f'{{ q(func: eq(bigmv, "b7{pad}")) {{ uid }} }}')
+    assert len(out["data"]["q"]) == 1
+
+
 def test_proc_cluster_predicate_move(cluster):
     """Cross-process tablet move: stream out of the source group's
     replicas, raft-propose into the destination, flip, drop

@@ -1,0 +1,812 @@
+"""Crash-safe live tablet moves: phased migration + durable move journal.
+
+Layers:
+  - pure units: the deterministic size-based rebalance picker over
+    adversarial distributions; MoveJournal torn-tail recovery at every
+    byte boundary (test_wal_crash.py-style).
+  - in-process DistributedCluster: chunked multi-proposal moves, the
+    bounded Phase-2 fence (commits on other predicates flow during
+    Phase 1; fenced commits bounce RETRYABLE), selective MemoryLayer
+    invalidation (an unrelated predicate's cache survives a move),
+    coordinator-crash recovery at every journaled phase boundary
+    (named `crash` fault points), durable journal recovery across a
+    full cluster restart, replicated-Zero journaling, auto-rebalance.
+  - multi-process ProcCluster chaos smoke (`chaos` marker, fixed seed):
+    the bank workload runs while the move coordinator is killed at
+    every phase boundary and the destination group is partitioned —
+    after recovery the cluster heals to exactly-once placement with
+    ledger-exact balances and exact edge counts.
+"""
+
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu.conn import faults
+from dgraph_tpu.conn.faults import FaultPlan, InjectedCrash
+from dgraph_tpu.conn.retry import Deadline, RetryPolicy, deadline_scope, retrying_call
+from dgraph_tpu.utils.observe import METRICS
+from dgraph_tpu.worker.groups import DistributedCluster
+from dgraph_tpu.worker.tabletmove import (
+    MoveJournal,
+    TabletFencedError,
+    pick_rebalance_move,
+)
+from dgraph_tpu.x import keys
+
+CRASH_POINTS = (
+    "move.begin", "move.copy", "move.fence",
+    "move.delta", "move.flip", "move.drop",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _crash_plan(point: str) -> FaultPlan:
+    return FaultPlan(
+        seed=7, rules=[dict(point=point, action="crash", p=1.0, max=1)]
+    )
+
+
+def _group_holding(c, pred):
+    """Group ids whose KV physically holds any key of the tablet."""
+    prefix = keys.PredicatePrefix(pred)
+    return sorted(
+        g for g in c.groups
+        if list(c.groups[g].any_replica().kv.iterate(prefix, 1 << 61))
+    )
+
+
+# ---------------------------------------------------------------------------
+# pure units
+# ---------------------------------------------------------------------------
+
+
+def test_pick_rebalance_move_adversarial_and_deterministic():
+    # balanced -> no move
+    assert pick_rebalance_move(
+        {"a": 100, "b": 100}, {"a": 1, "b": 2}, [1, 2], 1) is None
+    # simple skew -> biggest tablet moves to the empty group
+    assert pick_rebalance_move(
+        {"a": 300, "b": 100}, {"a": 1, "b": 1}, [1, 2], 1) == ("a", 2)
+    # one giant tablet that would merely flip the imbalance is skipped
+    # in favor of the next-smaller tablet that narrows the gap
+    assert pick_rebalance_move(
+        {"big": 1000, "s1": 10, "s2": 10},
+        {"big": 1, "s1": 1, "s2": 1}, [1, 2], 1,
+    ) == ("big", 2)  # |(1023-1001) - 1001| = 979 < 1020: still narrows
+    assert pick_rebalance_move(
+        {"big": 1000}, {"big": 1}, [1, 2], 1) is None  # pure flip: refuse
+    # byte-empty tablets still spread by count (+1 weight per tablet)
+    got = pick_rebalance_move(
+        {"a": 0, "b": 0, "c": 0, "d": 0},
+        {"a": 1, "b": 1, "c": 1, "d": 1}, [1, 2], 1)
+    assert got == ("a", 2)  # equal weights tie-break lexicographically
+    # but an empty skew stays put under a byte-scale min_move threshold
+    assert pick_rebalance_move(
+        {"a": 0, "b": 0, "c": 0, "d": 0},
+        {"a": 1, "b": 1, "c": 1, "d": 1}, [1, 2], 1 << 10) is None
+    # gap below min_move_bytes -> no move
+    assert pick_rebalance_move(
+        {"a": 120, "b": 100}, {"a": 1, "b": 2}, [1, 2], 1 << 10) is None
+    # group-load tie (two equally loaded donors): smallest gid donates;
+    # tablet-weight tie inside the donor breaks lexicographically; and
+    # the choice is stable across dict insertion orders
+    s1 = {"x": 50, "x2": 50, "y": 50, "y2": 50, "z": 0}
+    t1 = {"x": 1, "x2": 1, "y": 2, "y2": 2, "z": 3}
+    s2 = dict(reversed(list(s1.items())))
+    t2 = dict(reversed(list(t1.items())))
+    assert (
+        pick_rebalance_move(s1, t1, [1, 2, 3], 1)
+        == pick_rebalance_move(s2, t2, [3, 2, 1], 1)
+        == ("x", 3)
+    )
+    # a move that would merely widen the spread is refused outright
+    assert pick_rebalance_move(
+        {"x": 50, "y": 50, "z": 0},
+        {"x": 1, "y": 2, "z": 3}, [1, 2, 3], 1) is None
+    # no groups at all
+    assert pick_rebalance_move({}, {}, [], 1) is None
+
+
+def test_move_journal_roundtrip_and_clear(tmp_path):
+    j = MoveJournal(str(tmp_path / "moves.journal"))
+    j.record("p1", {"src": 1, "dst": 2, "phase": "copy", "read_ts": 9})
+    j.record("p2", {"src": 2, "dst": 1, "phase": "copy", "read_ts": 11})
+    j.record("p1", {"src": 1, "dst": 2, "phase": "fence", "read_ts": 9})
+    j.clear("p2")
+    j.close()
+    got = MoveJournal(str(tmp_path / "moves.journal")).pending()
+    assert got == {"p1": {"src": 1, "dst": 2, "phase": "fence", "read_ts": 9}}
+
+
+def test_move_journal_torn_tail_every_byte_boundary(tmp_path):
+    """A crash mid-append leaves a torn tail: recovery folds to the
+    last COMPLETE record and physically truncates the garbage so later
+    appends land on a clean boundary (the WAL-crash contract)."""
+    import os
+
+    seed = tmp_path / "seed.journal"
+    j = MoveJournal(str(seed))
+    j.record("p1", {"src": 1, "dst": 2, "phase": "copy", "read_ts": 5})
+    j.record("p1", {"src": 1, "dst": 2, "phase": "fence", "read_ts": 5})
+    j.record("p1", {"src": 1, "dst": 2, "phase": "drop", "read_ts": 5})
+    j.close()
+    blob = seed.read_bytes()
+    # locate the last record's start
+    offsets, pos = [], 0
+    while pos < len(blob):
+        _, plen = MoveJournal._HDR.unpack_from(blob, pos)
+        offsets.append(pos)
+        pos += MoveJournal._HDR.size + plen
+    assert pos == len(blob) and len(offsets) == 3
+    last = offsets[-1]
+    for cut in range(last, len(blob)):
+        p = tmp_path / f"cut_{cut}.journal"
+        p.write_bytes(blob[:cut])
+        jr = MoveJournal(str(p))
+        assert jr.pending()["p1"]["phase"] == "fence", cut
+        assert os.path.getsize(p) == last, cut  # tail truncated
+        # appends after repair continue cleanly
+        jr.clear("p1")
+        jr.close()
+        assert MoveJournal(str(p)).pending() == {}, cut
+
+
+# ---------------------------------------------------------------------------
+# in-process cluster: phases, fence, chunking, caches
+# ---------------------------------------------------------------------------
+
+N_EDGES = 64
+
+
+def _seed_cluster(c, n=N_EDGES, val_pad=0):
+    c.alter("mv: string @index(exact) .\nother: string @index(exact) .")
+    pad = "x" * val_pad
+    rdf = [f'<0x{i:x}> <mv> "m{i}{pad}" .' for i in range(1, n + 1)]
+    rdf += [f'<0x{i:x}> <other> "o{i}" .' for i in range(1, 9)]
+    c.new_txn().mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+
+
+def _counts(c):
+    mv = len(c.query("{ q(func: has(mv)) { uid } }")["data"]["q"])
+    other = len(c.query("{ q(func: has(other)) { uid } }")["data"]["q"])
+    return mv, other
+
+
+def test_chunked_move_and_unrelated_cache_survives(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_MOVE_CHUNK_BYTES", "1024")
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2)
+    try:
+        _seed_cluster(c, val_pad=64)
+        src = c.zero.belongs_to("mv")
+        dst = 2 if src == 1 else 1
+        # populate the shared decoded-list cache for BOTH predicates
+        assert _counts(c) == (N_EDGES, 8)
+        other_keys = [
+            k for k in c.mem._cache
+            if k.startswith(keys.PredicatePrefix("other"))
+        ]
+        assert other_keys, "cache should hold the unrelated predicate"
+        chunks0 = METRICS.value("tablet_move_chunks_total")
+        assert c.move_tablet("mv", dst) is True
+        # bounded proposals: the tablet shipped in multiple chunks
+        assert METRICS.value("tablet_move_chunks_total") >= chunks0 + 3
+        # placement flipped, exactly-once: only dst holds the tablet
+        assert c.zero.belongs_to("mv") == dst
+        assert _group_holding(c, "mv") == [dst]
+        # the unrelated predicate's cache entries SURVIVED the move
+        # (the old mover cleared the whole MemoryLayer) ...
+        assert all(k in c.mem._cache for k in other_keys)
+        # ... while the moved tablet's entries were invalidated
+        assert not any(
+            k.startswith(keys.PredicatePrefix("mv")) for k in c.mem._cache
+        )
+        # data exact after the move
+        assert _counts(c) == (N_EDGES, 8)
+        out = c.query('{ q(func: eq(mv, "m1x%s")) { mv } }' % ("x" * 63))
+        assert len(out["data"]["q"]) == 1
+        # writes land on the new owner
+        c.new_txn().mutate_rdf(
+            set_rdf='<0xfff> <mv> "post-move" .', commit_now=True
+        )
+        out = c.query('{ q(func: eq(mv, "post-move")) { uid } }')
+        assert out["data"]["q"] == [{"uid": "0xfff"}]
+    finally:
+        c.close()
+
+
+def test_phase1_does_not_block_other_commits(monkeypatch):
+    """The acceptance check: a multi-chunk move under concurrent
+    writes holds the global commit lock only for the bounded Phase-2
+    fence — commits on a non-moving predicate complete DURING Phase 1
+    (the old mover was stop-the-world for the whole copy), and writes
+    to the MOVING predicate during Phase 1 survive via the delta."""
+    monkeypatch.setenv("DGRAPH_TPU_MOVE_CHUNK_BYTES", "1024")
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2)
+    try:
+        _seed_cluster(c, n=96, val_pad=128)  # tens of chunks
+        src = c.zero.belongs_to("mv")
+        dst = 2 if src == 1 else 1
+        # stretch phase 1 deterministically: 15ms per chunk flush
+        faults.install(FaultPlan(seed=3, rules=[
+            dict(point="move.chunk", action="delay", p=1.0, delay_ms=15),
+        ]))
+        done = threading.Event()
+        moved = []
+
+        def run_move():
+            try:
+                moved.append(c.move_tablet("mv", dst))
+            finally:
+                done.set()
+
+        th = threading.Thread(target=run_move)
+        t0 = time.perf_counter()
+        th.start()
+        lat_max = 0.0
+        i = 0
+        while not done.is_set():
+            i += 1
+            t1 = time.perf_counter()
+            # non-moving predicate: must not block on the copy
+            c.new_txn().mutate_rdf(
+                set_rdf=f'<0x{0x500 + i:x}> <other> "d{i}" .',
+                commit_now=True,
+            )
+            lat_max = max(lat_max, time.perf_counter() - t1)
+            # moving predicate: keeps accepting writes in phase 1; a
+            # fence bounce is retryable and the write still lands
+            try:
+                c.new_txn().mutate_rdf(
+                    set_rdf=f'<0x{0x600 + i:x}> <mv> "live{i}" .',
+                    commit_now=True,
+                )
+            except TabletFencedError:
+                retrying_call(
+                    lambda i=i: c.new_txn().mutate_rdf(
+                        set_rdf=f'<0x{0x600 + i:x}> <mv> "live{i}" .',
+                        commit_now=True,
+                    ),
+                    policy=RetryPolicy(base=0.01, cap=0.1, max_attempts=50),
+                    retryable=(TabletFencedError,),
+                )
+            time.sleep(0.005)
+        th.join(timeout=30)
+        move_s = time.perf_counter() - t0
+        assert moved == [True]
+        # commits flowed while the move was in flight, each far faster
+        # than the move itself
+        assert i >= 3, (i, move_s)
+        assert lat_max < max(1.0, move_s / 2), (lat_max, move_s)
+        # every acked write to the moving tablet survived the move
+        out = c.query("{ q(func: has(mv)) { uid } }")
+        assert len(out["data"]["q"]) == 96 + i
+        faults.reset()
+        # fence duration was observed and bounded
+        assert METRICS.value("tablet_move_chunks_total") > 0
+    finally:
+        faults.reset()
+        c.close()
+
+
+def test_recover_moves_skips_an_in_flight_move(monkeypatch):
+    """recover_moves() (e.g. an auto-rebalance tick) must NEVER treat a
+    LIVE move's journal entry as a crashed one: a concurrent rollback
+    would clear the journal under the mover, its flip would no-op, and
+    the source drop would destroy the only copy of the tablet."""
+    monkeypatch.setenv("DGRAPH_TPU_MOVE_CHUNK_BYTES", "1024")
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2)
+    try:
+        _seed_cluster(c, n=96, val_pad=128)
+        src = c.zero.belongs_to("mv")
+        dst = 2 if src == 1 else 1
+        faults.install(FaultPlan(seed=3, rules=[
+            dict(point="move.chunk", action="delay", p=1.0, delay_ms=20),
+        ]))
+        done = threading.Event()
+        moved = []
+
+        def run_move():
+            try:
+                moved.append(c.move_tablet("mv", dst))
+            finally:
+                done.set()
+
+        th = threading.Thread(target=run_move)
+        th.start()
+        recovered = 0
+        while not done.is_set():
+            recovered += c.recover_moves()  # concurrent healing ticks
+            time.sleep(0.01)
+        th.join(timeout=30)
+        assert moved == [True]
+        assert recovered == 0  # the live move was never "recovered"
+        assert c.zero.moves() == {}
+        assert _group_holding(c, "mv") == [dst]
+        assert _counts(c)[0] == 96  # nothing lost
+    finally:
+        faults.reset()
+        c.close()
+
+
+def test_crash_at_every_phase_boundary_recovers():
+    """Kill the move coordinator at each journaled phase boundary: the
+    journal + recover_moves() always heal to exactly-once placement —
+    copy/fence roll back, drop rolls forward — with exact data."""
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2)
+    try:
+        _seed_cluster(c)
+        recovered0 = METRICS.value("tablet_move_recovered_total")
+        for point in CRASH_POINTS:
+            src = c.zero.belongs_to("mv")
+            dst = 2 if src == 1 else 1
+            faults.install(_crash_plan(point))
+            with pytest.raises(InjectedCrash):
+                c.move_tablet("mv", dst)
+            faults.reset()
+            assert c.zero.moves(), point  # journal survived the crash
+            c.recover_moves()
+            # journal drained; placement is exactly-once
+            assert c.zero.moves() == {}, point
+            where = c.zero.belongs_to("mv")
+            assert where in (src, dst), point
+            assert _group_holding(c, "mv") == [where], point
+            # data exact, queries correct
+            assert _counts(c)[0] == N_EDGES, point
+            out = c.query('{ q(func: eq(mv, "m7")) { mv } }')
+            assert out["data"]["q"] == [{"mv": "m7"}], point
+            # crashes at/after the flip recover FORWARD
+            if point in ("move.flip", "move.drop"):
+                assert where == dst, point
+            else:
+                assert where == src, point
+        assert (
+            METRICS.value("tablet_move_recovered_total")
+            >= recovered0 + len(CRASH_POINTS)
+        )
+        # the cluster is fully functional: a clean move completes
+        src = c.zero.belongs_to("mv")
+        dst = 2 if src == 1 else 1
+        assert c.move_tablet("mv", dst) is True
+        assert _group_holding(c, "mv") == [dst]
+    finally:
+        faults.reset()
+        c.close()
+
+
+def test_stale_fence_bounces_retryable_until_recovered():
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2)
+    try:
+        _seed_cluster(c)
+        src = c.zero.belongs_to("mv")
+        dst = 2 if src == 1 else 1
+        faults.install(_crash_plan("move.fence"))
+        with pytest.raises(InjectedCrash):
+            c.move_tablet("mv", dst)
+        faults.reset()
+        # the dead coordinator left the fence up: commits to the moving
+        # tablet bounce RETRYABLE (never wrong data) ...
+        rej0 = METRICS.value("tablet_fence_rejected_total")
+        with pytest.raises(TabletFencedError) as ei:
+            c.new_txn().mutate_rdf(
+                set_rdf='<0x200> <mv> "nope" .', commit_now=True
+            )
+        assert getattr(ei.value, "retryable", False) is True
+        assert METRICS.value("tablet_fence_rejected_total") == rej0 + 1
+        # ... drop_attr of the moving tablet is refused the same way ...
+        with pytest.raises(TabletFencedError):
+            c.drop_attr("mv")
+        # ... commits on other predicates are unaffected ...
+        c.new_txn().mutate_rdf(
+            set_rdf='<0x201> <other> "fine" .', commit_now=True
+        )
+        # ... and reads keep serving from the source throughout
+        assert _counts(c)[0] == N_EDGES
+        # recovery lifts the fence (rollback) and writes flow again
+        c.recover_moves()
+        c.new_txn().mutate_rdf(
+            set_rdf='<0x200> <mv> "now-ok" .', commit_now=True
+        )
+        assert _counts(c)[0] == N_EDGES + 1
+        assert c.zero.belongs_to("mv") == src
+    finally:
+        faults.reset()
+        c.close()
+
+
+def test_durable_journal_recovery_across_restart(tmp_path):
+    """Coordinator death at a phase boundary, then a FULL cluster
+    restart from disk: startup recovery resolves the journaled move —
+    fence rolls back, drop rolls forward — before serving."""
+    d = str(tmp_path / "dc")
+    # -- crash after the flip: restart completes the move forward
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2, data_dir=d)
+    _seed_cluster(c)
+    src = c.zero.belongs_to("mv")
+    dst = 2 if src == 1 else 1
+    faults.install(_crash_plan("move.flip"))
+    with pytest.raises(InjectedCrash):
+        c.move_tablet("mv", dst)
+    faults.reset()
+    c.close()
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2, data_dir=d)
+    try:
+        assert c.zero.moves() == {}  # startup recovery drained it
+        assert c.zero.belongs_to("mv") == dst
+        assert _group_holding(c, "mv") == [dst]
+        assert _counts(c)[0] == N_EDGES
+        # -- crash mid-fence: restart rolls the move back
+        faults.install(_crash_plan("move.delta"))
+        with pytest.raises(InjectedCrash):
+            c.move_tablet("mv", src)
+        faults.reset()
+    finally:
+        c.close()
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2, data_dir=d)
+    try:
+        assert c.zero.moves() == {}
+        assert c.zero.belongs_to("mv") == dst  # rollback: still at dst
+        assert _group_holding(c, "mv") == [dst]
+        assert _counts(c)[0] == N_EDGES
+        # and a clean move works after both recoveries
+        assert c.move_tablet("mv", src) is True
+        assert _group_holding(c, "mv") == [src]
+        # hard crash right after a COMPLETED move (no clean close, no
+        # later commit): the flip was persisted at flip time — BEFORE
+        # the journal cleared — so restart must not route the tablet
+        # to the already-dropped old owner
+        c._save_zero_state = lambda: None  # close() persists nothing
+    finally:
+        faults.reset()
+        c.close()
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2, data_dir=d)
+    try:
+        assert c.zero.moves() == {}
+        assert c.zero.belongs_to("mv") == src
+        assert _group_holding(c, "mv") == [src]
+        assert _counts(c)[0] == N_EDGES
+    finally:
+        c.close()
+
+
+def test_replicated_zero_journals_moves_in_state_machine():
+    """With a raft-backed Zero the journal lives in the replicated
+    state machine (snapshot-inclusive), not a coordinator file."""
+    c = DistributedCluster(
+        n_groups=2, replicas=1, pump_ms=2,
+        replicated_zero=True, zero_replicas=3,
+    )
+    try:
+        _seed_cluster(c, n=16)
+        src = c.zero.belongs_to("mv")
+        dst = 2 if src == 1 else 1
+        faults.install(_crash_plan("move.delta"))
+        with pytest.raises(InjectedCrash):
+            c.move_tablet("mv", dst)
+        faults.reset()
+        # every zero replica journals the fence phase through raft
+        # (followers apply asynchronously — poll for convergence)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            phases = [
+                z.sm.moves.get("mv", {}).get("phase") for z in c.zero_nodes
+            ]
+            if phases == ["fence"] * len(c.zero_nodes):
+                break
+            time.sleep(0.05)
+        assert phases == ["fence"] * len(c.zero_nodes), phases
+        # state-machine snapshot round-trips the journal
+        blob = c.zero_nodes[0].sm.dump()
+        from dgraph_tpu.zero.replicated import ZeroStateMachine
+
+        sm2 = ZeroStateMachine()
+        sm2.load(blob)
+        assert sm2.moves == c.zero_nodes[0].sm.moves
+        c.recover_moves()
+        assert c.zero.moves() == {}
+        assert c.zero.belongs_to("mv") == src
+        assert _counts(c)[0] == 16
+        assert c.move_tablet("mv", dst) is True
+        assert c.zero.belongs_to("mv") == dst
+        assert _counts(c)[0] == 16
+    finally:
+        faults.reset()
+        c.close()
+
+
+def test_auto_rebalance_loop_moves_skewed_tablets():
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2)
+    try:
+        _seed_cluster(c, val_pad=32)
+        # force-skew everything onto group 1
+        for pred in list(c.zero.tablets):
+            if c.zero.belongs_to(pred) != 1:
+                c.move_tablet(pred, 1)
+        c.enable_auto_rebalance(interval_s=0.05)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if any(g == 2 for g in c.zero.tablets.values()):
+                break
+            time.sleep(0.05)
+        assert any(g == 2 for g in c.zero.tablets.values()), dict(
+            c.zero.tablets
+        )
+        assert _counts(c)[0] == N_EDGES  # data intact after the move
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-process chaos smoke (fixed seed, tier-1)
+# ---------------------------------------------------------------------------
+
+N_ACCOUNTS = 6
+START_BAL = 100
+
+
+@pytest.mark.chaos
+def test_move_chaos_bank_crash_every_phase_and_partition(monkeypatch):
+    """The acceptance scenario on a real multi-process cluster: the
+    bank workload runs while the 'bal' tablet is moved between groups
+    with the coordinator killed at EVERY journaled phase boundary and
+    the destination group partitioned mid-move. After each recovery:
+    placement is exactly-once, balances are ledger-exact (sum always
+    conserved), edge counts exact, and the fence only ever produced
+    retryable errors — never wrong data."""
+    from dgraph_tpu.worker.harness import ProcCluster
+
+    monkeypatch.setenv("DGRAPH_TPU_MOVE_CHUNK_BYTES", "2048")
+    c = ProcCluster(n_groups=2, replicas=1)
+    stop = threading.Event()
+    stats = {"ok": 0, "fence_retries": 0, "ambiguous": 0}
+    ledger = {i: START_BAL for i in range(1, N_ACCOUNTS + 1)}
+    lock = threading.Lock()
+    try:
+        c.alter("bal: int @upsert .\nacct: string @index(exact) @upsert .")
+        rdf = []
+        for i in range(1, N_ACCOUNTS + 1):
+            rdf.append(f'<0x{i:x}> <acct> "a{i}" .')
+            rdf.append(f'<0x{i:x}> <bal> "{START_BAL}"^^<xs:int> .')
+        c.new_txn().mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+
+        import numpy as np
+
+        def writer():
+            rng = np.random.default_rng(42)
+            while not stop.is_set():
+                frm, to = (
+                    int(x) + 1
+                    for x in rng.choice(N_ACCOUNTS, 2, replace=False)
+                )
+                amt = int(rng.integers(1, 10))
+                rdf = (
+                    f'<0x{frm:x}> <bal> "{ledger[frm] - amt}"^^<xs:int> .\n'
+                    f'<0x{to:x}> <bal> "{ledger[to] + amt}"^^<xs:int> .'
+                )
+                try:
+                    try:
+                        c.new_txn().mutate_rdf(set_rdf=rdf, commit_now=True)
+                    except TabletFencedError:
+                        # the serving contract: fence errors are
+                        # retryable through conn/retry backoff
+                        with lock:
+                            stats["fence_retries"] += 1
+                        retrying_call(
+                            lambda: c.new_txn().mutate_rdf(
+                                set_rdf=rdf, commit_now=True
+                            ),
+                            policy=RetryPolicy(
+                                base=0.02, cap=0.2, max_attempts=60
+                            ),
+                            retryable=(TabletFencedError,),
+                        )
+                    with lock:
+                        ledger[frm] -= amt
+                        ledger[to] += amt
+                        stats["ok"] += 1
+                except Exception:
+                    with lock:
+                        stats["ambiguous"] += 1
+                time.sleep(0.01)
+
+        th = threading.Thread(target=writer)
+        th.start()
+
+        def check(tag):
+            out = c.query("{ q(func: has(bal)) { uid bal } }")
+            assert not out["extensions"].get("degraded"), tag
+            bals = {int(x["uid"], 16): x["bal"] for x in out["data"]["q"]}
+            assert len(bals) == N_ACCOUNTS, (tag, bals)
+            assert sum(bals.values()) == N_ACCOUNTS * START_BAL, (tag, bals)
+            with lock:
+                amb = stats["ambiguous"]
+                snap = dict(ledger)
+            if amb == 0:
+                # ledger-exact: every acked transfer applied exactly
+                # once (sample a stable account read)
+                out2 = c.query('{ q(func: eq(acct, "a1")) { bal } }')
+                assert out2["data"]["q"], tag
+            assert c.zero.moves() == {}, tag
+            return bals
+
+        # kill the coordinator at every journaled phase boundary
+        for point in CRASH_POINTS:
+            src = c.zero.belongs_to("bal")
+            dst = 2 if src == 1 else 1
+            faults.install(_crash_plan(point))
+            with pytest.raises(InjectedCrash):
+                c.move_tablet("bal", dst)
+            faults.reset()
+            assert c.zero.moves(), point
+            c.recover_moves()
+            check(point)
+            where = c.zero.belongs_to("bal")
+            assert where == (dst if point in ("move.flip", "move.drop")
+                             else src), point
+
+        # partition the DESTINATION group mid-copy: the move fails
+        # bounded, the journal survives, recovery rolls it back
+        src = c.zero.belongs_to("bal")
+        dst = 2 if src == 1 else 1
+        plan = faults.install(FaultPlan(seed=99))
+        for addr in c.remote_groups[dst].addrs:
+            plan.partition(addr)
+        with deadline_scope(Deadline.after(3.0)):
+            with pytest.raises(Exception):
+                c.move_tablet("bal", dst)
+        assert c.zero.moves(), "journal must survive a failed rollback"
+        plan.heal()
+        faults.reset()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                c.recover_moves()
+                break
+            except Exception:
+                time.sleep(0.3)
+        check("partition-rollback")
+        assert c.zero.belongs_to("bal") == src
+
+        # and a clean live move completes under the same traffic
+        assert c.move_tablet("bal", dst) is True
+        check("clean-move")
+        assert c.zero.belongs_to("bal") == dst
+
+        stop.set()
+        th.join(timeout=30)
+        bals = check("final")
+        with lock:
+            if stats["ambiguous"] == 0:
+                assert bals == ledger, stats
+        assert stats["ok"] > 0, stats
+    finally:
+        stop.set()
+        faults.reset()
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# long randomized schedule (out of tier-1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_move_chaos_long_randomized_schedule(monkeypatch):
+    """Randomized (seeded) schedule: repeated moves under the bank
+    workload with coordinator crashes at random phase boundaries,
+    random partitions of source/destination, and RPC-plane noise —
+    invariants checked after every healing round."""
+    import numpy as np
+
+    from dgraph_tpu.worker.harness import ProcCluster
+
+    monkeypatch.setenv("DGRAPH_TPU_MOVE_CHUNK_BYTES", "4096")
+    c = ProcCluster(n_groups=2, replicas=3)
+    rng = np.random.default_rng(20260803)
+
+    def wait_healthy(timeout=15.0):
+        # healed partitions reopen through the heartbeat's half-open
+        # probes; wait for every circuit before the next clean round
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(
+                c.pool.healthy(a)
+                for g in c.remote_groups.values()
+                for a in g.addrs
+            ):
+                return
+            time.sleep(0.2)
+
+    try:
+        c.alter("bal: int @upsert .\nacct: string @index(exact) @upsert .")
+        rdf = []
+        for i in range(1, N_ACCOUNTS + 1):
+            rdf.append(f'<0x{i:x}> <acct> "a{i}" .')
+            rdf.append(f'<0x{i:x}> <bal> "{START_BAL}"^^<xs:int> .')
+        c.new_txn().mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+        ledger = {i: START_BAL for i in range(1, N_ACCOUNTS + 1)}
+        ambiguous = 0
+        for round_ in range(12):
+            # a few transfers
+            for _ in range(4):
+                frm, to = (
+                    int(x) + 1
+                    for x in rng.choice(N_ACCOUNTS, 2, replace=False)
+                )
+                amt = int(rng.integers(1, 10))
+                try:
+                    retrying_call(
+                        lambda: c.new_txn().mutate_rdf(
+                            set_rdf=(
+                                f'<0x{frm:x}> <bal> '
+                                f'"{ledger[frm] - amt}"^^<xs:int> .\n'
+                                f'<0x{to:x}> <bal> '
+                                f'"{ledger[to] + amt}"^^<xs:int> .'
+                            ),
+                            commit_now=True,
+                        ),
+                        policy=RetryPolicy(base=0.02, cap=0.3,
+                                           max_attempts=40),
+                        retryable=(TabletFencedError,),
+                    )
+                    ledger[frm] -= amt
+                    ledger[to] += amt
+                except Exception:
+                    ambiguous += 1
+            # a move, possibly killed at a random boundary
+            src = c.zero.belongs_to("bal")
+            dst = 2 if src == 1 else 1
+            mode = int(rng.integers(0, 3))
+            if mode == 0:
+                wait_healthy()
+                c.move_tablet("bal", dst)
+            elif mode == 1:
+                point = CRASH_POINTS[int(rng.integers(len(CRASH_POINTS)))]
+                faults.install(_crash_plan(point))
+                with pytest.raises(InjectedCrash):
+                    c.move_tablet("bal", dst)
+                faults.reset()
+                c.recover_moves()
+            else:
+                plan = faults.install(FaultPlan(seed=int(rng.integers(1e6))))
+                victim = dst if rng.integers(2) else src
+                for addr in c.remote_groups[victim].addrs:
+                    plan.partition(addr)
+                with deadline_scope(Deadline.after(3.0)):
+                    try:
+                        c.move_tablet("bal", dst)
+                    except Exception:
+                        pass
+                plan.heal()
+                faults.reset()
+                wait_healthy()
+                deadline = time.time() + 15
+                while c.zero.moves() and time.time() < deadline:
+                    try:
+                        c.recover_moves()
+                    except Exception:
+                        time.sleep(0.3)
+            assert c.zero.moves() == {}
+            out = c.query("{ q(func: has(bal)) { uid bal } }")
+            if out["extensions"].get("degraded"):
+                continue
+            bals = {int(x["uid"], 16): x["bal"] for x in out["data"]["q"]}
+            assert sum(bals.values()) == N_ACCOUNTS * START_BAL, (
+                round_, bals,
+            )
+            assert len(bals) == N_ACCOUNTS, (round_, bals)
+        if ambiguous == 0:
+            out = c.query("{ q(func: has(bal)) { uid bal } }")
+            bals = {int(x["uid"], 16): x["bal"] for x in out["data"]["q"]}
+            assert bals == ledger
+    finally:
+        faults.reset()
+        c.close()

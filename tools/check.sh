@@ -32,6 +32,7 @@ else
         tests/test_posting.py \
         tests/test_storage.py tests/test_raft.py \
         tests/test_replicated_zero.py tests/test_cluster_facade.py \
+        tests/test_tablet_move.py \
         tests/test_observability.py tests/test_distributed_tracing.py \
         tests/test_serving_front.py \
         tests/test_stream_encoder.py \
