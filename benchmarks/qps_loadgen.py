@@ -8,6 +8,12 @@ literals (the plan cache's serving regime). Each point reports achieved
 QPS, p50/p99 latency of accepted executions, and the serving-front
 counters (coalesced tasks, plan-cache hits, sheds, degrades).
 
+Read literals draw from a bounded Zipfian distribution (--zipf-s, 0 =
+the old uniform rotation): production traffic from millions of users
+repeats a few hot bindings far more than the tail, and only that regime
+exercises the plan cache's per-shape variant LRU (uniform rotation over
+256 literals blows the 16-variant LRU and pins plan_cache_hit at ~0).
+
 Modes swept per client count:
 
   batch_off  — BATCH_WINDOW_US=0, ADMISSION off: the pre-serving-front
@@ -19,10 +25,23 @@ Modes swept per client count:
                .retrying_call); p99 of accepted work must stay bounded
                instead of collapsing with the queue.
 
+Mixed read/write mode (--mix): each client flips a seeded coin per
+operation (write ratios 10% and 50%) — reads are the Zipfian hot-shape
+stream, writes insert a fresh entity with indexed fields plus a uid
+edge into the existing graph (the live-ingest shape: exact + int index
+maintenance, a @reverse edge, one commit per txn). Reported per point:
+sustainable mutation QPS, write p50/p99, read QPS/percentiles, and the
+write-path counters (group_commit batches, sheds). A/B rides
+DGRAPH_TPU_GROUP_COMMIT (group_on vs group_off = today's serial
+commits); --baseline runs one unmodified-engine mode for the
+pre-change capture the ROADMAP requires.
+
 Usage:
-  python benchmarks/qps_loadgen.py                 # full sweep -> BENCH_QPS.json
-  python benchmarks/qps_loadgen.py --seconds 5
+  python benchmarks/qps_loadgen.py                 # read sweep -> BENCH_QPS.json
+  python benchmarks/qps_loadgen.py --mix           # mixed sweep -> BENCH_QPS.json
+  python benchmarks/qps_loadgen.py --mix --baseline  # pre-change capture
   python benchmarks/qps_loadgen.py --sanity        # ~5s smoke (CI gate)
+  python benchmarks/qps_loadgen.py --write-sanity  # ~5s write-path smoke
 """
 
 from __future__ import annotations
@@ -33,6 +52,15 @@ import os
 import sys
 import threading
 import time
+
+# Mixed native/Python thread pools convoy badly at CPython's default
+# 5ms GIL switch interval: whichever pool makes more GIL-releasing FFI
+# calls (the query side, with its numpy/ctypes kernels) re-queues
+# behind a CPU-bound peer at every call and pays the full interval
+# each time — measured starving readers to ~1 qps beside one hot
+# writer. 1ms keeps both pools live; applied to EVERY mode (and to the
+# baseline capture), so no A/B arm is favored.
+sys.setswitchinterval(0.001)
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(
@@ -94,16 +122,38 @@ QUERY_SHAPES = [
 ]
 
 
-def client_queries(rng_state: int):
-    """Deterministic per-client query stream over the hot shapes."""
+def _zipf_picks(rng_state: int, n: int, s: float, count: int = 4096):
+    """Deterministic bounded-Zipf literal indices for one client:
+    p(k) ~ 1/k^s over ranks 1..n, rank->literal shuffled per client so
+    clients don't all hammer literal 1 in lockstep."""
+    import numpy as np
+
+    rng = np.random.default_rng(1_000_003 + rng_state)
+    ranks = np.arange(1, n + 1, dtype=np.float64)
+    p = ranks ** (-s)
+    p /= p.sum()
+    perm = np.random.default_rng(7).permutation(n)  # shared literal map
+    return [int(perm[i]) + 1 for i in rng.choice(n, size=count, p=p)]
+
+
+def client_queries(rng_state: int, zipf_s: float = 0.0):
+    """Deterministic per-client query stream over the hot shapes.
+    zipf_s > 0 draws literals Zipfian (the repeated-binding regime the
+    plan cache serves); 0 keeps the legacy uniform rotation."""
     i = rng_state
+    picks = _zipf_picks(rng_state, HOT_LITERALS, zipf_s) if zipf_s else None
     while True:
         shape = QUERY_SHAPES[i % len(QUERY_SHAPES)]
-        yield shape.format(i=(i * 13 + rng_state) % HOT_LITERALS + 1)
+        if picks is not None:
+            lit = picks[i % len(picks)]
+        else:
+            lit = (i * 13 + rng_state) % HOT_LITERALS + 1
+        yield shape.format(i=lit)
         i += 1
 
 
-def run_point(server, clients: int, seconds: float, warmup: float):
+def run_point(server, clients: int, seconds: float, warmup: float,
+              zipf_s: float = 0.0):
     """One closed-loop measurement point. Returns the row dict."""
     from dgraph_tpu.conn.retry import RetryPolicy, retrying_call
     from dgraph_tpu.serving import TooManyRequestsError
@@ -122,7 +172,7 @@ def run_point(server, clients: int, seconds: float, warmup: float):
     started = threading.Barrier(clients + 1)
 
     def client(cid: int):
-        stream = client_queries(cid)
+        stream = client_queries(cid, zipf_s)
         started.wait()
         go.wait()
         policy = RetryPolicy(base=0.002, cap=0.05, max_attempts=6)
@@ -192,6 +242,237 @@ def run_point(server, clients: int, seconds: float, warmup: float):
     return row
 
 
+def _pct(done, q):
+    if not done:
+        return None
+    return round(done[min(len(done) - 1, int(len(done) * q))], 3)
+
+
+_WRITE_SEQ = [0]  # process-global: entity names stay unique across points
+
+
+def run_mixed_point(server, clients: int, seconds: float, warmup: float,
+                    write_ratio: float, zipf_s: float,
+                    write_entities: int = 4,
+                    n_entities: int = N_ENTITIES):
+    """One closed-loop mixed read/write point: `clients` splits into a
+    writer pool and a reader pool at `write_ratio` (50/50 = half the
+    closed-loop clients are live writers — the mixed-traffic regime a
+    write-path change must be measured in, since a coin-flip mix would
+    only ever measure the read latency the writes ride behind). Writers
+    ingest live-loader-shaped batches: `write_entities` fresh entities
+    per txn, each with exact + int indexed fields and a @reverse uid
+    edge into the existing graph, one commit per txn through the public
+    txn API. Readers run the Zipfian hot-shape stream. Returns the row
+    dict with read/write stats split out."""
+    from dgraph_tpu.utils.observe import METRICS
+    from dgraph_tpu.zero.zero import TxnConflictError
+
+    counters = (
+        "group_commit_total", "group_commit_txns_total",
+        "mutation_edges_total", "num_commits",
+        "plan_cache_hit_total", "admission_shed_total",
+    )
+    writers = min(max(1, round(clients * write_ratio)), clients - 1)
+    lat_lock = threading.Lock()
+    rlats: list = []
+    wlats: list = []
+    errors = [0]
+    stop = threading.Event()
+    go = threading.Event()
+    started = threading.Barrier(clients + 1)
+    with _WRITE_SEQ_LOCK:
+        seq_base = _WRITE_SEQ[0]
+        _WRITE_SEQ[0] += 100_000_000
+
+    def writer(cid: int):
+        seq = seq_base + cid * 10_000_000
+        started.wait()
+        go.wait()
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                objs = []
+                for _ in range(write_entities):
+                    seq += 1
+                    objs.append({
+                        "uid": f"_:w{seq}",
+                        "name": f"wuser{seq}",
+                        "age": int(seq % 70),
+                        "city": f"city{seq % 12}",
+                        "knows": [{"uid": hex(seq % n_entities + 1)}],
+                    })
+                t = server.new_txn()
+                t.mutate_json(set_obj=objs, commit_now=True)
+            except TxnConflictError:
+                continue  # retryable; fresh inserts shouldn't conflict
+            except Exception:
+                errors[0] += 1
+                continue
+            took = (time.perf_counter() - t0) * 1e3
+            with lat_lock:
+                wlats.append(took)
+
+    def reader(cid: int):
+        stream = client_queries(cid, zipf_s)
+        started.wait()
+        go.wait()
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                server.query(next(stream))
+            except Exception:
+                errors[0] += 1
+                continue
+            took = (time.perf_counter() - t0) * 1e3
+            with lat_lock:
+                rlats.append(took)
+
+    threads = [
+        threading.Thread(
+            target=writer if c < writers else reader, args=(c,)
+        )
+        for c in range(clients)
+    ]
+    for th in threads:
+        th.start()
+    started.wait()
+    go.set()
+    time.sleep(warmup)
+    with lat_lock:
+        rlats.clear()
+        wlats.clear()
+    base = {k: METRICS.value(k) for k in counters}
+    t_start = time.perf_counter()
+    time.sleep(seconds)
+    stop.set()
+    elapsed = time.perf_counter() - t_start
+    for th in threads:
+        th.join()
+    with lat_lock:
+        rd, wd = sorted(rlats), sorted(wlats)
+    row = {
+        "clients": clients,
+        "writers": writers,
+        "write_ratio": write_ratio,
+        "write_entities": write_entities,
+        "mutation_qps": round(len(wd) / elapsed, 1),
+        "mutation_edges_qps": round(
+            len(wd) * write_entities * 5 / elapsed, 1
+        ),
+        "write_p50_ms": _pct(wd, 0.50),
+        "write_p99_ms": _pct(wd, 0.99),
+        "read_qps": round(len(rd) / elapsed, 1),
+        "read_p50_ms": _pct(rd, 0.50),
+        "read_p99_ms": _pct(rd, 0.99),
+        "errors": errors[0],
+    }
+    for k in counters:
+        row[k.replace("_total", "")] = int(METRICS.value(k) - base[k])
+    return row
+
+
+_WRITE_SEQ_LOCK = threading.Lock()
+
+
+def mixed_sweep(args) -> dict:
+    """The live-write capture: ratios x client counts x commit modes,
+    modes interleaved per point and medianed across reps (same
+    same-weather discipline as the read sweep). --baseline runs ONE
+    unmodified-engine mode (the pre-change capture); otherwise group_on
+    vs group_off ride DGRAPH_TPU_GROUP_COMMIT in the same run."""
+    import statistics
+
+    from dgraph_tpu.x import config
+
+    server = build_server(args.memlayer_entries, args.entities)
+    for q in (s.format(i=1) for s in QUERY_SHAPES):
+        server.query(q)
+    if args.baseline:
+        # --baseline exists to run on a PRE-change checkout (where the
+        # GROUP_COMMIT knob is unregistered and must not be set); on a
+        # post-change tree it pins the serial escape hatch so the rows
+        # can never silently measure the new pipeline
+        env = {"GROUP_COMMIT": 0} if "GROUP_COMMIT" in config.REGISTRY \
+            else {}
+        modes = [("serial", env)]
+    else:
+        modes = [
+            ("group_on", {"GROUP_COMMIT": 1}),
+            ("group_off", {"GROUP_COMMIT": 0}),
+        ]
+    ratios = args.write_ratios
+    samples = {
+        name: {(r, c): [] for r in ratios for c in args.clients}
+        for name, _ in modes
+    }
+    for rep in range(args.reps):
+        for ratio in ratios:
+            for clients in args.clients:
+                for name, env in modes:
+                    for k, v in env.items():
+                        config.set_env(k, v)
+                    row = run_mixed_point(
+                        server, clients, args.seconds, args.warmup,
+                        ratio, args.zipf_s, args.write_entities,
+                        n_entities=args.entities,
+                    )
+                    for k in env:
+                        config.unset_env(k)
+                    samples[name][(ratio, clients)].append(row)
+                    print(
+                        f"[rep{rep} {name}] mix={ratio} c={clients:3d} "
+                        f"mut_qps={row['mutation_qps']:8.1f} "
+                        f"wp50={row['write_p50_ms']}ms "
+                        f"wp99={row['write_p99_ms']}ms "
+                        f"read_qps={row['read_qps']:8.1f} "
+                        f"plan_hit={row['plan_cache_hit']} "
+                        f"batches={row['group_commit']}",
+                        flush=True,
+                    )
+
+    def median_row(rows):
+        out = dict(rows[0])
+        for k, v in rows[0].items():
+            if isinstance(v, (int, float)) and k not in (
+                "clients", "writers", "write_ratio", "write_entities"
+            ):
+                vals = [r[k] for r in rows if r[k] is not None]
+                out[k] = (
+                    round(statistics.median(vals), 3) if vals else None
+                )
+        out["reps"] = len(rows)
+        return out
+
+    results: dict = {}
+    for name, _ in modes:
+        for ratio in ratios:
+            key = f"mix_{int(ratio * 100)}"
+            results.setdefault(key, {})[name] = [
+                median_row(samples[name][(ratio, c)])
+                for c in args.clients
+            ]
+
+    headline: dict = {"zipf_s": args.zipf_s, "clients": args.clients}
+    for ratio in ratios:
+        key = f"mix_{int(ratio * 100)}"
+        for name, _ in modes:
+            rows = results[key][name]
+            best = max(rows, key=lambda r: r["mutation_qps"] or 0)
+            headline[f"{key}_{name}_mutation_qps"] = best["mutation_qps"]
+            headline[f"{key}_{name}_write_p99_ms"] = best["write_p99_ms"]
+            headline[f"{key}_{name}_clients"] = best["clients"]
+    if not args.baseline:
+        for ratio in ratios:
+            key = f"mix_{int(ratio * 100)}"
+            off = headline.get(f"{key}_group_off_mutation_qps") or 0
+            on = headline.get(f"{key}_group_on_mutation_qps") or 0
+            headline[f"{key}_speedup_x"] = (
+                round(on / off, 2) if off else None
+            )
+    return {"rows": results, "headline": headline}
+
+
 def sweep(args) -> dict:
     from dgraph_tpu.x import config
 
@@ -228,7 +509,8 @@ def sweep(args) -> dict:
                 for k, v in env.items():
                     config.set_env(k, v)
                 row = run_point(
-                    server, clients, args.seconds, args.warmup
+                    server, clients, args.seconds, args.warmup,
+                    args.zipf_s,
                 )
                 for k in env:
                     config.unset_env(k)
@@ -308,20 +590,73 @@ def main(argv=None):
         "default)",
     )
     ap.add_argument(
-        "--clients", type=int, nargs="+", default=[1, 4, 8, 16]
+        "--clients", type=int, nargs="+", default=None,
+        help="client counts (default: 1 4 8 16 read sweep; 2 4 8 "
+        "mixed — a mixed point needs at least one of each pool)",
     )
     ap.add_argument("--entities", type=int, default=N_ENTITIES)
     ap.add_argument("--out", default=None)
     ap.add_argument(
+        "--zipf-s", type=float, default=1.1,
+        help="Zipf exponent for read literals (0 = legacy uniform "
+        "rotation); the repeated-binding regime the plan cache serves",
+    )
+    ap.add_argument(
+        "--mix", action="store_true",
+        help="mixed read/write sweep (write ratios via --write-ratios) "
+        "instead of the read-only sweep",
+    )
+    ap.add_argument(
+        "--write-ratios", type=float, nargs="+", default=[0.1, 0.5],
+    )
+    ap.add_argument(
+        "--write-entities", type=int, default=4,
+        help="fresh entities per write txn (the live-loader ingest "
+        "batch shape; 5 edges each incl. the @reverse uid edge)",
+    )
+    ap.add_argument(
+        "--baseline", action="store_true",
+        help="with --mix: run ONE unmodified-engine mode (the "
+        "pre-change live-write baseline capture) instead of the "
+        "group_on/group_off A/B",
+    )
+    ap.add_argument(
         "--sanity", action="store_true",
         help="~5s smoke run (CI gate): no artifact written",
     )
+    ap.add_argument(
+        "--write-sanity", action="store_true",
+        help="~5s mixed read/write smoke (CI gate): no artifact written",
+    )
     args = ap.parse_args(argv)
-    if args.sanity:
+    if args.clients is None:
+        args.clients = [2, 4, 8] if (args.mix or args.write_sanity) \
+            else [1, 4, 8, 16]
+    if args.sanity or args.write_sanity:
         args.seconds, args.warmup, args.reps = 0.6, 0.15, 1
         args.clients = [2, 4]
         args.entities = 600
-    out = sweep(args)
+    if args.write_sanity:
+        args.mix = True
+        args.write_ratios = [0.5]
+    if args.mix:
+        out = mixed_sweep(args)
+    else:
+        out = sweep(args)
+    if args.write_sanity:
+        rows = [
+            r
+            for modes in out["rows"].values()
+            for rws in modes.values()
+            for r in rws
+        ]
+        ok = all(
+            r["mutation_qps"] > 0 and r["read_qps"] > 0 and
+            r["errors"] == 0
+            for r in rows
+        )
+        print(f"write-sanity: {'OK' if ok else 'FAIL'} {out['headline']}")
+        return 0 if ok else 1
     if args.sanity:
         top = out["headline"]
         ok = (
@@ -336,7 +671,24 @@ def main(argv=None):
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "BENCH_QPS.json",
     )
-    written = stamp.guarded_write(path, out, jax.default_backend())
+    # every sweep kind lands in ONE artifact: merge into the existing
+    # BENCH_QPS.json keys instead of clobbering (a read-sweep rerun
+    # must not silently drop the mixed/mixed_baseline captures)
+    out_keys = (
+        {"mixed_baseline": out} if (args.mix and args.baseline)
+        else {"mixed": out} if args.mix
+        else out
+    )
+    merged = out_keys
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                merged = json.load(f)
+            merged.pop("provenance", None)
+            merged.update(out_keys)
+        except Exception:
+            merged = out_keys
+    written = stamp.guarded_write(path, merged, jax.default_backend())
     print(f"wrote {written}")
     return 0
 

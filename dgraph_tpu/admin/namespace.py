@@ -27,6 +27,9 @@ class NamespaceManager:
         self.server.kv.put(
             _NS_COUNTER_KEY, self.server.zero.next_ts(), struct.pack("<Q", nxt)
         )
+        bump = getattr(self.server, "bump_snapshot", None)
+        if bump is not None:  # direct-KV write: watermark must cover it
+            bump()
         return nxt
 
     def create_namespace(self, groot_password: str = "password") -> int:

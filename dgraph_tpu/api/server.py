@@ -89,10 +89,19 @@ class TxnHandle:
         commit_now: bool = False,
         access_jwt: Optional[str] = None,
     ):
-        body = json.dumps({"set": set_obj, "delete": del_obj}, default=str)
-        ns, _ = self.server._authorize_mutation(
-            access_jwt, sorted(_json_preds(set_obj) | _json_preds(del_obj)), body
-        )
+        if self.server.acl is None and self.server.audit is None:
+            # the common unsecured path: computing the predicate set
+            # and dumping the audit body would be pure waste per write
+            ns = keys.GALAXY_NS
+        else:
+            body = json.dumps(
+                {"set": set_obj, "delete": del_obj}, default=str
+            )
+            ns, _ = self.server._authorize_mutation(
+                access_jwt,
+                sorted(_json_preds(set_obj) | _json_preds(del_obj)),
+                body,
+            )
         uids = self.server._apply_json(self.txn, set_obj, del_obj, ns)
         if commit_now:
             self.commit()
@@ -235,6 +244,7 @@ class Server:
         self.schema = State()
         self.vector_indexes: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self._group_commit = None  # lazy (worker/groupcommit.py)
         from dgraph_tpu.posting.memlayer import MemoryLayer
 
         self.acl = None  # enabled via enable_acl() (ref --acl superflag)
@@ -336,21 +346,46 @@ class Server:
         return ns, user
 
     def _apply_nquads(self, txn, set_nqs, del_nqs, ns) -> Dict[str, str]:
+        from dgraph_tpu.posting.mutation import apply_edges
+
         blank: Dict[str, int] = {}
+        fresh_uids: set = set()  # uids leased by THIS request
 
         def resolve(ref: str) -> int:
             if ref.startswith("_:"):
                 if ref not in blank:
                     blank[ref] = self.zero.assign_uids(1)
+                    fresh_uids.add(blank[ref])
                 return blank[ref]
             if ref.startswith("0x"):
                 return int(ref, 16)
             return int(ref)
 
-        for nq in set_nqs:
-            self._apply_nquad(txn, nq, resolve, OP_SET, ns=ns)
-        for nq in del_nqs:
-            self._apply_nquad(txn, nq, resolve, OP_DEL, ns=ns)
+        # batched application: plain edges accumulate and flush through
+        # apply_edges (bulk reads + bulk tokens); a star delete flushes
+        # first so it observes every edge that preceded it in order
+        pending: List[DirectedEdge] = []
+
+        def flush():
+            if pending:
+                apply_edges(txn, self.schema, pending)
+                pending.clear()
+
+        for nqs, op in ((set_nqs, OP_SET), (del_nqs, OP_DEL)):
+            for nq in nqs:
+                if nq.star:
+                    if op != OP_DEL:
+                        raise ValueError("S P * only valid in delete")
+                    flush()
+                    delete_entity_attr(
+                        txn, self.schema, resolve(nq.subject),
+                        nq.predicate, ns,
+                    )
+                    continue
+                e = self._nquad_edge(nq, resolve, op, ns=ns)
+                e.fresh = e.entity in fresh_uids
+                pending.append(e)
+        flush()
         return {k[2:]: hex(v) for k, v in blank.items()}
 
     def _bootstrap_schema(self):
@@ -391,6 +426,10 @@ class Server:
         # reuse (and overwrite) existing entities' uids
         if max_uid and max_uid < (1 << 62) and self.zero._max_uid <= max_uid:
             self.zero.assign_uids(max_uid - self.zero._max_uid)
+        # seed the snapshot watermark past everything recovered, so
+        # watermark reads see the restored store from the first query
+        # (max()-guarded: online restore can run beside live commits)
+        self._snapshot_ts = max(self._snapshot_ts, self.zero.read_ts())
         self.rebuild_vector_indexes()
 
     def rebuild_vector_indexes(self):
@@ -420,7 +459,10 @@ class Server:
             # batcher watermark past every read_ts allocated during the
             # alter, so queries that raced the (non-transactional)
             # schema writes never coalesce with post-alter traffic
-            self._snapshot_ts = self.zero.next_ts()
+            # (max()-guarded like every other watermark writer)
+            self._snapshot_ts = max(
+                self._snapshot_ts, self.zero.next_ts()
+            )
 
     def _alter_inner(self, schema_text, drop_attr, drop_all):
         with self._lock:
@@ -465,6 +507,18 @@ class Server:
                     ts,
                     f"type {tu.name} {{\n  {fields}\n}}\n".encode("utf-8"),
                 )
+
+    def bump_snapshot(self) -> int:
+        """Advance the snapshot watermark past every timestamp leased
+        so far. Direct-KV writers that bypass the commit path (bulk
+        loaders, the namespace counter) MUST call this after their
+        writes land, or watermark reads would never see them; commits
+        and alters advance it themselves. Returns the new watermark.
+        max()-guarded like every other watermark writer: a commit
+        leased after our read_ts may publish a larger watermark before
+        this assignment runs."""
+        self._snapshot_ts = max(self._snapshot_ts, self.zero.read_ts())
+        return self._snapshot_ts
 
     def _ensure_vector_index(self, su):
         from dgraph_tpu.models.vector import VectorIndex
@@ -514,6 +568,130 @@ class Server:
         return TxnHandle(self, read_only)
 
     def _commit(self, txn: Txn) -> int:
+        from dgraph_tpu.x import config as _config
+
+        from dgraph_tpu.utils.observe import METRICS as _METRICS
+
+        # admission costs writes too: a commit charges the same
+        # in-flight token budget queries draw from (retryable 429 over
+        # budget; no-op with DGRAPH_TPU_ADMISSION off)
+        n_edges = sum(len(p) for p in txn.cache.deltas.values())
+        ticket = self.serving.admit_write(n_edges)
+        try:
+            if not bool(_config.get("GROUP_COMMIT")):
+                # escape hatch (DGRAPH_TPU_GROUP_COMMIT=0): today's
+                # serial per-txn path, byte-for-byte
+                commit_ts = self._commit_serial(txn)
+            else:
+                gc = self._group_commit
+                if gc is None:
+                    with self._lock:
+                        gc = self._group_commit
+                        if gc is None:
+                            from dgraph_tpu.worker.groupcommit import (
+                                GroupCommit,
+                            )
+
+                            gc = self._group_commit = GroupCommit(
+                                self._gc_propose
+                            )
+                with _METRICS.timer("commit_latency_seconds"):
+                    commit_ts = gc.commit(txn)
+                self._post_commit(txn, commit_ts)
+            # counted for BOTH arms (only on success — the metric is
+            # postings WRITTEN): the A/B escape hatch must not turn
+            # the edge-throughput denominator dark
+            _METRICS.inc("mutation_edges_total", n_edges)
+            return commit_ts
+        finally:
+            self.serving.release_write(ticket)
+
+    def _gc_propose(self, members):
+        """Group-commit propose phase (batch leader's thread): ONE
+        oracle exchange decides every member, then all committed
+        members' deltas land under ONE lock hold. Returns the apply
+        barrier (watermark + zero.applied in commit-ts order)."""
+        from dgraph_tpu.utils.observe import METRICS, TRACER
+
+        from dgraph_tpu.worker.groupcommit import assign_verdicts
+
+        with TRACER.span("commit", batch=len(members)):
+            committed = assign_verdicts(
+                members,
+                self.zero.commit_batch(
+                    [
+                        (m.txn.start_ts, m.txn.conflict_keys)
+                        for m in members
+                    ],
+                    track=True,
+                ),
+            )
+            try:
+                # encode OUTSIDE the lock (one native batched call per
+                # txn, posting/pl.encode_deltas), land all batch
+                # members' writes in ONE put_batch under one lock hold
+                from dgraph_tpu.posting.pl import encode_deltas
+
+                writes = [
+                    (key, m.commit_ts, recb)
+                    for m in committed
+                    for key, recb in encode_deltas(m.txn.cache.deltas)
+                ]
+                with self._lock:
+                    self.kv.put_batch(writes)
+            except Exception as e:
+                # NEVER raise past the oracle: the verdicts are
+                # tracked pending, and only the barrier below clears
+                # them — an exception escaping here would leak
+                # _pending entries and stall every later
+                # begin_txn/read_ts for the full wait bound
+                for m in committed:
+                    if m.error is None:
+                        m.error = e
+
+        def barrier():
+            try:
+                with self._lock:
+                    for m in committed:
+                        # watermark BEFORE the apply barrier, advanced
+                        # in commit-ts order (members cts-ascending,
+                        # barriers FIFO) — the micro-batcher's
+                        # snapshot-grouping proof needs monotonicity;
+                        # max() so a concurrent bump_snapshot (bulk
+                        # load, namespace counter) never regresses
+                        self._snapshot_ts = max(
+                            self._snapshot_ts, m.commit_ts
+                        )
+                        self.zero.applied(m.commit_ts)
+            finally:
+                ok = 0
+                for m in committed:
+                    self.mem.invalidate(m.txn.cache.deltas.keys())
+                    if m.error is None:
+                        ok += 1
+                if ok:
+                    METRICS.inc("num_commits", ok)
+                    self.serving.on_commit()  # ONE epoch bump per batch
+
+        return barrier
+
+    def _post_commit(self, txn: Txn, commit_ts: int) -> None:
+        """Per-txn post-commit work on the committer's own thread
+        (stats feed, CDC, subscriptions, vector ingest) — everything
+        after the apply barrier that doesn't need batch ordering."""
+        from dgraph_tpu.posting.mutation import ingest_vectors
+
+        self._feed_stats(txn.cache.deltas)
+        cdc = getattr(self, "_cdc", None)
+        if cdc is not None:
+            cdc.emit_commit(commit_ts, txn.cache.deltas)
+        subs = getattr(self, "_subscriptions", None)
+        if subs is not None:
+            subs.on_commit(txn.cache.deltas)
+        # vector index ingestion at commit (shared factory seam)
+        ingest_vectors(self.vector_indexes, txn.cache.deltas)
+
+    def _commit_serial(self, txn: Txn) -> int:
         # serialized: MemKV is single-writer, and readers must not see a
         # commit_ts whose deltas aren't written yet (ADVICE r1 #2)
         from dgraph_tpu.utils.observe import METRICS, TRACER
@@ -527,8 +705,9 @@ class Server:
             finally:
                 # watermark BEFORE the apply barrier: any read_ts
                 # allocated after this commit becomes visible observes
-                # the advanced watermark (micro-batcher snapshot key)
-                self._snapshot_ts = commit_ts
+                # the advanced watermark (micro-batcher snapshot key);
+                # max() guards a concurrent bump_snapshot
+                self._snapshot_ts = max(self._snapshot_ts, commit_ts)
                 self.zero.applied(commit_ts)
         METRICS.inc("num_commits")
         self.mem.invalidate(txn.cache.deltas.keys())
@@ -618,6 +797,36 @@ class Server:
         apply_all(del_rdf, OP_DEL)
         return {k[2:]: hex(v) for k, v in blank.items()}
 
+    def _nquad_edge(
+        self,
+        nq: NQuad,
+        resolve,
+        op: int,
+        subj_uid: Optional[int] = None,
+        obj_uid: Optional[int] = None,
+        ns: int = keys.GALAXY_NS,
+    ) -> DirectedEdge:
+        """Build the DirectedEdge for one (non-star) N-Quad."""
+        subj = subj_uid if subj_uid is not None else resolve(nq.subject)
+        if nq.object_id:
+            return DirectedEdge(
+                subj,
+                nq.predicate,
+                value_id=obj_uid if obj_uid is not None else resolve(nq.object_id),
+                facets=nq.facets,
+                op=op,
+                ns=ns,
+            )
+        return DirectedEdge(
+            subj,
+            nq.predicate,
+            value=nq.object_value,
+            lang=nq.lang,
+            facets=nq.facets,
+            op=op,
+            ns=ns,
+        )
+
     def _apply_nquad(
         self,
         txn: Txn,
@@ -631,31 +840,15 @@ class Server:
         """Apply one N-Quad. Callers either pass a `resolve` function or
         pre-resolved subject/object uids (the upsert fan-out path — pinned
         by role, so `uid(v) <p> uid(v)` self-pairs resolve correctly)."""
-        subj = subj_uid if subj_uid is not None else resolve(nq.subject)
         if nq.star:
             if op != OP_DEL:
                 raise ValueError("S P * only valid in delete")
+            subj = subj_uid if subj_uid is not None else resolve(nq.subject)
             delete_entity_attr(txn, self.schema, subj, nq.predicate, ns)
             return
-        if nq.object_id:
-            edge = DirectedEdge(
-                subj,
-                nq.predicate,
-                value_id=obj_uid if obj_uid is not None else resolve(nq.object_id),
-                facets=nq.facets,
-                op=op,
-                ns=ns,
-            )
-        else:
-            edge = DirectedEdge(
-                subj,
-                nq.predicate,
-                value=nq.object_value,
-                lang=nq.lang,
-                facets=nq.facets,
-                op=op,
-                ns=ns,
-            )
+        edge = self._nquad_edge(
+            nq, resolve, op, subj_uid=subj_uid, obj_uid=obj_uid, ns=ns
+        )
         apply_edge(txn, self.schema, edge)
 
     def _apply_json(
@@ -693,6 +886,8 @@ class Server:
         null field value in delete drops the predicate (S P *)."""
         blank = blank if blank is not None else {}
 
+        fresh_uids: set = set()  # uids leased by THIS request
+
         def resolve_many(ref) -> List[int]:
             if isinstance(ref, int):
                 return [ref]
@@ -701,13 +896,14 @@ class Server:
             if ref.startswith("_:"):
                 if ref not in blank:
                     blank[ref] = self.zero.assign_uids(1)
+                    fresh_uids.add(blank[ref])
                 return [blank[ref]]
             return [int(ref, 16) if ref.startswith("0x") else int(ref)]
 
-        def to_val(pred: str, v) -> Val:
+        def to_val(su, v) -> Val:
             # (geo dicts never reach here — walk() routes them through
-            # is_geo_literal directly)
-            su = self.schema.get(pred)
+            # is_geo_literal directly; `su` is the caller's schema
+            # entry — one lookup per field, not one per item)
             tid = su.value_type if su is not None else None
             if tid == TypeID.DATETIME:
                 from dgraph_tpu.types.types import parse_datetime
@@ -729,14 +925,26 @@ class Server:
                 in ("Point", "Polygon", "MultiPolygon", "MultiPoint")
             )
 
+        # batched application: edges accumulate and flush through
+        # apply_edges (bulk reads + bulk tokens, posting/mutation.py);
+        # every delete flushes first so it observes the edges that
+        # preceded it in walk order
+        from dgraph_tpu.posting.mutation import apply_edges
+
+        pending: List[DirectedEdge] = []
+
+        def flush():
+            if pending:
+                apply_edges(txn, self.schema, pending)
+                pending.clear()
+
         def edge(subj, pred, op, value=None, value_id=None, lang=""):
-            apply_edge(
-                txn,
-                self.schema,
+            pending.append(
                 DirectedEdge(
                     subj, pred, value=value, value_id=value_id,
                     lang=lang, op=op, ns=ns,
-                ),
+                    fresh=subj in fresh_uids,
+                )
             )
 
         def walk(obj, op, top=False) -> List[int]:
@@ -745,6 +953,7 @@ class Server:
             if op == OP_DEL and not rest and top:
                 # bare top-level {"uid": U}: delete the node outright
                 # (nested bare refs are edge targets, not node deletes)
+                flush()
                 for subj in subjects:
                     for pred in self._node_type_preds(txn, subj, ns):
                         delete_entity_attr(txn, self.schema, subj, pred, ns)
@@ -766,6 +975,7 @@ class Server:
                     )
                     if v is None:
                         if op == OP_DEL:
+                            flush()
                             delete_entity_attr(
                                 txn, self.schema, subj, pred, ns
                             )
@@ -778,7 +988,7 @@ class Server:
                         and v
                         and isinstance(v[0], (int, float))
                     ):
-                        edge(subj, pred, op, value=to_val(pred, v))
+                        edge(subj, pred, op, value=to_val(su, v))
                         continue
                     for item in _as_list(v):
                         if is_geo_literal(item):
@@ -800,7 +1010,7 @@ class Server:
                         else:
                             edge(
                                 subj, pred, op,
-                                value=to_val(pred, item), lang=lang,
+                                value=to_val(su, item), lang=lang,
                             )
             return subjects
 
@@ -808,6 +1018,7 @@ class Server:
             walk(obj, OP_SET, top=True)
         for obj in _as_list(del_obj):
             walk(obj, OP_DEL, top=True)
+        flush()
         return {k[2:]: hex(v) for k, v in blank.items()}
 
     # -- queries ----------------------------------------------------------------
@@ -894,7 +1105,21 @@ class Server:
                     else min(deadline, degrade_deadline)
                 )
             truncated = False
-            ts = read_ts if read_ts is not None else self.zero.read_ts()
+            # snapshot-watermark read (ref worker/oracle MaxAssigned):
+            # `_snapshot_ts` is published only after a commit's deltas
+            # are written, and advances in commit-ts order — so a read
+            # AT the watermark sees a complete store without leasing a
+            # fresh ts and waiting out the apply barrier. Under mixed
+            # traffic that wait serialized every read behind the write
+            # pipeline's in-flight window; an in-flight (unacked)
+            # commit is legitimately excluded from the snapshot. 0 =
+            # nothing committed yet: fall back to a fresh barrier-
+            # waited lease.
+            ts = (
+                read_ts
+                if read_ts is not None
+                else (self._snapshot_ts or self.zero.read_ts())
+            )
             t_assigned = _time.monotonic()
             with TRACER.span("query", ns=ns) as root, \
                     profile_scope() as prof, \

@@ -271,12 +271,36 @@ class ZeroState(Message):
     FIELDS = {"state_json": (1, "bytes")}
 
 
+class ZeroCommitReq(Message):
+    """One member of a batched commit exchange: a txn's start ts plus
+    its conflict-key fingerprints (pb.TxnContext analog — the keys are
+    already 64-bit fingerprints on this plane, varint-encoded here)."""
+
+    FIELDS = {"start_ts": (1, "uint"), "cks": (2, ("rep", "uint"))}
+
+
+class ZeroCommitBatch(Message):
+    """The group-commit oracle exchange: N (start_ts, conflict_keys)
+    sets decided in ONE Zero round trip, verdicts returned per txn (an
+    aborted member never fails its batchmates). Rides as a typed
+    nested field on ZeroExec — u64 fingerprint lists stay varints
+    instead of JSON numerals, and the zero-process arg normalizer
+    never sees (and can't mangle) the nested list shape."""
+
+    FIELDS = {"txns": (1, ("rep", ("msg", ZeroCommitReq)))}
+
+
 class ZeroExec(Message):
     """ZeroProposal analog: one Zero state-machine op. args is the
     op-specific body (structured JSON — Zero ops are heterogeneous,
-    like pb.ZeroProposal's oneof)."""
+    like pb.ZeroProposal's oneof); `commit_batch` is the typed body of
+    the batched commit op (decoders that predate it skip the field)."""
 
-    FIELDS = {"op": (1, "str"), "args_json": (2, "bytes")}
+    FIELDS = {
+        "op": (1, "str"),
+        "args_json": (2, "bytes"),
+        "commit_batch": (3, ("msg", ZeroCommitBatch)),
+    }
 
 
 class RaftEnvelope(Message):
@@ -304,7 +328,7 @@ REGISTRY: Dict[str, type] = {
     for c in (
         KV, KVList, HealthInfo, GetRequest, GetResponse,
         IterateRequest, Proposal, ProposalResponse, Ack, ZeroState,
-        ZeroExec, RaftEnvelope,
+        ZeroExec, ZeroCommitReq, ZeroCommitBatch, RaftEnvelope,
     )
 }
 

@@ -185,6 +185,11 @@ class BulkLoader:
         self._index_uids.clear()
         self._counts.clear()
         self._vectors.clear()
+        # direct-KV writes bypassed the commit path: advance the
+        # snapshot watermark so watermark reads see the loaded data
+        bump = getattr(server, "bump_snapshot", None)
+        if bump is not None:
+            bump()
         return ts
 
 

@@ -524,6 +524,7 @@ class ParallelBulkLoader:
         if self._native_ok():
             ts = self._load_texts_native(texts)
             if ts is not None:
+                self._bump_snapshot()
                 return ts
         xidmap = self._assign_xids(texts)
         chunks = self._chunk(texts)
@@ -552,7 +553,15 @@ class ParallelBulkLoader:
                 os.unlink(r.path)
             except FileNotFoundError:
                 pass
+        self._bump_snapshot()
         return ts
+
+    def _bump_snapshot(self):
+        # direct-KV writes bypassed the commit path: advance the
+        # snapshot watermark so watermark reads see the loaded data
+        bump = getattr(self.server, "bump_snapshot", None)
+        if bump is not None:
+            bump()
 
     def _chunk(self, texts: List[str]) -> List[str]:
         """Split on line boundaries into ~workers*2 chunks."""

@@ -92,6 +92,15 @@ DECLS = {
     # codec.cpp — streaming arena result encoder
     "enc_uid_objs": (_i64, [_u64p, _i64, _u8p, _i64, _u8p, _i64, _u8p]),
     "enc_int_objs": (_i64, [_i64p, _i64, _u8p, _i64, _u8p, _i64, _u8p]),
+    # codec.cpp — mutation write-path kernels (group commit)
+    "enc_delta_records": (
+        _i64,
+        [_i64p, _i64, _u8p, _u64p, _u8p, _i64p, _u8p, _u8p, _i64p],
+    ),
+    "tok_terms_ascii": (
+        _i64,
+        [_u8p, _i64p, _i64, _int, _u8p, _i64p, _i64p],
+    ),
     # codec.cpp — quantized vector scoring (models/vector.py)
     "vec_qi8_topk": (
         _i64,
@@ -566,6 +575,79 @@ def enc_int_objs(vals: np.ndarray, pre: bytes, post: bytes):
     `{"c":5},{"c":3}` count-object bulk emitter."""
     vals = np.ascontiguousarray(vals, np.int64)
     return _enc_objs("enc_int_objs", vals, ctypes.c_int64, 20, pre, post)
+
+
+def enc_delta_records(counts, flags, uids, tids, vlens, vblob: bytes):
+    """Batched posting-delta record encode (posting/pl.encode_deltas):
+    ONE native call serializes every fast-shape posting (no lang, no
+    facets) of a whole txn's write set, byte-identical to the per-key
+    Python encoder. Returns a list of per-key record bytes (aligned
+    with `counts`), or None when the native lib is unavailable."""
+    if _LIB is None:
+        return None
+    counts = np.ascontiguousarray(counts, np.int64)
+    flags = np.ascontiguousarray(flags, np.uint8)
+    uids = np.ascontiguousarray(uids, np.uint64)
+    tids = np.ascontiguousarray(tids, np.uint8)
+    vlens = np.ascontiguousarray(vlens, np.int64)
+    n_keys = counts.size
+    total = int(5 * n_keys + 17 * flags.size + vlens.sum())
+    out = np.empty((total,), np.uint8)
+    offs = np.empty((n_keys + 1,), np.int64)
+    vb = (
+        np.frombuffer(vblob, np.uint8) if vblob else np.zeros(1, np.uint8)
+    )
+    wrote = _LIB.enc_delta_records(
+        _ptr(counts, ctypes.c_int64), n_keys,
+        _ptr(flags, ctypes.c_uint8), _ptr(uids, ctypes.c_uint64),
+        _ptr(tids, ctypes.c_uint8), _ptr(vlens, ctypes.c_int64),
+        _ptr(vb, ctypes.c_uint8),
+        _ptr(out, ctypes.c_uint8), _ptr(offs, ctypes.c_int64),
+    )
+    assert wrote == total, (wrote, total)
+    ob = out.tobytes()
+    ol = offs.tolist()  # python ints: numpy-scalar slicing is slow
+    return [ob[ol[i]:ol[i + 1]] for i in range(n_keys)]
+
+
+def tok_terms_ascii(values, prefix: int):
+    """Bulk ASCII term tokenization (tok/tok.py TermTokenizer fast
+    path): `values` is a list of pure-ASCII byte strings; returns a
+    list of per-value sorted-unique token lists (each token prefixed
+    with the tokenizer identifier byte), byte-identical to the Python
+    tokenizer over ASCII input — or None when the native lib is
+    unavailable."""
+    if _LIB is None:
+        return None
+    n = len(values)
+    blob = b"".join(values)
+    offs = np.zeros((n + 1,), np.int64)
+    np.cumsum(
+        np.fromiter((len(v) for v in values), np.int64, n), out=offs[1:]
+    )
+    total = len(blob)
+    max_toks = total // 2 + n + 1
+    bb = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+    out = np.empty((total + max_toks,), np.uint8)
+    tok_offs = np.empty((max_toks + 1,), np.int64)
+    tok_counts = np.empty((n,), np.int64)
+    ntok = _LIB.tok_terms_ascii(
+        _ptr(bb, ctypes.c_uint8), _ptr(offs, ctypes.c_int64), n,
+        prefix,
+        _ptr(out, ctypes.c_uint8), _ptr(tok_offs, ctypes.c_int64),
+        _ptr(tok_counts, ctypes.c_int64),
+    )
+    ob = out.tobytes()
+    to = tok_offs[: ntok + 1].tolist()
+    tc = tok_counts.tolist()
+    result = []
+    t = 0
+    for i in range(n):
+        cnt = tc[i]
+        result.append([ob[to[j]:to[j + 1]] for j in range(t, t + cnt)])
+        t += cnt
+    assert t == ntok
+    return result
 
 
 def vec_qi8_topk(

@@ -11,10 +11,12 @@
 // the pack/unpack loops into SIMD shifts/masks (the groupvarint-equivalent).
 // Exposed via ctypes (dgraph_tpu/native/__init__.py) — no pybind11 needed.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <cmath>
 #include <thread>
+#include <utility>
 #include <vector>
 
 #if defined(__AVX512VNNI__) || defined(__AVX512BW__) || defined(__AVX2__)
@@ -1114,6 +1116,134 @@ int64_t enc_int_objs(const int64_t* vals, int64_t n, const uint8_t* pre,
         }
     }
     return p - out;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Mutation write-path kernels (posting/pl.py encode_deltas +
+// tok/tok.py TermTokenizer bulk path): the live write path applied
+// per-edge Python work for every posting — these move the two hottest
+// loops (delta-record serialization, term tokenization) into one
+// native call per transaction batch.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// Batched posting-delta record encode for the fast scalar/uid shapes
+// (no lang, no facets). Wire layout is posting/pl.py's, byte-exact:
+//   per key:     kind u8 (=1 KIND_DELTA) | count u32 LE | postings...
+//   per posting: flags u8 | uid u64 LE | value_type u8 |
+//                lang_len u8 (=0) | vlen u32 LE | value bytes |
+//                nfacets u16 (=0)
+// Inputs are flat over all keys' postings in order; `vblob` holds the
+// value bytes of value postings concatenated (vlens[j]==0 for pure uid
+// edges). `out_offs` (n_keys+1) receives each key's record span in
+// `out`; caller sizes `out` exactly (5 per key + 17 + vlen per
+// posting). Returns total bytes written. Little-endian host assumed,
+// like the bit-pack codec above.
+int64_t enc_delta_records(
+    const int64_t* counts, int64_t n_keys,
+    const uint8_t* flags, const uint64_t* uids, const uint8_t* tids,
+    const int64_t* vlens, const uint8_t* vblob,
+    uint8_t* out, int64_t* out_offs) {
+    uint8_t* p = out;
+    int64_t j = 0;     // flat posting cursor
+    int64_t voff = 0;  // value-blob cursor
+    for (int64_t k = 0; k < n_keys; k++) {
+        out_offs[k] = p - out;
+        *p++ = 1;  // KIND_DELTA
+        uint32_t cnt = (uint32_t)counts[k];
+        memcpy(p, &cnt, 4);
+        p += 4;
+        for (int64_t c = 0; c < counts[k]; c++, j++) {
+            *p++ = flags[j];
+            uint64_t u = uids[j];
+            memcpy(p, &u, 8);
+            p += 8;
+            *p++ = tids[j];
+            *p++ = 0;  // lang_len
+            uint32_t vl = (uint32_t)vlens[j];
+            memcpy(p, &vl, 4);
+            p += 4;
+            if (vl) {
+                memcpy(p, vblob + voff, vl);
+                voff += vl;
+                p += vl;
+            }
+            *p++ = 0;
+            *p++ = 0;  // nfacets u16
+        }
+    }
+    out_offs[n_keys] = p - out;
+    return p - out;
+}
+
+// Bulk ASCII term tokenization (tok/tok.py TermTokenizer fast path):
+// for each input string — caller guarantees pure ASCII; non-ASCII
+// values take the Python unicode pipeline — lowercase, split into
+// maximal [a-z0-9_'] runs (the `\w'` class over ASCII), dedupe,
+// byte-sort, and emit each token as `prefix` byte + chars: exactly
+// sorted({w for w in _word_re.findall(s.lower())}) with the
+// tokenizer's identifier prefix applied. CSR output: token t spans
+// out[tok_offs[t] : tok_offs[t+1]], input i owns tok_counts[i]
+// consecutive tokens. Caller capacities: out >= total input bytes +
+// one prefix byte per possible token; tok_offs >= 1 + sum over inputs
+// of (len/2 + 1). Returns total token count.
+int64_t tok_terms_ascii(
+    const uint8_t* blob, const int64_t* offs, int64_t n, int prefix,
+    uint8_t* out, int64_t* tok_offs, int64_t* tok_counts) {
+    int64_t ntok = 0;
+    uint8_t* p = out;
+    tok_offs[0] = 0;
+    std::vector<uint8_t> low;
+    std::vector<std::pair<int64_t, int64_t>> words;  // (start, len)
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t* s = blob + offs[i];
+        int64_t len = offs[i + 1] - offs[i];
+        low.resize((size_t)len);
+        for (int64_t c = 0; c < len; c++) {
+            uint8_t ch = s[c];
+            low[(size_t)c] =
+                (ch >= 'A' && ch <= 'Z') ? (uint8_t)(ch + 32) : ch;
+        }
+        words.clear();
+        int64_t start = -1;
+        for (int64_t c = 0; c <= len; c++) {
+            uint8_t ch = c < len ? low[(size_t)c] : 0;
+            bool w = (ch >= 'a' && ch <= 'z') || (ch >= '0' && ch <= '9')
+                  || ch == '_' || ch == '\'';
+            if (w && start < 0) start = c;
+            if (!w && start >= 0) {
+                words.emplace_back(start, c - start);
+                start = -1;
+            }
+        }
+        const uint8_t* lo = low.data();
+        std::sort(words.begin(), words.end(),
+                  [lo](const std::pair<int64_t, int64_t>& a,
+                       const std::pair<int64_t, int64_t>& b) {
+                      int64_t m = a.second < b.second ? a.second : b.second;
+                      int c = memcmp(lo + a.first, lo + b.first, (size_t)m);
+                      if (c) return c < 0;
+                      return a.second < b.second;
+                  });
+        int64_t emitted = 0;
+        for (size_t wi = 0; wi < words.size(); wi++) {
+            if (wi > 0 && words[wi].second == words[wi - 1].second &&
+                memcmp(lo + words[wi].first, lo + words[wi - 1].first,
+                       (size_t)words[wi].second) == 0)
+                continue;  // duplicate word
+            *p++ = (uint8_t)prefix;
+            memcpy(p, lo + words[wi].first, (size_t)words[wi].second);
+            p += words[wi].second;
+            ntok++;
+            emitted++;
+            tok_offs[ntok] = p - out;
+        }
+        tok_counts[i] = emitted;
+    }
+    return ntok;
 }
 
 }  // extern "C"

@@ -12,12 +12,14 @@ the new ones (ref index.go:497 addMutationHelper's current-value read).
 
 from __future__ import annotations
 
+import struct
 from typing import List, Optional
 
 from dgraph_tpu.posting.lists import LocalCache, Txn
 from dgraph_tpu.posting.pl import (
     OP_DEL,
     OP_SET,
+    VALUE_UID,
     Posting,
     lang_uid,
     value_uid,
@@ -41,6 +43,7 @@ class DirectedEdge:
         "facets",
         "op",
         "ns",
+        "fresh",
     )
 
     def __init__(
@@ -53,6 +56,7 @@ class DirectedEdge:
         facets=None,
         op: int = OP_SET,
         ns: int = keys.GALAXY_NS,
+        fresh: bool = False,
     ):
         self.entity = entity
         self.attr = attr
@@ -62,6 +66,11 @@ class DirectedEdge:
         self.facets = facets or {}
         self.op = op
         self.ns = ns
+        # `fresh` marks a subject whose uid was leased by THIS request
+        # (a blank node): its (entity, pred) keys cannot hold committed
+        # values, so the batched apply path skips the deindex read —
+        # byte-identical outcome, the serial path just reads emptiness
+        self.fresh = fresh
 
 
 def _facet_bytes(facets) -> tuple[dict, dict]:
@@ -99,6 +108,238 @@ def apply_edge(
 
     if su.count:
         _update_count_index(txn, su, edge, data_key)
+
+
+def ingest_vectors(vector_indexes, deltas) -> None:
+    """Vector-index ingestion at commit (factory seam, ref
+    tok/index/index.go boundary): ONE implementation for every engine's
+    post-commit hook. No-op without vector predicates — the per-key
+    parse is measurable on the write path."""
+    if not vector_indexes:
+        return
+    for key, posts in deltas.items():
+        pk = keys.parse_key(key)
+        vidx = vector_indexes.get(pk.attr)
+        if vidx is not None and pk.is_data:
+            for p in posts:
+                if p.is_value and p.op == OP_SET:
+                    vidx.insert(pk.uid, p.val().value)
+                elif p.op == OP_DEL:
+                    vidx.remove(pk.uid)
+
+
+def apply_edges(
+    txn: Txn, st: State, edges: List[DirectedEdge],
+    update_schema: bool = True,
+) -> None:
+    """Batched edge application: semantically identical to calling
+    apply_edge per edge in order, but the common live-ingest shape —
+    scalar value SET with no lang/facets on a non-list, non-count
+    predicate, writing a (entity, pred) key no other edge in the batch
+    touches — runs through bulk machinery:
+
+      - ONE values_many pass reads every such key's current postings
+        (the deindex check) instead of a KV read per edge;
+      - term tokens for ASCII strings come from ONE native call
+        (codec.cpp tok_terms_ascii), exact/int/bool tokens from direct
+        formatters — build_tokens only runs for the long tail;
+      - tokenizer objects are the schema entry's cached list.
+
+    Reordering is safe exactly because fast-path keys are
+    batch-exclusive: edges sharing a data key (and every rich shape)
+    fall back to apply_edge in their original relative order, index
+    postings for one uid always come from that uid's own (excluded)
+    data-key edges, and per-key delta order is all the layered store
+    observes. Keys holding live prior values also fall back (the
+    deindex-old-tokens path)."""
+    if len(edges) < 2:
+        for e in edges:
+            apply_edge(txn, st, e, update_schema)
+        return
+    # classes: 0 slow (apply_edge in order), 1 fast scalar value,
+    # 2 fast list-uid SET (append-only postings, order-free)
+    infos = []
+    key_owners: dict = {}
+    key_mixed: dict = {}  # dk -> a non-class-2 edge touches it
+    st_get = st.get
+    for e in edges:
+        su = st_get(e.attr)
+        if su is None:
+            if not update_schema:
+                raise ValueError(f"no schema for predicate {e.attr!r}")
+            tid = (
+                TypeID.UID
+                if e.value_id is not None
+                else (e.value.tid if e.value else TypeID.DEFAULT)
+            )
+            su = st.ensure_default(e.attr, tid)
+        dk = keys.DataKey(e.attr, e.entity, e.ns)
+        if (
+            e.value_id is None
+            and not su.is_uid
+            and e.value is not None
+            and e.op == OP_SET
+            and not e.facets
+            and not e.lang
+            and not su.is_list
+            and not su.count
+        ):
+            cls = 1
+        elif (
+            e.value_id is not None
+            and su.is_list
+            and e.op == OP_SET
+            and not e.facets
+            and not su.count
+        ):
+            # list-uid SET postings append commutatively (two SETs on
+            # one key land as independent final_op entries), so these
+            # may even share a data key with each other — just not
+            # with any slower-class edge
+            cls = 2
+        else:
+            cls = 0
+        key_owners[dk] = key_owners.get(dk, 0) + 1
+        if cls != 2:
+            key_mixed[dk] = True
+        infos.append((e, su, dk, cls))
+    fast = [
+        i
+        for i, (_e, _su, dk, cls) in enumerate(infos)
+        if cls == 1 and key_owners[dk] == 1
+    ]
+    stored: dict = {}
+    if fast:
+        # the deindex check (does the key hold live prior values?) is
+        # only needed where deindexing could happen at all — preds WITH
+        # tokenizers (serial apply_edge reads under the same guard) —
+        # and never for a `fresh` subject with no txn-local delta
+        # (a uid leased this request has no committed values to read)
+        need_read = [
+            i
+            for i in fast
+            if infos[i][1].tokenizers
+            and not (
+                infos[i][0].fresh
+                and infos[i][2] not in txn.cache.deltas
+            )
+        ]
+        old_by_idx: dict = {}
+        if need_read:
+            oldvals = txn.cache.values_many(
+                [infos[i][2] for i in need_read]
+            )
+            old_by_idx = dict(zip(need_read, oldvals))
+        kept = []
+        for i in fast:
+            if old_by_idx.get(i):
+                continue  # live prior values: deindex path, per-edge
+            e, su, _dk, _cls = infos[i]
+            try:
+                stored[i] = (
+                    convert(e.value, su.value_type)
+                    if su.value_type != TypeID.DEFAULT
+                    else e.value
+                )
+            except Exception:
+                continue  # conversion error: re-raised by apply_edge
+            kept.append(i)
+        fast = kept
+    tokens = _bulk_tokens(infos, fast, stored)
+    fastset = set(fast)
+    add_delta = txn.cache.add_delta
+    add_ck = txn.add_conflict_key
+    for i, (e, su, dk, cls) in enumerate(infos):
+        if i in fastset:
+            sv = stored[i]
+            add_delta(
+                dk,
+                Posting(
+                    uid=VALUE_UID,
+                    op=OP_SET,
+                    value=to_binary(sv),
+                    value_type=sv.tid,
+                ),
+            )
+            add_ck(dk if su.upsert else dk + b"#v")
+            for tokb in tokens.get(i, ()):
+                ikey = keys.IndexKey(e.attr, tokb, e.ns)
+                add_delta(ikey, Posting(uid=e.entity, op=OP_SET))
+                if su.upsert:
+                    add_ck(ikey)
+        elif cls == 2 and dk not in key_mixed:
+            # fast list-uid SET: no reads, append-only postings — the
+            # same deltas _apply_uid_edge produces for this shape
+            add_delta(dk, Posting(uid=e.value_id, op=OP_SET))
+            add_ck(
+                dk if su.upsert else dk + b"#u",
+                str(e.value_id).encode(),
+            )
+            if su.directive_reverse:
+                rk = keys.ReverseKey(e.attr, e.value_id, e.ns)
+                add_delta(rk, Posting(uid=e.entity, op=OP_SET))
+                add_ck(rk, str(e.entity).encode())
+        else:
+            apply_edge(txn, st, e, update_schema)
+
+
+def _bulk_tokens(infos, fast, stored) -> dict:
+    """edge index -> index token list for the fast-path edges: native
+    bulk term tokenization for ASCII strings, direct formatters for
+    exact/int/bool, build_tokens for anything else."""
+    from dgraph_tpu import native
+    from dgraph_tpu.tok.tok import (
+        BoolTokenizer,
+        ExactTokenizer,
+        IntTokenizer,
+        TermTokenizer,
+    )
+
+    tokens: dict = {i: [] for i in fast}
+    term_idx: List[int] = []
+    term_vals: List[bytes] = []
+    term_ident = 0
+    for i in fast:
+        _e, su, _dk, _el = infos[i]
+        sv = stored[i]
+        for t in su.tokenizer_objs():
+            if isinstance(t, TermTokenizer) and sv.tid == TypeID.STRING:
+                s = str(sv.value)
+                if s.isascii() and native.NATIVE_AVAILABLE:
+                    term_idx.append(i)
+                    term_vals.append(s.encode("utf-8"))
+                    term_ident = t.identifier
+                    continue
+            elif isinstance(t, ExactTokenizer) and sv.tid == TypeID.STRING:
+                tokens[i].append(
+                    t.prefix() + str(sv.value).encode("utf-8")
+                )
+                continue
+            elif isinstance(t, IntTokenizer) and sv.tid == TypeID.INT:
+                tokens[i].append(
+                    t.prefix()
+                    + struct.pack(
+                        ">Q", (int(sv.value) + (1 << 63)) & ((1 << 64) - 1)
+                    )
+                )
+                continue
+            elif isinstance(t, BoolTokenizer) and sv.tid == TypeID.BOOL:
+                tokens[i].append(
+                    t.prefix() + (b"\x01" if sv.value else b"\x00")
+                )
+                continue
+            tokens[i].extend(build_tokens(sv, [t]))
+    if term_idx:
+        got = native.tok_terms_ascii(term_vals, term_ident)
+        if got is None:
+            for i, vb in zip(term_idx, term_vals):
+                tokens[i].extend(
+                    build_tokens(stored[i], [TermTokenizer()])
+                )
+        else:
+            for i, toks in zip(term_idx, got):
+                tokens[i].extend(toks)
+    return tokens
 
 
 def _apply_uid_edge(txn: Txn, su: SchemaUpdate, edge: DirectedEdge, data_key):

@@ -80,7 +80,7 @@ def value_uid(stored: "Val") -> int:
     return _farm_fp(go_value_binary(stored.tid, stored.value))
 
 
-@dataclass
+@dataclass(slots=True)
 class Posting:
     uid: int
     op: int = OP_SET
@@ -224,6 +224,75 @@ def encode_delta(postings: List[Posting]) -> bytes:
     for p in postings:
         _enc_posting(p, out)
     return b"".join(out)
+
+
+def encode_deltas(deltas: Dict[bytes, List[Posting]]):
+    """Batched delta encode for a whole txn's write set: returns
+    [(key, delta_record_bytes)] for every non-empty key (in write-set
+    order), byte-identical to per-key encode_delta. The common
+    scalar/uid posting shapes (no facets, no lang) encode through ONE
+    native call across keys (codec.cpp enc_delta_records); keys
+    holding facet/lang postings take the Python encoder PER KEY, so a
+    single rich edge never disables the kernel for the whole txn."""
+    from dgraph_tpu import native
+
+    items = [(k, p) for k, p in deltas.items() if p]
+    if not items:
+        return []
+    if not native.NATIVE_AVAILABLE:
+        return [(k, encode_delta(p)) for k, p in items]
+    fast: List[int] = []  # indices into items taking the native kernel
+    out: List = [None] * len(items)
+    for i, (k, posts) in enumerate(items):
+        if any(p.facets or p.lang for p in posts):
+            out[i] = (k, encode_delta(posts))
+        else:
+            fast.append(i)
+    if fast:
+        recs = _encode_deltas_native([items[i] for i in fast])
+        if recs is None:  # native call unavailable after all
+            for i in fast:
+                out[i] = (items[i][0], encode_delta(items[i][1]))
+        else:
+            for j, i in enumerate(fast):
+                out[i] = (items[i][0], recs[j])
+    return out
+
+
+def _encode_deltas_native(items):
+    """One-call encode of fast-shape postings (caller pre-filtered:
+    no facets, no lang); returns the per-key record list or None when
+    the native library is unavailable. Inputs assemble through plain
+    lists converted to arrays in bulk — per-element numpy stores would
+    cost more than the native call saves."""
+    from dgraph_tpu import native
+
+    counts: List[int] = []
+    flags: List[int] = []
+    uids: List[int] = []
+    tids: List[int] = []
+    vlens: List[int] = []
+    vals: List[bytes] = []
+    for _k, posts in items:
+        counts.append(len(posts))
+        for p in posts:
+            v = p.value
+            flags.append((1 if v is not None else 0) | (p.op << 1))
+            uids.append(p.uid)
+            tids.append(int(p.value_type))
+            if v is not None:
+                vlens.append(len(v))
+                vals.append(v)
+            else:
+                vlens.append(0)
+    return native.enc_delta_records(
+        np.array(counts, np.int64),
+        np.frombuffer(bytes(flags), np.uint8),
+        np.array(uids, np.uint64),
+        np.frombuffer(bytes(tids), np.uint8),
+        np.array(vlens, np.int64),
+        b"".join(vals),
+    )
 
 
 def decode_record(data: bytes):

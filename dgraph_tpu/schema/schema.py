@@ -55,7 +55,17 @@ class SchemaUpdate:
         return self.value_type == TypeID.UID
 
     def tokenizer_objs(self):
-        return [get_tokenizer(n) for n in self.tokenizers]
+        """Tokenizer objects for this predicate, cached on the entry —
+        the mutation path calls this per edge, and re-resolving the
+        registry each time was measurable on the live write path. A
+        schema set replaces the whole SchemaUpdate (fresh cache); the
+        key guards against in-place `tokenizers` edits too."""
+        key = tuple(self.tokenizers)
+        cached = getattr(self, "_tok_cache", None)
+        if cached is None or cached[0] != key:
+            cached = (key, [get_tokenizer(n) for n in key])
+            self._tok_cache = cached
+        return cached[1]
 
 
 @dataclass

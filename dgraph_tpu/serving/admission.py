@@ -183,6 +183,16 @@ class AdmissionController:
         # charges (that would blow the budget exactly under the load
         # the gate exists for)
         degrade = enabled and self.saturated()
+        self._charge(cost, enabled, "retry with backoff")
+        if degrade:
+            METRICS.inc("admission_degraded_total")
+        return Ticket(cost, degrade)
+
+    def _charge(self, cost: float, enabled: bool, retry_hint: str) -> None:
+        """The locked check-and-charge shared by the query and write
+        gates: the budget check and the charge happen in ONE lock hold
+        (a burst of arrivals must not all pass the check before any of
+        them charges)."""
         with self._lock:
             if enabled:
                 limit = self.max_inflight()
@@ -191,14 +201,27 @@ class AdmissionController:
                     raise TooManyRequestsError(
                         f"server over in-flight budget "
                         f"({self.inflight_cost:.0f}+{cost:.0f} > "
-                        f"{limit:.0f} tokens); retry with backoff"
+                        f"{limit:.0f} tokens); {retry_hint}"
                     )
             self.inflight += 1
             self.inflight_cost += cost
             METRICS.set_gauge("admission_inflight_queries", self.inflight)
-        if degrade:
-            METRICS.inc("admission_degraded_total")
-        return Ticket(cost, degrade)
+
+    # one token per this many postings in a write's delta set (a small
+    # txn costs 1 token like a cheap query; a bulk-ish live ingest
+    # charges proportionally — writes compete for the same budget
+    # instead of riding under the gate while queries are shed)
+    _EDGES_PER_TOKEN = 50.0
+
+    def admit_write(self, n_edges: int) -> Ticket:
+        """Admit one commit or raise TooManyRequestsError (retryable,
+        HTTP 429). Writes charge the SAME in-flight token budget as
+        queries: under overload a server that sheds reads but admits
+        unlimited mutations just moves the queue to the write path.
+        Always call `release(ticket)` in a finally block."""
+        cost = max(1.0, float(n_edges) / self._EDGES_PER_TOKEN)
+        self._charge(cost, self.enabled(), "retry the commit with backoff")
+        return Ticket(cost, False)
 
     def release(self, ticket: Ticket) -> None:
         with self._lock:

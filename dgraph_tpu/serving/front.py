@@ -66,6 +66,15 @@ class ServingFront:
     def admit(self, shape: Optional[str], blocks=None) -> Ticket:
         return self.admission.admit(shape, blocks)
 
+    def admit_write(self, n_edges: int) -> Ticket:
+        """Admission for the commit path: writes cost tokens out of the
+        same in-flight budget queries draw from (raises the retryable
+        TooManyRequestsError over budget). Pair with release_write."""
+        return self.admission.admit_write(n_edges)
+
+    def release_write(self, ticket: Ticket) -> None:
+        self.admission.release(ticket)
+
     def finish(
         self,
         ticket: Optional[Ticket],

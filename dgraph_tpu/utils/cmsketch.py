@@ -11,6 +11,7 @@ from __future__ import annotations
 import hashlib
 import math
 import struct
+import threading
 
 import numpy as np
 
@@ -23,30 +24,73 @@ class CountMinSketch:
         self.depth = int(math.ceil(math.log(1.0 / delta)))
         self.matrix = np.zeros((self.depth, self.width), dtype=np.uint64)
         self.count = 0
+        self._buf: dict = {}  # pending adds (flushed in bulk)
+        # guards ONLY the buffer dict (swap + mutation): concurrent
+        # writers racing an unguarded dict during a flush iteration
+        # would raise, unlike the old value-only matrix races the
+        # sketch tolerates by design
+        self._buf_lock = threading.Lock()
 
-    def _indexes(self, key: bytes) -> np.ndarray:
-        # double hashing: h_i = h1 + i*h2 (Kirsch-Mitzenmacher)
+    def _rows(self, key: bytes):
+        """Per-row matrix column for `key` — double hashing
+        h_i = h1 + i*h2 (Kirsch-Mitzenmacher), ONE implementation for
+        the flush and estimate paths."""
         d = hashlib.blake2b(key, digest_size=16).digest()
         h1, h2 = struct.unpack("<QQ", d)
-        i = np.arange(self.depth, dtype=np.uint64)
-        return (np.uint64(h1) + i * np.uint64(h2 | 1)) % np.uint64(self.width)
+        h2 |= 1
+        w, mask = self.width, self._U64_MASK
+        for i in range(self.depth):
+            yield i, ((h1 + i * h2) & mask) % w
+
+    _U64_MASK = (1 << 64) - 1
+    _BUF_FLUSH = 256
 
     def add(self, key: bytes, count: int = 1):
-        idx = self._indexes(key)
-        self.matrix[np.arange(self.depth), idx] += np.uint64(count)
-        self.count += count
+        # buffered: this runs per index key on EVERY commit
+        # (feed_stats), and the per-add hash + matrix scatter dominated
+        # the write path. Adds land in a small dict (repeated hot
+        # tokens collapse to one entry) and flush into the matrix in
+        # bulk; estimates flush first, so nothing observable lags. The
+        # sketch stays best-effort on VALUES under concurrent writers
+        # (like the old unlocked numpy scatter), but the buffer dict
+        # itself is lock-guarded: a swap racing a writer would
+        # otherwise mutate the dict mid-flush-iteration and raise.
+        with self._buf_lock:
+            buf = self._buf
+            buf[key] = buf.get(key, 0) + count
+            self.count += count
+            full = len(buf) >= self._BUF_FLUSH
+        if full:
+            self._flush()
+
+    def _flush(self):
+        with self._buf_lock:
+            buf, self._buf = self._buf, {}
+        # the detached dict is exclusively ours (every writer goes
+        # through the lock above), so iterating it is race-free
+        m = self.matrix
+        for key, count in buf.items():
+            c = np.uint64(count)
+            for i, col in self._rows(key):
+                m[i, col] += c
 
     def estimate(self, key: bytes) -> int:
-        idx = self._indexes(key)
-        return int(self.matrix[np.arange(self.depth), idx].min())
+        if self._buf:
+            self._flush()
+        m = self.matrix
+        return int(min(m[i, col] for i, col in self._rows(key)))
 
     def merge(self, other: "CountMinSketch"):
         if self.matrix.shape != other.matrix.shape:
             raise ValueError("cannot merge sketches of different shapes")
+        self._flush()
+        other._flush()
         self.matrix += other.matrix
         self.count += other.count
 
     def reset(self):
+        with self._buf_lock:
+            self._buf = {}
         self.matrix[:] = 0
         self.count = 0
 
@@ -74,13 +118,22 @@ def feed_stats(stats: "StatsHolder", deltas) -> None:
     """Count a commit's index-key postings into the sketch — ONE
     implementation for every engine (api/server.Server and
     worker/harness.ProcCluster both feed their StatsHolder from commit
-    deltas; the eq planner and the admission cost model read it)."""
+    deltas; the eq planner and the admission cost model read it).
+    Keys are sifted with direct byte probes (tag byte 0, kind byte
+    KIND_INDEX after the nsattr prefix) instead of a full parse_key per
+    key: this runs over EVERY delta key of every commit, and most of
+    them are data/reverse/count keys the sketch ignores."""
     from dgraph_tpu.x import keys
 
     for key, posts in deltas.items():
-        try:
-            pk = keys.parse_key(key)
-        except Exception:
+        if not posts or len(key) < 12 or key[0] != keys.TAG_DEFAULT:
             continue
-        if pk.is_index and posts:
-            stats.record(pk.attr, pk.term, len(posts))
+        nlen = (key[1] << 8) | key[2]
+        kpos = 3 + nlen
+        if kpos >= len(key) or key[kpos] != keys.KIND_INDEX:
+            continue
+        try:
+            attr = key[11:kpos].decode("utf-8")  # nsattr minus u64 ns
+        except UnicodeDecodeError:
+            continue
+        stats.record(attr, key[kpos + 1:], len(posts))

@@ -44,6 +44,7 @@ from __future__ import annotations
 import fnmatch
 import json
 import os
+import random
 import threading
 import time
 from collections import OrderedDict, deque
@@ -177,11 +178,14 @@ class Metrics:
             )
         return out
 
-    def observe(self, name: str, seconds: float):
+    def observe(self, name: str, seconds: float, buckets=None):
+        """Record one histogram observation. `buckets` overrides the
+        default latency ladder on FIRST observation only (count-valued
+        histograms like group_commit_batch_size pass a count ladder)."""
         with self._lock:
             h = self._hists.get(name)
             if h is None:
-                h = self._hists[name] = Histogram()
+                h = self._hists[name] = Histogram(buckets)
             h.observe(seconds)
 
     @contextmanager
@@ -432,15 +436,42 @@ def parse_traceparent(header: str) -> Optional[SpanContext]:
         return None
 
 
+_FORK_GEN = [0]  # bumped in a fork's child so id streams never share
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(
+        after_in_child=lambda: _FORK_GEN.__setitem__(0, _FORK_GEN[0] + 1)
+    )
+
+
+class _IdRng(threading.local):
+    """Per-thread PRNG for trace/span ids, seeded once from os.urandom.
+    Ids stay collision-free across alpha/zero processes (independent
+    128-bit urandom seeds per thread; the fork hook reseeds a fork's
+    child so parent and child never share a stream — spawn'd replicas
+    are fresh interpreters anyway), but the per-ID cost drops from one
+    syscall — os.urandom AND os.getpid both measure 100µs+ on some
+    sandboxed kernels, dominating span creation on the hot paths — to
+    a getrandbits call."""
+
+    def get(self) -> "random.Random":
+        if getattr(self, "gen", None) != _FORK_GEN[0]:
+            self.rng = random.Random(int.from_bytes(os.urandom(16), "big"))
+            self.gen = _FORK_GEN[0]
+        return self.rng
+
+
+_ID_RNG = _IdRng()
+
+
 def _gen_trace_id() -> int:
-    """Random 128-bit trace id. os.urandom is fork-safe and per-call, so
-    ids never collide across alpha/zero processes (the old sequential
-    per-process counter corrupted merged traces)."""
-    return int.from_bytes(os.urandom(16), "big") or 1
+    """Random 128-bit trace id; never collides across alpha/zero
+    processes (the old sequential per-process counter corrupted merged
+    traces)."""
+    return _ID_RNG.get().getrandbits(128) or 1
 
 
 def _gen_span_id() -> int:
-    return int.from_bytes(os.urandom(8), "big") or 1
+    return _ID_RNG.get().getrandbits(64) or 1
 
 
 class Span:
@@ -1232,6 +1263,35 @@ declare_metric(
 declare_metric(
     "counter", "metrics_scrape_errors_total",
     "Per-instance scrape failures during cluster metrics aggregation.",
+)
+declare_metric(
+    "counter", "group_commit_total",
+    "Commit batches executed by the group-commit coalescer "
+    "(worker/groupcommit.py): one oracle exchange + one bounded "
+    "proposal per owning group per batch.",
+)
+declare_metric(
+    "counter", "group_commit_txns_total",
+    "Transactions committed through the group-commit coalescer "
+    "(divide by group_commit_total for the realized batch width).",
+)
+declare_metric(
+    "gauge", "commit_pipeline_depth",
+    "Commit batches whose apply barrier is still outstanding — the "
+    "group-commit pipeline's in-flight depth (proposals for the next "
+    "batch overlap the previous batch's barrier).",
+)
+declare_metric(
+    "histogram", "group_commit_batch_size",
+    "Distribution of transactions coalesced per commit batch "
+    "(count-valued buckets, capped by "
+    "DGRAPH_TPU_GROUP_COMMIT_MAX_TXNS).",
+)
+declare_metric(
+    "counter", "mutation_edges_total",
+    "Postings written by committed transactions (data + index + "
+    "reverse + count deltas) — the write path's edge throughput "
+    "denominator.",
 )
 declare_metric(
     "counter", "num_commits",

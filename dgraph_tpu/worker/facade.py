@@ -148,6 +148,7 @@ class ClusterFacade:
     # self.zero/self.schema, both duck-typed here)
     from dgraph_tpu.api.server import Server as _S
 
+    _nquad_edge = _S._nquad_edge
     _apply_nquad = _S._apply_nquad
     _apply_nquads = _S._apply_nquads
     _apply_rdf = _S._apply_rdf

@@ -495,6 +495,7 @@ class DistributedCluster:
         # racing phase-2 of a move would land on the source group and be
         # destroyed by the drop; ref predicate_move.go's blocking phase)
         self._commit_lock = threading.Lock()
+        self._group_commit = None  # lazy (worker/groupcommit.py)
         self._bootstrap_schema()
         self.intents: Optional[IntentLog] = None
         if data_dir is not None:
@@ -746,8 +747,130 @@ class DistributedCluster:
         return ClusterTxn(self)
 
     def _commit(self, txn: Txn) -> int:
+        from dgraph_tpu.x import config as _config
+
+        if not bool(_config.get("GROUP_COMMIT")):
+            # escape hatch (DGRAPH_TPU_GROUP_COMMIT=0): today's serial
+            # per-txn path, byte-for-byte
+            return self._commit_serial(txn)
+        gc = self._group_commit
+        if gc is None:
+            with self._commit_lock:
+                gc = self._group_commit
+                if gc is None:
+                    from dgraph_tpu.worker.groupcommit import GroupCommit
+
+                    gc = self._group_commit = GroupCommit(self._gc_propose)
+        return gc.commit(txn)
+
+    def _commit_serial(self, txn: Txn) -> int:
         with self._commit_lock:
             return self._commit_locked(txn)
+
+    def _gc_propose(self, members):
+        """Group-commit propose phase: under ONE commit-lock hold (the
+        mover's fence exclusion point) — per-member fence bounces, ONE
+        oracle exchange for the whole batch, per-member intents, then
+        ONE bounded ("delta", writes) proposal per (group, frame-budget
+        chunk) instead of one proposal per txn per group. The in-proc
+        raft's propose includes its apply wait, so only the barrier
+        bookkeeping trails into the pipeline here."""
+        from dgraph_tpu.posting.pl import encode_deltas
+        from dgraph_tpu.worker.groupcommit import (
+            assign_verdicts,
+            chunk_group_writes,
+        )
+        from dgraph_tpu.x import config as _config
+
+        committed: list = []
+        plans: list = []
+        with self._commit_lock:
+            live = []
+            for m in members:
+                try:
+                    self._check_fences(m.txn)
+                except Exception as e:
+                    m.error = e  # retryable per member, no verdict burnt
+                else:
+                    live.append(m)
+            if live:
+                committed = assign_verdicts(
+                    live,
+                    self.zero.zero.commit_batch(
+                        [
+                            (m.txn.start_ts, m.txn.conflict_keys)
+                            for m in live
+                        ],
+                        track=True,
+                    ),
+                )
+            try:
+                for m in committed:
+                    per_group: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
+                    for key, recb in encode_deltas(m.txn.cache.deltas):
+                        gid = self.zero.should_serve(
+                            keys.parse_key(key).attr
+                        )
+                        per_group.setdefault(gid, []).append(
+                            (key, m.commit_ts, recb)
+                        )
+                    plans.append((m, per_group))
+                    if self.intents is not None:
+                        self.intents.append_intent(m.commit_ts, per_group)
+                frame_budget = max(
+                    1 << 20, int(_config.get("MAX_FRAME_BYTES")) // 4
+                )
+                for gid, writes, mset in chunk_group_writes(
+                    plans, frame_budget
+                ):
+                    try:
+                        self._propose_and_wait(gid, ("delta", writes))
+                    except TimeoutError as e:
+                        err = PartialCommitError(
+                            f"batched commit proposal to group {gid} "
+                            f"timed out; intents journaled — "
+                            f"recover_intents() or restart completes "
+                            f"it: {e}"
+                        )
+                        for m in mset:
+                            if m.error is None:
+                                m.error = err
+                if self.intents is not None:
+                    marked = False
+                    for m, _pg in plans:
+                        if m.error is None:
+                            self.intents.mark_done(m.commit_ts)
+                            marked = True
+                    if marked:
+                        self._save_zero_state()
+            except Exception as e:
+                # NEVER raise past the oracle: only the barrier clears
+                # the tracked pending verdicts — an escaping exception
+                # would leak _pending and stall every later
+                # begin_txn/read_ts for the full wait bound
+                for m in committed:
+                    if m.error is None:
+                        m.error = e
+            # publish into drain() accounting BEFORE the commit lock
+            # releases (worker/groupcommit.py mark_proposed); proposals
+            # here are synchronous, so this is belt-and-braces for the
+            # mover's fence
+            gc = self._group_commit
+            if gc is not None:
+                gc.mark_proposed()
+
+        def barrier():
+            from dgraph_tpu.posting.mutation import ingest_vectors
+
+            for m in committed:
+                self.zero.zero.applied(m.commit_ts)
+            for m in committed:
+                self.mem.invalidate(m.txn.cache.deltas.keys())
+            for m in committed:
+                if m.error is None:
+                    ingest_vectors(self.vector_indexes, m.txn.cache.deltas)
+
+        return barrier
 
     def _commit_locked(self, txn: Txn) -> int:
         self._check_fences(txn)
@@ -967,18 +1090,30 @@ class ClusterTxn:
 
     def mutate_rdf(self, set_rdf: str = "", del_rdf: str = "", commit_now=False):
         from dgraph_tpu.loaders.rdf import parse_rdf
-        from dgraph_tpu.posting.mutation import apply_edge
+        from dgraph_tpu.posting.mutation import apply_edges
         from dgraph_tpu.posting.pl import OP_DEL, OP_SET
         from dgraph_tpu.posting.mutation import DirectedEdge, delete_entity_attr
 
         blank: Dict[str, int] = {}
+        fresh_uids: set = set()  # uids leased by THIS request
 
         def resolve(ref: str) -> int:
             if ref.startswith("_:"):
                 if ref not in blank:
                     blank[ref] = self.cluster.zero.zero.assign_uids(1)
+                    fresh_uids.add(blank[ref])
                 return blank[ref]
             return int(ref, 16) if ref.startswith("0x") else int(ref)
+
+        # batched application (posting/mutation.apply_edges): edges
+        # accumulate and flush in bulk; a star delete flushes first so
+        # it observes every edge that preceded it in order
+        pending: List[DirectedEdge] = []
+
+        def flush():
+            if pending:
+                apply_edges(self.txn, self.cluster.schema, pending)
+                pending.clear()
 
         for rdf, op in ((set_rdf, OP_SET), (del_rdf, OP_DEL)):
             for nq in parse_rdf(rdf):
@@ -986,6 +1121,7 @@ class ClusterTxn:
                 self.cluster.zero.should_serve(nq.predicate)
                 subj = resolve(nq.subject)
                 if nq.star:
+                    flush()
                     delete_entity_attr(
                         self.txn, self.cluster.schema, subj, nq.predicate
                     )
@@ -994,13 +1130,16 @@ class ClusterTxn:
                     edge = DirectedEdge(
                         subj, nq.predicate, value_id=resolve(nq.object_id),
                         facets=nq.facets, op=op,
+                        fresh=subj in fresh_uids,
                     )
                 else:
                     edge = DirectedEdge(
                         subj, nq.predicate, value=nq.object_value,
                         lang=nq.lang, facets=nq.facets, op=op,
+                        fresh=subj in fresh_uids,
                     )
-                apply_edge(self.txn, self.cluster.schema, edge)
+                pending.append(edge)
+        flush()
         if commit_now:
             return self.commit()
         return blank

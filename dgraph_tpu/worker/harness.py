@@ -129,6 +129,8 @@ class ProcCluster:
         )
         self.remote_groups: Dict[int, RemoteGroup] = {}
         self._commit_lock = threading.Lock()
+        self._group_commit = None  # lazy (worker/groupcommit.py)
+        self._commit_prop_pool = None  # lazy proposal executor
         self._rebalance_stop = None
         self._rebalance_thread = None
         self._tablets_path: Optional[str] = None
@@ -267,6 +269,8 @@ class ProcCluster:
             # let a mid-tick move finish before its replicas vanish —
             # an unjoined mover would race the journal close below
             self._rebalance_thread.join(timeout=15)
+        if self._commit_prop_pool is not None:
+            self._commit_prop_pool.shutdown(wait=False)
         for nid in list(self.procs):
             self.kill(nid)
         self.pool.close()
@@ -307,6 +311,41 @@ class ProcCluster:
         return ClusterTxn(self)
 
     def _commit(self, txn: Txn) -> int:
+        # admission costs writes too: a commit charges the same
+        # in-flight token budget queries draw from (retryable 429 over
+        # budget; no-op with DGRAPH_TPU_ADMISSION off)
+        n_edges = sum(len(p) for p in txn.cache.deltas.values())
+        ticket = self.serving.admit_write(n_edges)
+        try:
+            if not bool(config.get("GROUP_COMMIT")):
+                # escape hatch (DGRAPH_TPU_GROUP_COMMIT=0): today's
+                # serial per-txn path, byte-for-byte
+                cts = self._commit_serial(txn)
+            else:
+                gc = self._group_commit
+                if gc is None:
+                    with self._commit_lock:
+                        gc = self._group_commit
+                        if gc is None:
+                            from dgraph_tpu.worker.groupcommit import (
+                                GroupCommit,
+                            )
+
+                            gc = self._group_commit = GroupCommit(
+                                self._gc_propose
+                            )
+                with METRICS.timer("commit_latency_seconds"):
+                    cts = gc.commit(txn)
+                self._feed_stats(txn.cache.deltas)
+            # counted for BOTH arms (only on success — the metric is
+            # postings WRITTEN): the A/B escape hatch must not turn
+            # the edge-throughput denominator dark
+            METRICS.inc("mutation_edges_total", n_edges)
+            return cts
+        finally:
+            self.serving.release_write(ticket)
+
+    def _commit_serial(self, txn: Txn) -> int:
         # the mutation entry point stamps ONE deadline that flows through
         # zero.commit and every group proposal beneath it
         budget = float(config.get("COMMIT_DEADLINE_S"))
@@ -320,6 +359,152 @@ class ProcCluster:
         self.serving.on_commit()  # commit-epoch plan invalidation
         self._feed_stats(txn.cache.deltas)
         return cts
+
+    def _gc_propose(self, members):
+        """Group-commit propose phase (ref the TxnWriter batching
+        model): under ONE commit-lock hold — the mover's fence
+        exclusion point — bounce fenced members retryably, decide the
+        whole batch in ONE zero.commit exchange, journal intents, and
+        dispatch the batch's deltas as bounded per-group ("delta",
+        writes) proposals on the commit pool. Proposal completion waits
+        ride in the returned barrier, so the NEXT batch's oracle
+        exchange and proposals are in flight before this batch's apply
+        barrier completes (the pipeline); the snapshot watermark still
+        advances in commit-ts order because barriers run FIFO."""
+        from dgraph_tpu.posting.pl import encode_deltas
+        from dgraph_tpu.worker.groupcommit import assign_verdicts
+        from dgraph_tpu.worker.tabletmove import check_fences
+
+        budget = float(config.get("COMMIT_DEADLINE_S"))
+        dl = Deadline.after(budget)
+        committed: list = []
+        plans: list = []  # (member, per_group writes)
+        futs: list = []  # (future, member set for that chunk)
+        with deadline_scope(dl), TRACER.span(
+            "commit", batch=len(members)
+        ), self._commit_lock:
+            live = []
+            for m in members:
+                try:
+                    # fence bounces are retryable and PER MEMBER — a
+                    # moving tablet never aborts its batchmates, and no
+                    # oracle verdict is burned for the bounced txn
+                    check_fences(self.zero, m.txn.cache.deltas)
+                except Exception as e:
+                    m.error = e
+                else:
+                    live.append(m)
+            if live:
+                committed = assign_verdicts(
+                    live,
+                    self.zero.zero.commit_batch(
+                        [
+                            (m.txn.start_ts, m.txn.conflict_keys)
+                            for m in live
+                        ],
+                        track=True,
+                    ),
+                )
+            try:
+                for m in committed:
+                    per_group: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
+                    for key, recb in encode_deltas(m.txn.cache.deltas):
+                        gid = self.zero.should_serve(
+                            keys.parse_key(key).attr
+                        )
+                        per_group.setdefault(gid, []).append(
+                            (key, m.commit_ts, recb)
+                        )
+                    plans.append((m, per_group))
+                    if self.intents is not None:
+                        self.intents.append_intent(m.commit_ts, per_group)
+                # ONE bounded proposal per (group, frame-budget chunk)
+                # for the whole batch, dispatched async on the commit
+                # pool — the apply wait happens in the barrier
+                frame_budget = max(
+                    1 << 20, int(config.get("MAX_FRAME_BYTES")) // 4
+                )
+                from dgraph_tpu.worker.groupcommit import (
+                    chunk_group_writes,
+                )
+
+                for gid, writes, mset in chunk_group_writes(
+                    plans, frame_budget
+                ):
+                    g = self.remote_groups[gid]
+                    timeout = max(0.5, dl.remaining())
+                    futs.append(
+                        (
+                            self._commit_pool().submit(
+                                g.propose, ("delta", writes), timeout
+                            ),
+                            mset,
+                        )
+                    )
+            except Exception as e:
+                # NEVER raise past the oracle: only the barrier clears
+                # the tracked pending verdicts — an escaping exception
+                # would leak _pending and stall every later
+                # begin_txn/read_ts for the full wait bound
+                for m in committed:
+                    if m.error is None:
+                        m.error = e
+            # publish into drain() accounting BEFORE the commit lock
+            # releases — the mover's fence must see these airborne
+            # proposals (worker/groupcommit.py mark_proposed)
+            gc = self._group_commit
+            if gc is not None:
+                gc.mark_proposed()
+
+        def barrier():
+            try:
+                for fut, mset in futs:
+                    try:
+                        fut.result()
+                    except Exception as e:
+                        # ambiguous like the serial path's propose
+                        # timeout: the intent stays pending and
+                        # recover_intents()/restart completes it
+                        for m in mset:
+                            if m.error is None:
+                                m.error = e
+                if self.intents is not None:
+                    for m, _pg in plans:
+                        if m.error is None:
+                            self.intents.mark_done(m.commit_ts)
+            finally:
+                ok = 0
+                for m in committed:
+                    # watermark BEFORE the apply barrier, advanced in
+                    # commit-ts order (batches barrier FIFO); max() so
+                    # a concurrent move's watermark bump never regresses
+                    self._snapshot_ts = max(
+                        self._snapshot_ts, m.commit_ts
+                    )
+                    self.zero.zero.applied(m.commit_ts)
+                    if m.error is None:
+                        ok += 1
+                for m in committed:
+                    self.mem.invalidate(m.txn.cache.deltas.keys())
+                if ok:
+                    METRICS.inc("num_commits", ok)
+                    self.serving.on_commit()  # ONE epoch bump per batch
+
+        return barrier
+
+    def _commit_pool(self):
+        """Bounded executor for pipelined commit proposals. Lazy, and
+        only ever touched from a batch leader's propose phase — which
+        runs under _commit_lock — so creation cannot race."""
+        pool = self._commit_prop_pool
+        if pool is None:
+            import concurrent.futures
+
+            pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="commitprop"
+            )
+            self._commit_prop_pool = pool
+        return pool
 
     def _feed_stats(self, deltas):
         """Index-key posting counts into the selectivity sketch — the
@@ -356,8 +541,9 @@ class ProcCluster:
             if self.intents is not None:
                 self.intents.mark_done(commit_ts)
         finally:
-            # watermark BEFORE the apply barrier (batcher snapshot key)
-            self._snapshot_ts = commit_ts
+            # watermark BEFORE the apply barrier (batcher snapshot key);
+            # max() guards concurrent watermark bumps (moves)
+            self._snapshot_ts = max(self._snapshot_ts, commit_ts)
             self.zero.zero.applied(commit_ts)
             self.mem.invalidate(txn.cache.deltas.keys())
         return commit_ts
@@ -451,8 +637,9 @@ class ProcCluster:
 
     def _move_bump_snapshot(self):
         # routing changed outside the applied barrier: advance the
-        # batcher watermark past every in-flight read_ts
-        self._snapshot_ts = self.zero.zero.next_ts()
+        # batcher watermark past every in-flight read_ts (max()-guarded
+        # like every other watermark writer)
+        self._snapshot_ts = max(self._snapshot_ts, self.zero.zero.next_ts())
 
     def move_tablet(self, pred: str, dst_group: int):
         """Cross-process phased predicate move (ref
@@ -552,10 +739,16 @@ class ProcCluster:
                         time.monotonic() + self.serving.degrade_budget_s()
                     )
                 t_parsed = time.perf_counter()
+                # snapshot-watermark read (ref worker/oracle
+                # MaxAssigned): the watermark is published only after a
+                # commit batch's proposals are applied, and advances in
+                # commit-ts order — reads at it skip the fresh-lease +
+                # apply-barrier wait that serialized reads behind the
+                # write pipeline (see api/server.py query)
                 ts = (
                     read_ts
                     if read_ts is not None
-                    else self.zero.zero.read_ts()
+                    else (self._snapshot_ts or self.zero.zero.read_ts())
                 )
                 t_ts = time.perf_counter()
                 cache = LocalCache(kv, ts, mem=self.mem)

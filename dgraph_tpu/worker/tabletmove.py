@@ -454,6 +454,15 @@ class TabletMover:
                 faults.syncpoint("move.copy", pred)
                 # phase 2: bounded fence
                 with self.c._commit_lock:
+                    # group commit pipelines proposals past its propose
+                    # phase (which holds the commit lock we now own):
+                    # wait out every proposal already in flight, or the
+                    # delta catch-up below could pass a key an airborne
+                    # commit then lands on — destroyed by the source
+                    # drop
+                    gc = getattr(self.c, "_group_commit", None)
+                    if gc is not None:
+                        gc.drain()
                     with METRICS.timer("tablet_move_fence_seconds"):
                         zero.move_fence(pred)
                         faults.syncpoint("move.fence", pred)

@@ -171,6 +171,27 @@ _define(
     "thresholds (query/dispatch.py) — benchmarking hook.",
 )
 _define(
+    "GROUP_COMMIT", "bool", True,
+    "Group-commit write pipeline (worker/groupcommit.py): concurrent "
+    "committers coalesce into batches that share ONE oracle verdict "
+    "exchange and ONE bounded raft proposal per owning group, with the "
+    "snapshot watermark advanced in commit-ts order. 0 restores the "
+    "serial per-txn commit path byte-for-byte (the A/B escape hatch).",
+)
+_define(
+    "GROUP_COMMIT_MAX_TXNS", "int", 64,
+    "Cap on transactions coalesced into one commit batch "
+    "(worker/groupcommit.py); excess committers form the next batch.",
+)
+_define(
+    "GROUP_COMMIT_WINDOW_US", "int", 200,
+    "Extra microseconds a commit-batch leader waits for more "
+    "committers to arrive — only while an earlier batch's apply "
+    "barrier is still in flight (an idle engine always commits "
+    "immediately, like the PR 7 batcher's natural batching). 0 "
+    "disables the wait; batches still form from whatever is queued.",
+)
+_define(
     "LAMBDA_URL", "str", "",
     "GraphQL @lambda resolver endpoint; the alpha CLI superflag takes "
     "precedence (graphql/resolve.py).",

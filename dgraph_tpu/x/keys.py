@@ -49,32 +49,43 @@ def _prefix(tag: int, nsattr: bytes) -> bytes:
     return struct.pack(">BH", tag, len(nsattr)) + nsattr
 
 
+# key-kind prefix cache: the (tag, ns, attr, kind) head of a key is
+# attr-constant, and the mutation path builds several keys per edge —
+# re-packing the prefix each time was measurable on the live write
+# path. Bounded by a wholesale clear (attrs are few; a clear only
+# costs re-derivation).
+_PFX_CACHE: dict = {}
+
+
+def _kind_prefix(kind: int, attr: str, ns: int) -> bytes:
+    ck = (kind, attr, ns)
+    p = _PFX_CACHE.get(ck)
+    if p is None:
+        if len(_PFX_CACHE) > 8192:
+            _PFX_CACHE.clear()
+        p = _PFX_CACHE[ck] = (
+            _prefix(TAG_DEFAULT, namespace_attr(ns, attr)) + bytes([kind])
+        )
+    return p
+
+
 def DataKey(attr: str, uid: int, ns: int = GALAXY_NS) -> bytes:
-    return (
-        _prefix(TAG_DEFAULT, namespace_attr(ns, attr))
-        + bytes([KIND_DATA])
-        + struct.pack(">Q", uid)
-    )
+    return _kind_prefix(KIND_DATA, attr, ns) + struct.pack(">Q", uid)
 
 
 def ReverseKey(attr: str, uid: int, ns: int = GALAXY_NS) -> bytes:
-    return (
-        _prefix(TAG_DEFAULT, namespace_attr(ns, attr))
-        + bytes([KIND_REVERSE])
-        + struct.pack(">Q", uid)
-    )
+    return _kind_prefix(KIND_REVERSE, attr, ns) + struct.pack(">Q", uid)
 
 
 def IndexKey(attr: str, term: bytes, ns: int = GALAXY_NS) -> bytes:
     if isinstance(term, str):
         term = term.encode("utf-8")
-    return _prefix(TAG_DEFAULT, namespace_attr(ns, attr)) + bytes([KIND_INDEX]) + term
+    return _kind_prefix(KIND_INDEX, attr, ns) + term
 
 
 def CountKey(attr: str, count: int, reverse: bool = False, ns: int = GALAXY_NS) -> bytes:
     return (
-        _prefix(TAG_DEFAULT, namespace_attr(ns, attr))
-        + bytes([KIND_COUNT])
+        _kind_prefix(KIND_COUNT, attr, ns)
         + struct.pack(">I", count)
         + (b"\x01" if reverse else b"\x00")
     )

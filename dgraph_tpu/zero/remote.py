@@ -41,13 +41,14 @@ class RemoteZero:
         got = self.pool.call(addr, "zero.state", timeout=2.0)
         return json.loads(got.state_json)
 
-    def _exec(self, kind: str, *args, timeout: float = 15.0):
+    def _exec(self, kind: str, *args, timeout: float = 15.0, batch=None):
         """Leader-routed Zero op. Runs under the ambient deadline (see
         conn/retry.py), retries with full-jitter backoff instead of a
         fixed 50ms sleep, and sends `idem=True`: a reconnect-and-resend
         of a lease/commit/abort dedupes in the server's idempotency LRU
         rather than re-proposing (a double-applied commit could flip a
-        verdict; a double-applied lease leaks a block)."""
+        verdict; a double-applied lease leaks a block). `batch` carries
+        the typed ZeroCommitBatch body of the batched commit op."""
         dl = effective_deadline(timeout)
         last = "no zero leader"
         attempt = 0
@@ -70,6 +71,7 @@ class RemoteZero:
                             args_json=json.dumps(
                                 {"args": list(args), "timeout": wait_s}
                             ).encode(),
+                            commit_batch=batch,
                         ),
                         timeout=wait_s + 3.0,
                         idem=True,
@@ -109,19 +111,23 @@ class RemoteZero:
         return self._exec("lease_ts", count)
 
     def begin_txn(self) -> int:
+        # waits out in-flight commits below the start ts, like
+        # read_ts(): a txn snapshot must be complete or SSI misses the
+        # lost update (see zero/zero.py begin_txn)
+        from dgraph_tpu.zero.zero import wait_applied_below
+
         ts = self.next_ts()
-        with self._lock:
+        with self._cv:
             self._active.add(ts)
+            wait_applied_below(self._cv, self._pending, ts)
         return ts
 
     def read_ts(self) -> int:
+        from dgraph_tpu.zero.zero import wait_applied_below
+
         ts = self.next_ts()
         with self._cv:
-            deadline = 30.0
-            while self._pending and min(self._pending) < ts and deadline > 0:
-                t0 = time.monotonic()
-                self._cv.wait(timeout=min(1.0, deadline))
-                deadline -= time.monotonic() - t0
+            wait_applied_below(self._cv, self._pending, ts)
         return ts
 
     def assign_uids(self, count: int) -> int:
@@ -163,6 +169,32 @@ class RemoteZero:
             if track:
                 self._pending.add(commit_ts)
         return commit_ts
+
+    def commit_batch(self, items, track: bool = False):
+        """ONE zero.exec round trip deciding N txns (the group-commit
+        oracle exchange): verdicts come back per member, so an aborted
+        member never fails its batchmates. The batch body rides typed
+        (conn/messages.ZeroCommitBatch), not through args_json."""
+        from dgraph_tpu.conn.messages import ZeroCommitBatch, ZeroCommitReq
+
+        batch = ZeroCommitBatch(
+            txns=[
+                ZeroCommitReq(
+                    start_ts=int(s),
+                    cks=sorted(int(c) for c in cks),
+                )
+                for s, cks in items
+            ]
+        )
+        verdicts = self._exec("commit_batch", batch=batch)
+        with self._lock:
+            for (s, _), v in zip(items, verdicts):
+                self._active.discard(int(s))
+                if int(v[1]):
+                    self._floor = max(self._floor, int(v[1]))
+                if v[0] == "commit" and track:
+                    self._pending.add(int(v[1]))
+        return [tuple(v) for v in verdicts]
 
     def applied(self, commit_ts: int):
         with self._cv:

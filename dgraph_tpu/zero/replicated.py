@@ -85,21 +85,21 @@ class ZeroStateMachine:
             return first
         if kind == "commit":
             start_ts, cks = args
-            prior = self.txn_verdicts.get(start_ts)
-            if prior is not None:
-                return prior
-            if start_ts in self.aborted:
-                return ("abort", 0)
-            for ck in cks:
-                if self.commits.get(ck, 0) > start_ts:
-                    self.aborted.add(start_ts)
-                    return self._record_verdict(
-                        start_ts, ("abort", self.commits[ck])
-                    )
-            self.max_ts += 1
-            for ck in cks:
-                self.commits[ck] = self.max_ts
-            return self._record_verdict(start_ts, ("commit", self.max_ts))
+            return self._commit_one(start_ts, cks)
+        if kind == "commit_batch":
+            # ONE consensus round deciding N txns, verdicts per member
+            # (an aborted member never fails its batchmates). Members
+            # decide in list order — the serial order back-to-back
+            # "commit" ops would have produced — and each verdict is
+            # recorded in txn_verdicts, so a member re-proposed solo
+            # (or in a different batch) after a lost ack replays its
+            # original verdict instead of re-running conflict checks.
+            (batch,) = args
+            items = batch["b"] if isinstance(batch, dict) else batch
+            return [
+                self._commit_one(int(start_ts), cks)
+                for start_ts, cks in items
+            ]
         if kind == "abort":
             (start_ts,) = args
             self.aborted.add(start_ts)
@@ -162,6 +162,25 @@ class ZeroStateMachine:
             }
             return ("ok",)
         raise ValueError(f"unknown zero op {kind!r}")
+
+    def _commit_one(self, start_ts: int, cks) -> tuple:
+        """Deterministic per-txn commit-or-abort (shared by the solo
+        and batched ops)."""
+        prior = self.txn_verdicts.get(start_ts)
+        if prior is not None:
+            return prior
+        if start_ts in self.aborted:
+            return ("abort", 0)
+        for ck in cks:
+            if self.commits.get(ck, 0) > start_ts:
+                self.aborted.add(start_ts)
+                return self._record_verdict(
+                    start_ts, ("abort", self.commits[ck])
+                )
+        self.max_ts += 1
+        for ck in cks:
+            self.commits[ck] = self.max_ts
+        return self._record_verdict(start_ts, ("commit", self.max_ts))
 
     def _record_verdict(self, start_ts: int, verdict: tuple) -> tuple:
         self.txn_verdicts[start_ts] = verdict
@@ -325,19 +344,23 @@ class ReplicatedZero:
         return self._propose("lease_ts", count)
 
     def begin_txn(self) -> int:
+        # waits out in-flight commits below the start ts, like
+        # read_ts(): a txn snapshot must be complete or SSI misses the
+        # lost update (see zero/zero.py begin_txn)
+        from dgraph_tpu.zero.zero import wait_applied_below
+
         ts = self.next_ts()
-        with self._lock:
+        with self._cv:
             self._active.add(ts)
+            wait_applied_below(self._cv, self._pending, ts)
         return ts
 
     def read_ts(self) -> int:
+        from dgraph_tpu.zero.zero import wait_applied_below
+
         ts = self.next_ts()
         with self._cv:
-            deadline = 30.0
-            while self._pending and min(self._pending) < ts and deadline > 0:
-                t0 = time.monotonic()
-                self._cv.wait(timeout=min(1.0, deadline))
-                deadline -= time.monotonic() - t0
+            wait_applied_below(self._cv, self._pending, ts)
         return ts
 
     def assign_uids(self, count: int) -> int:
@@ -381,6 +404,29 @@ class ReplicatedZero:
             except TimeoutError:
                 pass
         return commit_ts
+
+    def commit_batch(self, items, track: bool = False):
+        """ONE consensus round deciding N txns (group commit): returns
+        a ("commit", ts) / ("abort", last_ts) verdict per member in
+        order — an aborted member never fails its batchmates."""
+        payload = [
+            [int(s), sorted(int(c) for c in cks)] for s, cks in items
+        ]
+        verdicts = self._propose("commit_batch", {"b": payload})
+        with self._lock:
+            for (s, _), v in zip(items, verdicts):
+                self._active.discard(int(s))
+                if int(v[1]):
+                    self._floor = max(self._floor, int(v[1]))
+                if v[0] == "commit" and track:
+                    self._pending.add(int(v[1]))
+            floor = min(self._active) if self._active else None
+        if floor is not None:
+            try:
+                self._propose("gc", floor, timeout=1.0)
+            except TimeoutError:
+                pass
+        return [tuple(v) for v in verdicts]
 
     def applied(self, commit_ts: int):
         with self._cv:

@@ -111,11 +111,29 @@ class ZeroProcess:
         with self._apply_cv:
             self._req_id += 1
             rid = self._req_id
-        args = a.get("args") or []
-        # JSON round-trip turns tuples/ints-as-keys; normalize args
-        args = [
-            [int(x) for x in v] if isinstance(v, list) else v for v in args
-        ]
+        if (
+            isinstance(m, ZeroExec)
+            and m.commit_batch is not None
+            and m.commit_batch.txns
+        ):
+            # typed batched-commit body (group commit): the nested
+            # (start_ts, cks-list) shape never rides args_json, so the
+            # scalar-list normalizer below can't mangle it
+            args = [
+                {
+                    "b": [
+                        [int(t.start_ts), [int(c) for c in t.cks]]
+                        for t in m.commit_batch.txns
+                    ]
+                }
+            ]
+        else:
+            args = a.get("args") or []
+            # JSON round-trip turns tuples/ints-as-keys; normalize args
+            args = [
+                [int(x) for x in v] if isinstance(v, list) else v
+                for v in args
+            ]
         op = (kind, self.node_id, rid, *args)
         if not self.raft.propose(op):
             return {"not_leader": True, "hint": self.raft.leader_id}

@@ -1,0 +1,675 @@
+"""Group-commit write pipeline tests (worker/groupcommit.py).
+
+Unit layer: batched oracle verdicts (per-txn isolation, serial-order
+equivalence, idempotent replay under resend), native delta-encode and
+bulk-tokenizer byte-equality against the Python encoders, batched
+apply_edges equivalence against the per-edge path, the
+DGRAPH_TPU_GROUP_COMMIT=0 escape hatch restoring the serial commit
+path byte-for-byte through the public commit API, watermark
+monotonicity under concurrent pipelined commits, per-member fence
+bounces, and write admission costing.
+
+Cluster layer (marked `chaos`): a fixed-seed drop+delay+disconnect
+schedule plus a replica crash while concurrent committers drive the
+bank workload through group commit on a real multi-process cluster —
+balances stay ledger-exact, an aborted batch member never aborts its
+batchmates, and acked transfers apply exactly once.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.conn import faults
+from dgraph_tpu.conn.faults import FaultPlan
+from dgraph_tpu.posting.pl import (
+    OP_DEL,
+    OP_SET,
+    Posting,
+    encode_delta,
+    encode_deltas,
+)
+from dgraph_tpu.types.types import TypeID, Val
+from dgraph_tpu.utils.observe import METRICS
+from dgraph_tpu.x import config
+from dgraph_tpu.zero.zero import TxnConflictError, ZeroLite
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# batched oracle verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_zerolite_commit_batch_verdicts_match_serial_order():
+    """Batch members decide in list order — exactly what back-to-back
+    commit() calls produce: a later same-key member whose start_ts
+    predates an earlier member's commit aborts; disjoint keys commit."""
+    z = ZeroLite()
+    t1, t2, t3 = z.begin_txn(), z.begin_txn(), z.begin_txn()
+    v = z.commit_batch([(t1, {0xA}), (t2, {0xA}), (t3, {0xB})], track=True)
+    assert v[0][0] == "commit" and v[2][0] == "commit"
+    assert v[1] == ("abort", v[0][1])  # isolated: batchmates unharmed
+    assert v[2][1] == v[0][1] + 1  # consecutive commit timestamps
+    # tracked members are pending until applied
+    for verdict in (v[0], v[2]):
+        z.applied(verdict[1])
+
+
+def test_zero_sm_commit_batch_is_idempotent_on_replay():
+    """A batch re-proposed with a fresh request id (lost ack), or one
+    member re-proposed SOLO through the plain commit op, replays the
+    recorded verdicts instead of re-running conflict detection."""
+    from dgraph_tpu.zero.replicated import ZeroStateMachine
+
+    sm = ZeroStateMachine()
+    sm.max_ts = 7  # starts 5/6/7 were leased
+    batch = {"b": [[5, [10]], [6, [10]], [7, [11]]]}
+    out = sm.apply(("commit_batch", 1, 1, batch))
+    assert [o[0] for o in out] == ["commit", "abort", "commit"]
+    # same batch, fresh req id: identical verdicts, no new timestamps
+    out2 = sm.apply(("commit_batch", 1, 2, batch))
+    assert [tuple(v) for v in out2] == [tuple(v) for v in out]
+    # solo replay of one member through the old op: recorded verdict
+    assert sm.apply(("commit", 1, 3, 6, [10])) == tuple(out[1])
+    assert sm.apply(("commit", 1, 4, 5, [10])) == tuple(out[0])
+
+
+def test_zero_commit_batch_wire_roundtrip():
+    """The typed ZeroCommitBatch body survives the zero.exec encode/
+    decode — u64 conflict fingerprints intact."""
+    from dgraph_tpu.conn.messages import (
+        ZeroCommitBatch,
+        ZeroCommitReq,
+        ZeroExec,
+    )
+
+    big = (1 << 64) - 3
+    e = ZeroExec(
+        op="commit_batch",
+        args_json=b"{}",
+        commit_batch=ZeroCommitBatch(
+            txns=[
+                ZeroCommitReq(start_ts=9, cks=[1, big]),
+                ZeroCommitReq(start_ts=10, cks=[]),
+            ]
+        ),
+    )
+    d = ZeroExec.decode(e.encode())
+    assert d.op == "commit_batch"
+    assert d.commit_batch.txns[0].start_ts == 9
+    assert d.commit_batch.txns[0].cks == [1, big]
+    assert d.commit_batch.txns[1].start_ts == 10
+
+
+# ---------------------------------------------------------------------------
+# native mutation kernels: byte-equality
+# ---------------------------------------------------------------------------
+
+
+def _random_posting(rng):
+    if rng.random() < 0.5:
+        return Posting(
+            uid=rng.getrandbits(64) or 1,
+            op=rng.choice([OP_SET, OP_DEL]),
+        )
+    return Posting(
+        uid=rng.getrandbits(64) or 1,
+        op=rng.choice([OP_SET, OP_DEL]),
+        value=bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 48))),
+        value_type=TypeID(rng.choice([0, 1, 2, 9])),
+    )
+
+
+def test_native_delta_encode_byte_equality_randomized():
+    """encode_deltas (ONE native enc_delta_records call for the whole
+    write set) is byte-identical to per-key encode_delta over a
+    randomized corpus; rich shapes (lang/facets) fall back per key."""
+    rng = random.Random(1234)
+    deltas = {}
+    for k in range(300):
+        deltas[b"key%d" % k] = [
+            _random_posting(rng) for _ in range(rng.randint(1, 7))
+        ]
+    got = dict(encode_deltas(deltas))
+    want = {k: encode_delta(p) for k, p in deltas.items()}
+    assert got == want
+    # rich shapes: the whole set falls back, still byte-identical
+    deltas[b"lang"] = [
+        Posting(uid=3, lang="en", value=b"x", value_type=TypeID(9))
+    ]
+    deltas[b"facets"] = [
+        Posting(uid=4, facets={"f": b"1"}, facet_types={"f": TypeID(1)})
+    ]
+    got = dict(encode_deltas(deltas))
+    assert got == {k: encode_delta(p) for k, p in deltas.items()}
+    # edge shapes: empty value vs no value are distinct records
+    deltas2 = {b"e": [Posting(uid=1, value=b"", value_type=TypeID(9))]}
+    assert dict(encode_deltas(deltas2)) == {
+        b"e": encode_delta(deltas2[b"e"])
+    }
+
+
+def test_native_term_tokens_byte_equality_randomized():
+    """tok_terms_ascii matches the Python TermTokenizer byte-for-byte
+    over adversarial ASCII input (case, digits, quotes, underscores,
+    duplicates, empties, punctuation runs)."""
+    from dgraph_tpu import native
+    from dgraph_tpu.tok.tok import get_tokenizer
+
+    if not native.NATIVE_AVAILABLE:
+        pytest.skip("native library unavailable")
+    term = get_tokenizer("term")
+    rng = random.Random(99)
+    import string
+
+    alpha = string.ascii_letters + string.digits + "_' .,;:-!?@#\t\r\n"
+    vals = [
+        "".join(rng.choice(alpha) for _ in range(rng.randint(0, 80)))
+        for _ in range(400)
+    ]
+    vals += ["", " ", "A A a", "don't STOP Don't", "__x__ 'y' z9"]
+    got = native.tok_terms_ascii(
+        [v.encode() for v in vals], term.identifier
+    )
+    for v, toks in zip(vals, got):
+        assert toks == term.tokens(Val(TypeID.STRING, v)), v
+
+
+# ---------------------------------------------------------------------------
+# batched apply_edges equivalence
+# ---------------------------------------------------------------------------
+
+_APPLY_SCHEMA = (
+    "name: string @index(exact, term) .\n"
+    "age: int @index(int) .\n"
+    "city: string .\n"
+    "tag: [string] @index(exact) .\n"
+    "knows: [uid] @reverse .\n"
+    "boss: uid @reverse .\n"
+    "bio: string @index(fulltext) @lang .\n"
+    "upname: string @index(exact) @upsert .\n"
+)
+
+
+def _random_edges(rng, n):
+    from dgraph_tpu.posting.mutation import DirectedEdge
+
+    edges = []
+    for _ in range(n):
+        ent = rng.randint(1, 12)
+        kind = rng.random()
+        if kind < 0.35:
+            edges.append(
+                DirectedEdge(
+                    ent, rng.choice(["name", "city", "upname"]),
+                    value=Val(
+                        TypeID.STRING,
+                        f"Val {rng.randint(0, 6)} x{rng.randint(0, 3)}",
+                    ),
+                    op=OP_SET,
+                    fresh=bool(rng.random() < 0.3),
+                )
+            )
+        elif kind < 0.5:
+            edges.append(
+                DirectedEdge(
+                    ent, "age", value=Val(TypeID.INT, rng.randint(0, 90)),
+                    op=OP_SET,
+                )
+            )
+        elif kind < 0.65:
+            edges.append(
+                DirectedEdge(
+                    ent, rng.choice(["knows", "boss"]),
+                    value_id=rng.randint(1, 12), op=OP_SET,
+                )
+            )
+        elif kind < 0.75:
+            edges.append(
+                DirectedEdge(
+                    ent, "tag",
+                    value=Val(TypeID.STRING, f"t{rng.randint(0, 4)}"),
+                    op=rng.choice([OP_SET, OP_DEL]),
+                )
+            )
+        elif kind < 0.85:
+            edges.append(
+                DirectedEdge(
+                    ent, "bio",
+                    value=Val(TypeID.STRING, "some Bio text here"),
+                    lang=rng.choice(["", "en"]), op=OP_SET,
+                )
+            )
+        else:
+            edges.append(
+                DirectedEdge(
+                    ent, "name",
+                    value=Val(TypeID.STRING, f"Val {rng.randint(0, 6)}"),
+                    op=OP_DEL,
+                )
+            )
+    return edges
+
+
+def test_apply_edges_equivalent_to_per_edge_loop():
+    """apply_edges (fast classes + bulk reads + native tokens) produces
+    a store byte-identical to the per-edge apply_edge loop, over
+    randomized mixed batches including shared keys, deletes, langs,
+    list values, uid/reverse edges and upsert preds."""
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.posting.mutation import apply_edge, apply_edges
+
+    rng = random.Random(4242)
+    for round_ in range(6):
+        edges_spec = _random_edges(rng, rng.randint(2, 24))
+        dumps = []
+        for mode in ("batched", "per_edge"):
+            s = Server()
+            s.alter(_APPLY_SCHEMA)
+            t = s.new_txn()
+            if mode == "batched":
+                apply_edges(t.txn, s.schema, edges_spec)
+            else:
+                for e in edges_spec:
+                    apply_edge(t.txn, s.schema, e)
+            # per-key delta postings must MERGE identically; record
+            # bytes can differ only in intra-key ordering where the
+            # batch reorders commute — compare the merged visible state
+            t.commit()
+            q = s.query(
+                '{ q(func: has(name)) { uid name age city tag '
+                "knows { uid } boss { uid } bio } }"
+            )
+            dumps.append(q["data"])
+        assert dumps[0] == dumps[1], f"round {round_}: {edges_spec}"
+
+
+# ---------------------------------------------------------------------------
+# group commit through the public API
+# ---------------------------------------------------------------------------
+
+
+def _mk_server():
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter(
+        "name: string @index(exact) .\n"
+        "bal: int @upsert .\n"
+        "knows: [uid] @reverse .\n"
+    )
+    return s
+
+
+def test_concurrent_committers_coalesce_and_commit():
+    s = _mk_server()
+    base_batches = METRICS.value("group_commit_total")
+    base_txns = METRICS.value("group_commit_txns_total")
+    errs = []
+
+    def w(i):
+        try:
+            t = s.new_txn()
+            t.mutate_json(
+                set_obj={"uid": "_:x", "name": f"gc{i}",
+                         "knows": [{"uid": "0x1"}]},
+                commit_now=True,
+            )
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    ths = [threading.Thread(target=w, args=(i,)) for i in range(32)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert not errs
+    out = s.query('{ q(func: has(name)) { name } }')
+    assert len(out["data"]["q"]) == 32
+    assert METRICS.value("group_commit_txns_total") - base_txns >= 32
+    assert METRICS.value("group_commit_total") - base_batches >= 1
+    # pipeline fully drained: no outstanding barrier
+    assert METRICS.value("commit_pipeline_depth") == 0
+    s._group_commit.drain()  # returns immediately when drained
+
+
+def test_batch_conflict_aborts_only_the_loser():
+    """Two txns racing the same @upsert key through group commit: one
+    commits, the other gets TxnConflictError — and an unrelated txn in
+    the same window always commits (per-member verdict isolation)."""
+    s = _mk_server()
+    t0 = s.new_txn()
+    t0.mutate_json(set_obj={"uid": "0x100", "bal": 5}, commit_now=True)
+    results = []
+    start = threading.Barrier(3)
+
+    def contender(v):
+        t = s.new_txn()
+        t.mutate_json(set_obj={"uid": "0x100", "bal": v})
+        start.wait()
+        try:
+            t.commit()
+            results.append("ok")
+        except TxnConflictError:
+            results.append("abort")
+
+    def bystander():
+        t = s.new_txn()
+        t.mutate_json(set_obj={"uid": "0x200", "name": "safe"})
+        start.wait()
+        t.commit()
+        results.append("bystander_ok")
+
+    ths = [
+        threading.Thread(target=contender, args=(1,)),
+        threading.Thread(target=contender, args=(2,)),
+        threading.Thread(target=bystander),
+    ]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert sorted(results) == ["abort", "bystander_ok", "ok"], results
+    out = s.query('{ q(func: eq(name, "safe")) { name } }')
+    assert out["data"]["q"] == [{"name": "safe"}]
+
+
+def test_escape_hatch_restores_serial_path_byte_for_byte(monkeypatch):
+    """DGRAPH_TPU_GROUP_COMMIT=0 through the public commit API: the
+    coalescer is never even constructed, and the stored KV bytes match
+    a group-commit engine's byte-for-byte for the same single-threaded
+    mutation sequence."""
+    import dgraph_tpu.worker.groupcommit as gcmod
+
+    def run(mode):
+        config.set_env("GROUP_COMMIT", mode)
+        try:
+            s = _mk_server()
+            for i in range(12):
+                t = s.new_txn()
+                t.mutate_json(
+                    set_obj={
+                        "uid": f"_:n{i}",
+                        "name": f"user{i}",
+                        "knows": [{"uid": "0x1"}],
+                    },
+                    commit_now=True,
+                )
+            try:
+                t = s.new_txn()
+                t.mutate_json(set_obj={"uid": "0x100", "bal": 1})
+                t2 = s.new_txn()
+                t2.mutate_json(set_obj={"uid": "0x100", "bal": 2})
+                t.commit()
+                t2.commit()
+            except TxnConflictError:
+                pass  # same conflict either way
+            return s.kv.dump_bytes()
+        finally:
+            config.unset_env("GROUP_COMMIT")
+
+    on = run(1)
+
+    def _boom(*a, **k):  # the serial path must never touch the coalescer
+        raise AssertionError("GroupCommit constructed with hatch off")
+
+    monkeypatch.setattr(gcmod.GroupCommit, "__init__", _boom)
+    off = run(0)
+    assert on == off
+
+
+def test_watermark_advances_in_commit_ts_order():
+    """Under concurrent pipelined commits the snapshot watermark only
+    ever advances (the micro-batcher's snapshot-grouping proof depends
+    on monotonicity)."""
+    s = _mk_server()
+    stop = threading.Event()
+    samples = [0]
+    bad = []
+
+    def sampler():
+        last = 0
+        while not stop.is_set():
+            cur = s._snapshot_ts
+            if cur < last:
+                bad.append((last, cur))
+            last = cur
+            samples[0] += 1
+            time.sleep(0.0005)
+
+    def writer(base):
+        for i in range(40):
+            t = s.new_txn()
+            t.mutate_json(
+                set_obj={"uid": "_:w", "name": f"w{base}-{i}"},
+                commit_now=True,
+            )
+
+    sam = threading.Thread(target=sampler)
+    ws = [threading.Thread(target=writer, args=(b,)) for b in range(4)]
+    sam.start()
+    for w in ws:
+        w.start()
+    for w in ws:
+        w.join()
+    stop.set()
+    sam.join()
+    assert not bad, f"watermark went backwards: {bad[:3]}"
+    assert samples[0] > 0
+    # every commit is visible at the final watermark
+    out = s.query('{ q(func: has(name)) { name } }')
+    assert len(out["data"]["q"]) == 160
+
+
+def test_fence_bounce_is_per_member_and_retryable():
+    """A batch member touching a fenced (moving) tablet bounces with
+    the retryable TabletFencedError BEFORE the oracle; its batchmates
+    commit normally."""
+    from dgraph_tpu.worker.groups import DistributedCluster
+    from dgraph_tpu.worker.tabletmove import TabletFencedError
+
+    c = DistributedCluster(n_groups=1, replicas=1)
+    try:
+        c.alter("pa: string @index(exact) .\npb: string @index(exact) .")
+        c.zero._fenced.add("pa")
+        start = threading.Barrier(2)
+        out = {}
+
+        def fenced_writer():
+            t = c.new_txn()
+            t.mutate_rdf(set_rdf='<0x1> <pa> "x" .')
+            start.wait()
+            try:
+                t.commit()
+                out["fenced"] = "committed"
+            except TabletFencedError as e:
+                out["fenced"] = ("bounced", getattr(e, "retryable", None))
+
+        def clean_writer():
+            t = c.new_txn()
+            t.mutate_rdf(set_rdf='<0x2> <pb> "y" .')
+            start.wait()
+            out["clean"] = t.commit()
+
+        ths = [
+            threading.Thread(target=fenced_writer),
+            threading.Thread(target=clean_writer),
+        ]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert out["fenced"] == ("bounced", True)
+        assert isinstance(out["clean"], int)
+        got = c.query('{ q(func: eq(pb, "y")) { pb } }')
+        assert got["data"]["q"] == [{"pb": "y"}]
+        # the fence lifted: the bounced member's retry succeeds
+        c.zero._fenced.discard("pa")
+        t = c.new_txn()
+        t.mutate_rdf(set_rdf='<0x1> <pa> "x" .', commit_now=True)
+    finally:
+        c.close()
+
+
+def test_admission_costs_writes():
+    """With admission on and the budget consumed, a commit sheds with
+    the retryable TooManyRequestsError; releasing the budget lets the
+    retry through (the write-side half of the admission contract)."""
+    from dgraph_tpu.serving import TooManyRequestsError
+
+    s = _mk_server()
+    config.set_env("ADMISSION", 1)
+    config.set_env("MAX_INFLIGHT", 4)
+    try:
+        hog = s.serving.admit_write(10_000)  # swallows the budget
+        t = s.new_txn()
+        t.mutate_json(set_obj={"uid": "_:a", "name": "shedme"})
+        with pytest.raises(TooManyRequestsError):
+            t.commit()
+        s.serving.release_write(hog)
+        t2 = s.new_txn()
+        t2.mutate_json(
+            set_obj={"uid": "_:a", "name": "shedme"}, commit_now=True
+        )
+        out = s.query('{ q(func: eq(name, "shedme")) { name } }')
+        assert out["data"]["q"] == [{"name": "shedme"}]
+    finally:
+        config.unset_env("ADMISSION")
+        config.unset_env("MAX_INFLIGHT")
+
+
+# ---------------------------------------------------------------------------
+# chaos: concurrent committers through group commit under faults
+# ---------------------------------------------------------------------------
+
+N_ACCOUNTS = 8
+START_BAL = 100
+
+
+@pytest.mark.chaos
+def test_chaos_group_commit_bank_fixed_seed():
+    """Fixed-seed drop+delay+disconnect across the RPC plane plus a
+    replica crash+restart while FOUR concurrent committers drive bank
+    transfers through group commit on a real multi-process cluster:
+
+      - balances stay ledger-exact (sum conserved at every check);
+      - an acked transfer applies exactly once (idempotent replay
+        under resend — proposals ride idem keys, verdicts are
+        recorded per txn);
+      - a conflict abort never takes down batchmates (the other
+        writers' acked transfers all land);
+      - TimeoutError acks are AMBIGUOUS (may or may not have applied)
+        and are excluded from the exact-ledger claim, like the
+        serial-path chaos bank.
+    """
+    from dgraph_tpu.worker.harness import ProcCluster
+
+    c = ProcCluster(n_groups=1, replicas=3)
+    plan = None
+    try:
+        c.alter("bal: int @upsert .")
+        rdf = []
+        for i in range(1, N_ACCOUNTS + 1):
+            rdf.append(f'<0x{i:x}> <bal> "{START_BAL}"^^<xs:int> .')
+        c.new_txn().mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+        plan = faults.install(
+            FaultPlan(
+                seed=777,
+                rules=[
+                    dict(point="send", action="drop", p=0.04),
+                    dict(point="send", action="delay", p=0.10, delay_ms=4),
+                    dict(point="send", action="disconnect", p=0.02),
+                ],
+            )
+        )
+        applied_lock = threading.Lock()
+        applied = []  # (frm, to, amt) for every ACKED transfer
+        ambiguous = [0]
+
+        def reader_balance(uid):
+            out = c.query("{ q(func: has(bal)) { uid bal } }")
+            for row in out["data"]["q"]:
+                if int(row["uid"], 16) == uid:
+                    return row["bal"]
+            return None
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(6):
+                frm, to = (
+                    int(x) + 1
+                    for x in rng.choice(N_ACCOUNTS, 2, replace=False)
+                )
+                amt = int(rng.integers(1, 9))
+                for _attempt in range(6):
+                    t = c.new_txn()
+                    try:
+                        # read-modify-write on @upsert keys: real
+                        # conflicts under concurrency
+                        bf = t.txn.cache.value(
+                            _bal_key(frm)
+                        )
+                        bt = t.txn.cache.value(_bal_key(to))
+                        bfv = int(bf.value) if bf else START_BAL
+                        btv = int(bt.value) if bt else START_BAL
+                        t.mutate_rdf(
+                            set_rdf=(
+                                f'<0x{frm:x}> <bal> "{bfv - amt}"'
+                                f"^^<xs:int> .\n"
+                                f'<0x{to:x}> <bal> "{btv + amt}"'
+                                f"^^<xs:int> ."
+                            ),
+                        )
+                        t.commit()
+                        with applied_lock:
+                            applied.append((frm, to, amt))
+                        break
+                    except TxnConflictError:
+                        continue  # not applied: retry cleanly
+                    except TimeoutError:
+                        ambiguous[0] += 1
+                        break
+
+        def _bal_key(uid):
+            from dgraph_tpu.x import keys as _k
+
+            return _k.DataKey("bal", uid)
+
+        ths = [
+            threading.Thread(target=worker, args=(s,)) for s in range(4)
+        ]
+        for t in ths:
+            t.start()
+        # crash one replica mid-traffic and bring it back (process
+        # SIGKILL — the group's raft quorum keeps serving)
+        time.sleep(0.4)
+        victim = next(iter(c.procs))
+        c.kill(victim)
+        time.sleep(0.3)
+        c.restart(victim)
+        for t in ths:
+            t.join()
+        faults.reset()
+        out = c.query("{ q(func: has(bal)) { uid bal } }")
+        bals = {
+            int(x["uid"], 16): x["bal"] for x in out["data"]["q"]
+        }
+        assert sum(bals.values()) == N_ACCOUNTS * START_BAL, (
+            bals, applied, ambiguous,
+        )
+        assert METRICS.value("group_commit_txns_total") > 0
+    finally:
+        faults.reset()
+        if plan is not None:
+            plan.heal()
+        c.close()

@@ -76,9 +76,14 @@ def test_packed_setops_corpus_under_ubsan():
             # threaded vec_qi8_topk_lists CSR scan, and the
             # vec_qi8_quantize row quantizer) through adversarial
             # scales, duplicates, tombstones, empty/aliased slices
+            # test_group_commit drives the mutation write-path kernels
+            # (enc_delta_records batched record serialization over the
+            # randomized posting corpus incl. 0-length and max-u64
+            # values, tok_terms_ascii over adversarial ASCII) through
+            # their byte-equality suites
             "tests/test_packed_setops.py", "tests/test_uidpack.py",
             "tests/test_bitmap_setops.py", "tests/test_stream_encoder.py",
-            "tests/test_vector_quant.py",
+            "tests/test_vector_quant.py", "tests/test_group_commit.py",
             "-q", "-m", "not slow", "-p", "no:cacheprovider",
         ],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=900,

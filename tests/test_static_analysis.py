@@ -493,6 +493,22 @@ def test_abi_covers_encoder_exports():
         assert len(exports[name][1]) == len(native.DECLS[name][1]), name
 
 
+def test_abi_covers_mutation_kernel_exports():
+    """The write-path mutation kernels are parsed from codec.cpp and
+    covered by DECLS (regression guard: a missing restype on the
+    int64-returning encoders is the memory-corruption class)."""
+    from dgraph_tpu import native
+
+    with open(
+        os.path.join(REPO, "dgraph_tpu", "native", "codec.cpp")
+    ) as f:
+        exports = check_ctypes_abi.parse_cpp_exports(f.read())
+    for name in ("enc_delta_records", "tok_terms_ascii"):
+        assert name in exports, name
+        assert name in native.DECLS, name
+        assert len(exports[name][1]) == len(native.DECLS[name][1]), name
+
+
 def test_abi_covers_adaptive_engine_exports():
     """The real adaptive-engine entry points are parsed from codec.cpp
     and covered by DECLS (regression guard for the new kernels)."""

@@ -37,10 +37,14 @@ else
         tests/test_serving_front.py \
         tests/test_stream_encoder.py \
         tests/test_vector_quant.py \
+        tests/test_group_commit.py \
         -q -p no:cacheprovider
 
     echo "== qps loadgen sanity (~5s) =="
     python benchmarks/qps_loadgen.py --sanity
+
+    echo "== qps loadgen write sanity (~5s) =="
+    python benchmarks/qps_loadgen.py --write-sanity
 
     echo "== encode microbench sanity (~5s) =="
     python bench.py --encode-sanity
