@@ -440,14 +440,17 @@ def _bench_obs(platform, fanout=100, pool=200_000):
 
     s, q, edges, load_s = _build_fanout_graph(fanout, pool)
 
-    def run_mode(trace: bool, sample: float, sink: str = ""):
+    def run_mode(trace: bool, sample: float, sink: str = "", env=None,
+                 reps: int = 5):
         config.set_env("TRACE", trace)
         config.set_env("TRACE_SAMPLE", sample)
+        for k, v in (env or {}).items():
+            config.set_env(k, v)
         observe.TRACER.set_sink(sink or None)
         try:
             s.query(q)  # warm caches under the mode's settings
             best = float("inf")
-            for _ in range(5):
+            for _ in range(reps):
                 t0 = time.perf_counter()
                 s.query(q)
                 best = min(best, time.perf_counter() - t0)
@@ -456,6 +459,8 @@ def _bench_obs(platform, fanout=100, pool=200_000):
             observe.TRACER.set_sink(None)
             config.unset_env("TRACE")
             config.unset_env("TRACE_SAMPLE")
+            for k in env or {}:
+                config.unset_env(k)
 
     sink_path = os.path.join(
         tempfile.mkdtemp(prefix="dgraph_obs_bench_"), "spans.jsonl"
@@ -464,6 +469,31 @@ def _bench_obs(platform, fanout=100, pool=200_000):
     unsampled_ms = run_mode(trace=True, sample=0.0)
     sampled_ms = run_mode(trace=True, sample=1.0, sink=sink_path)
     overhead_pct = (unsampled_ms - off_ms) / off_ms * 100.0
+
+    # per-tablet traffic accounting + exemplars A/B (both always-on by
+    # default): the telemetry plane's acceptance gate requires the
+    # always-on arm within 1% of accounting-off, asserted in-capture —
+    # interleaved best-of-9 pairs so minute-scale box drift cancels
+    observe.TABLETS.clear()
+    acct_off_ms = float("inf")
+    acct_on_ms = float("inf")
+    for _ in range(9):
+        acct_off_ms = min(acct_off_ms, run_mode(
+            trace=True, sample=0.0,
+            env={"TABLET_TRAFFIC": 0, "EXEMPLARS": 0}, reps=1,
+        ))
+        acct_on_ms = min(acct_on_ms, run_mode(
+            trace=True, sample=0.0,
+            env={"TABLET_TRAFFIC": 1, "EXEMPLARS": 1}, reps=1,
+        ))
+    assert observe.TABLETS.snapshot(), "accounting arm recorded nothing"
+    acct_overhead_pct = (acct_on_ms - acct_off_ms) / acct_off_ms * 100.0
+    assert acct_overhead_pct <= 1.0, (
+        f"always-on traffic accounting + exemplars cost "
+        f"{acct_overhead_pct:.2f}% on fanout_3level_1M "
+        f"(on {acct_on_ms:.2f}ms vs off {acct_off_ms:.2f}ms); "
+        f"the telemetry-plane gate requires <= 1%"
+    )
 
     # raw JSONL sink throughput: how many spans/s the exporter absorbs
     n_spans = 20_000
@@ -483,6 +513,15 @@ def _bench_obs(platform, fanout=100, pool=200_000):
                 "tracing_off_ms": round(off_ms, 2),
                 "fully_sampled_ms": round(sampled_ms, 2),
                 "unsampled_overhead_pct": round(overhead_pct, 2),
+            },
+        ),
+        (
+            "fanout_3level_1M_accounting",
+            round(acct_on_ms, 2),
+            {
+                "unit": "ms",
+                "accounting_off_ms": round(acct_off_ms, 2),
+                "overhead_pct": round(acct_overhead_pct, 2),
             },
         ),
         (
@@ -506,6 +545,11 @@ def _bench_obs(platform, fanout=100, pool=200_000):
                 "fully_sampled_jsonl": round(sampled_ms, 2),
             },
             "unsampled_overhead_pct": round(overhead_pct, 2),
+            "traffic_accounting_ms": {
+                "accounting_off": round(acct_off_ms, 2),
+                "accounting_on": round(acct_on_ms, 2),
+                "overhead_pct": round(acct_overhead_pct, 2),
+            },
             "jsonl_sink_spans_per_s": round(sink_spans_per_s),
             "graph": {"edges": edges, "load_seconds": round(load_s, 1)},
         },
@@ -868,8 +912,74 @@ def _bench_chaos(platform):
     )
 
 
+def _explain_sanity():
+    """The ~5s CI gate for the EXPLAIN surface (tools/check.sh
+    --explain-sanity): debug on/off byte-equality over the DQL golden
+    smoke subset, schema validation of every captured plan, and one
+    rendered-plan snapshot through the CLI renderer."""
+    import os as _os
+
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.cli import render_plan
+
+    here = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), "tests", "ref_golden"
+    )
+    cases = json.load(open(_os.path.join(here, "cases.json")))[::9]
+    s = Server()
+    s.alter(open(_os.path.join(here, "schema.txt")).read())
+    for rdf in ("triples.rdf", "triples_facets.rdf"):
+        t = s.new_txn()
+        t.mutate_rdf(
+            set_rdf=open(_os.path.join(here, rdf)).read(),
+            commit_now=True,
+        )
+
+    def data_bytes(d):
+        raw = getattr(d, "raw", None)
+        return (
+            bytes(raw)
+            if raw is not None
+            else json.dumps(d, sort_keys=True).encode()
+        )
+
+    checked = planned = 0
+    for case in cases:
+        q = case["query"]
+        try:
+            plain = data_bytes(s.query(q, want="raw")["data"])
+        except Exception:
+            continue  # error queries covered by tests/test_explain.py
+        res = s.query(q, want="raw", debug=True)
+        assert data_bytes(res["data"]) == plain, case["id"]
+        plan = res["extensions"]["plan"]
+        assert isinstance(plan["nodes"], list), case["id"]
+        checked += 1
+        planned += bool(plan["nodes"])
+    assert checked >= 30, f"only {checked} smoke cases executed"
+    # one rendered-plan snapshot: the renderer's contract lines
+    res = s.query(
+        "{ q(func: has(name)) { name friend { uid } } }", debug=True
+    )
+    out = render_plan(res["extensions"]["plan"])
+    assert out.startswith("Query plan (wall "), out
+    assert "\n  plan cache: " in out and "\n  admission: " in out, out
+    assert "friend level=1 [batched]" in out, out
+    print(
+        json.dumps(
+            {
+                "explain_sanity": "OK",
+                "cases_checked": checked,
+                "cases_with_plan_nodes": planned,
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    if "--chaos-only" in sys.argv:
+    if "--explain-sanity" in sys.argv:
+        _explain_sanity()
+    elif "--chaos-only" in sys.argv:
         # host-only capture: no device involved in the RPC plane
         _bench_chaos("cpu")
     elif "--fanout-only" in sys.argv:

@@ -152,18 +152,55 @@ def client_queries(rng_state: int, zipf_s: float = 0.0):
         i += 1
 
 
+# every BENCH_QPS row stamps these per-run deltas so rows are
+# self-describing: what the serving front actually did during the
+# measurement window, not just the latency it produced
+_ROW_COUNTERS = (
+    "admission_shed_total", "admission_degraded_total",
+    "degraded_queries_total", "batch_coalesced_total",
+    "plan_cache_hit_total", "plan_cache_miss_total",
+    "group_commit_total", "group_commit_txns_total",
+    "mutation_edges_total", "num_commits",
+)
+
+
+def metric_base() -> dict:
+    """Counter + batch-width-histogram snapshot before a measurement
+    window (pair with stamp_metric_deltas)."""
+    from dgraph_tpu.utils.observe import METRICS
+
+    base = {k: METRICS.value(k) for k in _ROW_COUNTERS}
+    base["_gc_sum"], base["_gc_count"] = METRICS.hist_stats(
+        "group_commit_batch_size"
+    )
+    return base
+
+
+def stamp_metric_deltas(row: dict, base: dict) -> dict:
+    """Fold the window's metric deltas into a bench row: raw counter
+    deltas (minus the _total suffix), the plan-cache hit RATE, and the
+    REALIZED group-commit batch width (histogram sum/count delta)."""
+    from dgraph_tpu.utils.observe import METRICS
+
+    for k in _ROW_COUNTERS:
+        row[k.replace("_total", "")] = int(METRICS.value(k) - base[k])
+    looked = row["plan_cache_hit"] + row["plan_cache_miss"]
+    row["plan_cache_hit_rate"] = (
+        round(row["plan_cache_hit"] / looked, 4) if looked else 0.0
+    )
+    s, c = METRICS.hist_stats("group_commit_batch_size")
+    dc = c - base["_gc_count"]
+    row["group_commit_batch_width"] = (
+        round((s - base["_gc_sum"]) / dc, 2) if dc else 0.0
+    )
+    return row
+
+
 def run_point(server, clients: int, seconds: float, warmup: float,
               zipf_s: float = 0.0):
     """One closed-loop measurement point. Returns the row dict."""
     from dgraph_tpu.conn.retry import RetryPolicy, retrying_call
     from dgraph_tpu.serving import TooManyRequestsError
-    from dgraph_tpu.utils.observe import METRICS
-
-    counters = (
-        "batch_coalesced_total", "plan_cache_hit_total",
-        "plan_cache_miss_total", "admission_shed_total",
-        "admission_degraded_total",
-    )
     lat_lock = threading.Lock()
     lats: list = []
     sheds = [0]
@@ -215,7 +252,7 @@ def run_point(server, clients: int, seconds: float, warmup: float,
     time.sleep(warmup)
     with lat_lock:
         lats.clear()
-    base = {k: METRICS.value(k) for k in counters}
+    base = metric_base()
     shed0 = sheds[0]
     t_start = time.perf_counter()
     time.sleep(seconds)
@@ -237,9 +274,7 @@ def run_point(server, clients: int, seconds: float, warmup: float,
         ),
         "shed": sheds[0] - shed0,
     }
-    for k in counters:
-        row[k.replace("_total", "")] = int(METRICS.value(k) - base[k])
-    return row
+    return stamp_metric_deltas(row, base)
 
 
 def _pct(done, q):
@@ -265,14 +300,8 @@ def run_mixed_point(server, clients: int, seconds: float, warmup: float,
     edge into the existing graph, one commit per txn through the public
     txn API. Readers run the Zipfian hot-shape stream. Returns the row
     dict with read/write stats split out."""
-    from dgraph_tpu.utils.observe import METRICS
     from dgraph_tpu.zero.zero import TxnConflictError
 
-    counters = (
-        "group_commit_total", "group_commit_txns_total",
-        "mutation_edges_total", "num_commits",
-        "plan_cache_hit_total", "admission_shed_total",
-    )
     writers = min(max(1, round(clients * write_ratio)), clients - 1)
     lat_lock = threading.Lock()
     rlats: list = []
@@ -342,7 +371,7 @@ def run_mixed_point(server, clients: int, seconds: float, warmup: float,
     with lat_lock:
         rlats.clear()
         wlats.clear()
-    base = {k: METRICS.value(k) for k in counters}
+    base = metric_base()
     t_start = time.perf_counter()
     time.sleep(seconds)
     stop.set()
@@ -367,9 +396,7 @@ def run_mixed_point(server, clients: int, seconds: float, warmup: float,
         "read_p99_ms": _pct(rd, 0.99),
         "errors": errors[0],
     }
-    for k in counters:
-        row[k.replace("_total", "")] = int(METRICS.value(k) - base[k])
-    return row
+    return stamp_metric_deltas(row, base)
 
 
 _WRITE_SEQ_LOCK = threading.Lock()

@@ -86,6 +86,13 @@ class DgraphServicer:
             if request.mutations:
                 return self._do_mutations(request, resp, t0)
             variables = dict(request.vars) if request.vars else None
+            # EXPLAIN/ANALYZE over gRPC: a reserved "debug" entry in
+            # Request.vars (stripped before parse — it is a transport
+            # flag, not a query variable) turns on plan capture
+            debug = False
+            if variables is not None:
+                debug = variables.pop("debug", "") in ("true", "1")
+                variables = variables or None
             if request.resp_format == pb.Request.RDF:
                 resp.rdf = self.engine.query_rdf(
                     request.query, variables=variables
@@ -95,7 +102,8 @@ class DgraphServicer:
                 return resp
             if request.read_only:
                 out = self.engine.query(
-                    request.query, variables=variables, want="raw"
+                    request.query, variables=variables, want="raw",
+                    debug=debug,
                 )
                 resp.txn.start_ts = 0
             else:
@@ -116,6 +124,11 @@ class DgraphServicer:
             # blocks, the txn path) dump as before
             rawb = getattr(d, "raw", None)
             resp.json = rawb if rawb is not None else json.dumps(d).encode()
+            plan = (out.get("extensions") or {}).get("plan")
+            if debug and plan is not None:
+                # the EXPLAIN plan rides the hdrs side channel so the
+                # Json payload stays byte-identical to a non-debug run
+                resp.hdrs.append("plan=" + json.dumps(plan))
         except Exception as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         resp.latency.total_ns = time.monotonic_ns() - t0

@@ -123,6 +123,36 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply({"spans": merged_traces(200)})
             else:
                 self._reply({"spans": TRACER.recent(200)})
+        elif path == "/debug/tablets":
+            from dgraph_tpu.utils.observe import TABLETS
+
+            # cluster engines merge every alpha's traffic rows (plus
+            # unreachable_instances); single-process engines serve the
+            # local accumulator
+            merged_tablets = getattr(self.engine, "merged_tablets", None)
+            if merged_tablets is not None:
+                self._reply(merged_tablets())
+            else:
+                TABLETS.publish()
+                self._reply({"tablets": TABLETS.snapshot()})
+        elif path == "/debug/healthz":
+            from dgraph_tpu.utils import observe
+
+            health = getattr(self.engine, "health", None)
+            self._reply(health() if health is not None else observe.healthz())
+        elif path == "/debug/openmetrics":
+            from dgraph_tpu.utils.observe import METRICS
+
+            data = METRICS.render_openmetrics().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8",
+            )
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
         elif path == "/debug/prometheus_metrics":
             from dgraph_tpu.utils.observe import METRICS
 
@@ -207,6 +237,10 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 raw = self._body().decode("utf-8")
                 variables = None
+                # EXPLAIN/ANALYZE: ?debug=true (the reference's debug
+                # query param) or a "debug": true JSON body field turns
+                # on plan capture; data bytes are unchanged by it
+                debug = qs.get("debug", ["false"])[0] == "true"
                 if "json" in self.headers.get("Content-Type", ""):
                     body = json.loads(raw)
                     if not isinstance(body, dict):
@@ -215,6 +249,9 @@ class _Handler(BaseHTTPRequestHandler):
                     variables = body.get("variables")
                     if variables is not None and not isinstance(variables, dict):
                         raise ValueError('"variables" must be an object')
+                    # accept only explicit truthy spellings: a client
+                    # sending the STRING "false" must not enable debug
+                    debug = body.get("debug", debug) in (True, "true", "1")
                 timeout_ms = None
                 if qs.get("timeout"):
                     t = qs["timeout"][0]  # "5s" / "500ms" (ref ?timeout=)
@@ -230,6 +267,7 @@ class _Handler(BaseHTTPRequestHandler):
                     # serving surface: data stays wire bytes end-to-end
                     # (no dict parse-back; _reply splices the arena)
                     want="raw",
+                    debug=debug,
                 )
                 # keep the engine's server_latency/profile/trace_id and
                 # stamp the HTTP-layer total on top (reference envelope)

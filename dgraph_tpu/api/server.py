@@ -1021,7 +1021,28 @@ class Server:
         flush()
         return {k[2:]: hex(v) for k, v in blank.items()}
 
+    # -- observability ----------------------------------------------------------
+
+    def health(self) -> dict:
+        """Single-node health/SLO rollup (/debug/healthz body): the
+        process healthz (admission rates, pipeline depth, SLO burn
+        windows) plus this engine's snapshot-watermark lag. No raft
+        groups here — the cluster engines report those."""
+        from dgraph_tpu.utils import observe
+
+        out = observe.healthz("alpha")
+        out["snapshot_watermark"] = int(self._snapshot_ts)
+        ma = getattr(self.zero, "max_assigned", None)
+        if isinstance(ma, (int, float)):
+            out["watermark_lag"] = max(0, int(ma) - self._snapshot_ts)
+        return out
+
     # -- queries ----------------------------------------------------------------
+
+    def _plan_cache_tiers(self) -> Dict[str, float]:
+        from dgraph_tpu.posting.lists import cache_tier_snapshot
+
+        return cache_tier_snapshot(self.mem)
 
     def query(
         self,
@@ -1031,6 +1052,7 @@ class Server:
         variables: Optional[Dict[str, str]] = None,
         timeout_ms: Optional[float] = None,
         want: str = "dict",
+        debug: bool = False,
     ) -> dict:
         """Run a read-only query at a fresh (or given) read ts.
         timeout_ms bounds execution (ref x/limits --query timeout).
@@ -1041,12 +1063,19 @@ class Server:
 
         `want="raw"` skips the dict-API parse-back: `data` comes back
         as a streamjson.RawJson byte shell for response assembly to
-        splice (the HTTP/gRPC serving surface)."""
+        splice (the HTTP/gRPC serving surface).
+
+        `debug=True` (EXPLAIN/ANALYZE — HTTP ?debug=true, gRPC
+        Request.vars["debug"]) turns on the decision-capture hooks and
+        attaches the structured plan tree as `extensions.plan`. Capture
+        is observation-only: response `data` bytes are identical with
+        the flag on or off (golden-enforced, tests/test_explain.py)."""
         import time as _time
 
         t_begin = _time.monotonic()
+        parse_info: Optional[dict] = {} if debug else None
         # plan cache: repeated query shapes skip parse entirely
-        blocks, shape = self.serving.parse(q, variables)
+        blocks, shape = self.serving.parse(q, variables, info=parse_info)
         t_parsed = _time.monotonic()
         # admission gate BEFORE the read-ts allocation: a shed must be
         # FAST and side-effect-free — under overload the oracle's
@@ -1121,8 +1150,9 @@ class Server:
                 else (self._snapshot_ts or self.zero.read_ts())
             )
             t_assigned = _time.monotonic()
+            cache_base = self._plan_cache_tiers() if debug else None
             with TRACER.span("query", ns=ns) as root, \
-                    profile_scope() as prof, \
+                    profile_scope(debug=debug) as prof, \
                     METRICS.timer("query_latency_seconds"):
                 try:
                     cache = LocalCache(self.kv, ts, mem=self.mem)
@@ -1182,6 +1212,25 @@ class Server:
             if total_ns > 0 and prof.encode:
                 prof.encode["share"] = round(enc_ns / total_ns, 4)
             ext["profile"] = prof.to_dict()
+            if prof.plan is not None:
+                prof.plan.plan_cache = parse_info or {}
+                prof.plan.admission = {
+                    "enabled": self.serving.admission.enabled(),
+                    "cost": round(ticket.cost, 3),
+                    "degrade": ticket.degrade,
+                }
+                if cache_base is not None:
+                    now_tiers = self._plan_cache_tiers()
+                    prof.plan.cache = {
+                        k: now_tiers[k] - cache_base.get(k, 0)
+                        for k in now_tiers
+                    }
+                prof.plan.meta = {
+                    "read_ts": int(ts),
+                    "snapshot_watermark": int(self._snapshot_ts),
+                    "wall_ns": total_ns,
+                }
+                ext["plan"] = prof.plan.to_dict()
             if root.trace_id:
                 ext["trace_id"] = f"{root.trace_id:032x}"
             if ticket.degrade:

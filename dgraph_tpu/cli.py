@@ -416,6 +416,255 @@ def cmd_metrics(args):
     return 0
 
 
+def render_plan(plan: dict) -> str:
+    """Human-readable EXPLAIN rendering of an extensions.plan tree:
+    one indented line per (predicate, level) node with uids in/out,
+    read strategy, wall time, and kernel counts, preceded by the
+    query-level decisions (plan cache, admission, cache tiers,
+    micro-batching, set-op routing). Pure — unit-tested against a
+    captured plan (tests/test_explain.py)."""
+    lines = []
+    wall = plan.get("wall_ns")
+    head = "Query plan"
+    if wall is not None:
+        head += f" (wall {wall / 1e6:.2f}ms"
+        if "read_ts" in plan:
+            head += f", read_ts {plan['read_ts']}"
+        if "snapshot_watermark" in plan:
+            head += f", watermark {plan['snapshot_watermark']}"
+        head += ")"
+    lines.append(head)
+    pc = plan.get("plan_cache") or {}
+    if pc:
+        if not pc.get("enabled", True):
+            lines.append("  plan cache: disabled")
+        else:
+            shape = pc.get("shape")
+            lines.append(
+                "  plan cache: %s%s"
+                % (
+                    "HIT" if pc.get("hit") else "MISS",
+                    f'  shape="{shape}"' if shape else "",
+                )
+            )
+    adm = plan.get("admission") or {}
+    if adm:
+        lines.append(
+            "  admission: cost %s (%s%s)"
+            % (
+                adm.get("cost"),
+                "gate on" if adm.get("enabled") else "gate off",
+                ", degraded" if adm.get("degrade") else "",
+            )
+        )
+    cache = plan.get("cache") or {}
+    if cache:
+        lines.append(
+            "  cache: %d memlayer hits / %d misses, "
+            "%d batch reads (%d keys), %d point reads"
+            % (
+                cache.get("memlayer_hits", 0),
+                cache.get("memlayer_misses", 0),
+                cache.get("batch_reads", 0),
+                cache.get("batch_read_keys", 0),
+                cache.get("point_reads", 0),
+            )
+        )
+    mb = plan.get("microbatch") or {}
+    if mb.get("coalesced") or mb.get("solo"):
+        lines.append(
+            "  microbatch: %d coalesced (max width %d) / %d solo"
+            % (
+                mb.get("coalesced", 0),
+                mb.get("members_max", 0),
+                mb.get("solo", 0),
+            )
+        )
+    setops = plan.get("setops") or []
+    if setops:
+        packed = sum(1 for s in setops if s.get("verdict") == "packed")
+        lines.append(
+            "  setops: %d decisions, %d packed / %d decoded%s"
+            % (
+                len(setops),
+                packed,
+                len(setops) - packed,
+                (
+                    f" ({plan['setops_dropped']} dropped)"
+                    if plan.get("setops_dropped")
+                    else ""
+                ),
+            )
+        )
+
+    def walk(node, depth):
+        kern = node.get("kernels") or {}
+        kern_s = ""
+        if kern:
+            kern_s = " kernels{%s}" % ", ".join(
+                f"{k}={int(v)}" for k, v in sorted(kern.items())
+            )
+        if node.get("read") == "root":
+            lines.append(
+                "  %s%s (root%s) -> %d uids"
+                % (
+                    "  " * depth,
+                    node.get("attr"),
+                    f" func={node['func']}" if node.get("func") else "",
+                    node.get("uids_out", 0),
+                )
+            )
+        else:
+            lines.append(
+                "  %s%s level=%d [%s] %d -> %d uids, %.2fms%s"
+                % (
+                    "  " * depth,
+                    node.get("attr"),
+                    node.get("level", 0),
+                    node.get("read", "?"),
+                    node.get("uids_in", 0),
+                    node.get("uids_out", 0),
+                    node.get("wall_ns", 0) / 1e6,
+                    kern_s,
+                )
+            )
+        for c in node.get("children", ()):
+            walk(c, depth + 1)
+
+    for root in plan.get("nodes", ()):
+        walk(root, 0)
+    return "\n".join(lines)
+
+
+def cmd_explain(args):
+    """EXPLAIN/ANALYZE a query: run it with debug=true against a
+    running alpha (--addr) or a local data dir (-p) and render the
+    extensions.plan tree as an indented plan."""
+    query = args.query
+    if query == "-":
+        query = sys.stdin.read()
+    if args.addr:
+        import urllib.request
+
+        req = urllib.request.Request(
+            args.addr.rstrip("/") + "/query?debug=true",
+            data=query.encode("utf-8"),
+            method="POST",
+            headers={"Content-Type": "application/dql"},
+        )
+        try:
+            res = json.loads(
+                urllib.request.urlopen(req, timeout=args.timeout).read()
+            )
+        except Exception as e:
+            print(f"query against {args.addr} failed: {e}", file=sys.stderr)
+            return 1
+        if res.get("errors"):
+            print(json.dumps(res["errors"], indent=2), file=sys.stderr)
+            return 1
+    else:
+        from dgraph_tpu.api.server import Server
+
+        server = Server(data_dir=args.p)
+        res = server.query(query, debug=True)
+    plan = (res.get("extensions") or {}).get("plan")
+    if plan is None:
+        print("no extensions.plan in the response", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(plan, indent=2, sort_keys=True))
+    else:
+        print(render_plan(plan))
+    return 0
+
+
+def _render_health(h: dict) -> str:
+    lines = [
+        "status: %s  (instance %s, pid %s, up %.0fs)"
+        % (
+            h.get("status", "?"), h.get("instance", "?"),
+            h.get("pid", "?"), h.get("uptime_s", 0),
+        )
+    ]
+    if "snapshot_watermark" in h:
+        lag = h.get("watermark_lag")
+        lines.append(
+            "watermark: %s%s"
+            % (
+                h["snapshot_watermark"],
+                f" (lag {lag})" if lag is not None else "",
+            )
+        )
+    adm = h.get("admission") or {}
+    lines.append(
+        "admission: %d in flight, %d shed, %d degraded"
+        % (
+            adm.get("inflight", 0), adm.get("shed_total", 0),
+            adm.get("degraded_queries_total", 0),
+        )
+    )
+    lines.append(
+        "commit pipeline depth: %d" % h.get("commit_pipeline_depth", 0)
+    )
+    for gid, g in sorted((h.get("groups") or {}).items()):
+        reps = []
+        for nid, r in sorted(g.get("replicas", {}).items()):
+            if not r.get("ok"):
+                reps.append(f"{nid}:DOWN")
+            else:
+                tag = "*" if r.get("is_leader") else ""
+                lag = r.get("applied_lag", 0)
+                reps.append(
+                    f"{nid}{tag}@{r.get('applied', 0)}"
+                    + (f"(-{lag})" if lag else "")
+                )
+        lines.append(
+            "group %s: %s  [%s]"
+            % (
+                gid,
+                "leader=%s" % g.get("leader")
+                if g.get("healthy")
+                else "NO LEADER",
+                " ".join(reps),
+            )
+        )
+    for name, rep in sorted((h.get("slo") or {}).items()):
+        wins = rep.get("windows", {})
+        burn = ", ".join(
+            f"{w}={v.get('burn_rate')}" for w, v in sorted(wins.items())
+        )
+        lines.append(
+            "slo %s (<=%sms @ %s): burn %s"
+            % (name, rep.get("threshold_ms"), rep.get("target"), burn)
+        )
+    unreachable = h.get("unreachable_instances")
+    if unreachable:
+        lines.append("unreachable: " + ", ".join(unreachable))
+    return "\n".join(lines)
+
+
+def cmd_health(args):
+    """Scrape + print the cluster health/SLO rollup of a running alpha
+    (/debug/healthz: per-group raft leadership and applied-index lag,
+    snapshot-watermark lag, commit pipeline depth, admission shed and
+    degraded rates, multi-window SLO burn rates)."""
+    import urllib.request
+
+    url = args.addr.rstrip("/") + "/debug/healthz"
+    try:
+        h = json.loads(
+            urllib.request.urlopen(url, timeout=args.timeout).read()
+        )
+    except Exception as e:
+        print(f"scrape of {url} failed: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(h, indent=2, sort_keys=True))
+    else:
+        print(_render_health(h))
+    return 0
+
+
 def cmd_metrics_ref(args):
     """Regenerate (or print) the METRICS.md metric-name reference."""
     from dgraph_tpu.utils import observe
@@ -632,6 +881,41 @@ def main(argv=None):
     )
     p.add_argument("--timeout", type=float, default=5.0)
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "explain",
+        help="EXPLAIN/ANALYZE a query: run with debug=true and render "
+        "the plan tree",
+    )
+    p.add_argument("query", help="DQL query text ('-' reads stdin)")
+    p.add_argument(
+        "--addr", default="",
+        help="base URL of a running alpha (default: run locally "
+        "against -p / in-memory)",
+    )
+    add_p(p)
+    p.add_argument(
+        "--json", action="store_true",
+        help="raw extensions.plan JSON instead of the rendered tree",
+    )
+    p.add_argument("--timeout", type=float, default=15.0)
+    p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser(
+        "health",
+        help="scrape + print the cluster health/SLO rollup "
+        "(/debug/healthz) of a running alpha",
+    )
+    p.add_argument(
+        "--addr", default="http://127.0.0.1:8080",
+        help="base URL of the alpha HTTP endpoint",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="raw healthz JSON instead of the rendered summary",
+    )
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_health)
 
     p = sub.add_parser(
         "metrics-ref",

@@ -55,6 +55,18 @@ class ReadCounters:
 READ_COUNTERS = ReadCounters()
 
 
+def cache_tier_snapshot(mem=None) -> dict:
+    """Cache-tier counter snapshot for the EXPLAIN `cache` block (one
+    shared mapping — the entry points diff two of these around a debug
+    query). Process-wide counters: under concurrent queries a delta
+    attributes a class of work, not an exact per-query count."""
+    out = READ_COUNTERS.snapshot()
+    if mem is not None:
+        out["memlayer_hits"] = mem.hits
+        out["memlayer_misses"] = mem.misses
+    return out
+
+
 class LocalCache:
     """Per-txn read-through cache with uncommitted delta overlay.
 

@@ -27,6 +27,7 @@ from dgraph_tpu.posting.pl import (
 from dgraph_tpu.schema.schema import SchemaUpdate, State
 from dgraph_tpu.tok.tok import build_tokens
 from dgraph_tpu.types.types import TypeID, Val, convert, to_binary
+from dgraph_tpu.utils import observe
 from dgraph_tpu.x import keys
 
 
@@ -97,6 +98,11 @@ def apply_edge(
             else (edge.value.tid if edge.value else TypeID.DEFAULT)
         )
         su = st.ensure_default(edge.attr, tid)
+
+    # per-tablet traffic accounting (the rebalancer's mutation signal);
+    # fast-path edges in apply_edges are counted there instead
+    if observe.tablet_traffic_enabled():
+        observe.TABLETS.note_write(edge.ns, edge.attr, 1)
 
     data_key = keys.DataKey(edge.attr, edge.entity, edge.ns)
     cache = txn.cache
@@ -249,8 +255,16 @@ def apply_edges(
     fastset = set(fast)
     add_delta = txn.cache.add_delta
     add_ck = txn.add_conflict_key
+    # fast-path edges never reach apply_edge (which counts itself):
+    # aggregate their per-tablet traffic here, one note per predicate
+    traffic = observe.tablet_traffic_enabled()
+    wcounts: dict = {}
     for i, (e, su, dk, cls) in enumerate(infos):
         if i in fastset:
+            if traffic:
+                wcounts[(e.ns, e.attr)] = (
+                    wcounts.get((e.ns, e.attr), 0) + 1
+                )
             sv = stored[i]
             add_delta(
                 dk,
@@ -268,6 +282,10 @@ def apply_edges(
                 if su.upsert:
                     add_ck(ikey)
         elif cls == 2 and dk not in key_mixed:
+            if traffic:
+                wcounts[(e.ns, e.attr)] = (
+                    wcounts.get((e.ns, e.attr), 0) + 1
+                )
             # fast list-uid SET: no reads, append-only postings — the
             # same deltas _apply_uid_edge produces for this shape
             add_delta(dk, Posting(uid=e.value_id, op=OP_SET))
@@ -281,6 +299,8 @@ def apply_edges(
                 add_ck(rk, str(e.entity).encode())
         else:
             apply_edge(txn, st, e, update_schema)
+    for (ns, attr), n in wcounts.items():
+        observe.TABLETS.note_write(ns, attr, n)
 
 
 def _bulk_tokens(infos, fast, stored) -> dict:

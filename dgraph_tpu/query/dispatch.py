@@ -262,7 +262,43 @@ class SetOpDispatcher:
         and the pair clears the selectivity crossover (ratio 1 — always —
         when the native block engine is in); None -> caller takes the
         decoded dense path. Fallback candidate spans route back through
-        run_pairs, so big spans still hit the vmapped device kernels."""
+        run_pairs, so big spans still hit the vmapped device kernels.
+
+        Debug-mode queries capture the decision inputs (operand sizes,
+        packed-ness, the PACKED_MIN_RATIO gate, the verdict) into the
+        EXPLAIN plan — see _note_plan_pair."""
+        got = self._try_packed_inner(op, a, b)
+        self._note_plan_pair(op, a, b, got is not None)
+        return got
+
+    def _note_plan_pair(self, op: str, a, b, packed: bool) -> None:
+        from dgraph_tpu.utils.observe import current_plan
+
+        plan = current_plan()
+        if plan is None:
+            return
+        a_packed = isinstance(a, PackedOperand)
+        b_packed = isinstance(b, PackedOperand)
+        plan.note_setop(
+            {
+                "site": "pair",
+                "op": op,
+                "a": int(len(a)),
+                "b": int(len(b)),
+                "a_packed": a_packed,
+                "b_packed": b_packed,
+                # a packed operand whose decode is memoized takes the
+                # dense path regardless of the ratio (sunk cost)
+                "decode_sunk": bool(
+                    (not a_packed or a._uids is not None)
+                    and (not b_packed or b._uids is not None)
+                ),
+                "min_ratio": int(self.packed_min_ratio()),
+                "verdict": "packed" if packed else "decoded",
+            }
+        )
+
+    def _try_packed_inner(self, op: str, a, b) -> Optional[np.ndarray]:
         if all(
             not isinstance(x, PackedOperand) or x._uids is not None
             for x in (a, b)

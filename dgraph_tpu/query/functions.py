@@ -103,6 +103,24 @@ class FuncRunner:
             0 < est < DISPATCHER.packed_min_ratio() * max(1, len(src))
         ):
             pop = self.cache.packed_operand(key)
+        from dgraph_tpu.utils.observe import current_plan
+
+        plan = current_plan()
+        if plan is not None:
+            # EXPLAIN: the StatsHolder-fed whole-operand route pick at
+            # the index-intersect hot path (the cost-based planner's
+            # future input): sketch estimate vs the ratio gate, and
+            # whether a packed operand was actually available
+            plan.note_setop(
+                {
+                    "site": "index_intersect",
+                    "attr": attr,
+                    "stats_estimate": int(est),
+                    "src": int(len(src)),
+                    "min_ratio": int(DISPATCHER.packed_min_ratio()),
+                    "verdict": "packed" if pop is not None else "decoded",
+                }
+            )
         if pop is None:
             return np.intersect1d(
                 self.cache.uids(key), src, assume_unique=True

@@ -37,7 +37,13 @@ from dgraph_tpu.query.functions import (
 )
 from dgraph_tpu.schema.schema import State
 from dgraph_tpu.types.types import TypeID, Val, compare_vals, convert
-from dgraph_tpu.utils.observe import METRICS, TRACER, current_profile
+from dgraph_tpu.utils import observe
+from dgraph_tpu.utils.observe import (
+    METRICS,
+    TRACER,
+    current_plan,
+    current_profile,
+)
 from dgraph_tpu.x import config, keys
 
 # ---------------------------------------------------------------------------
@@ -549,6 +555,28 @@ class Executor:
             else:
                 node.dest_uids = self._order_and_paginate(gq, node.dest_uids)
 
+        plan = current_plan()
+        if plan is not None:
+            # the block's root node anchors the plan tree: level-1
+            # children link to it by ExecNode identity. uids_out is the
+            # post-order/pagination root set (@cascade pruning happens
+            # later and is reflected in the children's own counts).
+            fn = gq.func
+            plan.note_node(
+                {
+                    "id": id(node),
+                    "parent": None,
+                    "attr": gq.attr or "(block)",
+                    "level": 0,
+                    "func": fn.name if fn is not None else None,
+                    "uids_in": 0,
+                    "uids_out": int(len(node.dest_uids)),
+                    "read": "root",
+                    "wall_ns": 0,
+                    "kernels": {},
+                }
+            )
+
         if gq.var_name:
             self.uid_vars[gq.var_name] = node.dest_uids
 
@@ -782,22 +810,71 @@ class Executor:
                 for u, x in prop.items()
             }
 
-    def _record_level_task(
-        self, attr: str, parent: ExecNode, parents: int, t0: float
-    ) -> None:
-        """Attribute one (predicate, level) task to the active query
-        profile; level = depth of the parent chain (root reads are 1)."""
-        prof = current_profile()
-        if prof is None:
-            return
+    @staticmethod
+    def _level_of(parent: ExecNode) -> int:
+        """Depth of the parent chain (root reads are level 1)."""
         level = 1
         p = parent
         while getattr(p, "parent_node", None) is not None:
             level += 1
             p = p.parent_node
+        return level
+
+    def _record_level_task(
+        self, attr: str, parent: ExecNode, parents: int, t0: float,
+        uids_out: int = 0, decoded_bytes: int = 0,
+    ) -> None:
+        """Attribute one (predicate, level) task: always-on per-tablet
+        traffic accounting (read tasks, uids, decoded bytes, latency
+        EWMA — the traffic-driven rebalancer's signal) plus the active
+        query profile when one is collecting."""
+        ms = (time.perf_counter() - t0) * 1e3
+        if observe.tablet_traffic_enabled():
+            observe.TABLETS.note_read(
+                self.ns, attr, 1, uids_out, decoded_bytes, 0, ms
+            )
+        prof = current_profile()
+        if prof is None:
+            return
         prof.record_level_task(
-            attr, level, parents, (time.perf_counter() - t0) * 1e3,
-            self.level_batch,
+            attr, self._level_of(parent), parents, ms, self.level_batch,
+        )
+
+    def _record_plan_node(
+        self, cnode: ExecNode, parent: ExecNode, attr: str,
+        uids_in: int, uids_out: int, t0: float, k0, read: str,
+    ) -> None:
+        """One EXPLAIN plan-tree node (debug-mode queries only): uids
+        in/out, read strategy, wall-ns over the whole child build
+        (read + filter + pagination), and this THREAD's kernel-count
+        deltas since `k0` (the packed_setops counters are per-thread,
+        and one child builds entirely on one thread, so the delta is
+        exactly this node's kernel mix)."""
+        plan = current_plan()
+        if plan is None:
+            return
+        from dgraph_tpu.ops import packed_setops
+
+        kernels = {}
+        if k0 is not None:
+            k1 = packed_setops.counters()
+            kernels = {
+                k: k1[k] - k0.get(k, 0)
+                for k in k1
+                if isinstance(k1[k], (int, float)) and k1[k] != k0.get(k, 0)
+            }
+        plan.note_node(
+            {
+                "id": id(cnode),
+                "parent": id(parent),
+                "attr": attr,
+                "level": self._level_of(parent),
+                "uids_in": int(uids_in),
+                "uids_out": int(uids_out),
+                "read": read,
+                "wall_ns": int((time.perf_counter() - t0) * 1e9),
+                "kernels": kernels,
+            }
         )
 
     def _make_child(self, parent: ExecNode, cgq: GraphQuery) -> Optional[ExecNode]:
@@ -830,6 +907,15 @@ class Executor:
         su = self.st.get(attr[1:] if reverse else attr)
         cnode = ExecNode(gq=cgq, attr=attr, src_uids=parent.dest_uids)
         cnode.parent_node = parent
+        # EXPLAIN capture (debug queries only): wall clock + this
+        # thread's kernel counters over the whole child build
+        _plan = current_plan()
+        _plan_t0 = time.perf_counter()
+        _plan_k0 = None
+        if _plan is not None:
+            from dgraph_tpu.ops import packed_setops
+
+            _plan_k0 = packed_setops.counters()
         cnode.under_cascade = (
             parent.under_cascade or parent.gq.cascade or cgq.cascade
         )
@@ -874,7 +960,10 @@ class Executor:
                         rows.append(r)
                         row_toks.append(tok)
                     flat, offs = ragged.pack_rows(rows)
-            self._record_level_task(attr, parent, len(level_keys), t0)
+            self._record_level_task(
+                attr, parent, len(level_keys), t0,
+                uids_out=len(flat), decoded_bytes=int(flat.nbytes),
+            )
             if cgq.filter is not None:
                 dest = self.eval_filter(
                     cgq.filter, ragged.merge_flat(flat, offs)
@@ -985,7 +1074,10 @@ class Executor:
                 else:
                     self.cache.prefetch(dkeys)
                     all_posts = [self.cache.values(k) for k in dkeys]
-            self._record_level_task(attr, parent, len(dkeys), t0)
+            self._record_level_task(
+                attr, parent, len(dkeys), t0,
+                uids_out=sum(1 for ps in all_posts if ps),
+            )
             for u, posts in zip(parent.dest_uids, all_posts):
                 if cgq.lang == "*":
                     pass  # @* keeps every language; encoder fans out fields
@@ -1019,6 +1111,22 @@ class Executor:
                 }
                 parent.own_vars.add(cgq.var_name)
                 self.var_def_node[cgq.var_name] = parent
+        uids_out = (
+            len(cnode.dest_uids) if cnode.is_uid_pred else len(cnode.values)
+        )
+        if observe.tablet_traffic_enabled():
+            observe.TABLETS.note_result(
+                self.ns, attr,
+                int(cnode.dest_uids.nbytes) if cnode.is_uid_pred
+                else uids_out * 8,
+            )
+        if _plan is not None:
+            self._record_plan_node(
+                cnode, parent, attr,
+                uids_in=len(parent.dest_uids), uids_out=uids_out,
+                t0=_plan_t0, k0=_plan_k0,
+                read="batched" if self.level_batch else "per_uid",
+            )
         return cnode
 
     def _make_checkpwd_child(self, parent: ExecNode, cgq: GraphQuery) -> ExecNode:

@@ -40,25 +40,39 @@ class ServingFront:
 
     # -- plan cache -----------------------------------------------------------
 
-    def parse(self, q: str, variables=None) -> Tuple[list, Optional[str]]:
+    def parse(
+        self, q: str, variables=None, info: Optional[dict] = None
+    ) -> Tuple[list, Optional[str]]:
         """dql.parse through the plan cache. Returns (blocks, shape);
         shape is None when the query doesn't lex (parse raises the real
         error) — such queries bypass the cache. With the cache disabled
         (PLAN_CACHE_SIZE=0) the normalization pass — a second full
         tokenize per query — is skipped outright (the shape would feed
-        nothing: cost stats are disabled with the cache)."""
+        nothing: cost stats are disabled with the cache).
+
+        `info`, when given (debug/EXPLAIN requests), is filled with the
+        plan-cache outcome: {"hit": bool, "shape": normalized-key,
+        "enabled": bool} — the entry point folds it into
+        extensions.plan."""
         from dgraph_tpu import dql
 
         if self.plan_cache.capacity() == 0:
+            if info is not None:
+                info.update(enabled=False, hit=False, shape=None)
             return dql.parse(q, variables), None
         norm = normalize(q)
         if norm is None:
+            if info is not None:
+                info.update(enabled=True, hit=False, shape=None)
             return dql.parse(q, variables), None
         shape, literals = norm
         blocks = self.plan_cache.get(shape, literals, variables)
+        hit = blocks is not None
         if blocks is None:
             blocks = dql.parse(q, variables)
             self.plan_cache.put(shape, literals, blocks, variables)
+        if info is not None:
+            info.update(enabled=True, hit=hit, shape=shape)
         return blocks, shape
 
     # -- admission ------------------------------------------------------------

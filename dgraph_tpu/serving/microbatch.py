@@ -229,6 +229,16 @@ class MicroBatcher:
 
     # -- core ----------------------------------------------------------------
 
+    @staticmethod
+    def _note_plan(members: int) -> None:
+        """EXPLAIN capture: this member's coalescing outcome (solo vs
+        coalesced dispatch and the batch width) — debug queries only."""
+        from dgraph_tpu.utils.observe import current_plan
+
+        plan = current_plan()
+        if plan is not None:
+            plan.note_microbatch(members)
+
     def _submit(self, key, cache, keys_list, run, split):
         win = window_us()
         inflight = (
@@ -236,6 +246,7 @@ class MicroBatcher:
         )
         if win <= 0 or inflight <= 1:
             # off switch / nobody to coalesce with: today's direct path
+            self._note_plan(1)
             return run(cache, keys_list)
         lead = False
         with self._lock:
@@ -293,13 +304,16 @@ class MicroBatcher:
                         )
                     )
             if bailed:
+                self._note_plan(1)
                 return run(cache, keys_list)
             if g.error is not None:
                 # the LEADER failed (its deadline, its RPC fault) — that
                 # must not fail healthy members that would have
                 # succeeded solo; re-read alone at the same snapshot
                 # and let any genuine store error surface as our own
+                self._note_plan(1)
                 return run(cache, keys_list)
+            self._note_plan(len(g.results))
             return g.results[idx]
         if g is not None:
             # batch leader: wait (bounded) for the runner ahead of us,
@@ -319,6 +333,7 @@ class MicroBatcher:
                 members = list(g.members)
         try:
             if g is None:
+                self._note_plan(1)
                 return run(cache, keys_list)
             spans: List[Tuple[int, int]] = []
             row = 0
@@ -365,6 +380,7 @@ class MicroBatcher:
                 g.results = results
                 g.done = True
                 g.cv.notify_all()
+            self._note_plan(len(members))
             return results[0]
         finally:
             # hand the key to the batch that formed behind us

@@ -118,20 +118,34 @@ def metrics_reference() -> str:
 
 
 class Histogram:
+    """Cumulative-bucket histogram with a bounded per-bucket exemplar
+    ring: the LATEST (value, trace_id, unix_ts) landing in each bucket
+    is retained (at most len(buckets)+1 exemplars total), exported in
+    OpenMetrics exemplar syntax by `Metrics.render_openmetrics` so a
+    dashboard's latency bucket links straight to a trace."""
+
     def __init__(self, buckets: Optional[List[float]] = None):
         self.buckets = buckets or _BUCKETS
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
         self.total = 0
+        # one slot per bucket (incl. +Inf): (value, trace_id, unix_ts)
+        self.exemplars: List[Optional[Tuple[float, int, float]]] = (
+            [None] * (len(self.buckets) + 1)
+        )
 
-    def observe(self, v: float):
+    def observe(self, v: float, trace_id: int = 0):
         self.sum += v
         self.total += 1
         for i, b in enumerate(self.buckets):
             if v <= b:
                 self.counts[i] += 1
+                if trace_id:
+                    self.exemplars[i] = (v, trace_id, time.time())
                 return
         self.counts[-1] += 1
+        if trace_id:
+            self.exemplars[-1] = (v, trace_id, time.time())
 
 
 class Metrics:
@@ -181,12 +195,56 @@ class Metrics:
     def observe(self, name: str, seconds: float, buckets=None):
         """Record one histogram observation. `buckets` overrides the
         default latency ladder on FIRST observation only (count-valued
-        histograms like group_commit_batch_size pass a count ladder)."""
+        histograms like group_commit_batch_size pass a count ladder).
+
+        When exemplars are enabled (DGRAPH_TPU_EXEMPLARS) and a trace
+        context is active, the observation is retained as the bucket's
+        exemplar — the metrics→trace link render_openmetrics exports.
+        Entry-point latency histograms additionally feed the SLO burn
+        windows (slo_report)."""
+        trace_id = 0
+        if _exemplars_enabled():
+            cur = _CURRENT.get()
+            if cur is not None:
+                trace_id = int(getattr(cur, "trace_id", 0) or 0)
+        slo = _SLO_TRACKED.get(name)
+        if slo is not None:
+            slo.note(seconds)
         with self._lock:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists[name] = Histogram(buckets)
-            h.observe(seconds)
+            h.observe(seconds, trace_id)
+
+    def hist_stats(self, name: str) -> Tuple[float, int]:
+        """(sum, count) of one histogram (0, 0 when never observed) —
+        benchmarks diff this around a run for realized batch widths
+        without parsing the exposition text."""
+        with self._lock:
+            h = self._hists.get(name)
+            return (h.sum, h.total) if h is not None else (0.0, 0)
+
+    def exemplars(self, name: str) -> List[dict]:
+        """The retained exemplars of one histogram: [{le, value,
+        trace_id, ts}] — what the slow-query log embeds to close the
+        metrics→trace loop without parsing the exposition."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                return []
+            out = []
+            les = [str(b) for b in h.buckets] + ["+Inf"]
+            for le, ex in zip(les, h.exemplars):
+                if ex is not None:
+                    out.append(
+                        {
+                            "le": le,
+                            "value": ex[0],
+                            "trace_id": f"{ex[1]:032x}",
+                            "ts": ex[2],
+                        }
+                    )
+            return out
 
     @contextmanager
     def timer(self, name: str):
@@ -218,8 +276,170 @@ class Metrics:
                 out.append(f"{base}_count {h.total}")
         return "\n".join(out) + "\n"
 
+    def render_openmetrics(self) -> str:
+        """OpenMetrics text format with histogram bucket exemplars:
+
+            name_bucket{le="0.1"} 17 # {trace_id="<32hex>"} 0.084 <ts>
+
+        Served at /debug/openmetrics; the classic render() stays the
+        Prometheus-text scrape/merge surface (merge_expositions does
+        not need exemplars — they are per-process trace anchors, not
+        aggregatable counts). Terminated by `# EOF` per the spec."""
+        out: List[str] = []
+        with self._lock:
+            for k, v in sorted(self._counters.items()):
+                # OpenMetrics counters sample as <name>_total with the
+                # metric FAMILY name in TYPE; most of our counter names
+                # already carry the suffix
+                fam = k[: -len("_total")] if k.endswith("_total") else k
+                out.append(f"# TYPE {self.prefix}_{fam} counter")
+                out.append(f"{self.prefix}_{fam}_total {v}")
+            for k, v in sorted(self._gauges.items()):
+                out.append(f"# TYPE {self.prefix}_{k} gauge")
+                out.append(f"{self.prefix}_{k} {v}")
+            for k, h in sorted(self._hists.items()):
+                base = f"{self.prefix}_{k}"
+                out.append(f"# TYPE {base} histogram")
+                cum = 0
+                rows = list(zip(h.buckets, h.counts, h.exemplars))
+                rows.append(("+Inf", h.counts[-1], h.exemplars[-1]))
+                for b, c, ex in rows:
+                    cum += c
+                    line = f'{base}_bucket{{le="{b}"}} {cum}'
+                    if ex is not None:
+                        val, tid, ts = ex
+                        line += (
+                            f' # {{trace_id="{tid:032x}"}} '
+                            f"{val:.9g} {ts:.3f}"
+                        )
+                    out.append(line)
+                out.append(f"{base}_sum {h.sum}")
+                out.append(f"{base}_count {h.total}")
+        out.append("# EOF")
+        return "\n".join(out) + "\n"
+
 
 METRICS = Metrics()
+
+
+def _exemplars_enabled() -> bool:
+    from dgraph_tpu.x import config
+
+    return bool(config.get("EXEMPLARS"))
+
+
+def parse_openmetrics_exemplars(text: str) -> Dict[str, dict]:
+    """{series: {"trace_id", "value", "ts"}} for every exemplar-carrying
+    line of an OpenMetrics exposition — the round-trip witness that the
+    exemplar syntax we emit is the one the OpenMetrics spec defines
+    (`<series> <value> # {<labels>} <exemplar-value> [<ts>]`)."""
+    out: Dict[str, dict] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " # " not in line:
+            continue
+        series_part, _, ex_part = line.partition(" # ")
+        name_part, _, _val = series_part.rpartition(" ")
+        if not ex_part.startswith("{"):
+            continue
+        labels_raw = ex_part[1 : ex_part.index("}")]
+        rest = ex_part[ex_part.index("}") + 1 :].split()
+        if not rest:
+            continue
+        try:
+            labels = _parse_labels(labels_raw)
+            out[name_part] = {
+                "trace_id": labels.get("trace_id", ""),
+                "value": float(rest[0]),
+                "ts": float(rest[1]) if len(rest) > 1 else None,
+            }
+        except (ValueError, IndexError):
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate windows (health/SLO rollup)
+# ---------------------------------------------------------------------------
+
+
+class SloWindows:
+    """Minute-bucketed (total, over-threshold) rings behind the
+    multi-window SLO burn rates in /debug/healthz. A request is "bad"
+    when it exceeds DGRAPH_TPU_SLO_QUERY_MS; burn rate over a window is
+    bad_fraction / error_budget where the budget is 1 -
+    DGRAPH_TPU_SLO_TARGET (burn 1.0 = exactly consuming budget; the
+    standard multi-window alert pages on short AND long windows burning
+    simultaneously). Fed by Metrics.observe on the entry-point latency
+    histograms, so no entry point needs its own SLO call."""
+
+    WINDOWS_S = (60, 300, 1800, 3600)
+    _BUCKET_S = 60
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # minute-aligned ring: {minute: [total, bad]}
+        self._buckets: "OrderedDict[int, List[int]]" = OrderedDict()
+
+    @staticmethod
+    def _threshold_s() -> float:
+        from dgraph_tpu.x import config
+
+        return float(config.get("SLO_QUERY_MS")) / 1e3
+
+    @staticmethod
+    def _target() -> float:
+        from dgraph_tpu.x import config
+
+        return min(0.999999, max(0.0, float(config.get("SLO_TARGET"))))
+
+    def note(self, seconds: float) -> None:
+        bad = seconds > self._threshold_s()
+        minute = int(time.time()) // self._BUCKET_S
+        with self._lock:
+            b = self._buckets.get(minute)
+            if b is None:
+                b = self._buckets[minute] = [0, 0]
+                # retention: the longest window + one partial bucket
+                horizon = minute - max(self.WINDOWS_S) // self._BUCKET_S - 1
+                while self._buckets and next(iter(self._buckets)) < horizon:
+                    self._buckets.popitem(last=False)
+            b[0] += 1
+            if bad:
+                b[1] += 1
+
+    def report(self) -> dict:
+        now_min = int(time.time()) // self._BUCKET_S
+        budget = 1.0 - self._target()
+        out = {
+            "threshold_ms": self._threshold_s() * 1e3,
+            "target": self._target(),
+            "windows": {},
+        }
+        with self._lock:
+            items = list(self._buckets.items())
+        for w in self.WINDOWS_S:
+            lo = now_min - w // self._BUCKET_S
+            total = sum(t for m, (t, _) in items if m > lo)
+            bad = sum(b for m, (_, b) in items if m > lo)
+            rate = (bad / total) if total else 0.0
+            out["windows"][f"{w}s"] = {
+                "total": total,
+                "bad": bad,
+                "error_rate": round(rate, 6),
+                "burn_rate": round(rate / budget, 3) if budget else None,
+            }
+        return out
+
+
+# entry-point latency histograms feed the SLO windows on every observe
+_SLO_TRACKED: Dict[str, SloWindows] = {
+    "query_latency_seconds": SloWindows(),
+    "commit_latency_seconds": SloWindows(),
+}
+
+
+def slo_report() -> dict:
+    return {name: slo.report() for name, slo in _SLO_TRACKED.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -819,6 +1039,174 @@ def init_from_env(instance: str = "") -> Tracer:
 
 
 # ---------------------------------------------------------------------------
+# Per-tablet traffic accounting
+# ---------------------------------------------------------------------------
+
+
+class TabletTraffic:
+    """Sharded (namespace, predicate) traffic accumulator — the signal
+    the traffic-driven rebalancer consumes (worker/tabletmove.py
+    pick_rebalance_move_by_traffic) and /debug/tablets serves.
+
+    Always-on by default (DGRAPH_TPU_TABLET_TRAFFIC): the record path
+    must stay cheap enough for every level read and commit, so the
+    table shards over SHARDS independent locks keyed by predicate hash
+    (a level task touches exactly one shard, and concurrent queries on
+    different predicates never contend), and one record is a dict probe
+    plus a handful of float adds under that shard lock — no METRICS
+    call, no allocation after the first touch of a tablet.
+
+    Per tablet: read tasks + uids, mutation edges, decoded bytes (the
+    ragged level buffer the reads materialized), result bytes (what
+    survived to the result row), and a latency EWMA over per-task ms.
+    Totals are cumulative; scrapers snapshot (drain) on demand, and the
+    cluster merge sums rows by (ns, predicate) with a read-weighted
+    EWMA average (worker/harness.merge_tablet_rows)."""
+
+    SHARDS = 16
+    _EWMA_ALPHA = 0.2
+
+    def __init__(self):
+        self._locks = [threading.Lock() for _ in range(self.SHARDS)]
+        self._shards: List[Dict[Tuple[int, str], List[float]]] = [
+            {} for _ in range(self.SHARDS)
+        ]
+
+    # entry layout: [reads, read_uids, mutation_edges, decoded_bytes,
+    #                result_bytes, lat_ewma_ms]
+    _N_FIELDS = 6
+
+    def _entry(self, shard: dict, ns: int, attr: str) -> List[float]:
+        e = shard.get((ns, attr))
+        if e is None:
+            e = shard[(ns, attr)] = [0.0] * self._N_FIELDS
+        return e
+
+    def note_read(
+        self, ns: int, attr: str, tasks: int, uids: int,
+        decoded_bytes: int, result_bytes: int, ms: float,
+    ) -> None:
+        i = hash(attr) % self.SHARDS
+        with self._locks[i]:
+            e = self._entry(self._shards[i], ns, attr)
+            first = e[0] == 0
+            e[0] += tasks
+            e[1] += uids
+            e[3] += decoded_bytes
+            e[4] += result_bytes
+            e[5] = (
+                ms if first else e[5] + self._EWMA_ALPHA * (ms - e[5])
+            )
+
+    def note_result(self, ns: int, attr: str, nbytes: int) -> None:
+        """Bytes of this tablet's data that survived into a query's
+        result tree (recorded at node completion, after filters and
+        pagination — the serving-value counterpart of decoded_bytes)."""
+        if not nbytes:
+            return
+        i = hash(attr) % self.SHARDS
+        with self._locks[i]:
+            self._entry(self._shards[i], ns, attr)[4] += nbytes
+
+    def note_write(self, ns: int, attr: str, edges: int) -> None:
+        i = hash(attr) % self.SHARDS
+        with self._locks[i]:
+            self._entry(self._shards[i], ns, attr)[2] += edges
+
+    def snapshot(self) -> List[dict]:
+        """One row per tablet, sorted by (ns, predicate) — the
+        /debug/tablets body and the rebalancer's input."""
+        rows: List[dict] = []
+        for lock, shard in zip(self._locks, self._shards):
+            with lock:
+                items = [(k, list(v)) for k, v in shard.items()]
+            for (ns, attr), e in items:
+                rows.append(
+                    {
+                        "ns": int(ns),
+                        "predicate": attr,
+                        "reads": int(e[0]),
+                        "read_uids": int(e[1]),
+                        "mutation_edges": int(e[2]),
+                        "decoded_bytes": int(e[3]),
+                        "result_bytes": int(e[4]),
+                        "lat_ewma_ms": round(e[5], 3),
+                    }
+                )
+        rows.sort(key=lambda r: (r["ns"], r["predicate"]))
+        return rows
+
+    def publish(self) -> None:
+        """Mirror the aggregate into per-alpha gauges (the scrape-time
+        drain): tablet count only — per-tablet series ride the JSON
+        surface, not the exposition (unbounded label cardinality)."""
+        n = sum(len(s) for s in self._shards)
+        METRICS.set_gauge("tablet_traffic_tablets", float(n))
+
+    def clear(self) -> None:
+        for lock, shard in zip(self._locks, self._shards):
+            with lock:
+                shard.clear()
+
+
+TABLETS = TabletTraffic()
+
+
+def tablet_traffic_enabled() -> bool:
+    from dgraph_tpu.x import config
+
+    return bool(config.get("TABLET_TRAFFIC"))
+
+
+# ---------------------------------------------------------------------------
+# Health registry (/debug/healthz)
+# ---------------------------------------------------------------------------
+
+
+_HEALTH_SOURCES: Dict[str, object] = {}
+_START_TIME = time.time()
+
+
+def register_health(name: str, fn) -> None:
+    """Register a per-process health source: `fn()` returns a small
+    JSON-able dict folded into /debug/healthz under `name`. Engines
+    register raft/watermark/pipeline views at construction; a source
+    that raises reports {"error": ...} instead of failing the probe."""
+    _HEALTH_SOURCES[name] = fn
+
+
+def healthz(instance: str = "") -> dict:
+    """The per-process health rollup: registered sources + admission
+    shed/degraded rates + commit pipeline depth + multi-window SLO burn
+    rates from the entry-point latency histograms."""
+    out: Dict[str, object] = {
+        "instance": instance,
+        "pid": os.getpid(),
+        "uptime_s": round(time.time() - _START_TIME, 1),
+        "status": "healthy",
+        "admission": {
+            "inflight": METRICS.value("admission_inflight_queries"),
+            "shed_total": METRICS.value("admission_shed_total"),
+            "degraded_total": METRICS.value("admission_degraded_total"),
+            "degraded_queries_total": METRICS.value(
+                "degraded_queries_total"
+            ),
+        },
+        "commit_pipeline_depth": METRICS.value("commit_pipeline_depth"),
+        "slo": slo_report(),
+    }
+    sources = {}
+    for name, fn in sorted(_HEALTH_SOURCES.items()):
+        try:
+            sources[name] = fn()
+        except Exception as e:  # a broken source must not fail the probe
+            sources[name] = {"error": f"{type(e).__name__}: {e}"}
+    if sources:
+        out["sources"] = sources
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Per-query profile
 # ---------------------------------------------------------------------------
 
@@ -837,6 +1225,114 @@ _PROFILE_EVENT_KEYS = (
 )
 
 
+class PlanCapture:
+    """EXPLAIN/ANALYZE decision capture for ONE debug-mode query — the
+    structured `extensions.plan` tree. Allocated only when the request
+    carries `debug: true` (profile_scope(debug=True)), so the normal
+    path pays a single None check per hook site. Thread-safe like the
+    profile: parallel sibling workers append under one lock.
+
+    What the hooks record:
+      nodes       per-(predicate, level) execution nodes from the
+                  executor (query/subgraph.py): uids in/out, read
+                  strategy, per-thread kernel-count deltas (bitmap/
+                  probe/gallop pairs, decoded/streamed uids from the
+                  PR 6 counters), wall-ns; assembled into a tree by
+                  ExecNode identity.
+      setops      packed-vs-decoded decisions at the dispatch sites
+                  (query/dispatch._try_packed, functions.
+                  _index_src_intersect): operand sizes, StatsHolder
+                  selectivity estimate, the PACKED_MIN_RATIO verdict.
+                  Capped — a pathological query must not balloon the
+                  response.
+      microbatch  coalescing outcome per level read (solo vs coalesced,
+                  member count) from serving/microbatch.py.
+      plan_cache  hit/miss + the normalized shape key
+                  (serving/plancache.py via ServingFront.parse).
+      admission   the admission decision: estimated cost, degrade flag
+                  (serving/admission.py via the entry points).
+      cache       cache-tier deltas for this query: memlayer hits/
+                  misses, point/batch reads (entry-point stamped).
+    """
+
+    MAX_SETOPS = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.nodes: List[dict] = []
+        self.setops: List[dict] = []
+        self.setops_dropped = 0
+        self.microbatch = {"solo": 0, "coalesced": 0, "members_max": 0}
+        self.plan_cache: Dict[str, object] = {}
+        self.admission: Dict[str, object] = {}
+        self.cache: Dict[str, float] = {}
+        self.meta: Dict[str, object] = {}
+
+    def note_node(self, rec: dict) -> None:
+        with self._lock:
+            self.nodes.append(rec)
+
+    def note_setop(self, rec: dict) -> None:
+        with self._lock:
+            if len(self.setops) >= self.MAX_SETOPS:
+                self.setops_dropped += 1
+                return
+            self.setops.append(rec)
+
+    def note_microbatch(self, members: int) -> None:
+        with self._lock:
+            if members > 1:
+                self.microbatch["coalesced"] += 1
+                self.microbatch["members_max"] = max(
+                    self.microbatch["members_max"], members
+                )
+            else:
+                self.microbatch["solo"] += 1
+
+    def tree(self) -> List[dict]:
+        """Nest the flat node records into per-block trees by ExecNode
+        identity (each record carries its own `id` and `parent` id).
+        Orphans (parent never recorded, e.g. the root was a var-only
+        block) surface as roots — never silently dropped."""
+        with self._lock:
+            nodes = [dict(n) for n in self.nodes]
+        by_id = {n["id"]: n for n in nodes}
+        roots: List[dict] = []
+        for n in nodes:
+            n["children"] = []
+        for n in nodes:
+            parent = by_id.get(n.get("parent"))
+            if parent is not None:
+                parent["children"].append(n)
+            else:
+                roots.append(n)
+        for n in nodes:
+            n.pop("id", None)
+            n.pop("parent", None)
+        return roots
+
+    def to_dict(self) -> dict:
+        out = {
+            "nodes": self.tree(),
+            "setops": list(self.setops),
+            "microbatch": dict(self.microbatch),
+            "plan_cache": dict(self.plan_cache),
+            "admission": dict(self.admission),
+            "cache": dict(self.cache),
+        }
+        if self.setops_dropped:
+            out["setops_dropped"] = self.setops_dropped
+        out.update(self.meta)
+        return out
+
+
+def current_plan() -> Optional[PlanCapture]:
+    """The active debug-mode plan capture, or None (the common case —
+    every hook site gates on this)."""
+    prof = _PROFILE.get()
+    return prof.plan if prof is not None else None
+
+
 class QueryProfile:
     """Attribution for ONE query: per-(predicate, level) task timings,
     packed-vs-decoded kernel counts + decoded bytes, retry/degradation
@@ -844,8 +1340,12 @@ class QueryProfile:
     responses. Thread-safe: executor workers record into the same
     profile via the propagated context."""
 
-    def __init__(self):
+    def __init__(self, debug: bool = False):
         self._lock = threading.Lock()
+        # EXPLAIN/ANALYZE capture — allocated only for debug requests
+        self.plan: Optional[PlanCapture] = (
+            PlanCapture() if debug else None
+        )
         self.level_tasks: List[dict] = []
         self.rpc_fragments: List[dict] = []
         self.events: Dict[str, float] = {}
@@ -918,11 +1418,20 @@ def current_profile() -> Optional[QueryProfile]:
 
 
 @contextmanager
-def profile_scope():
+def profile_scope(debug: bool = False):
     """Collect a QueryProfile for the enclosed query. Counter deltas are
     process-local and can overlap across concurrent queries — they
-    attribute classes of work, not exact per-query counts."""
-    prof = QueryProfile()
+    attribute classes of work, not exact per-query counts.
+
+    `debug=True` additionally allocates the EXPLAIN/ANALYZE PlanCapture
+    (prof.plan): the decision-capture hooks at the dispatch sites go
+    live for this query only, and the entry point attaches the
+    assembled tree as `extensions.plan`. Capture is observation-only —
+    response `data` bytes are identical with the flag on or off
+    (golden-corpus-enforced, tests/test_explain.py)."""
+    prof = QueryProfile(debug=debug)
+    if debug:
+        METRICS.inc("explain_queries_total")
     token = _PROFILE.set(prof)
     before = {k: METRICS.value(k) for k in _PROFILE_EVENT_KEYS}
     k0 = None
@@ -1072,6 +1581,18 @@ def maybe_log_slow(
         "query": text[:2000],
         "spans": tr.trace_spans(tid) if tid else [],
     }
+    if _exemplars_enabled():
+        # close the metrics→trace loop from the log side too: the
+        # latency histogram's current exemplars (one (value, trace_id)
+        # anchor per bucket) ride along with the slow record, so a
+        # reader can jump from the log to the traces anchoring the
+        # distribution this query landed in
+        name = (
+            "commit_latency_seconds"
+            if kind == "commit"
+            else "query_latency_seconds"
+        )
+        record["exemplars"] = METRICS.exemplars(name)
     if extra:
         record.update(extra)
     log = slow_query_log()
@@ -1113,13 +1634,29 @@ def start_debug_http(host: str = "127.0.0.1", port: int = 0):
         def do_GET(self):
             if self.path == "/debug/prometheus_metrics":
                 self._send(METRICS.render().encode(), "text/plain")
+            elif self.path == "/debug/openmetrics":
+                self._send(
+                    METRICS.render_openmetrics().encode(),
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8",
+                )
             elif self.path.startswith("/debug/traces"):
                 self._send(
                     json.dumps({"spans": TRACER.recent(200)}).encode(),
                     "application/json",
                 )
-            elif self.path == "/healthz":
-                self._send(b"ok", "text/plain")
+            elif self.path == "/debug/tablets":
+                TABLETS.publish()
+                self._send(
+                    json.dumps(
+                        {"tablets": TABLETS.snapshot()}
+                    ).encode(),
+                    "application/json",
+                )
+            elif self.path in ("/healthz", "/debug/healthz"):
+                self._send(
+                    json.dumps(healthz()).encode(), "application/json"
+                )
             else:
                 self._send(b"not found", "text/plain", 404)
 
@@ -1155,6 +1692,18 @@ def attach_debug_surface(rpc_server):
     rpc_server.register(
         "debug.traces",
         lambda a: {"spans": TRACER.recent(int((a or {}).get("n", 200)))},
+    )
+
+    def _tablets(a):
+        TABLETS.publish()
+        return {
+            "tablets": TABLETS.snapshot(),
+            "instance": rpc_server.instance,
+        }
+
+    rpc_server.register("debug.tablets", _tablets)
+    rpc_server.register(
+        "debug.health", lambda a: healthz(rpc_server.instance)
     )
     rpc_server.register("debug.info", lambda a: dict(info))
     return srv, port
@@ -1210,6 +1759,12 @@ declare_metric(
 declare_metric(
     "counter", "exec_parallel_siblings",
     "Sibling subtrees submitted to the parallel executor pool.",
+)
+declare_metric(
+    "counter", "explain_queries_total",
+    "Queries served with the debug (EXPLAIN/ANALYZE) flag: the "
+    "PlanCapture hooks were live and extensions.plan was assembled "
+    "(utils/observe.py profile_scope).",
 )
 declare_metric(
     "counter", "fault_*_total",
@@ -1456,6 +2011,12 @@ declare_metric(
 declare_metric(
     "gauge", "cache_point_reads",
     "Point LocalCache reads (READ_COUNTERS mirror).",
+)
+declare_metric(
+    "gauge", "tablet_traffic_tablets",
+    "Distinct (namespace, predicate) tablets tracked by this process's "
+    "traffic accumulator (utils/observe.py TabletTraffic; per-tablet "
+    "rows ride the /debug/tablets JSON surface, not the exposition).",
 )
 declare_metric(
     "gauge", "exec_pool_queue_depth",

@@ -144,6 +144,15 @@ class ClusterFacade:
     def _audit(self, *a, **kw):
         pass
 
+    # telemetry passthroughs: the HTTP /debug endpoints duck-type these
+    # off the engine (cluster views when available)
+
+    def health(self) -> dict:
+        return self.cluster.health()
+
+    def merged_tablets(self) -> dict:
+        return self.cluster.merged_tablets()
+
     # borrow the single-node mutation appliers (they only touch
     # self.zero/self.schema, both duck-typed here)
     from dgraph_tpu.api.server import Server as _S
@@ -180,6 +189,7 @@ class ClusterFacade:
         variables: Optional[Dict[str, str]] = None,
         timeout_ms: Optional[float] = None,
         want: str = "dict",
+        debug: bool = False,
     ) -> dict:
         import time as _time
 
@@ -187,7 +197,9 @@ class ClusterFacade:
         from dgraph_tpu.posting.lists import LocalCache
         from dgraph_tpu.query.streamjson import encode_response_data
         from dgraph_tpu.query.subgraph import Executor
+        from dgraph_tpu.utils.observe import profile_scope
 
+        t0 = _time.perf_counter()
         ts = read_ts if read_ts is not None else self.cluster.zero.zero.read_ts()
         cache = LocalCache(self.kv, ts, mem=self.cluster.mem)
         ex = Executor(
@@ -201,12 +213,20 @@ class ClusterFacade:
                 else None
             ),
         )
-        nodes = ex.process(dql.parse(q, variables))
+        with profile_scope(debug=debug) as prof:
+            nodes = ex.process(dql.parse(q, variables))
         data, _ = encode_response_data(
             nodes, val_vars=ex.val_vars, schema=self.cluster.schema,
             want=want,
         )
-        return {"data": data}
+        out = {"data": data}
+        if prof.plan is not None:
+            prof.plan.meta = {
+                "read_ts": int(ts),
+                "wall_ns": int((_time.perf_counter() - t0) * 1e9),
+            }
+            out["extensions"] = {"plan": prof.plan.to_dict()}
+        return out
 
     def query_rdf(self, q, read_ts=None, variables=None) -> str:
         from dgraph_tpu import dql

@@ -1073,6 +1073,70 @@ class DistributedCluster:
 
         return run_rebalance(self, min_move_bytes=min_move_bytes)
 
+    def rebalance_by_traffic(self, min_move_bytes: int = 1 << 10):
+        """Traffic-weighted rebalancing: each tablet weighs its size
+        PLUS observed traffic from the process-local accumulator (one
+        process hosts every in-process replica), so a hot small tablet
+        can out-score a cold giant one."""
+        from dgraph_tpu.worker.tabletmove import run_rebalance
+
+        return run_rebalance(
+            self, min_move_bytes=min_move_bytes, by_traffic=True
+        )
+
+    def merged_tablets(self) -> dict:
+        """Per-tablet traffic rows (the /debug/tablets body). The
+        in-process cluster shares ONE accumulator across its replicas,
+        so the local snapshot already is the cluster view."""
+        from dgraph_tpu.utils import observe
+        from dgraph_tpu.worker.harness import merge_tablet_rows
+
+        observe.TABLETS.publish()
+        return {
+            "tablets": merge_tablet_rows([observe.TABLETS.snapshot()]),
+            "instances": ["local"],
+            "unreachable_instances": [],
+        }
+
+    def health(self) -> dict:
+        """The health/SLO rollup (/debug/healthz body): per-group raft
+        leadership + per-replica applied-index lag straight off the
+        in-process nodes, snapshot-watermark lag, plus the shared
+        process healthz (admission rates, pipeline depth, SLO burn)."""
+        from dgraph_tpu.utils import observe
+
+        out = observe.healthz("local")
+        groups: Dict[str, dict] = {}
+        for gid, group in sorted(self.groups.items()):
+            leader = group.leader()
+            leader_applied = leader.applied_index if leader else 0
+            replicas = {}
+            for n in group.nodes:
+                down = n.id in self.net.down
+                replicas[str(n.id)] = {
+                    "ok": not down,
+                    "is_leader": leader is not None and n.id == leader.id,
+                    "term": int(n.raft.term),
+                    "applied": int(n.applied_index),
+                    "applied_lag": max(
+                        0, int(leader_applied - n.applied_index)
+                    ),
+                }
+            groups[str(gid)] = {
+                "leader": leader.id if leader else None,
+                "healthy": leader is not None,
+                "replicas": replicas,
+            }
+        out["groups"] = groups
+        # this cluster reads at fresh barrier-waited timestamps (no
+        # published watermark), so the watermark view is zero-sourced
+        ma = getattr(self.zero.zero, "max_assigned", None)
+        if isinstance(ma, (int, float)):
+            out["snapshot_watermark"] = int(ma)
+        if any(not g["healthy"] for g in groups.values()):
+            out["status"] = "degraded"
+        return out
+
     # -- failure handling ---------------------------------------------------------
 
     def kill_node(self, node_id: int):

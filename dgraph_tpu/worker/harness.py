@@ -37,6 +37,41 @@ from dgraph_tpu.worker.remote import RemoteGroup, RemoteKV
 from dgraph_tpu.x import config, keys
 
 
+def merge_tablet_rows(per_instance: List[List[dict]]) -> List[dict]:
+    """Merge per-process /debug/tablets rows into ONE cluster view:
+    counters (reads, uids, edges, bytes) sum by (ns, predicate); the
+    latency EWMA merges as the read-weighted average (an instance that
+    served 10x the reads owns 10x of the merged latency signal).
+    The tablets analog of observe.merge_expositions."""
+    merged: Dict[Tuple[int, str], dict] = {}
+    for rows in per_instance:
+        for r in rows:
+            key = (int(r.get("ns", 0)), str(r.get("predicate", "")))
+            m = merged.get(key)
+            if m is None:
+                m = merged[key] = {
+                    "ns": key[0], "predicate": key[1], "reads": 0,
+                    "read_uids": 0, "mutation_edges": 0,
+                    "decoded_bytes": 0, "result_bytes": 0,
+                    "_lat_w": 0.0,
+                }
+            for f in (
+                "reads", "read_uids", "mutation_edges",
+                "decoded_bytes", "result_bytes",
+            ):
+                m[f] += int(r.get(f, 0))
+            m["_lat_w"] += (
+                float(r.get("lat_ewma_ms", 0.0)) * int(r.get("reads", 0))
+            )
+    out = []
+    for m in merged.values():
+        w = m.pop("_lat_w")
+        m["lat_ewma_ms"] = round(w / m["reads"], 3) if m["reads"] else 0.0
+        out.append(m)
+    out.sort(key=lambda r: (r["ns"], r["predicate"]))
+    return out
+
+
 def _free_ports(n: int) -> List[int]:
     socks, ports = [], []
     for _ in range(n):
@@ -675,6 +710,17 @@ class ProcCluster:
 
         return run_rebalance(self, min_move_bytes=min_move_bytes)
 
+    def rebalance_by_traffic(self, min_move_bytes: int = 1 << 10):
+        """One traffic-weighted rebalance step: tablets weigh their
+        size PLUS observed traffic (cluster-merged /debug/tablets
+        rows), so a hot small tablet can out-score a cold giant one
+        (worker/tabletmove.pick_rebalance_move_by_traffic)."""
+        from dgraph_tpu.worker.tabletmove import run_rebalance
+
+        return run_rebalance(
+            self, min_move_bytes=min_move_bytes, by_traffic=True
+        )
+
     def enable_auto_rebalance(self, interval_s: Optional[float] = None):
         """Jittered background auto-rebalance loop (poll_policy over
         DGRAPH_TPU_REBALANCE_INTERVAL_S): heals journaled half-moves,
@@ -689,7 +735,7 @@ class ProcCluster:
 
     def query(self, q: str, read_ts: Optional[int] = None,
               timeout_s: Optional[float] = None,
-              want: str = "dict") -> dict:
+              want: str = "dict", debug: bool = False) -> dict:
         """Query with graceful degradation: the entry point stamps one
         deadline for the whole read fan-out, and a group whose quorum is
         unreachable yields empty reads plus a `degraded`/`partial`
@@ -705,8 +751,13 @@ class ProcCluster:
         counts, retry/degradation events, and per-instance RPC
         fragments piggybacked on the responses. Queries slower than
         DGRAPH_TPU_SLOW_QUERY_MS are force-sampled and appended to the
-        slow-query JSONL log with their local span tree."""
-        from dgraph_tpu.posting.lists import LocalCache
+        slow-query JSONL log with their local span tree.
+
+        `debug=True` (EXPLAIN/ANALYZE) turns on the decision-capture
+        hooks and attaches the structured plan tree as
+        `extensions.plan`; response `data` bytes are identical with the
+        flag on or off (observation-only capture)."""
+        from dgraph_tpu.posting.lists import LocalCache, cache_tier_snapshot
         from dgraph_tpu.query.functions import QueryBudgetError
         from dgraph_tpu.query.streamjson import encode_response_data
         from dgraph_tpu.query.subgraph import Executor
@@ -720,16 +771,20 @@ class ProcCluster:
         shape = None
         slow = False
         completed = False  # clean, untruncated execution
+        parse_info: Optional[dict] = {} if debug else None
+        cache_base = cache_tier_snapshot(self.mem) if debug else None
         try:
             with deadline_scope(
                 current_deadline() or Deadline.after(budget)
             ), \
                     TRACER.span("query") as root, \
-                    profile_scope() as prof, \
+                    profile_scope(debug=debug) as prof, \
                     METRICS.timer("query_latency_seconds"):
                 with TRACER.span("parse"):
                     # plan cache: repeated shapes skip parse entirely
-                    blocks, shape = self.serving.parse(q)
+                    blocks, shape = self.serving.parse(
+                        q, info=parse_info
+                    )
                 # admission gate: shed fast past the in-flight budget,
                 # degrade (bounded budget + partial response) under
                 # saturation — a shed raises out through the root span
@@ -816,6 +871,25 @@ class ProcCluster:
             if total_ns > 0 and prof.encode:
                 prof.encode["share"] = round(enc_ns / total_ns, 4)
             ext["profile"] = prof.to_dict()
+            if prof.plan is not None:
+                prof.plan.plan_cache = parse_info or {}
+                prof.plan.admission = {
+                    "enabled": self.serving.admission.enabled(),
+                    "cost": round(ticket.cost, 3),
+                    "degrade": ticket.degrade,
+                }
+                if cache_base is not None:
+                    now_tiers = cache_tier_snapshot(self.mem)
+                    prof.plan.cache = {
+                        k: now_tiers[k] - cache_base.get(k, 0)
+                        for k in now_tiers
+                    }
+                prof.plan.meta = {
+                    "read_ts": int(ts),
+                    "snapshot_watermark": int(self._snapshot_ts),
+                    "wall_ns": total_ns,
+                }
+                ext["plan"] = prof.plan.to_dict()
             if root.trace_id:
                 ext["trace_id"] = f"{root.trace_id:032x}"
             if ticket.degrade:
@@ -864,38 +938,187 @@ class ProcCluster:
             out[f"{kind}-{nid}"] = tuple(cfg["rpc_addr"])
         return out
 
+    def _scrape_all(
+        self, method: str, args=None, timeout: float = 2.0
+    ) -> Tuple[Dict[str, object], List[str]]:
+        """Call one debug RPC on every replica process — in PARALLEL,
+        so an unreachable replica costs one timeout total, not one per
+        position in a serial sweep (the operator probing an outage is
+        exactly who cannot wait N x 2s). Returns ({instance: reply},
+        [unreachable instances]). Degraded-scrape contract: a dead or
+        partitioned replica yields a PARTIAL merge plus its name in
+        the unreachable list — never an exception out of the
+        aggregation path (regression: kill one alpha mid-scrape,
+        tests/test_telemetry.py)."""
+        labels = sorted(self.instance_labels().items())
+        replies: Dict[str, object] = {}
+        unreachable: List[str] = []
+
+        def one(item):
+            label, addr = item
+            try:
+                return label, self.pool.call(
+                    addr, method, args, timeout=timeout
+                )
+            except (RpcError, OSError, TimeoutError):
+                return label, None
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        if labels:
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(labels))
+            ) as ex:
+                for label, got in ex.map(one, labels):
+                    if got is None:
+                        METRICS.inc("metrics_scrape_errors_total")
+                        unreachable.append(label)
+                    else:
+                        replies[label] = got
+        return replies, unreachable
+
     def scrape_metrics(self) -> Dict[str, str]:
         """One Prometheus exposition text per cluster process — every
         replica via its debug.metrics RPC plus this coordinator's own
         registry under the "client" label. Unreachable instances are
         skipped and counted (metrics_scrape_errors_total)."""
+        return self.scrape_metrics_ex()[0]
+
+    def scrape_metrics_ex(self) -> Tuple[Dict[str, str], List[str]]:
+        replies, unreachable = self._scrape_all("debug.metrics")
         texts: Dict[str, str] = {"client": METRICS.render()}
-        for label, addr in self.instance_labels().items():
-            try:
-                got = self.pool.call(addr, "debug.metrics", timeout=2.0)
-                texts[label] = got["text"]
-            except RpcError:
-                METRICS.inc("metrics_scrape_errors_total")
-        return texts
+        for label, got in replies.items():
+            texts[label] = got["text"]
+        return texts, unreachable
 
-    def merged_metrics(self) -> str:
+    def merged_metrics(self, with_meta: bool = False):
         """The cluster-wide /debug/prometheus_metrics body: counters
-        summed, histogram buckets merged, per-instance labels kept."""
-        return observe.merge_expositions(self.scrape_metrics())
+        summed, histogram buckets merged, per-instance labels kept.
+        `with_meta=True` returns (text, unreachable_instances) — the
+        partial-merge contract when replicas are down."""
+        texts, unreachable = self.scrape_metrics_ex()
+        merged = observe.merge_expositions(texts)
+        if with_meta:
+            return merged, unreachable
+        return merged
 
-    def merged_traces(self, n: int = 200) -> List[dict]:
+    def merged_traces(self, n: int = 200, with_meta: bool = False):
         """Recent spans across every cluster process, tagged with the
-        instance that emitted them (the /debug/traces aggregation)."""
+        instance that emitted them (the /debug/traces aggregation).
+        `with_meta=True` returns (spans, unreachable_instances)."""
         spans = [
             dict(s, instance="client") for s in TRACER.recent(n)
         ]
-        for label, addr in self.instance_labels().items():
-            try:
-                got = self.pool.call(
-                    addr, "debug.traces", {"n": n}, timeout=2.0
-                )
-                spans.extend(dict(s, instance=label) for s in got["spans"])
-            except RpcError:
-                METRICS.inc("metrics_scrape_errors_total")
+        replies, unreachable = self._scrape_all("debug.traces", {"n": n})
+        for label, got in replies.items():
+            spans.extend(dict(s, instance=label) for s in got["spans"])
         spans.sort(key=lambda s: s.get("start") or 0)
+        if with_meta:
+            return spans, unreachable
         return spans
+
+    def merged_tablets(self) -> dict:
+        """Cluster-wide per-tablet traffic: every replica's
+        debug.tablets rows plus the coordinator's own accumulator,
+        summed by (ns, predicate) with a read-weighted EWMA average —
+        the /debug/tablets aggregation and the traffic-driven
+        rebalancer's input. Partial on replica outage, with the dead
+        instances named in unreachable_instances."""
+        observe.TABLETS.publish()
+        per_instance = [("client", observe.TABLETS.snapshot())]
+        replies, unreachable = self._scrape_all("debug.tablets")
+        for label, got in replies.items():
+            per_instance.append((label, got.get("tablets", [])))
+        return {
+            "tablets": merge_tablet_rows(
+                [rows for _label, rows in per_instance]
+            ),
+            "instances": [label for label, _rows in per_instance],
+            "unreachable_instances": unreachable,
+        }
+
+    def health(self) -> dict:
+        """The cluster health/SLO rollup behind `dgraph-tpu health`:
+        the coordinator's own healthz (admission rates, commit pipeline
+        depth, SLO burn windows) plus per-group raft state — leader
+        presence and per-replica applied-index lag from the health RPC
+        every alpha already serves — snapshot-watermark lag, and each
+        replica process's healthz via debug.health."""
+        out = observe.healthz("client")
+        # probe every replica of every group in one parallel sweep (a
+        # dead replica costs one timeout total, not one per position)
+        all_addrs = [
+            (gid, addr)
+            for gid, rg in sorted(self.remote_groups.items())
+            for addr in rg.addrs
+        ]
+
+        def probe(item):
+            gid, addr = item
+            try:
+                return gid, addr, self.pool.call(
+                    addr, "health", timeout=2.0
+                )
+            except (RpcError, OSError, TimeoutError):
+                return gid, addr, None
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        probed = []
+        if all_addrs:
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(all_addrs))
+            ) as ex:
+                probed = list(ex.map(probe, all_addrs))
+        groups: Dict[str, dict] = {}
+        for gid in sorted(self.remote_groups):
+            replicas = {}
+            leader_applied = 0
+            leader = None
+            for pgid, addr, h in probed:
+                if pgid != gid:
+                    continue
+                if h is None:
+                    replicas[f"{addr[0]}:{addr[1]}"] = {"ok": False}
+                    continue
+                nid = int(getattr(h, "node", 0))
+                applied = int(getattr(h, "applied", 0))
+                is_leader = bool(getattr(h, "is_leader", False))
+                if is_leader:
+                    leader = nid
+                    leader_applied = max(leader_applied, applied)
+                replicas[str(nid)] = {
+                    "ok": True,
+                    "is_leader": is_leader,
+                    "term": int(getattr(h, "term", 0)),
+                    "applied": applied,
+                }
+            for r in replicas.values():
+                if r.get("ok"):
+                    r["applied_lag"] = max(
+                        0, leader_applied - r["applied"]
+                    )
+            groups[str(gid)] = {
+                "leader": leader,
+                "healthy": leader is not None,
+                "replicas": replicas,
+            }
+        out["groups"] = groups
+        out["snapshot_watermark"] = int(self._snapshot_ts)
+        # watermark lag: how far the serving snapshot trails the newest
+        # leased timestamp (in-flight commits). Only the local ZeroLite
+        # exposes max_assigned without a consensus round; omitted on a
+        # remote Zero quorum.
+        ma = getattr(self.zero.zero, "max_assigned", None)
+        if isinstance(ma, (int, float)):
+            out["watermark_lag"] = max(0, int(ma) - self._snapshot_ts)
+        replies, unreachable = self._scrape_all("debug.health")
+        out["processes"] = {
+            label: got for label, got in sorted(replies.items())
+        }
+        out["unreachable_instances"] = unreachable
+        if unreachable or any(
+            not g["healthy"] for g in groups.values()
+        ):
+            out["status"] = "degraded"
+        return out

@@ -267,6 +267,103 @@ def pick_rebalance_move(
     return None
 
 
+# how many stored bytes one byte of observed traffic is worth in the
+# traffic-weighted score: served (decoded + result) bytes count 1:1
+# against resident bytes, and one mutation edge is charged as a ~64-byte
+# record write. Deliberately a constant, not a knob — the score must be
+# reproducible from /debug/tablets alone.
+_TRAFFIC_EDGE_BYTES = 64
+
+
+def traffic_score(size_bytes: int, row: Optional[dict]) -> int:
+    """Traffic-weighted load score of one tablet: its resident bytes
+    plus the traffic it has served (decoded + result bytes read off it,
+    mutation edges written into it). A hot small tablet can outweigh a
+    cold giant one — exactly the case byte-only balancing gets wrong
+    (a 1-byte tablet serving 1M reads is the group's real load)."""
+    score = int(size_bytes)
+    if row:
+        score += int(row.get("decoded_bytes", 0))
+        score += int(row.get("result_bytes", 0))
+        score += int(row.get("mutation_edges", 0)) * _TRAFFIC_EDGE_BYTES
+    return score
+
+
+def pick_rebalance_move_by_traffic(
+    sizes: Dict[str, int],
+    traffic: Dict[str, dict],
+    tablets: Dict[str, int],
+    group_ids: Iterable[int],
+    min_move_bytes: int,
+) -> Optional[Tuple[str, int]]:
+    """The traffic-weighted analog of pick_rebalance_move: same
+    deterministic gap-narrowing picker (ties → smallest gid /
+    lexicographic pred, +1-per-tablet floor), but every tablet weighs
+    its traffic_score instead of raw bytes. `traffic` maps predicate →
+    a /debug/tablets row (cluster-merged); missing rows score as cold.
+    Behind DGRAPH_TPU_REBALANCE_BY_TRAFFIC — size-based stays the
+    default."""
+    scores = {
+        p: traffic_score(sizes.get(p, 0), traffic.get(p))
+        for p in tablets
+    }
+    return pick_rebalance_move(scores, tablets, group_ids, min_move_bytes)
+
+
+_TRAFFIC_FIELDS = (
+    "decoded_bytes", "result_bytes", "mutation_edges", "reads",
+)
+
+
+def cluster_traffic_by_pred(cluster) -> Dict[str, dict]:
+    """Cluster-wide per-predicate traffic rows for the rebalancer:
+    merged /debug/tablets when the cluster aggregates (ProcCluster),
+    else the local accumulator. Namespaces collapse — a tablet moves
+    as a whole across namespaces."""
+    from dgraph_tpu.utils import observe
+
+    getter = getattr(cluster, "merged_tablets", None)
+    rows = (
+        getter()["tablets"]
+        if getter is not None
+        else observe.TABLETS.snapshot()
+    )
+    out: Dict[str, dict] = {}
+    for r in rows:
+        agg = out.setdefault(
+            r["predicate"], {k: 0 for k in _TRAFFIC_FIELDS}
+        )
+        for k in agg:
+            agg[k] += int(r.get(k, 0))
+    return out
+
+
+def _traffic_window(cluster) -> Dict[str, dict]:
+    """Per-predicate traffic accrued SINCE the previous rebalance step
+    on this cluster. The accumulator's totals are cumulative-for-life;
+    scoring on them would chase stale hotspots (a tablet that served
+    10GB in hour one and is now idle must not out-score the tablet
+    serving real load NOW). Each call diffs against — and then
+    advances — a per-cluster baseline, so an auto-rebalance loop's
+    ticks see one window of recent traffic each. The first call (no
+    baseline yet) sees the lifetime totals: the bootstrap window."""
+    current = cluster_traffic_by_pred(cluster)
+    baseline = getattr(cluster, "_tabletmove_traffic_base", None)
+    cluster._tabletmove_traffic_base = {
+        p: dict(v) for p, v in current.items()
+    }
+    if baseline is None:
+        return current
+    window: Dict[str, dict] = {}
+    for p, cur in current.items():
+        base = baseline.get(p, {})
+        window[p] = {
+            k: max(0, cur.get(k, 0) - base.get(k, 0))
+            for k in _TRAFFIC_FIELDS
+        }
+    return window
+
+
 def tablet_size(cluster, pred: str) -> int:
     """Record bytes of one tablet (data + split parts) on its owning
     group — the rebalancer's load signal (ref zero/tablet.go size
@@ -325,10 +422,16 @@ def recover_all(cluster) -> int:
     return n
 
 
-def run_rebalance(cluster, min_move_bytes: int = 1 << 10) -> Optional[str]:
-    """One size-based rebalance step: pick deterministically, move.
-    Returns the moved predicate or None. Predicates already moving (in
-    flight here or journaled) are not candidates."""
+def run_rebalance(
+    cluster, min_move_bytes: int = 1 << 10,
+    by_traffic: Optional[bool] = None,
+) -> Optional[str]:
+    """One rebalance step: pick deterministically, move. Returns the
+    moved predicate or None. Predicates already moving (in flight here
+    or journaled) are not candidates. Scoring is size-based by default;
+    DGRAPH_TPU_REBALANCE_BY_TRAFFIC (or an explicit by_traffic=True)
+    weighs each tablet by its observed traffic on top of bytes
+    (pick_rebalance_move_by_traffic)."""
     lock, active = _move_state(cluster)
     with lock:  # movers mutate the registry under this lock
         busy = set(active)
@@ -337,9 +440,17 @@ def run_rebalance(cluster, min_move_bytes: int = 1 << 10) -> Optional[str]:
         p: g for p, g in cluster.zero.tablets.items() if p not in busy
     }
     sizes = {p: cluster.tablet_size_bytes(p) for p in tablets}
-    pick = pick_rebalance_move(
-        sizes, tablets, cluster._move_group_ids(), min_move_bytes
-    )
+    if by_traffic is None:
+        by_traffic = bool(config.get("REBALANCE_BY_TRAFFIC"))
+    if by_traffic:
+        pick = pick_rebalance_move_by_traffic(
+            sizes, _traffic_window(cluster), tablets,
+            cluster._move_group_ids(), min_move_bytes,
+        )
+    else:
+        pick = pick_rebalance_move(
+            sizes, tablets, cluster._move_group_ids(), min_move_bytes
+        )
     if pick is None:
         return None
     pred, dst = pick

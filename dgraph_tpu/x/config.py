@@ -151,6 +151,14 @@ _define(
     "tests can flip it between queries.",
 )
 _define(
+    "EXEMPLARS", "bool", True,
+    "Trace exemplars on latency histograms: each histogram bucket "
+    "retains its latest (value, trace_id) observation, exported in "
+    "OpenMetrics exemplar syntax at /debug/openmetrics and embedded in "
+    "slow-query log records — the metrics→trace link "
+    "(utils/observe.py). 0 disables exemplar capture.",
+)
+_define(
     "FAKE_NOW", "str", "",
     "Frozen timestamp for @default($now) GraphQL values — test "
     "determinism hook (graphql/resolve.py). Empty = real UTC now.",
@@ -286,6 +294,16 @@ _define(
     "through every remote read beneath it (worker/harness.py).",
 )
 _define(
+    "REBALANCE_BY_TRAFFIC", "bool", False,
+    "Auto-rebalance scoring mode: when on, the tablet picker weighs "
+    "each tablet by size PLUS its observed traffic (decoded/result "
+    "bytes served, mutation-edge volume, from the per-tablet traffic "
+    "accumulator), so a hot small tablet can outweigh a cold giant "
+    "one (worker/tabletmove.py pick_rebalance_move_by_traffic). Off "
+    "by default: size-based rebalance stays the deterministic "
+    "baseline.",
+)
+_define(
     "REBALANCE_INTERVAL_S", "float", 480.0,
     "Mean period of the jittered auto-rebalance loop "
     "(enable_auto_rebalance: each tick heals journaled half-moves, "
@@ -308,6 +326,21 @@ _define(
     "SKIP_REMOTE_INTROSPECTION", "bool", False,
     "Defer @custom(http:{graphql:...}) remote-endpoint introspection "
     "at schema-update time — air-gapped loads (graphql/resolve.py).",
+)
+_define(
+    "SLO_QUERY_MS", "float", 250.0,
+    "SLO latency objective in milliseconds for the entry-point "
+    "latency histograms (query_latency_seconds / "
+    "commit_latency_seconds): operations slower than this count "
+    "against the error budget in the multi-window burn rates served "
+    "at /debug/healthz (utils/observe.py SloWindows).",
+)
+_define(
+    "SLO_TARGET", "float", 0.99,
+    "SLO availability target (fraction of operations meeting "
+    "DGRAPH_TPU_SLO_QUERY_MS): the error budget is 1 - target, and a "
+    "window's burn rate is its error rate divided by that budget "
+    "(burn 1.0 = consuming budget exactly) (utils/observe.py).",
 )
 _define(
     "SLOW_QUERY_LOG", "str", "",
@@ -343,6 +376,15 @@ _define(
     "encoder by contract. 0 is the escape hatch back to the "
     "ExecNode->dict->json.dumps path (query/outputjson.py) for A/B "
     "benchmarking (BENCH_ENCODE.json) and triage.",
+)
+_define(
+    "TABLET_TRAFFIC", "bool", True,
+    "Per-tablet traffic accounting (utils/observe.py TabletTraffic): "
+    "every level read and committed mutation records into a sharded "
+    "(namespace, predicate) accumulator served at /debug/tablets and "
+    "consumed by the traffic-driven rebalancer. Always-on by design "
+    "(overhead proven within noise in BENCH_OBS.json); 0 is the A/B "
+    "escape hatch for that capture.",
 )
 _define(
     "TRACE", "bool", True,

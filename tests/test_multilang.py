@@ -97,6 +97,7 @@ def test_cjk_fulltext_bigrams():
 
 def test_decrypt_cli_roundtrip(tmp_path):
     """dgraph decrypt (ref dgraph/cmd/decrypt/decrypt.go:47)."""
+    pytest.importorskip("cryptography")
     import gzip
     import os
 

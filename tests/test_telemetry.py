@@ -1,0 +1,602 @@
+"""The telemetry plane: per-tablet traffic accounting, the traffic-
+driven rebalancer, trace exemplars (OpenMetrics round-trip + slow-log
+embedding), the health/SLO rollup, and degraded-scrape robustness
+(partial merges + unreachable_instances with an alpha down).
+"""
+
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu.utils import observe
+from dgraph_tpu.utils.observe import (
+    METRICS,
+    TABLETS,
+    Metrics,
+    SloWindows,
+    TabletTraffic,
+    parse_openmetrics_exemplars,
+)
+
+
+# ---------------------------------------------------------------------------
+# traffic accumulator
+# ---------------------------------------------------------------------------
+
+
+def test_traffic_accumulator_fields_and_merge_of_shards():
+    t = TabletTraffic()
+    t.note_read(0, "name", 1, 100, 800, 0, 2.0)
+    t.note_read(0, "name", 1, 50, 400, 0, 4.0)
+    t.note_result(0, "name", 256)
+    t.note_write(0, "name", 7)
+    t.note_read(5, "name", 1, 1, 8, 0, 1.0)  # other namespace: own row
+    t.note_write(0, "friend", 3)
+    rows = {(r["ns"], r["predicate"]): r for r in t.snapshot()}
+    r = rows[(0, "name")]
+    assert r["reads"] == 2 and r["read_uids"] == 150
+    assert r["decoded_bytes"] == 1200 and r["result_bytes"] == 256
+    assert r["mutation_edges"] == 7
+    # EWMA: 2.0 then +0.2*(4.0-2.0) = 2.4
+    assert abs(r["lat_ewma_ms"] - 2.4) < 1e-9
+    assert rows[(5, "name")]["reads"] == 1
+    assert rows[(0, "friend")]["mutation_edges"] == 3
+    t.clear()
+    assert t.snapshot() == []
+
+
+def test_query_and_mutation_feed_the_global_accumulator():
+    from dgraph_tpu.api.server import Server
+
+    TABLETS.clear()
+    s = Server()
+    s.alter("tname: string @index(exact) .\ntfriend: [uid] .")
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf=(
+            '<0x1> <tname> "A" .\n<0x2> <tname> "B" .\n'
+            "<0x1> <tfriend> <0x2> ."
+        ),
+        commit_now=True,
+    )
+    s.query("{ q(func: has(tname)) { tname tfriend { uid } } }")
+    rows = {r["predicate"]: r for r in TABLETS.snapshot()}
+    assert rows["tname"]["mutation_edges"] >= 2
+    assert rows["tfriend"]["mutation_edges"] >= 1
+    assert rows["tname"]["reads"] >= 1
+    assert rows["tfriend"]["reads"] >= 1
+    assert rows["tfriend"]["decoded_bytes"] > 0
+    assert rows["tfriend"]["result_bytes"] > 0
+    assert rows["tname"]["lat_ewma_ms"] >= 0
+
+
+def test_traffic_knob_off_disables_capture(monkeypatch):
+    from dgraph_tpu.api.server import Server
+
+    monkeypatch.setenv("DGRAPH_TPU_TABLET_TRAFFIC", "0")
+    TABLETS.clear()
+    s = Server()
+    s.alter("oname: string .")
+    s.new_txn().mutate_rdf(
+        set_rdf='<0x1> <oname> "A" .', commit_now=True
+    )
+    s.query("{ q(func: has(oname)) { oname } }")
+    assert TABLETS.snapshot() == []
+
+
+def test_merge_tablet_rows_weighted_ewma():
+    from dgraph_tpu.worker.harness import merge_tablet_rows
+
+    a = [{"ns": 0, "predicate": "p", "reads": 9, "read_uids": 90,
+          "mutation_edges": 1, "decoded_bytes": 900, "result_bytes": 90,
+          "lat_ewma_ms": 1.0}]
+    b = [{"ns": 0, "predicate": "p", "reads": 1, "read_uids": 10,
+          "mutation_edges": 2, "decoded_bytes": 100, "result_bytes": 10,
+          "lat_ewma_ms": 11.0},
+         {"ns": 0, "predicate": "q", "reads": 0, "read_uids": 0,
+          "mutation_edges": 5, "decoded_bytes": 0, "result_bytes": 0,
+          "lat_ewma_ms": 0.0}]
+    merged = {r["predicate"]: r for r in merge_tablet_rows([a, b])}
+    p = merged["p"]
+    assert p["reads"] == 10 and p["decoded_bytes"] == 1000
+    assert p["mutation_edges"] == 3
+    # read-weighted: (9*1.0 + 1*11.0) / 10 = 2.0
+    assert abs(p["lat_ewma_ms"] - 2.0) < 1e-9
+    assert merged["q"]["mutation_edges"] == 5
+    assert merged["q"]["lat_ewma_ms"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# traffic-driven rebalance picking (pure, adversarial distributions)
+# ---------------------------------------------------------------------------
+
+
+def test_pick_by_traffic_hot_small_beats_cold_giant():
+    from dgraph_tpu.worker.tabletmove import (
+        pick_rebalance_move,
+        pick_rebalance_move_by_traffic,
+    )
+
+    sizes = {"giant": 10_000_000, "hot": 1_000}
+    tablets = {"giant": 1, "hot": 1}
+    # size-based would move the giant
+    assert pick_rebalance_move(sizes, tablets, [1, 2], 1) == ("giant", 2)
+    traffic = {
+        "hot": {"decoded_bytes": 50_000_000, "result_bytes": 5_000_000,
+                "mutation_edges": 100_000},
+    }
+    # traffic-weighted: the hot tiny tablet carries the real load
+    assert pick_rebalance_move_by_traffic(
+        sizes, traffic, tablets, [1, 2], 1
+    ) == ("hot", 2)
+
+
+def test_pick_by_traffic_cold_cluster_degenerates_to_size():
+    from dgraph_tpu.worker.tabletmove import (
+        pick_rebalance_move,
+        pick_rebalance_move_by_traffic,
+    )
+
+    sizes = {"a": 5000, "b": 100, "c": 40}
+    tablets = {"a": 1, "b": 1, "c": 2}
+    assert pick_rebalance_move_by_traffic(
+        sizes, {}, tablets, [1, 2], 1
+    ) == pick_rebalance_move(sizes, tablets, [1, 2], 1)
+
+
+def test_pick_by_traffic_deterministic_and_balanced_noop():
+    from dgraph_tpu.worker.tabletmove import pick_rebalance_move_by_traffic
+
+    sizes = {"a": 100, "b": 100}
+    tablets = {"a": 1, "b": 2}
+    traffic = {
+        "a": {"decoded_bytes": 1000, "result_bytes": 0,
+              "mutation_edges": 0},
+        "b": {"decoded_bytes": 1000, "result_bytes": 0,
+              "mutation_edges": 0},
+    }
+    # balanced: no move; and repeat calls agree (determinism)
+    for _ in range(3):
+        assert pick_rebalance_move_by_traffic(
+            sizes, traffic, tablets, [1, 2], 1
+        ) is None
+
+
+def test_traffic_window_diffs_between_rebalance_steps():
+    """The rebalancer scores traffic accrued SINCE the last step, not
+    lifetime totals — an old hotspot gone idle must stop out-scoring
+    currently-hot tablets on later ticks."""
+    from dgraph_tpu.worker.tabletmove import _traffic_window
+
+    class FakeCluster:
+        def __init__(self):
+            self.rows = []
+
+        def merged_tablets(self):
+            return {"tablets": self.rows}
+
+    c = FakeCluster()
+    c.rows = [{"ns": 0, "predicate": "old_hot", "reads": 100,
+               "decoded_bytes": 10_000, "result_bytes": 1000,
+               "mutation_edges": 50}]
+    first = _traffic_window(c)
+    assert first["old_hot"]["decoded_bytes"] == 10_000  # bootstrap
+    # old_hot goes idle; new_hot starts serving
+    c.rows = [
+        {"ns": 0, "predicate": "old_hot", "reads": 100,
+         "decoded_bytes": 10_000, "result_bytes": 1000,
+         "mutation_edges": 50},
+        {"ns": 0, "predicate": "new_hot", "reads": 10,
+         "decoded_bytes": 4_000, "result_bytes": 400,
+         "mutation_edges": 0},
+    ]
+    second = _traffic_window(c)
+    assert second["old_hot"] == {
+        "decoded_bytes": 0, "result_bytes": 0, "mutation_edges": 0,
+        "reads": 0,
+    }
+    assert second["new_hot"]["decoded_bytes"] == 4_000
+
+
+def test_run_rebalance_honors_traffic_knob(monkeypatch):
+    """End-to-end on the in-process cluster: a hot small tablet moves
+    ahead of a cold giant one when traffic scoring is on."""
+    from dgraph_tpu.worker.groups import DistributedCluster
+
+    TABLETS.clear()
+    c = DistributedCluster(n_groups=2, replicas=1, pump_ms=2)
+    try:
+        c.alter("hot: string @index(exact) .\ncold: string .")
+        # giant cold tablet, small hot tablet — both land on group 1
+        rdf = ['<0x%x> <cold> "%s" .' % (i, "x" * 256) for i in
+               range(1, 120)]
+        rdf.append('<0x1> <hot> "a" .')
+        c.new_txn().mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+        for pred in list(c.zero.tablets):
+            if c.zero.belongs_to(pred) != 1:
+                c.move_tablet(pred, 1)
+        TABLETS.clear()  # mutation traffic above is setup, not signal
+        for _ in range(50):
+            c.query("{ q(func: has(hot)) { hot } }")
+        sizes = {
+            "hot": c.tablet_size_bytes("hot"),
+            "cold": c.tablet_size_bytes("cold"),
+        }
+        assert sizes["cold"] > sizes["hot"] * 10  # genuinely adversarial
+        # drive reads until hot's traffic score outweighs cold's bytes
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            row = next(
+                r for r in TABLETS.snapshot() if r["predicate"] == "hot"
+            )
+            if row["decoded_bytes"] + row["result_bytes"] > sizes["cold"]:
+                break
+            c.query("{ q(func: has(hot)) { hot } }")
+        # size-based scoring would pick the giant...
+        from dgraph_tpu.worker.tabletmove import pick_rebalance_move
+
+        assert pick_rebalance_move(
+            sizes, dict(c.zero.tablets), [1, 2], 1
+        )[0] == "cold"
+        # ...the traffic-driven step moves the HOT tablet instead; and
+        # the knob routes run_rebalance the same way
+        monkeypatch.setenv("DGRAPH_TPU_REBALANCE_BY_TRAFFIC", "1")
+        from dgraph_tpu.worker.tabletmove import run_rebalance
+
+        moved = run_rebalance(c)
+        assert moved == "hot"
+        assert c.zero.belongs_to("hot") == 2
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+# ---------------------------------------------------------------------------
+
+
+def test_exemplars_bounded_and_roundtrip():
+    m = Metrics(prefix="t")
+    with observe.TRACER.span("query") as sp:
+        for v in (0.0004, 0.03, 0.03, 7.0, 42.0):
+            m.observe("lat_seconds", v)
+    text = m.render_openmetrics()
+    assert text.rstrip().endswith("# EOF")
+    ex = parse_openmetrics_exemplars(text)
+    # one exemplar per touched bucket, all carrying OUR trace id
+    assert len(ex) == 4  # 0.0005, 0.05, 10.0 and +Inf buckets
+    tid = f"{sp.trace_id:032x}"
+    for rec in ex.values():
+        assert rec["trace_id"] == tid
+        assert rec["ts"] is not None
+    inf = ex['t_lat_seconds_bucket{le="+Inf"}']
+    assert inf["value"] == 42.0
+    # exemplar lines match the OpenMetrics grammar
+    for line in text.splitlines():
+        if " # " in line:
+            assert re.match(
+                r'^\S+\{le="[^"]+"\} \d+(\.\d+)? # '
+                r'\{trace_id="[0-9a-f]{32}"\} \S+ \d+\.\d+$',
+                line,
+            ), line
+    # bounded: the ring is one slot per bucket, repeat observations
+    # replace rather than grow
+    h = m._hists["lat_seconds"]
+    assert len(h.exemplars) == len(h.buckets) + 1
+
+
+def test_exemplars_knob_off(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_EXEMPLARS", "0")
+    m = Metrics(prefix="t2")
+    with observe.TRACER.span("query"):
+        m.observe("lat_seconds", 0.03)
+    assert parse_openmetrics_exemplars(m.render_openmetrics()) == {}
+
+
+def test_exemplars_absent_without_trace_context():
+    m = Metrics(prefix="t3")
+    m.observe("lat_seconds", 0.03)  # no active span
+    assert parse_openmetrics_exemplars(m.render_openmetrics()) == {}
+
+
+def test_slow_query_log_embeds_exemplars(tmp_path, monkeypatch):
+    from dgraph_tpu.api.server import Server
+
+    log = tmp_path / "slow.jsonl"
+    monkeypatch.setenv("DGRAPH_TPU_SLOW_QUERY_LOG", str(log))
+    monkeypatch.setenv("DGRAPH_TPU_SLOW_QUERY_MS", "0.0")
+    s = Server()
+    s.alter("sname: string .")
+    s.new_txn().mutate_rdf(
+        set_rdf='<0x1> <sname> "A" .', commit_now=True
+    )
+    s.query("{ q(func: has(sname)) { sname } }")
+    rec = json.loads(log.read_text().splitlines()[-1])
+    assert "exemplars" in rec
+    assert rec["exemplars"], rec
+    for ex in rec["exemplars"]:
+        assert set(ex) == {"le", "value", "trace_id", "ts"}
+        assert re.fullmatch(r"[0-9a-f]{32}", ex["trace_id"])
+    # the slow query's own trace id is among the anchored buckets
+    # (it was just observed into the histogram)
+    assert any(
+        ex["trace_id"] == rec["trace_id"] for ex in rec["exemplars"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# health / SLO
+# ---------------------------------------------------------------------------
+
+
+def test_slo_windows_burn_rate(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_SLO_QUERY_MS", "100")
+    monkeypatch.setenv("DGRAPH_TPU_SLO_TARGET", "0.9")
+    w = SloWindows()
+    for _ in range(8):
+        w.note(0.05)  # good
+    for _ in range(2):
+        w.note(0.5)  # bad
+    rep = w.report()
+    assert rep["threshold_ms"] == 100.0
+    m = rep["windows"]["60s"]
+    assert m["total"] == 10 and m["bad"] == 2
+    assert abs(m["error_rate"] - 0.2) < 1e-9
+    # budget = 0.1 -> burn = 0.2 / 0.1 = 2.0
+    assert abs(m["burn_rate"] - 2.0) < 1e-9
+    # every window sees the same fresh data
+    assert rep["windows"]["3600s"]["total"] == 10
+
+
+def test_healthz_shape_and_sources():
+    observe.register_health("test_source", lambda: {"x": 1})
+    observe.register_health(
+        "broken_source", lambda: (_ for _ in ()).throw(ValueError("boom"))
+    )
+    try:
+        h = observe.healthz("me")
+        assert h["instance"] == "me" and h["status"] == "healthy"
+        assert {"admission", "commit_pipeline_depth", "slo"} <= set(h)
+        assert h["sources"]["test_source"] == {"x": 1}
+        assert "ValueError" in h["sources"]["broken_source"]["error"]
+    finally:
+        observe._HEALTH_SOURCES.pop("test_source", None)
+        observe._HEALTH_SOURCES.pop("broken_source", None)
+
+
+def test_distributed_cluster_health_and_tablets():
+    from dgraph_tpu.worker.groups import DistributedCluster
+
+    c = DistributedCluster(n_groups=2, replicas=3, pump_ms=2)
+    try:
+        c.alter("hname: string @index(exact) .")
+        c.new_txn().mutate_rdf(
+            set_rdf='<0x1> <hname> "A" .', commit_now=True
+        )
+        c.query("{ q(func: has(hname)) { hname } }")
+        h = c.health()
+        assert h["status"] == "healthy"
+        assert set(h["groups"]) == {"1", "2"}
+        for g in h["groups"].values():
+            assert g["healthy"] and g["leader"] is not None
+            assert len(g["replicas"]) == 3
+            for r in g["replicas"].values():
+                assert r["ok"] and r["applied_lag"] >= 0
+        assert any(
+            r["is_leader"] for r in h["groups"]["1"]["replicas"].values()
+        )
+        tabs = c.merged_tablets()
+        assert tabs["unreachable_instances"] == []
+        assert any(
+            r["predicate"] == "hname" for r in tabs["tablets"]
+        )
+        # kill a follower: group stays healthy, replica reports down
+        g1 = c.groups[1]
+        lead = g1.leader()
+        follower = next(n for n in g1.nodes if n.id != lead.id)
+        c.kill_node(follower.id)
+        h2 = c.health()
+        assert h2["groups"]["1"]["replicas"][str(follower.id)]["ok"] is False
+        assert h2["groups"]["1"]["healthy"]
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster scrape: degraded-scrape robustness + merged tablets + health
+# (one ProcCluster shared across the checks — spawn cost dominates)
+# ---------------------------------------------------------------------------
+
+
+def test_proc_cluster_telemetry_and_degraded_scrape():
+    from dgraph_tpu.worker.harness import ProcCluster
+
+    c = ProcCluster(n_groups=1, replicas=2)
+    try:
+        c.alter("pname: string @index(exact) .")
+        c.new_txn().mutate_rdf(
+            set_rdf='<0x1> <pname> "A" .\n<0x2> <pname> "B" .',
+            commit_now=True,
+        )
+        c.query("{ q(func: has(pname)) { pname } }")
+
+        # healthy-path: full merge, nothing unreachable
+        text, unreachable = c.merged_metrics(with_meta=True)
+        assert unreachable == []
+        assert "dgraph_tpu_num_queries" in text
+        tabs = c.merged_tablets()
+        assert tabs["unreachable_instances"] == []
+        assert any(r["predicate"] == "pname" for r in tabs["tablets"])
+        h = c.health()
+        assert h["groups"]["1"]["healthy"]
+        assert h["status"] == "healthy"
+        assert h["snapshot_watermark"] > 0
+        assert "watermark_lag" in h
+        assert h["processes"]  # per-replica healthz via debug.health
+        for ph in h["processes"].values():
+            assert "slo" in ph and "uptime_s" in ph
+
+        # kill one alpha mid-scrape: PARTIAL merge + the dead instance
+        # named — never an exception out of the aggregation path
+        victims = [
+            nid for nid, cfg in c._cfgs.items()
+            if not cfg.get("_module", "").endswith("zero_process")
+        ]
+        dead = victims[-1]
+        c.kill(dead)
+        text, unreachable = c.merged_metrics(with_meta=True)
+        assert unreachable == [f"alpha-{dead}"]
+        assert "dgraph_tpu_num_queries" in text  # partial merge intact
+        spans, unreachable2 = c.merged_traces(n=50, with_meta=True)
+        assert unreachable2 == [f"alpha-{dead}"]
+        assert isinstance(spans, list)
+        tabs = c.merged_tablets()
+        assert tabs["unreachable_instances"] == [f"alpha-{dead}"]
+        h2 = c.health()
+        assert f"alpha-{dead}" in h2["unreachable_instances"]
+        assert h2["status"] == "degraded"
+        # legacy no-meta signatures still return the bare merge
+        assert isinstance(c.merged_metrics(), str)
+        assert isinstance(c.merged_traces(10), list)
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# traffic-driven move, end-to-end under the chaos bank
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_traffic_driven_move_under_chaos_bank():
+    """The PR 10 chaos bank exercising a TRAFFIC-driven move: bank
+    transfers hammer the small 'bal' tablet while a byte-giant cold
+    tablet sits beside it; with drop/delay faults on the RPC plane,
+    rebalance_by_traffic must move the HOT tablet (not the giant) and
+    the ledger must stay exact through the move."""
+    from dgraph_tpu.conn import faults
+    from dgraph_tpu.conn.faults import FaultPlan
+    from dgraph_tpu.conn.retry import RetryPolicy, retrying_call
+    from dgraph_tpu.worker.harness import ProcCluster
+    from dgraph_tpu.worker.tabletmove import (
+        TabletFencedError,
+        cluster_traffic_by_pred,
+        pick_rebalance_move,
+    )
+
+    N_ACCOUNTS, START_BAL = 6, 100
+    TABLETS.clear()
+    c = ProcCluster(n_groups=2, replicas=1)
+    stop = threading.Event()
+    ledger = {i: START_BAL for i in range(1, N_ACCOUNTS + 1)}
+    lock = threading.Lock()
+    stats = {"ok": 0, "ambiguous": 0}
+    try:
+        c.alter("bal: int @upsert .\nblob: string .")
+        rdf = [
+            f'<0x{i:x}> <bal> "{START_BAL}"^^<xs:int> .'
+            for i in range(1, N_ACCOUNTS + 1)
+        ]
+        # the cold giant: lots of bytes, no traffic after load
+        rdf += [
+            '<0x%x> <blob> "%s" .' % (i + 100, "z" * 512)
+            for i in range(1, 200)
+        ]
+        c.new_txn().mutate_rdf(set_rdf="\n".join(rdf), commit_now=True)
+        for pred in ("bal", "blob"):
+            if c.zero.belongs_to(pred) != 1:
+                c.move_tablet(pred, 1)
+        TABLETS.clear()  # setup traffic is not signal
+
+        faults.install(
+            FaultPlan(
+                seed=321,
+                rules=[
+                    dict(point="send", action="drop", p=0.02),
+                    dict(point="send", action="delay", p=0.05, delay_ms=3),
+                ],
+            )
+        )
+
+        import numpy as np
+
+        def writer():
+            rng = np.random.default_rng(7)
+            while not stop.is_set():
+                frm, to = (
+                    int(x) + 1
+                    for x in rng.choice(N_ACCOUNTS, 2, replace=False)
+                )
+                amt = int(rng.integers(1, 10))
+                rdf = (
+                    f'<0x{frm:x}> <bal> "{ledger[frm] - amt}"^^<xs:int> .\n'
+                    f'<0x{to:x}> <bal> "{ledger[to] + amt}"^^<xs:int> .'
+                )
+                try:
+                    retrying_call(
+                        lambda: c.new_txn().mutate_rdf(
+                            set_rdf=rdf, commit_now=True
+                        ),
+                        policy=RetryPolicy(
+                            base=0.02, cap=0.2, max_attempts=60
+                        ),
+                        retryable=(TabletFencedError,),
+                    )
+                    with lock:
+                        ledger[frm] -= amt
+                        ledger[to] += amt
+                        stats["ok"] += 1
+                except Exception:
+                    with lock:
+                        stats["ambiguous"] += 1
+                time.sleep(0.005)
+
+        th = threading.Thread(target=writer)
+        th.start()
+        # accumulate hot-tablet traffic: reads + the writer's mutations
+        deadline = time.time() + 20
+        sizes = {
+            "bal": c.tablet_size_bytes("bal"),
+            "blob": c.tablet_size_bytes("blob"),
+        }
+        assert sizes["blob"] > sizes["bal"] * 5
+        while time.time() < deadline:
+            c.query("{ q(func: has(bal)) { uid bal } }")
+            traffic = cluster_traffic_by_pred(c)
+            bal = traffic.get("bal", {})
+            hot_score = (
+                bal.get("decoded_bytes", 0)
+                + bal.get("result_bytes", 0)
+                + bal.get("mutation_edges", 0) * 64
+            )
+            if hot_score > sizes["blob"]:
+                break
+        assert hot_score > sizes["blob"], (traffic, sizes)
+        # size-based scoring would move the giant...
+        tablets = dict(c.zero.tablets)
+        assert pick_rebalance_move(
+            {p: c.tablet_size_bytes(p) for p in tablets}, tablets,
+            [1, 2], 1,
+        )[0] == "blob"
+        # ...the traffic-driven step moves the HOT tablet instead
+        moved = c.rebalance_by_traffic()
+        assert moved == "bal", moved
+        assert c.zero.belongs_to("bal") == 2
+        assert c.zero.moves() == {}  # journal drained
+        stop.set()
+        th.join(timeout=30)
+        faults.reset()
+        out = c.query("{ q(func: has(bal)) { uid bal } }")
+        bals = {int(x["uid"], 16): x["bal"] for x in out["data"]["q"]}
+        assert sum(bals.values()) == N_ACCOUNTS * START_BAL, bals
+        with lock:
+            if stats["ambiguous"] == 0:
+                assert bals == ledger, stats  # ledger-exact
+        assert stats["ok"] > 0
+    finally:
+        stop.set()
+        faults.reset()
+        c.close()

@@ -38,7 +38,11 @@ else
         tests/test_stream_encoder.py \
         tests/test_vector_quant.py \
         tests/test_group_commit.py \
+        tests/test_explain.py tests/test_telemetry.py \
         -q -p no:cacheprovider
+
+    echo "== explain sanity (~5s) =="
+    python bench.py --explain-sanity
 
     echo "== qps loadgen sanity (~5s) =="
     python benchmarks/qps_loadgen.py --sanity
