@@ -141,17 +141,20 @@ def backup_to_uri(server, uri: str, incremental: bool = True) -> dict:
     if isinstance(h, FileHandler):
         return _local_backup(server, h.dir, incremental=incremental)
     staging = tempfile.mkdtemp(prefix="dgraph_backup_stage_")
-    # seed staging with the remote manifest so increments chain correctly
-    if h.exists("manifest.json"):
+    # seed staging with the remote manifest ONLY: backup() reads just
+    # the manifest to chain its `since`, so downloading (and later
+    # re-uploading) every historical chunk file would cost O(backup
+    # history) transfer per incremental for nothing
+    man_blob = h.get("manifest.json") if h.exists("manifest.json") else None
+    if man_blob is not None:
         with open(os.path.join(staging, "manifest.json"), "wb") as f:
-            f.write(h.get("manifest.json"))
-        man = json.loads(h.get("manifest.json"))
-        for entry in man.get("backups", []):
-            name = entry["path"]
-            with open(os.path.join(staging, name), "wb") as f:
-                f.write(h.get(name))
+            f.write(man_blob)
     out = _local_backup(server, staging, incremental=incremental)
-    for name in os.listdir(staging):
+    # upload only what this backup produced: its chunk files + the
+    # updated manifest
+    for name in [f["name"] for f in out.get("files", [])] + [
+        "manifest.json"
+    ]:
         with open(os.path.join(staging, name), "rb") as f:
             h.put(name, f.read())
     shutil.rmtree(staging)
@@ -166,6 +169,11 @@ def backup_to_uri(server, uri: str, incremental: bool = True) -> dict:
 class Sink:
     def send(self, key: bytes, value: bytes) -> None:
         raise NotImplementedError
+
+    def flush(self) -> None:
+        """Block until every send() so far is durably accepted by the
+        sink — the CDC checkpoint must not advance past events a
+        client-side buffer could still drop (admin/cdc.py)."""
 
     def close(self) -> None:
         pass
@@ -214,6 +222,11 @@ class KafkaSink(Sink):
 
     def send(self, key, value):
         self.producer.send(self.topic, key=key, value=value)
+
+    def flush(self):
+        # producer.send only buffers client-side; the CDC checkpoint
+        # waits on this before advancing
+        self.producer.flush()
 
     def close(self):
         self.producer.flush()

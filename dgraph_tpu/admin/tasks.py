@@ -18,12 +18,14 @@ KIND_BACKUP = 1
 KIND_EXPORT = 2
 KIND_ROLLUP = 3
 KIND_MOVE = 4
+KIND_RESTORE = 5
 
 _KIND_NAMES = {
     KIND_BACKUP: "backup",
     KIND_EXPORT: "export",
     KIND_ROLLUP: "rollup",
     KIND_MOVE: "move",
+    KIND_RESTORE: "restore",
 }
 
 QUEUED = "Queued"
@@ -119,10 +121,19 @@ class TaskQueue:
 
 
 def enqueue_backup(server, dest: str, **kw) -> int:
-    from dgraph_tpu.admin.backup import backup
+    from dgraph_tpu.admin.backup import backup_engine
 
     tq = _queue_of(server)
-    return tq.enqueue(KIND_BACKUP, lambda: backup(server, dest, **kw))
+    return tq.enqueue(KIND_BACKUP, lambda: backup_engine(server, dest, **kw))
+
+
+def enqueue_restore(server, src: str, **kw) -> int:
+    from dgraph_tpu.admin.backup import restore_engine
+
+    tq = _queue_of(server)
+    return tq.enqueue(
+        KIND_RESTORE, lambda: {"records": restore_engine(server, src, **kw)}
+    )
 
 
 def enqueue_move(cluster, pred: str, dst_group: int) -> int:

@@ -184,6 +184,7 @@ class _Handler(BaseHTTPRequestHandler):
         # (ref edgraph alter/admin guardian checks)
         _GUARDED = (
             "/alter", "/admin", "/admin/export", "/admin/backup",
+            "/admin/restore", "/admin/cdc",
             "/admin/schema/graphql", "/admin/draining", "/admin/shutdown",
             "/admin/task",
             # GraphQL resolvers run inside the engine without per-predicate
@@ -360,9 +361,18 @@ class _Handler(BaseHTTPRequestHandler):
                 from dgraph_tpu.admin import tasks
 
                 dest = qs.get("destination", ["/tmp/dgraph_tpu_backup"])[0]
-                tid = tasks.enqueue_backup(self.engine, dest)
+                full = qs.get("full", ["false"])[0] == "true"
+                tid = tasks.enqueue_backup(
+                    self.engine, dest, incremental=not full
+                )
                 if qs.get("wait", ["true"])[0] == "true":
-                    st = tasks._queue_of(self.engine).wait(tid)
+                    # distributed online backups can legitimately run
+                    # long (move drains alone cost up to the fence
+                    # deadline per tablet) — the queue default of 30s
+                    # would 500 a backup that later succeeds
+                    st = tasks._queue_of(self.engine).wait(
+                        tid, timeout=300
+                    )
                     ok = st.get("status") == "Success"
                     self._reply(
                         {"data": {"code": st.get("status", "Unknown"), **st}},
@@ -371,6 +381,66 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._reply(
                         {"data": {"code": "Success", "taskId": f"{tid:#x}"}}
+                    )
+            elif path == "/admin/restore":
+                from dgraph_tpu.admin import tasks
+
+                src = qs.get("source", [""])[0]
+                if not src:
+                    return self._error("restore needs ?source=<dir>")
+                tid = tasks.enqueue_restore(self.engine, src)
+                if qs.get("wait", ["true"])[0] == "true":
+                    st = tasks._queue_of(self.engine).wait(tid, timeout=300)
+                    ok = st.get("status") == "Success"
+                    self._reply(
+                        {"data": {"code": st.get("status", "Unknown"), **st}},
+                        200 if ok else 500,
+                    )
+                else:
+                    self._reply(
+                        {"data": {"code": "Success", "taskId": f"{tid:#x}"}}
+                    )
+            elif path == "/admin/cdc":
+                from dgraph_tpu.admin.cdc import cdc_for_uri
+
+                sink = qs.get("sink", [""])[0]
+                cdc = getattr(self.engine, "_cdc", None)
+                if qs.get("disable", [""])[0] == "true":
+                    if cdc is not None:
+                        cdc.close()
+                    self._reply({"data": {"code": "Success",
+                                          "enabled": False}})
+                elif sink:
+                    if cdc is not None:
+                        cdc.close()
+                    cdc = cdc_for_uri(self.engine, sink)
+                    self._reply(
+                        {
+                            "data": {
+                                "code": "Success",
+                                "enabled": True,
+                                "sink": sink,
+                                "checkpoint": cdc.checkpoint,
+                            }
+                        }
+                    )
+                else:
+                    # status probe; `dead` means the emitter thread is
+                    # gone and events defer to replay — re-enable with
+                    # ?sink= to recover the stream
+                    self._reply(
+                        {
+                            "data": {
+                                "enabled": cdc is not None,
+                                "sink": getattr(cdc, "sink_uri", None),
+                                "checkpoint": (
+                                    cdc.checkpoint if cdc else 0
+                                ),
+                                "dead": bool(
+                                    cdc is not None and cdc.dead
+                                ),
+                            }
+                        }
                     )
             elif path == "/admin/task":
                 tid = int(qs.get("id", ["0"])[0], 16)

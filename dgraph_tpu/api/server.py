@@ -663,6 +663,17 @@ class Server:
                             self._snapshot_ts, m.commit_ts
                         )
                         self.zero.applied(m.commit_ts)
+                # CDC rides the FIFO barrier, not _post_commit: members
+                # here are commit-ts ascending and barriers run in
+                # ticket order, so the sink stream stays strictly
+                # commit-ts ordered even across batches
+                cdc = getattr(self, "_cdc", None)
+                if cdc is not None:
+                    for m in committed:
+                        if m.error is None:
+                            cdc.emit_commit(
+                                m.commit_ts, m.txn.cache.deltas
+                            )
             finally:
                 ok = 0
                 for m in committed:
@@ -682,9 +693,8 @@ class Server:
         from dgraph_tpu.posting.mutation import ingest_vectors
 
         self._feed_stats(txn.cache.deltas)
-        cdc = getattr(self, "_cdc", None)
-        if cdc is not None:
-            cdc.emit_commit(commit_ts, txn.cache.deltas)
+        # CDC emission moved into the batch barrier (strict commit-ts
+        # order across group-commit batches)
         subs = getattr(self, "_subscriptions", None)
         if subs is not None:
             subs.on_commit(txn.cache.deltas)

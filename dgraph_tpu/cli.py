@@ -5,7 +5,11 @@ Subcommands mirror the reference's cobra tree (root.go:80):
   bulk     — offline bulk load RDF into a data dir (ref cmd/bulk)
   live     — transactional load into a running data dir (ref cmd/live)
   export   — dump RDF/JSON + schema (ref worker/export.go)
-  backup / restore — manifest-chain backups (ref worker/backup*.go)
+  backup / restore — manifest-chain backups, local or --addr online
+             against a live cluster (ref worker/backup*.go,
+             worker/online_restore.go)
+  cdc      — manage/tail the CDC stream of a running alpha
+             (ref worker/cdc.go)
   acl      — user/group/rule administration (ref cmd/acl)
   increment — smoke-test counter (ref cmd/increment)
   debug    — p-dir inspector (ref cmd/debug)
@@ -79,10 +83,13 @@ def cmd_alpha(args):
             engine.enable_acl(secret=f.read().strip())
     if args.audit_dir:
         engine.enable_audit(args.audit_dir)
-    if args.cdc_file:
-        from dgraph_tpu.admin.cdc import CDC
+    from dgraph_tpu.x import config as _config
 
-        CDC(engine, sink_path=args.cdc_file)
+    cdc_sink = args.cdc_file or _config.get("CDC_SINK")
+    if cdc_sink:
+        from dgraph_tpu.admin.cdc import cdc_for_uri
+
+        cdc_for_uri(engine, cdc_sink)
     if args.rollup_interval > 0:
         from dgraph_tpu.posting.rollup import RollupDaemon
 
@@ -174,7 +181,45 @@ def cmd_export(args):
     print(json.dumps(out))
 
 
+def _admin_call(addr: str, path: str, timeout: float = 300.0):
+    """POST an /admin op against a running alpha; returns the JSON body
+    or exits nonzero with the error on stderr."""
+    import urllib.error
+    import urllib.request
+
+    url = addr.rstrip("/") + path
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read())
+        except Exception:
+            body = {"errors": [{"message": str(e)}]}
+        print(json.dumps(body), file=sys.stderr)
+        return None
+    except Exception as e:
+        print(f"{url}: {e}", file=sys.stderr)
+        return None
+
+
 def cmd_backup(args):
+    """Backup a local data dir — or, with --addr, a LIVE cluster: the
+    running alpha coordinates a journaled online backup (distributed
+    driver when it serves a cluster) while writes keep flowing."""
+    from urllib.parse import quote
+
+    if args.addr:
+        out = _admin_call(
+            args.addr,
+            f"/admin/backup?destination={quote(args.dest)}"
+            + ("&full=true" if args.full else ""),
+        )
+        if out is None:
+            return 1
+        print(json.dumps(out.get("data", out)))
+        return 0
     from dgraph_tpu.admin.backup import backup
 
     entry = backup(_server(args), args.dest, incremental=not args.full)
@@ -182,10 +227,55 @@ def cmd_backup(args):
 
 
 def cmd_restore(args):
+    """Restore a manifest chain into a local data dir — or, with
+    --addr, ONLINE into a live cluster (verified records proposed
+    through each group's raft log; leases + snapshot watermark advance
+    so the data is immediately visible)."""
+    from urllib.parse import quote
+
+    if args.addr:
+        out = _admin_call(
+            args.addr, f"/admin/restore?source={quote(args.src)}"
+        )
+        if out is None:
+            return 1
+        print(json.dumps(out.get("data", out)))
+        return 0
     from dgraph_tpu.admin.backup import restore
 
     n = restore(_server(args), args.src)
     print(f"restored {n} records")
+
+
+def cmd_cdc(args):
+    """Manage the CDC stream of a running alpha: point it at a sink
+    (--sink), turn it off (--disable), probe its status (default), or
+    tail an ndjson sink file (--follow)."""
+    from urllib.parse import quote
+
+    if args.follow:
+        import time as _t
+
+        with open(args.follow) as f:
+            while True:
+                line = f.readline()
+                if line:
+                    sys.stdout.write(line)
+                    sys.stdout.flush()
+                elif args.once:
+                    return 0
+                else:
+                    _t.sleep(0.2)
+    if args.disable:
+        out = _admin_call(args.addr, "/admin/cdc?disable=true")
+    elif args.sink:
+        out = _admin_call(args.addr, f"/admin/cdc?sink={quote(args.sink)}")
+    else:
+        out = _admin_call(args.addr, "/admin/cdc")
+    if out is None:
+        return 1
+    print(json.dumps(out.get("data", out)))
+    return 0
 
 
 def cmd_acl(args):
@@ -773,16 +863,55 @@ def main(argv=None):
     p.add_argument("--format", choices=["rdf", "json"], default="rdf")
     p.set_defaults(fn=cmd_export)
 
-    p = sub.add_parser("backup")
+    p = sub.add_parser(
+        "backup",
+        help="manifest-chain backup of a data dir, or (--addr) a "
+        "journaled online backup coordinated by a running alpha",
+    )
     add_p(p)
     p.add_argument("--dest", required=True)
     p.add_argument("--full", action="store_true")
+    p.add_argument(
+        "--addr", default="",
+        help="base URL of a running alpha (online backup of the live "
+        "cluster it serves)",
+    )
     p.set_defaults(fn=cmd_backup)
 
-    p = sub.add_parser("restore")
+    p = sub.add_parser(
+        "restore",
+        help="restore a manifest chain into a data dir, or (--addr) "
+        "online into a live cluster",
+    )
     add_p(p)
     p.add_argument("--src", required=True)
+    p.add_argument(
+        "--addr", default="",
+        help="base URL of a running alpha (online restore)",
+    )
     p.set_defaults(fn=cmd_restore)
+
+    p = sub.add_parser(
+        "cdc",
+        help="manage/tail the CDC stream of a running alpha "
+        "(--sink enables, --disable stops, default probes status, "
+        "--follow tails an ndjson sink file)",
+    )
+    p.add_argument(
+        "--addr", default="http://127.0.0.1:8080",
+        help="base URL of the alpha HTTP endpoint",
+    )
+    p.add_argument("--sink", default="", help="ndjson sink path to enable")
+    p.add_argument("--disable", action="store_true")
+    p.add_argument(
+        "--follow", default="",
+        help="tail this ndjson sink file instead of calling the alpha",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="with --follow: dump current contents and exit",
+    )
+    p.set_defaults(fn=cmd_cdc)
 
     p = sub.add_parser("acl")
     add_p(p)

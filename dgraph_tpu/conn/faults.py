@@ -17,6 +17,11 @@ well-defined points —
              boundaries (worker/tabletmove.py via `syncpoint`): crash
              rules simulate coordinator death at exactly that boundary
              (InjectedCrash), delay rules stretch a phase
+  backup.*   the backup coordinator's journaled phase boundaries
+             (worker/backupdriver.py: backup.begin/group/manifest)
+  cdc.*      the CDC emitter's sink-write/checkpoint boundaries
+             (admin/cdc.py: cdc.emit/cdc.checkpoint — a crash here
+             simulates sink death inside the at-least-once window)
 
 Actions: drop | delay | dup | disconnect | partition | crash.
 `crash` only fires at named sync points. `partition` is a
@@ -294,10 +299,12 @@ def init_from_env(force: bool = False) -> Optional[FaultPlan]:
 
 
 def syncpoint(point: str, peer="coordinator"):
-    """Named in-code fault point (the tablet-move phase boundaries:
-    `move.begin`, `move.copy`, `move.chunk`, `move.fence`, `move.delta`,
-    `move.flip`, `move.drop`). Consults the active plan's deterministic
-    per-(point, peer) stream like any transport hook:
+    """Named in-code fault point (the tablet-move phase boundaries
+    `move.begin`/`copy`/`chunk`/`fence`/`delta`/`flip`/`drop`, the
+    backup coordinator's `backup.begin`/`group`/`manifest`, and the
+    CDC emitter's `cdc.emit`/`cdc.checkpoint`). Consults the active
+    plan's deterministic per-(point, peer) stream like any transport
+    hook:
 
       crash  -> raises InjectedCrash (simulated coordinator death at
                 exactly this boundary; the caller must not clean up)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
+import re
 import struct
 from dataclasses import dataclass
 from enum import IntEnum
@@ -138,11 +139,26 @@ def from_binary(tid: TypeID, data: bytes) -> Val:
 # ---------------------------------------------------------------------------
 
 
+_FRAC_RE = re.compile(r"(?<=\d)\.(\d+)")
+
+
+def _norm_frac(x: str) -> str:
+    """Normalize fractional seconds to exactly 6 digits: RFC3339 allows
+    any precision ('.52Z'), but fromisoformat before Python 3.11 only
+    accepts 3 or 6 digits. Extra precision truncates (Go parses
+    nanoseconds; microseconds is the most a datetime can hold)."""
+    return _FRAC_RE.sub(
+        lambda m: "." + m.group(1)[:6].ljust(6, "0"), x, count=1
+    )
+
+
 def parse_datetime(s: str) -> _dt.datetime:
     s = s.strip()
     # RFC3339 with optional fractional seconds / zone; also bare dates.
     for parse in (
-        lambda x: _dt.datetime.fromisoformat(x.replace("Z", "+00:00")),
+        lambda x: _dt.datetime.fromisoformat(
+            _norm_frac(x.replace("Z", "+00:00"))
+        ),
         lambda x: _dt.datetime.strptime(x, "%Y-%m-%d"),
         lambda x: _dt.datetime.strptime(x, "%Y-%m"),
         lambda x: _dt.datetime.strptime(x, "%Y"),

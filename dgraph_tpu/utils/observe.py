@@ -1726,10 +1726,62 @@ declare_metric(
     "cost budget (DGRAPH_TPU_MAX_INFLIGHT) was exhausted.",
 )
 declare_metric(
+    "counter", "backup_bytes_total",
+    "Uncompressed record-payload bytes written into backup chunk "
+    "files (admin/backup.py BackupWriter).",
+)
+declare_metric(
+    "counter", "backup_files_total",
+    "Backup chunk files committed into manifest entries.",
+)
+declare_metric(
+    "counter", "backup_move_races_total",
+    "Tablet captures retried because an ownership flip raced the copy "
+    "stream (worker/backupdriver.py): the buffered records were "
+    "discarded and the tablet re-streamed from its new owner, so it "
+    "lands in the backup exactly once.",
+)
+declare_metric(
+    "counter", "backup_moves_waited_total",
+    "Tablets whose backup capture waited out an in-flight move "
+    "(zero.moves_hint drain) before streaming.",
+)
+declare_metric(
+    "counter", "backup_records_total",
+    "KV version records written into committed backups.",
+)
+declare_metric(
+    "counter", "backup_resumed_total",
+    "Journaled in-flight backups resumed after a coordinator crash "
+    "(worker/backupdriver.py BackupJournal).",
+)
+declare_metric(
     "counter", "batch_coalesced_total",
     "Member (predicate, level) tasks coalesced into multi-query "
     "micro-batch dispatches (serving/microbatch.py); solo dispatches "
     "do not count.",
+)
+declare_metric(
+    "counter", "cdc_backpressure_waits_total",
+    "Commits that blocked on a full CDC event queue "
+    "(DGRAPH_TPU_CDC_QUEUE_MAX) until the sink emitter drained — the "
+    "bounded-queue backpressure contract (admin/cdc.py).",
+)
+declare_metric(
+    "counter", "cdc_events_total",
+    "CDC events delivered to the sink (file and/or callback), "
+    "including replays; dedup downstream on (commit_ts, seq).",
+)
+declare_metric(
+    "counter", "cdc_replayed_events_total",
+    "CDC events re-emitted by replay-from-checkpoint (KV versions "
+    "above the durable checkpoint scanned at startup/failover — the "
+    "sink-crash loss-window closer, admin/cdc.py).",
+)
+declare_metric(
+    "counter", "cdc_sink_retries_total",
+    "CDC sink deliveries retried after a sink failure "
+    "(conn/retry.RetryPolicy backoff in the emitter thread).",
 )
 declare_metric(
     "counter", "circuit_close_total",
@@ -1879,6 +1931,17 @@ declare_metric(
     "binding, epoch-invalidated entry, or cache disabled).",
 )
 declare_metric(
+    "counter", "restore_records_total",
+    "Verified backup records replayed by restore/restore_to_cluster.",
+)
+declare_metric(
+    "counter", "restore_verify_failures_total",
+    "Backup files refused by restore verification (gzip corruption, "
+    "sha256 mismatch, per-record CRC failure, record-count shortfall) "
+    "— each one is a torn backup that would otherwise have replayed "
+    "as a silent hole (admin/backup.py).",
+)
+declare_metric(
     "counter", "rpc_giveups_total",
     "RPC calls abandoned after exhausting retries/deadline.",
 )
@@ -1999,6 +2062,24 @@ declare_metric(
     "gauge", "admission_inflight_queries",
     "Queries currently in flight past the admission gate (tracked even "
     "with DGRAPH_TPU_ADMISSION=0; the micro-batcher's idle signal).",
+)
+declare_metric(
+    "gauge", "cdc_emitter_dead",
+    "1 when the CDC sink-emitter thread has died (sink crash, or a "
+    "failure that survived close-time retries): committed events are "
+    "deferred to replay-from-checkpoint until CDC is re-enabled — "
+    "alert on this, the stream is not flowing (admin/cdc.py).",
+)
+declare_metric(
+    "gauge", "cdc_checkpoint_ts",
+    "Durable CDC checkpoint commit-ts (replicated through the group "
+    "raft log on clusters; KV-resident on a single Server) — replay "
+    "after a crash/failover resumes above this (admin/cdc.py).",
+)
+declare_metric(
+    "gauge", "cdc_queue_depth",
+    "CDC events currently buffered between the commit paths and the "
+    "sink-emitter thread (bounded by DGRAPH_TPU_CDC_QUEUE_MAX).",
 )
 declare_metric(
     "gauge", "cache_batch_read_keys",

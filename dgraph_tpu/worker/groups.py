@@ -866,9 +866,15 @@ class DistributedCluster:
                 self.zero.zero.applied(m.commit_ts)
             for m in committed:
                 self.mem.invalidate(m.txn.cache.deltas.keys())
+            # CDC in the FIFO barrier: members are commit-ts ascending
+            # and barriers run in ticket order — the sink stream stays
+            # strictly commit-ts ordered across batches
+            cdc = getattr(self, "_cdc", None)
             for m in committed:
                 if m.error is None:
                     ingest_vectors(self.vector_indexes, m.txn.cache.deltas)
+                    if cdc is not None:
+                        cdc.emit_commit(m.commit_ts, m.txn.cache.deltas)
 
         return barrier
 
@@ -923,6 +929,11 @@ class DistributedCluster:
                         vidx.insert(pk.uid, p.val().value)
                     elif p.op == OP_DEL:
                         vidx.remove(pk.uid)
+        cdc = getattr(self, "_cdc", None)
+        if cdc is not None:
+            # serial path runs under the commit lock: emission here is
+            # already in commit-ts order
+            cdc.emit_commit(commit_ts, txn.cache.deltas)
         return commit_ts
 
     def _propose_and_wait(self, gid: int, proposal, timeout: float = 10.0):

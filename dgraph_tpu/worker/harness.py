@@ -521,6 +521,16 @@ class ProcCluster:
                         ok += 1
                 for m in committed:
                     self.mem.invalidate(m.txn.cache.deltas.keys())
+                # CDC in the FIFO barrier: members commit-ts ascending,
+                # barriers ticket-ordered — the sink stream stays
+                # strictly commit-ts ordered across batches
+                cdc = getattr(self, "_cdc", None)
+                if cdc is not None:
+                    for m in committed:
+                        if m.error is None:
+                            cdc.emit_commit(
+                                m.commit_ts, m.txn.cache.deltas
+                            )
                 if ok:
                     METRICS.inc("num_commits", ok)
                     self.serving.on_commit()  # ONE epoch bump per batch
@@ -581,6 +591,10 @@ class ProcCluster:
             self._snapshot_ts = max(self._snapshot_ts, commit_ts)
             self.zero.zero.applied(commit_ts)
             self.mem.invalidate(txn.cache.deltas.keys())
+        cdc = getattr(self, "_cdc", None)
+        if cdc is not None:
+            # serial path runs under the commit lock: already ordered
+            cdc.emit_commit(commit_ts, txn.cache.deltas)
         return commit_ts
 
     def recover_intents(self) -> int:

@@ -90,6 +90,13 @@ _define(
     "default; the in-flight gauge is tracked regardless.",
 )
 _define(
+    "BACKUP_CHUNK_BYTES", "int", 4 << 20,
+    "Byte bound on one backup chunk file's (uncompressed) record "
+    "payload (admin/backup.py BackupWriter): a tablet of any size "
+    "streams into bounded, individually-verifiable files instead of "
+    "one unbounded stream a torn write could silently shorten.",
+)
+_define(
     "BATCH_WINDOW_US", "int", 0,
     "Cross-query micro-batching (serving/microbatch.py): same-shape "
     "(predicate, level) tasks from different in-flight queries that "
@@ -115,6 +122,22 @@ _define(
     "Use the native C++ map/reduce pipeline for offline bulk loads when "
     "the compiled library is available (loaders/bulk2.py). Disable to "
     "force the pure-Python slow path.",
+)
+_define(
+    "CDC_QUEUE_MAX", "int", 4096,
+    "Bounded CDC event queue (admin/cdc.py): commits enqueue their "
+    "events here for the sink-emitter thread; a full queue blocks the "
+    "committer (backpressure) until the sink drains, so an event can "
+    "never be silently dropped while the process lives. Sink-crash "
+    "loss windows are closed by replay-from-checkpoint at startup.",
+)
+_define(
+    "CDC_SINK", "str", "",
+    "Default CDC sink URI for `dgraph-tpu alpha`/`cdc` when no "
+    "explicit sink is given: a file path / file:// URI (ndjson), or "
+    "kafka://host:port/topic when kafka-python is installed "
+    "(admin/handlers.py sink_for). Empty = CDC disabled unless "
+    "enabled explicitly.",
 )
 _define(
     "COMMIT_DEADLINE_S", "float", 20.0,

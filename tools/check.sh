@@ -4,6 +4,10 @@
 #   tools/check.sh          lint + analyzer/registry tests + smoke subset
 #   tools/check.sh --full   lint + the FULL tier-1 suite (same command the
 #                           ROADMAP pins for tier-1 verify)
+#   tools/check.sh --ops-sanity
+#                           the ~5s ops-plane gate alone: backup/restore
+#                           crash-consistency + CDC ordering/replay
+#                           (tests/test_ops_plane.py)
 #
 # Exit code is nonzero on the first failing stage, so CI can consume it
 # directly. JAX is pinned to CPU: the gate must never dial an accelerator.
@@ -12,6 +16,13 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
+
+if [[ "${1:-}" == "--ops-sanity" ]]; then
+    echo "== ops-plane sanity (~5s): backup/restore crash consistency + CDC =="
+    python -m pytest tests/test_ops_plane.py -q -p no:cacheprovider
+    echo "check.sh: ops-sanity passed"
+    exit 0
+fi
 
 echo "== dgraph-tpu lint =="
 python -m dgraph_tpu.cli lint
@@ -39,6 +50,7 @@ else
         tests/test_vector_quant.py \
         tests/test_group_commit.py \
         tests/test_explain.py tests/test_telemetry.py \
+        tests/test_ops_plane.py \
         -q -p no:cacheprovider
 
     echo "== explain sanity (~5s) =="
