@@ -976,9 +976,92 @@ def _explain_sanity():
     )
 
 
+def _plan_sanity():
+    """The ~5s CI gate for the cost-based planner + result cache
+    (tools/check.sh --plan-sanity): planner on/off AND result-cache
+    off/miss/hit byte-equality over the DQL golden smoke subset, with
+    the decision counters asserted live."""
+    import os as _os
+
+    from dgraph_tpu.api.server import Server
+    from dgraph_tpu.utils.observe import METRICS
+
+    here = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), "tests", "ref_golden"
+    )
+    cases = json.load(open(_os.path.join(here, "cases.json")))[::9]
+    s = Server()
+    s.alter(open(_os.path.join(here, "schema.txt")).read())
+    for rdf in ("triples.rdf", "triples_facets.rdf"):
+        t = s.new_txn()
+        t.mutate_rdf(
+            set_rdf=open(_os.path.join(here, rdf)).read(),
+            commit_now=True,
+        )
+
+    def run(q):
+        try:
+            d = s.query(q, want="raw")["data"]
+            raw = getattr(d, "raw", None)
+            return (
+                bytes(raw)
+                if raw is not None
+                else json.dumps(d, sort_keys=True).encode()
+            )
+        except Exception as exc:
+            return f"{type(exc).__name__}: {exc}"
+
+    def with_env(q, **env):
+        from dgraph_tpu.x import config as _config
+
+        saved = {k: _config.get_raw(k) for k in env}
+        for k, v in env.items():
+            _config.set_env(k, v)
+        try:
+            return run(q)
+        finally:
+            for k, old in saved.items():
+                if old is None:
+                    _config.unset_env(k)
+                else:
+                    _config.set_env(k, old)
+
+    r0 = METRICS.value("planner_reorders_total")
+    h0 = METRICS.value("result_cache_hit_total")
+    checked = 0
+    for case in cases:
+        q = case["query"]
+        base = with_env(q, QUERY_PLANNER=0, RESULT_CACHE_SIZE=0)
+        planner_on = with_env(q, QUERY_PLANNER=1, RESULT_CACHE_SIZE=0)
+        assert planner_on == base, f"planner changed bytes: {case['id']}"
+        first = with_env(q, RESULT_CACHE_SIZE=4096)
+        second = with_env(q, RESULT_CACHE_SIZE=4096)  # the HIT
+        assert first == base and second == base, (
+            f"result cache changed bytes: {case['id']}"
+        )
+        checked += 1
+    assert checked >= 30, f"only {checked} smoke cases executed"
+    reorders = METRICS.value("planner_reorders_total") - r0
+    hits = METRICS.value("result_cache_hit_total") - h0
+    assert reorders > 0, "planner never reordered over the smoke subset"
+    assert hits > 0, "result cache never hit over the smoke subset"
+    print(
+        json.dumps(
+            {
+                "plan_sanity": "OK",
+                "cases_checked": checked,
+                "planner_reorders": int(reorders),
+                "result_cache_hits": int(hits),
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
     if "--explain-sanity" in sys.argv:
         _explain_sanity()
+    elif "--plan-sanity" in sys.argv:
+        _plan_sanity()
     elif "--chaos-only" in sys.argv:
         # host-only capture: no device involved in the RPC plane
         _bench_chaos("cpu")

@@ -119,6 +119,13 @@ QUERY_SHAPES = [
     # filter + count
     '{{ q(func: eq(name, "user{i}")) @filter(lt(age, 50)) '
     "{{ name cnt: count(knows) }} }}",
+    # multi-arm AND with a verify-heavy arm declared FIRST: the
+    # planner's chain-reorder site (cheap lt arm runs first, the
+    # regexp verify sees the narrowed set) — makes planner_reorders
+    # deltas non-zero in every read row
+    '{{ q(func: eq(name, "user{i}")) '
+    "@filter(regexp(name, /user.*/) AND lt(age, 60)) "
+    "{{ name age }} }}",
 ]
 
 
@@ -154,11 +161,15 @@ def client_queries(rng_state: int, zipf_s: float = 0.0):
 
 # every BENCH_QPS row stamps these per-run deltas so rows are
 # self-describing: what the serving front actually did during the
-# measurement window, not just the latency it produced
+# measurement window, not just the latency it produced. The PR 15
+# additions (result_cache_*, planner_*, pushdown_*) make cache and
+# planner efficacy self-describing per row, read AND mixed sweeps.
 _ROW_COUNTERS = (
     "admission_shed_total", "admission_degraded_total",
     "degraded_queries_total", "batch_coalesced_total",
     "plan_cache_hit_total", "plan_cache_miss_total",
+    "result_cache_hit_total", "result_cache_miss_total",
+    "planner_reorders_total", "pushdown_applied_total",
     "group_commit_total", "group_commit_txns_total",
     "mutation_edges_total", "num_commits",
 )
@@ -187,6 +198,10 @@ def stamp_metric_deltas(row: dict, base: dict) -> dict:
     looked = row["plan_cache_hit"] + row["plan_cache_miss"]
     row["plan_cache_hit_rate"] = (
         round(row["plan_cache_hit"] / looked, 4) if looked else 0.0
+    )
+    rlooked = row["result_cache_hit"] + row["result_cache_miss"]
+    row["result_cache_hit_rate"] = (
+        round(row["result_cache_hit"] / rlooked, 4) if rlooked else 0.0
     )
     s, c = METRICS.hist_stats("group_commit_batch_size")
     dc = c - base["_gc_count"]
@@ -500,6 +515,142 @@ def mixed_sweep(args) -> dict:
     return {"rows": results, "headline": headline}
 
 
+def _reuse_modes(args):
+    """The PR 15 A/B arms: baseline (planner + result cache OFF) first,
+    then the reuse plane on — same build, knobs only."""
+    return [
+        ("reuse_off", {"RESULT_CACHE_SIZE": 0, "QUERY_PLANNER": 0}),
+        (
+            "reuse_on",
+            {
+                "RESULT_CACHE_SIZE": args.result_cache_size,
+                "QUERY_PLANNER": 1,
+            },
+        ),
+    ]
+
+
+def _assert_byte_identity(server, args) -> int:
+    """In-capture correctness gate: a sample of every shape's hot
+    literals must produce byte-identical responses with the reuse
+    plane off, on (populating miss), and on again (the actual HIT).
+    Returns the number of (query, run) comparisons made; raises on any
+    mismatch — a capture must never advertise a speedup over wrong
+    bytes."""
+    from dgraph_tpu.x import config
+
+    def raw(q):
+        return bytes(server.query(q, want="raw")["data"].raw)
+
+    checked = 0
+    for shape in QUERY_SHAPES:
+        for lit in (1, 2, 3, 17, 101):
+            q = shape.format(i=lit)
+            for k, v in _reuse_modes(args)[0][1].items():
+                config.set_env(k, v)
+            base = raw(q)
+            for k, v in _reuse_modes(args)[1][1].items():
+                config.set_env(k, v)
+            first, second = raw(q), raw(q)
+            for k in _reuse_modes(args)[1][1]:
+                config.unset_env(k)
+            assert first == base and second == base, (
+                f"reuse plane changed response bytes for {q!r}"
+            )
+            checked += 2
+    return checked
+
+
+def reuse_sweep(args) -> dict:
+    """Planner + result-cache A/B over the Zipfian repeated-shape read
+    mix (the ROADMAP item 2 payoff capture): same-run A/B with the
+    baseline arm FIRST at every point, byte-identity asserted
+    in-capture, and the reuse counters stamped into every row so each
+    row is self-describing."""
+    import statistics
+
+    from dgraph_tpu.x import config
+
+    server = build_server(args.memlayer_entries, args.entities)
+    for q in (s.format(i=1) for s in QUERY_SHAPES):
+        server.query(q)
+    byte_checks = _assert_byte_identity(server, args)
+    # drop the probe's cached entries so the measured arms start cold
+    server.serving.results.clear()
+
+    modes = _reuse_modes(args)
+    samples = {name: {c: [] for c in args.clients} for name, _ in modes}
+    for rep in range(args.reps):
+        for clients in args.clients:
+            for name, env in modes:  # baseline first within each point
+                for k, v in env.items():
+                    config.set_env(k, v)
+                row = run_point(
+                    server, clients, args.seconds, args.warmup,
+                    args.zipf_s,
+                )
+                for k in env:
+                    config.unset_env(k)
+                samples[name][clients].append(row)
+                print(
+                    f"[rep{rep} {name}] c={clients:3d} "
+                    f"qps={row['qps']:8.1f} p50={row['p50_ms']}ms "
+                    f"p99={row['p99_ms']}ms "
+                    f"rc_hit={row['result_cache_hit']} "
+                    f"plan_hit={row['plan_cache_hit']} "
+                    f"reorders={row['planner_reorders']}",
+                    flush=True,
+                )
+
+    def median_row(rows):
+        out = dict(rows[0])
+        for k, v in rows[0].items():
+            if isinstance(v, (int, float)) and k != "clients":
+                vals = [r[k] for r in rows if r[k] is not None]
+                out[k] = (
+                    round(statistics.median(vals), 3) if vals else None
+                )
+        out["reps"] = len(rows)
+        return out
+
+    results = {}
+    for name, _ in modes:
+        rows = []
+        for clients in args.clients:
+            row = median_row(samples[name][clients])
+            row["mode"] = name
+            rows.append(row)
+        results[name] = rows
+
+    def at(m, c):
+        return next(r for r in results[m] if r["clients"] == c)
+
+    multi = [r for r in results["reuse_on"] if r["clients"] > 1]
+    knee = (
+        max(multi, key=lambda r: r["qps"])["clients"]
+        if multi
+        else args.clients[-1]
+    )
+    on, off = at("reuse_on", knee), at("reuse_off", knee)
+    headline = {
+        "zipf_s": args.zipf_s,
+        "knee_clients": knee,
+        "qps_reuse_off_at_knee": off["qps"],
+        "qps_reuse_on_at_knee": on["qps"],
+        "reuse_speedup_x": (
+            round(on["qps"] / off["qps"], 2) if off["qps"] else None
+        ),
+        "p99_reuse_off_at_knee_ms": off["p99_ms"],
+        "p99_reuse_on_at_knee_ms": on["p99_ms"],
+        "result_cache_hit_at_knee": on["result_cache_hit"],
+        "result_cache_hit_rate_at_knee": on["result_cache_hit_rate"],
+        "plan_cache_hit_at_knee": on["plan_cache_hit"],
+        "byte_identity_checks": byte_checks,
+        "result_cache_size": args.result_cache_size,
+    }
+    return {"rows": results, "headline": headline}
+
+
 def sweep(args) -> dict:
     from dgraph_tpu.x import config
 
@@ -556,9 +707,14 @@ def sweep(args) -> dict:
             vals = [r[k] for r in rows if r[k] is not None]
             out[k] = round(statistics.median(vals), 3) if vals else None
         for k in rows[0]:
-            if k.startswith(("batch_", "plan_", "admission_")) or k in (
-                "shed", "completed"
-            ):
+            if k.endswith("_rate"):
+                out[k] = round(
+                    statistics.median([r[k] for r in rows]), 4
+                )
+            elif k.startswith(
+                ("batch_", "plan_", "admission_", "result_",
+                 "planner_", "pushdown_")
+            ) or k in ("shed", "completed"):
                 out[k] = int(statistics.median([r[k] for r in rows]))
         out["reps"] = len(rows)
         return out
@@ -634,6 +790,17 @@ def main(argv=None):
         "instead of the read-only sweep",
     )
     ap.add_argument(
+        "--reuse", action="store_true",
+        help="planner + result-cache A/B over the Zipfian "
+        "repeated-shape read mix (baseline arm first, byte-identity "
+        "asserted in-capture) -> the 'reuse' key of BENCH_QPS.json",
+    )
+    ap.add_argument(
+        "--result-cache-size", type=int, default=8192,
+        help="RESULT_CACHE_SIZE for the reuse_on arm (entries; must "
+        "cover shapes x hot literals or the LRU thrashes)",
+    )
+    ap.add_argument(
         "--write-ratios", type=float, nargs="+", default=[0.1, 0.5],
     )
     ap.add_argument(
@@ -668,6 +835,8 @@ def main(argv=None):
         args.write_ratios = [0.5]
     if args.mix:
         out = mixed_sweep(args)
+    elif args.reuse:
+        out = reuse_sweep(args)
     else:
         out = sweep(args)
     if args.write_sanity:
@@ -704,6 +873,7 @@ def main(argv=None):
     out_keys = (
         {"mixed_baseline": out} if (args.mix and args.baseline)
         else {"mixed": out} if args.mix
+        else {"reuse": out} if args.reuse
         else out
     )
     merged = out_keys

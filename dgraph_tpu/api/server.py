@@ -1085,7 +1085,9 @@ class Server:
         t_begin = _time.monotonic()
         parse_info: Optional[dict] = {} if debug else None
         # plan cache: repeated query shapes skip parse entirely
-        blocks, shape = self.serving.parse(q, variables, info=parse_info)
+        blocks, shape, literals = self.serving.parse(
+            q, variables, info=parse_info
+        )
         t_parsed = _time.monotonic()
         # admission gate BEFORE the read-ts allocation: a shed must be
         # FAST and side-effect-free — under overload the oracle's
@@ -1154,12 +1156,58 @@ class Server:
             # commit is legitimately excluded from the snapshot. 0 =
             # nothing committed yet: fall back to a fresh barrier-
             # waited lease.
+            # the watermark is sampled ONCE and reused for BOTH the
+            # read ts and the result-cache key: re-reading
+            # _snapshot_ts at key time would let a commit landing in
+            # between cache watermark-N bytes under the watermark-N+1
+            # key (a one-line TOCTOU that breaks the never-stale
+            # proof)
+            wm = self._snapshot_ts
             ts = (
                 read_ts
                 if read_ts is not None
-                else (self._snapshot_ts or self.zero.read_ts())
+                else (wm or self.zero.read_ts())
             )
             t_assigned = _time.monotonic()
+            # snapshot-keyed result reuse (serving/resultcache.py):
+            # watermark reads with no ACL are a pure function of
+            # (shape, literals, vars, ns, watermark) — the PR 7/11
+            # proof — so the whole response's wire bytes can be
+            # served from the LRU. Caller-pinned read_ts never
+            # caches; EXPLAIN queries always execute but record the
+            # would-hit tier in the plan.
+            rc_key = None
+            rc_probe = False
+            raw_hit = None
+            if read_ts is None and self.acl is None:
+                rc_key, raw_hit, rc_probe = self.serving.result_probe(
+                    shape, literals, variables, ns, wm, debug,
+                )
+            if raw_hit is not None:
+                from dgraph_tpu.serving.resultcache import hit_response
+
+                METRICS.inc("num_queries")
+                t_done = _time.monotonic()
+                # hits are SERVED traffic: they must land in the
+                # latency histogram the SLO/health surface reads. The
+                # sample is the PROCESSING span (post-assign), the
+                # same span the miss path's METRICS.timer covers — a
+                # hit recording full wall time would make hit samples
+                # incomparable with miss samples in one histogram
+                METRICS.observe(
+                    "query_latency_seconds", t_done - t_assigned
+                )
+                # shape stays out of the cost EWMA (finally passes
+                # shape only when `completed`): a hit's latency
+                # describes the cache, not the shape's execution cost
+                # the admission gate estimates
+                return hit_response(
+                    raw_hit, want,
+                    parsing_ns=int((t_parsed - t_begin) * 1e9),
+                    assign_ns=int((t_assigned - t_parsed) * 1e9),
+                    processing_ns=int((t_done - t_assigned) * 1e9),
+                    watermark=wm,
+                )
             cache_base = self._plan_cache_tiers() if debug else None
             with TRACER.span("query", ns=ns) as root, \
                     profile_scope(debug=debug) as prof, \
@@ -1235,6 +1283,12 @@ class Server:
                         k: now_tiers[k] - cache_base.get(k, 0)
                         for k in now_tiers
                     }
+                prof.plan.result_cache = {
+                    "enabled": self.serving.results.capacity() > 0,
+                    "eligible": rc_key is not None,
+                    "would_hit": bool(rc_probe),
+                    "watermark": int(self._snapshot_ts),
+                }
                 prof.plan.meta = {
                     "read_ts": int(ts),
                     "snapshot_watermark": int(self._snapshot_ts),
@@ -1257,6 +1311,10 @@ class Server:
                 threshold_ms=self.slow_query_ms,
             )
             completed = not truncated
+            if rc_key is not None and completed:
+                raw = getattr(out.get("data"), "raw", None)
+                if raw is not None:
+                    self.serving.results.put(rc_key, raw)
             return out
         finally:
             # only clean completions feed the shape cost EWMA: a
@@ -1369,6 +1427,12 @@ class Server:
         prof = observe.current_profile()
         if prof is not None:
             prof.encode.update(enc_stats)
+            if prof.plan is not None:
+                prof.plan.planner = (
+                    ex.planner.explain()
+                    if ex.planner is not None
+                    else {"enabled": False}
+                )
         return {"data": data}
 
 

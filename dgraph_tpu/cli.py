@@ -573,12 +573,14 @@ def render_plan(plan: dict) -> str:
     setops = plan.get("setops") or []
     if setops:
         packed = sum(1 for s in setops if s.get("verdict") == "packed")
+        pushed = sum(1 for s in setops if s.get("verdict") == "pushdown")
         lines.append(
-            "  setops: %d decisions, %d packed / %d decoded%s"
+            "  setops: %d decisions, %d packed / %d decoded%s%s"
             % (
                 len(setops),
                 packed,
-                len(setops) - packed,
+                len(setops) - packed - pushed,
+                f", {pushed} pushdown" if pushed else "",
                 (
                     f" ({plan['setops_dropped']} dropped)"
                     if plan.get("setops_dropped")
@@ -586,6 +588,38 @@ def render_plan(plan: dict) -> str:
                 ),
             )
         )
+    pl = plan.get("planner") or {}
+    if pl:
+        if not pl.get("enabled", False):
+            lines.append("  planner: off")
+        else:
+            lines.append(
+                "  planner: on, %d reorders, %d pushdowns"
+                % (pl.get("reorders", 0), pl.get("pushdowns", 0))
+            )
+            for so in pl.get("sibling_orders", ()):
+                lines.append(
+                    "    sibling order: %s" % " -> ".join(so.get("order", ()))
+                )
+            for ao in pl.get("and_orders", ()):
+                lines.append(
+                    "    filter AND order: %s"
+                    % " -> ".join(str(i) for i in ao.get("order", ()))
+                )
+    rc = plan.get("result_cache") or {}
+    if rc:
+        if not rc.get("enabled", False):
+            lines.append("  result cache: disabled")
+        else:
+            lines.append(
+                "  result cache: %s (watermark %s)"
+                % (
+                    "WOULD-HIT (EXPLAIN always executes)"
+                    if rc.get("would_hit")
+                    else ("eligible, cold" if rc.get("eligible") else "ineligible"),
+                    rc.get("watermark"),
+                )
+            )
 
     def walk(node, depth):
         kern = node.get("kernels") or {}
@@ -605,8 +639,9 @@ def render_plan(plan: dict) -> str:
                 )
             )
         else:
+            est = node.get("est_out")
             lines.append(
-                "  %s%s level=%d [%s] %d -> %d uids, %.2fms%s"
+                "  %s%s level=%d [%s] %d -> %d uids%s, %.2fms%s"
                 % (
                     "  " * depth,
                     node.get("attr"),
@@ -614,6 +649,7 @@ def render_plan(plan: dict) -> str:
                     node.get("read", "?"),
                     node.get("uids_in", 0),
                     node.get("uids_out", 0),
+                    f" (est {est})" if est is not None else "",
                     node.get("wall_ns", 0) / 1e6,
                     kern_s,
                 )

@@ -75,6 +75,13 @@ _USE_PALLAS = bool(config.get("PALLAS"))
 _MIN_PAD = 8
 
 
+def _planner_enabled() -> bool:
+    """The cost-based planner's knob (query/planner.py), read here
+    without importing the planner — the chain-fold order hook must
+    stay import-cycle-free."""
+    return bool(config.get("QUERY_PLANNER"))
+
+
 def _pow2(n: int) -> int:
     return max(_MIN_PAD, 1 << (max(1, n) - 1).bit_length())
 
@@ -622,6 +629,14 @@ class SetOpDispatcher:
             return parts[0]
         if op == "intersect" and any(len(p) == 0 for p in parts):
             return np.zeros((0,), np.uint64)
+        if op == "intersect" and len(parts) > 2 and _planner_enabled():
+            # planner hook (query/planner.py): fold smallest-first so
+            # the pairwise host chain's running result collapses as
+            # early as possible — intersection is commutative and the
+            # output is sorted-unique either way, so this is a pure
+            # execution-order choice (the chain-site analog of the
+            # packed fold's sorted-by-size walk below)
+            parts = sorted(parts, key=len)
         total = sum(len(p) for p in parts)
         if op == "union" and len(parts) > 256:
             # k-way union of MANY small rows: one host unique beats both

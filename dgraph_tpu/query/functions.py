@@ -50,7 +50,8 @@ class FuncRunner:
 
     def __init__(self, cache: LocalCache, st: State, ns: int = keys.GALAXY_NS,
                  vector_indexes=None, uid_vars=None, val_vars=None,
-                 stats=None, ordered_uid_vars=None, batcher=None):
+                 stats=None, ordered_uid_vars=None, batcher=None,
+                 planner=None):
         self.cache = cache
         self.st = st
         self.ns = ns
@@ -63,6 +64,10 @@ class FuncRunner:
         # cross-query micro-batcher (serving/microbatch.py): plain
         # similar_to searches may coalesce with other in-flight queries
         self.batcher = batcher
+        # cost-based planner (query/planner.py): rootless runs feed
+        # their observed cardinalities back into its CardBook — the
+        # estimate source for next queries' ordering decisions
+        self.planner = planner
 
     # -- helpers -------------------------------------------------------------
 
@@ -196,6 +201,14 @@ class FuncRunner:
         return self._run(fn, src=src)
 
     def _run(self, fn: FuncSpec, src: Optional[np.ndarray]) -> np.ndarray:
+        out = self._run_impl(fn, src)
+        if src is None and self.planner is not None:
+            # planner feedback: observed rootless cardinality -> the
+            # CardBook EWMA the next query's cost model reads
+            self.planner.note_root(fn, len(out))
+        return out
+
+    def _run_impl(self, fn: FuncSpec, src: Optional[np.ndarray]) -> np.ndarray:
         name = fn.name
         if fn.is_count:
             return self._count_func(fn, name, src)

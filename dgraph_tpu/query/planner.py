@@ -1,0 +1,415 @@
+"""Cost-based query planner: whole-query evaluation ordering and
+scan-strategy selection (ROADMAP open item 2).
+
+Mirrors the planning logic spread across the reference's
+worker/task.go (planForEqFilter selectivity ordering, the intersect-
+vs-filter choice at handleCompareFunction/handleHasFunction) and
+query/query.go (child execution order), lifted from the per-pair scan
+site — where rarest-first has lived since PR 5
+(functions._terms/plan_eq_order) — to whole-query scope:
+
+  order_and        AND filter chains evaluate cheapest/most-selective
+                   operand first with the RUNNING intersection as the
+                   next operand's candidate set (narrowing), and stop
+                   outright when it empties. Byte-identical by
+                   algebra: every filter function is a pure selection
+                   (run_filter(fn, s) == s ∩ match(fn)), so
+                   (((src ∩ M1) ∩ M2) ∩ ...) equals the unordered
+                   chain for ANY order — similar_to (a top-k whose
+                   result depends on the candidate set) is the one
+                   impure function and disables narrowing for its
+                   subtree.
+
+  order_siblings   var-free structural siblings execute
+                   cheapest-first (estimated fan-out x subtree size).
+                   Var-touching siblings keep declaration order — the
+                   serial/parallel byte-identity contract
+                   (tests/test_parallel_exec.py) already proves
+                   var-free subtrees commute; output order is
+                   restored by the caller regardless of execution
+                   order.
+
+  pushdown         the per-level intersect-vs-filter choice: a uid
+                   predicate's @filter whose tree is index-answerable
+                   WITHOUT the frontier (and whose estimated match
+                   set is smaller than the frontier) evaluates
+                   rootless and intersects the ragged level rows
+                   directly — the merged-frontier materialization and
+                   the per-candidate verify pass are skipped. Sound
+                   because rows ⊆ merged(rows) makes
+                   rows ∩ match == rows ∩ (merged ∩ match).
+
+Estimates come from three sources: StatsHolder cm-sketch selectivity
+(utils/cmsketch.py; index token -> approximate posting count), the
+process-global CardBook of observed cardinalities (per-(ns, attr,
+site) EWMAs fed by the executor's level reads and FuncRunner's root
+scans — the PR 5/PR 12 per-predicate profile signal), and structural
+cost classes per function kind. Unknown estimates fail CONSERVATIVE:
+no pushdown, declaration order preserved among equally-unknown
+operands.
+
+Every decision is observation-equivalent (response bytes are
+identical with DGRAPH_TPU_QUERY_PLANNER=0 — golden-corpus-enforced,
+tests/test_planner.py) and surfaced: planner_reorders_total /
+pushdown_applied_total metrics, and per-query decisions + estimated
+cardinalities in the EXPLAIN plan tree (extensions.plan.planner).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dgraph_tpu.utils.observe import METRICS, current_plan
+from dgraph_tpu.x import config
+
+_EWMA_ALPHA = 0.2
+
+# structural cost classes per function kind: 0 = var/literal lookup,
+# 1 = index point read, 2 = index range / per-candidate value test,
+# 3 = verify-heavy scan (regex, fuzzy, geo, password, vector)
+_COST_CLASS: Dict[str, int] = {
+    "uid": 0,
+    "uid_in": 1, "type": 1, "eq": 1,
+    "allofterms": 1, "anyofterms": 1, "alloftext": 1, "anyoftext": 1,
+    "le": 2, "lt": 2, "ge": 2, "gt": 2, "between": 2, "has": 2,
+    "regexp": 3, "match": 3, "checkpwd": 3,
+    "near": 3, "within": 3, "contains": 3, "intersects": 3,
+    "similar_to": 3,
+}
+_CLASS_DEFAULT = 3
+
+# similar_to is a top-k: its result depends on the candidate set, so
+# it is NOT a pure selection and its subtree must see the original src
+_IMPURE = frozenset({"similar_to"})
+
+# leaves whose root (src=None) and filter (src=candidates) forms are
+# verified equivalent selections — the pushdown whitelist. Inequality
+# compares are excluded: their root form walks the sortable index with
+# any-value list semantics while the filter form value-tests the
+# first/untagged value, which can diverge on list predicates.
+_PUSHDOWN_OK = frozenset({"uid", "uid_in", "type", "has", "eq"})
+
+# a level must be at least this wide before pushdown can pay for the
+# extra rootless evaluation
+_PUSHDOWN_MIN_FRONTIER = 64
+
+# EXPLAIN capture bound: a pathological query must not balloon the plan
+_MAX_DECISIONS = 16
+
+
+class CardBook:
+    """Process-global (ns, attr, site) -> observed-cardinality EWMA.
+
+    Sites: "level" (uids per parent at a traversal level, fed by the
+    executor's batched level reads) and "root:<func>" (result size of
+    a rootless function run, fed by FuncRunner). The book is advisory
+    — estimates steer evaluation order and scan strategy, never
+    results — so cross-engine collisions in one process are harmless.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cards: Dict[tuple, float] = {}
+
+    def note(self, ns: int, attr: str, site: str, n: float) -> None:
+        key = (ns, attr, site)
+        with self._lock:
+            prev = self._cards.get(key)
+            self._cards[key] = (
+                float(n)
+                if prev is None
+                else prev + _EWMA_ALPHA * (float(n) - prev)
+            )
+
+    def estimate(self, ns: int, attr: str, site: str) -> Optional[float]:
+        with self._lock:
+            return self._cards.get((ns, attr, site))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cards.clear()
+
+
+CARDS = CardBook()
+
+
+def planner_enabled() -> bool:
+    return bool(config.get("QUERY_PLANNER"))
+
+
+class Planner:
+    """Per-query planning state: cost estimates + the decision log the
+    EXPLAIN surface renders. One instance per Executor (construction is
+    two attribute grabs); the heavy state (CardBook, StatsHolder) is
+    shared and read-only here."""
+
+    def __init__(self, st, stats, ns: int, uid_vars=None, val_vars=None):
+        self.st = st
+        self.stats = stats  # StatsHolder (may be None)
+        self.ns = ns
+        # live references to the executor's var maps (sizes only)
+        self.uid_vars = uid_vars if uid_vars is not None else {}
+        self.val_vars = val_vars if val_vars is not None else {}
+        self.reorders = 0
+        self.pushdowns = 0
+        self.narrowed_chains = 0
+        self.sibling_orders: List[dict] = []
+        self.and_orders: List[dict] = []
+
+    # -- cardinality estimation ----------------------------------------------
+
+    def _eq_index_estimate(self, fn) -> Optional[float]:
+        """Sketch estimate for an indexed eq: sum over the literal
+        args' non-lossy tokens. None when unindexed, cold stats, or a
+        non-literal (val(..)) argument."""
+        if self.stats is None:
+            return None
+        su = self.st.get(fn.attr)
+        if su is None or not su.directive_index:
+            return None
+        tok = next((t for t in su.tokenizer_objs() if not t.is_lossy), None)
+        if tok is None:
+            return None
+        from dgraph_tpu.query.functions import _coerce
+        from dgraph_tpu.tok.tok import build_tokens
+
+        total = 0
+        vals = []
+        for a in fn.args:
+            if isinstance(a, list):
+                vals.extend(a)
+            else:
+                vals.append(a)
+        for v in vals:
+            if isinstance(v, tuple):
+                return None  # val(..) arg: value set unknown here
+            try:
+                toks = build_tokens(_coerce(v, su.value_type), [tok])
+            except (ValueError, TypeError):
+                return None
+            for tb in toks:
+                total += self.stats.estimate(fn.attr, tb)
+        return float(total) if total > 0 else None
+
+    def estimate_func(self, fn) -> Optional[float]:
+        """Estimated result cardinality of one function, or None."""
+        name = fn.name
+        if name == "uid" and not fn.is_count:
+            n = len([a for a in fn.args if not isinstance(a, tuple)])
+            for v in (fn.uid_var or "").split(","):
+                if not v:
+                    continue
+                if v in self.uid_vars:
+                    n += len(self.uid_vars[v])
+                elif v in self.val_vars:
+                    n += len(self.val_vars[v])
+            return float(n)
+        if name == "type" and self.stats is not None:
+            est = self.stats.estimate(
+                "dgraph.type", b"\x02" + fn.attr.encode("utf-8")
+            )
+            return float(est) if est > 0 else None
+        if name == "eq" and not fn.is_count and not fn.val_var:
+            est = self._eq_index_estimate(fn)
+            if est is not None:
+                return est
+        return CARDS.estimate(self.ns, fn.attr or "", f"root:{name}")
+
+    def estimate_tree(self, ft) -> Optional[float]:
+        """Estimated match cardinality of a filter tree: min over AND
+        arms (any known arm bounds the intersection), sum over OR arms
+        (all must be known — a missing arm unbounds the union)."""
+        if ft.func is not None:
+            return self.estimate_func(ft.func)
+        ests = [self.estimate_tree(c) for c in ft.children]
+        if ft.op == "and":
+            known = [e for e in ests if e is not None]
+            return min(known) if known else None
+        if ft.op == "or":
+            if any(e is None for e in ests) or not ests:
+                return None
+            return float(sum(ests))
+        return None  # "not": complement size is unknown
+
+    def _tree_class(self, ft) -> int:
+        if ft.func is not None:
+            return _COST_CLASS.get(ft.func.name, _CLASS_DEFAULT)
+        return max(
+            (self._tree_class(c) for c in ft.children),
+            default=_CLASS_DEFAULT,
+        )
+
+    def tree_pure(self, ft) -> bool:
+        """True when every leaf is a pure selection (narrowing-safe)."""
+        if ft.func is not None:
+            return ft.func.name not in _IMPURE
+        return all(self.tree_pure(c) for c in ft.children)
+
+    # -- AND-chain ordering ---------------------------------------------------
+
+    def order_and(self, children, n_src: int) -> List[int]:
+        """Evaluation order (indices into `children`) for an AND
+        chain: ascending (cost class, estimated cardinality,
+        declaration index). Unknown estimates sort as |src| so a known
+        selective arm always runs first."""
+        ests = [self.estimate_tree(c) for c in children]
+        keys = [
+            (
+                self._tree_class(c),
+                ests[i] if ests[i] is not None else float(n_src),
+                i,
+            )
+            for i, c in enumerate(children)
+        ]
+        order = [i for _, _, i in sorted(keys)]
+        if order != list(range(len(children))):
+            self.reorders += 1
+            METRICS.inc("planner_reorders_total")
+            if len(self.and_orders) < _MAX_DECISIONS:
+                self.and_orders.append(
+                    {
+                        "site": "filter_and",
+                        "order": order,
+                        "est": [
+                            None if ests[i] is None else int(ests[i])
+                            for i in order
+                        ],
+                    }
+                )
+        self.narrowed_chains += 1
+        return order
+
+    # -- sibling execution order ---------------------------------------------
+
+    def _sibling_score(self, gq, parents: int) -> float:
+        """Estimated work for one structural child subtree: expected
+        rows produced at its level times the subtree node count."""
+        su = self.st.get(gq.attr.lstrip("~")) if gq.attr else None
+        from dgraph_tpu.types.types import TypeID
+
+        is_uid = su is not None and (
+            su.value_type == TypeID.UID or gq.attr.startswith("~")
+        )
+        fan = CARDS.estimate(self.ns, gq.attr or "", "level")
+        if fan is None:
+            fan = 4.0 if is_uid else 1.0
+        rows = max(1.0, fan) * max(1, parents)
+
+        def subtree(g) -> int:
+            return 1 + sum(subtree(c) for c in g.children)
+
+        return rows * subtree(gq)
+
+    def order_siblings(self, gqs, var_free: List[bool], parents: int):
+        """Execution order for structural children: var-free children
+        are reassigned cheapest-first over the SLOTS var-free children
+        occupied; var-touching children stay exactly in place (their
+        declaration order is the serial-semantics contract)."""
+        free_idx = [i for i, f in enumerate(var_free) if f]
+        if len(free_idx) < 2:
+            return list(range(len(gqs)))
+        scored = sorted(
+            free_idx,
+            key=lambda i: (self._sibling_score(gqs[i], parents), i),
+        )
+        order = list(range(len(gqs)))
+        for slot, src in zip(free_idx, scored):
+            order[slot] = src
+        if order != list(range(len(gqs))):
+            self.reorders += 1
+            METRICS.inc("planner_reorders_total")
+            if len(self.sibling_orders) < _MAX_DECISIONS:
+                self.sibling_orders.append(
+                    {
+                        "site": "siblings",
+                        "order": [gqs[i].attr for i in order],
+                    }
+                )
+        return order
+
+    # -- intersect-vs-filter (pushdown) ---------------------------------------
+
+    def tree_pushdown_ok(self, ft) -> bool:
+        """Root-capable trees: every leaf's rootless form is a
+        verified-equivalent selection, and no NOT anywhere (its
+        complement needs the frontier as the universe)."""
+        if ft.func is not None:
+            fn = ft.func
+            if fn.name not in _PUSHDOWN_OK or fn.is_count:
+                return False
+            if fn.val_var:
+                # eq(val(x))/uid-of-val broadcast semantics differ
+                # between root and filter forms (MAXUID fallback)
+                return False
+            if fn.attr and fn.attr.startswith("~"):
+                return False
+            return True
+        if ft.op == "not":
+            return False
+        return bool(ft.children) and all(
+            self.tree_pushdown_ok(c) for c in ft.children
+        )
+
+    def pushdown_candidates(
+        self, ft, attr: str, frontier_len: int, eval_root
+    ) -> Optional[np.ndarray]:
+        """The rootless candidate set for a level filter, or None to
+        keep the filter strategy. `eval_root` is the executor's
+        rootless tree evaluator (called only once the decision is
+        made)."""
+        if frontier_len < _PUSHDOWN_MIN_FRONTIER:
+            return None
+        if not self.tree_pushdown_ok(ft):
+            return None
+        est = self.estimate_tree(ft)
+        if est is None or est >= frontier_len:
+            return None
+        cand = eval_root(ft)
+        self.pushdowns += 1
+        METRICS.inc("pushdown_applied_total")
+        plan = current_plan()
+        if plan is not None:
+            plan.note_setop(
+                {
+                    "site": "level_filter",
+                    "attr": attr,
+                    "verdict": "pushdown",
+                    "est": int(est),
+                    "frontier": int(frontier_len),
+                    "candidates": int(len(cand)),
+                }
+            )
+        return cand
+
+    # -- feedback + EXPLAIN ---------------------------------------------------
+
+    def note_level(self, attr: str, parents: int, uids_out: int) -> None:
+        """Observed per-parent fan-out of one (predicate, level) read."""
+        if parents > 0:
+            CARDS.note(self.ns, attr, "level", uids_out / parents)
+
+    def note_root(self, fn, n: int) -> None:
+        """Observed cardinality of one rootless function run."""
+        if fn.attr:
+            CARDS.note(self.ns, fn.attr, f"root:{fn.name}", n)
+
+    def estimate_level_out(self, attr: str, parents: int) -> Optional[int]:
+        """Pre-execution estimate of a level's output rows — the
+        EXPLAIN est-vs-actual column."""
+        fan = CARDS.estimate(self.ns, attr, "level")
+        if fan is None:
+            return None
+        return int(fan * max(1, parents))
+
+    def explain(self) -> dict:
+        return {
+            "enabled": True,
+            "reorders": self.reorders,
+            "pushdowns": self.pushdowns,
+            "narrowed_chains": self.narrowed_chains,
+            "sibling_orders": list(self.sibling_orders),
+            "and_orders": list(self.and_orders),
+        }

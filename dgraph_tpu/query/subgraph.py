@@ -195,6 +195,20 @@ class Executor:
         self.val_vars: Dict[str, Dict[int, Val]] = {}
         # where each value var is keyed (for per-parent aggregation)
         self.var_def_node: Dict[str, ExecNode] = {}
+        # cost-based planner (query/planner.py): whole-query evaluation
+        # ordering + intersect-vs-filter strategy, observation-
+        # equivalent by construction; None = declaration-order
+        # execution (the DGRAPH_TPU_QUERY_PLANNER=0 A/B escape hatch)
+        from dgraph_tpu.query.planner import Planner, planner_enabled
+
+        self.planner = (
+            Planner(
+                st, stats, ns,
+                uid_vars=self.uid_vars, val_vars=self.val_vars,
+            )
+            if planner_enabled()
+            else None
+        )
 
     def _runner(self) -> FuncRunner:
         return FuncRunner(
@@ -207,6 +221,7 @@ class Executor:
             stats=self.stats,
             ordered_uid_vars=self.ordered_uid_vars,
             batcher=self.batcher,
+            planner=self.planner,
         )
 
     # ------------------------------------------------------------------
@@ -625,9 +640,62 @@ class Executor:
         if ft.op == "not":
             inner = self.eval_filter(ft.children[0], src)
             return DISPATCHER.run_pairs("difference", [(src, inner)])[0]
+        # planner-ordered AND narrowing: cheapest/most-selective arm
+        # first, each arm seeing the RUNNING intersection as its
+        # candidate set. Byte-identical for pure-selection subtrees
+        # (query/planner.py order_and) — the whole-query lift of the
+        # scan-site rarest-first heuristic. Every arm still EVALUATES
+        # (against the narrowed — possibly empty — set, never more
+        # work than the unordered path's full src): an arm whose
+        # schema/index/argument checks raise must raise with the
+        # planner on too. Which error surfaces when several arms are
+        # broken is declaration-order on the unordered path, so any
+        # arm failure falls back to it — re-execution is safe (pure
+        # selections) and errors are rare.
+        if (
+            self.planner is not None
+            and ft.op == "and"
+            and len(ft.children) > 1
+            and self.planner.tree_pure(ft)
+        ):
+            from dgraph_tpu.query.functions import QueryBudgetError
+
+            order = self.planner.order_and(ft.children, len(src))
+            try:
+                cur = np.asarray(src, np.uint64)
+                for i in order:
+                    cur = self.eval_filter(ft.children[i], cur)
+                return np.asarray(cur, np.uint64)
+            except QueryBudgetError:
+                raise  # deadline trips abort immediately
+            except Exception:
+                # declaration-order fallback: surface the SAME error
+                # the unordered path would (broad catch on purpose —
+                # coercion ValueErrors etc. are part of the observable
+                # error surface, not just QueryError)
+                parts = [self.eval_filter(c, src) for c in ft.children]
+                return DISPATCHER.run_chain("intersect", parts).astype(
+                    np.uint64
+                )
         # whole AND/OR chain in ONE device dispatch (intersect_many /
         # k-way merge), not k-1 sequential pairwise calls
         parts = [self.eval_filter(c, src) for c in ft.children]
+        op = "intersect" if ft.op == "and" else "union"
+        return DISPATCHER.run_chain(op, parts).astype(np.uint64)
+
+    def _eval_filter_root(self, ft: FilterTree) -> np.ndarray:
+        """Rootless filter-tree evaluation (the pushdown strategy's
+        candidate set): every leaf runs with src=None, arms combine
+        with one chained set op. Callers guarantee the tree passed
+        planner.tree_pushdown_ok (no NOT, whitelisted leaves)."""
+        if ft.func is not None:
+            out = np.asarray(
+                self._runner()._run(ft.func, src=None), np.uint64
+            )
+            if len(out) > 1 and not bool(np.all(out[:-1] < out[1:])):
+                out = np.unique(out)  # e.g. path-ordered uid(var) roots
+            return out
+        parts = [self._eval_filter_root(c) for c in ft.children]
         op = "intersect" if ft.op == "and" else "union"
         return DISPATCHER.run_chain(op, parts).astype(np.uint64)
 
@@ -662,15 +730,50 @@ class Executor:
         # declaration order (serial semantics are order-sensitive there).
         results: Dict[int, Tuple[str, Any]] = {}
         workers = self.exec_workers
+        can_par = workers > 1 and not getattr(
+            _EXPAND_TLS, "in_worker", False
+        )
+        # the O(subtree) var-dependency classification is needed only
+        # by the planner and the parallel path — the plain serial
+        # executor must not pay it per expansion
+        var_free = (
+            [not self._gq_touches_vars(cgq) for cgq in structural]
+            if (self.planner is not None or can_par)
+            and len(structural) > 1
+            else None
+        )
+        # planner: var-free structural children execute cheapest-first
+        # (estimated fan-out x subtree size) — var-touching children
+        # keep declaration order, and output order is restored from
+        # `made` below, so execution order is observation-equivalent
+        # (the same commutation test_parallel_exec.py already proves
+        # for the parallel path)
+        exec_structural = structural
+        reordered = False
+        if self.planner is not None and var_free is not None:
+            order = self.planner.order_siblings(
+                structural, var_free, len(node.dest_uids)
+            )
+            reordered = order != list(range(len(structural)))
+            if reordered:
+                exec_structural = [structural[i] for i in order]
         # only non-worker threads submit (and wait on) futures; workers
         # expand their subtrees serially — a bounded pool whose workers
         # block on their own nested futures could self-starve
-        if workers > 1 and not getattr(_EXPAND_TLS, "in_worker", False):
-            par = [
-                cgq
-                for cgq in structural
-                if not self._gq_touches_vars(cgq)
-            ]
+        if can_par:
+            par = (
+                [
+                    cgq
+                    for cgq, free in zip(structural, var_free)
+                    if free
+                ]
+                if var_free is not None
+                else [
+                    cgq
+                    for cgq in structural
+                    if not self._gq_touches_vars(cgq)
+                ]
+            )
             if len(par) > 1:
                 pool = _expand_pool(workers)
                 # each subtree runs under a COPY of this context so
@@ -696,17 +799,48 @@ class Executor:
                         results[id(cgq)] = ("ok", fut.result())
                     except Exception as exc:  # re-raised in decl order
                         results[id(cgq)] = ("err", exc)
-        for cgq in structural:
+        # error fidelity under reordering: the declaration-order path
+        # raises the FIRST failing sibling's error and never executes
+        # the rest. When the planner reordered execution, collect
+        # per-sibling errors and re-raise the earliest-DECLARED one —
+        # the same error the unreordered path surfaces (budget trips
+        # still abort immediately: they are a whole-query deadline,
+        # not an arm-specific failure).
+        from dgraph_tpu.query.functions import QueryBudgetError
+
+        decl_idx = {id(c): i for i, c in enumerate(structural)}
+        sib_errors: Dict[int, BaseException] = {}
+        for cgq in exec_structural:
+            if sib_errors and decl_idx[id(cgq)] > min(sib_errors):
+                # the declaration-order path never executes siblings
+                # declared AFTER a failing one — skip them here too
+                # (only earlier-declared siblings can still change
+                # which error surfaces)
+                continue
             got = results.get(id(cgq))
             if got is not None:
                 status, val = got
                 if status == "err":
-                    raise val
+                    if not reordered or isinstance(val, QueryBudgetError):
+                        raise val
+                    sib_errors[decl_idx[id(cgq)]] = val
+                    continue
                 cnode = val
             else:
-                cnode = self._expand_one(node, cgq, depth)
+                if not reordered:
+                    cnode = self._expand_one(node, cgq, depth)
+                else:
+                    try:
+                        cnode = self._expand_one(node, cgq, depth)
+                    except QueryBudgetError:
+                        raise
+                    except Exception as exc:
+                        sib_errors[decl_idx[id(cgq)]] = exc
+                        continue
             if cnode is not None:
                 made[id(cgq)] = cnode
+        if sib_errors:
+            raise sib_errors[min(sib_errors)]
         for cgq in deferred:
             cnode = self._make_child(node, cgq)
             if cnode is not None:
@@ -843,6 +977,7 @@ class Executor:
     def _record_plan_node(
         self, cnode: ExecNode, parent: ExecNode, attr: str,
         uids_in: int, uids_out: int, t0: float, k0, read: str,
+        est_out: Optional[int] = None,
     ) -> None:
         """One EXPLAIN plan-tree node (debug-mode queries only): uids
         in/out, read strategy, wall-ns over the whole child build
@@ -871,6 +1006,9 @@ class Executor:
                 "level": self._level_of(parent),
                 "uids_in": int(uids_in),
                 "uids_out": int(uids_out),
+                # planner's PRE-execution cardinality estimate (None =
+                # cold CardBook) — the EXPLAIN est-vs-actual column
+                "est_out": est_out,
                 "read": read,
                 "wall_ns": int((time.perf_counter() - t0) * 1e9),
                 "kernels": kernels,
@@ -912,10 +1050,15 @@ class Executor:
         _plan = current_plan()
         _plan_t0 = time.perf_counter()
         _plan_k0 = None
+        _plan_est = None
         if _plan is not None:
             from dgraph_tpu.ops import packed_setops
 
             _plan_k0 = packed_setops.counters()
+            if self.planner is not None:
+                _plan_est = self.planner.estimate_level_out(
+                    attr, len(parent.dest_uids)
+                )
         cnode.under_cascade = (
             parent.under_cascade or parent.gq.cascade or cgq.cascade
         )
@@ -964,12 +1107,29 @@ class Executor:
                 attr, parent, len(level_keys), t0,
                 uids_out=len(flat), decoded_bytes=int(flat.nbytes),
             )
+            if self.planner is not None:
+                self.planner.note_level(attr, len(level_keys), len(flat))
             if cgq.filter is not None:
-                dest = self.eval_filter(
-                    cgq.filter, ragged.merge_flat(flat, offs)
-                )
+                # intersect-vs-filter strategy per level: when the
+                # planner says the filter's match set is index-
+                # answerable and smaller than the frontier, push it
+                # below the fan-out — evaluate rootless and intersect
+                # the ragged rows directly (no merged-frontier
+                # materialization, no per-candidate verify). Sound
+                # because rows ⊆ merged makes rows ∩ match identical
+                # either way (query/planner.py pushdown_candidates).
+                cand = None
+                if self.planner is not None:
+                    cand = self.planner.pushdown_candidates(
+                        cgq.filter, attr, int(len(flat)),
+                        self._eval_filter_root,
+                    )
+                if cand is None:
+                    cand = self.eval_filter(
+                        cgq.filter, ragged.merge_flat(flat, offs)
+                    )
                 flat, offs = DISPATCHER.run_rows_vs_one_ragged(
-                    "intersect", flat, offs, dest, row_tokens=row_toks
+                    "intersect", flat, offs, cand, row_tokens=row_toks
                 )
             lens = None
             # per-row Python features (edge facets, per-row ordering) still
@@ -1078,6 +1238,10 @@ class Executor:
                 attr, parent, len(dkeys), t0,
                 uids_out=sum(1 for ps in all_posts if ps),
             )
+            if self.planner is not None:
+                self.planner.note_level(
+                    attr, len(dkeys), sum(1 for ps in all_posts if ps)
+                )
             for u, posts in zip(parent.dest_uids, all_posts):
                 if cgq.lang == "*":
                     pass  # @* keeps every language; encoder fans out fields
@@ -1126,6 +1290,7 @@ class Executor:
                 uids_in=len(parent.dest_uids), uids_out=uids_out,
                 t0=_plan_t0, k0=_plan_k0,
                 read="batched" if self.level_batch else "per_uid",
+                est_out=_plan_est,
             )
         return cnode
 

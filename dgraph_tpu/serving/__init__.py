@@ -26,7 +26,14 @@ amortizable; this package amortizes it *across* queries:
                    signal says the server is saturated
                    (serving/admission.py).
 
-  ServingFront   — the per-engine bundle of the three, constructed by
+  ResultCache    — snapshot-keyed whole-response reuse: wire bytes
+                   served from a bounded LRU keyed on (normalized
+                   shape, literal bindings, variables, namespace,
+                   snapshot watermark, commit epoch) — provably
+                   byte-identical until a commit advances the
+                   watermark (serving/resultcache.py, PR 15).
+
+  ServingFront   — the per-engine bundle of the four, constructed by
                    api/server.Server and worker/harness.ProcCluster
                    (serving/front.py).
 """
@@ -38,3 +45,4 @@ from dgraph_tpu.serving.admission import (  # noqa: F401
 from dgraph_tpu.serving.front import ServingFront  # noqa: F401
 from dgraph_tpu.serving.microbatch import MicroBatcher  # noqa: F401
 from dgraph_tpu.serving.plancache import PlanCache, normalize  # noqa: F401
+from dgraph_tpu.serving.resultcache import ResultCache  # noqa: F401

@@ -1,16 +1,19 @@
-"""ServingFront: the per-engine bundle of plan cache, micro-batcher,
-and admission controller.
+"""ServingFront: the per-engine bundle of plan cache, result cache,
+micro-batcher, and admission controller.
 
 One instance per engine (api/server.Server, worker/harness.ProcCluster).
 The entry points drive it in four places:
 
-    blocks, shape = front.parse(q, variables)   # plan cache
+    blocks, shape, lits = front.parse(q, variables)  # plan cache
     ticket = front.admit(shape, blocks)         # admission gate (raises)
-    ...execute with batcher=front.batcher_for(cache)...
+    ...result-cache probe (shape, lits, watermark), else execute with
+       batcher=front.batcher_for(cache)...
     front.finish(ticket, shape, took_ms, slow)  # stats + release
 
 `on_commit()` hooks the engine's commit/alter paths: it bumps the plan
-cache epoch so no cached plan survives a commit unrevalidated.
+cache epoch so no cached plan survives a commit unrevalidated. The
+result cache needs no hook — its keys carry the snapshot watermark,
+which every commit/alter advances (serving/resultcache.py).
 """
 
 from __future__ import annotations
@@ -20,11 +23,15 @@ from typing import Optional, Tuple
 from dgraph_tpu.serving.admission import AdmissionController, Ticket
 from dgraph_tpu.serving.microbatch import MicroBatcher, window_us
 from dgraph_tpu.serving.plancache import PlanCache, normalize
+from dgraph_tpu.serving.resultcache import ResultCache
 
 
 class ServingFront:
     def __init__(self, stats=None, schema_fn=None, last_commit_fn=None):
         self.plan_cache = PlanCache()
+        # snapshot-keyed whole-response reuse (watermark-keyed; off by
+        # default via DGRAPH_TPU_RESULT_CACHE_SIZE=0)
+        self.results = ResultCache()
         # schema_fn: a getter, so engines that rebind their schema
         # wholesale (drop_all) are always read fresh
         self.admission = AdmissionController(
@@ -42,13 +49,16 @@ class ServingFront:
 
     def parse(
         self, q: str, variables=None, info: Optional[dict] = None
-    ) -> Tuple[list, Optional[str]]:
-        """dql.parse through the plan cache. Returns (blocks, shape);
-        shape is None when the query doesn't lex (parse raises the real
-        error) — such queries bypass the cache. With the cache disabled
-        (PLAN_CACHE_SIZE=0) the normalization pass — a second full
-        tokenize per query — is skipped outright (the shape would feed
-        nothing: cost stats are disabled with the cache).
+    ) -> Tuple[list, Optional[str], Optional[tuple]]:
+        """dql.parse through the plan cache. Returns (blocks, shape,
+        literals); shape is None when the query doesn't lex (parse
+        raises the real error) — such queries bypass both caches. The
+        literal tuple is the result cache's binding component (shape +
+        literals + variables reconstruct the query modulo whitespace).
+        With the plan cache disabled (PLAN_CACHE_SIZE=0) but the
+        result cache on, normalization still runs — the result cache
+        needs the shape key; with BOTH disabled the second tokenize is
+        skipped outright.
 
         `info`, when given (debug/EXPLAIN requests), is filled with the
         plan-cache outcome: {"hit": bool, "shape": normalized-key,
@@ -56,16 +66,21 @@ class ServingFront:
         extensions.plan."""
         from dgraph_tpu import dql
 
-        if self.plan_cache.capacity() == 0:
+        plan_on = self.plan_cache.capacity() > 0
+        if not plan_on and self.results.capacity() == 0:
             if info is not None:
                 info.update(enabled=False, hit=False, shape=None)
-            return dql.parse(q, variables), None
+            return dql.parse(q, variables), None, None
         norm = normalize(q)
         if norm is None:
             if info is not None:
-                info.update(enabled=True, hit=False, shape=None)
-            return dql.parse(q, variables), None
+                info.update(enabled=plan_on, hit=False, shape=None)
+            return dql.parse(q, variables), None, None
         shape, literals = norm
+        if not plan_on:
+            if info is not None:
+                info.update(enabled=False, hit=False, shape=shape)
+            return dql.parse(q, variables), shape, literals
         blocks = self.plan_cache.get(shape, literals, variables)
         hit = blocks is not None
         if blocks is None:
@@ -73,7 +88,32 @@ class ServingFront:
             self.plan_cache.put(shape, literals, blocks, variables)
         if info is not None:
             info.update(enabled=True, hit=hit, shape=shape)
-        return blocks, shape
+        return blocks, shape, literals
+
+    # -- result cache ---------------------------------------------------------
+
+    def result_probe(
+        self, shape, literals, variables, ns: int, watermark: int,
+        debug: bool = False,
+    ):
+        """Key + lookup for one result-cache-ELIGIBLE query — callers
+        gate the entry-point-specific conditions first (no pinned
+        read_ts, no ACL, cluster not degraded). Returns (key, raw_hit,
+        would_hit): key None when the cache is off, the query didn't
+        normalize, or nothing has committed yet; debug probes presence
+        WITHOUT serving (EXPLAIN always executes). One implementation
+        for both engines so key composition can never drift between
+        them."""
+        rc = self.results
+        if shape is None or not watermark or rc.capacity() == 0:
+            return None, None, False
+        key = rc.key(
+            shape, literals, variables, int(ns), int(watermark),
+            epoch=self.plan_cache.epoch,
+        )
+        if debug:
+            return key, None, rc.peek(key)
+        return key, rc.get(key), False
 
     # -- admission ------------------------------------------------------------
 

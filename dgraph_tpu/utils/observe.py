@@ -1266,6 +1266,13 @@ class PlanCapture:
         self.plan_cache: Dict[str, object] = {}
         self.admission: Dict[str, object] = {}
         self.cache: Dict[str, float] = {}
+        # cost-based planner decisions for this query: reorder/pushdown
+        # counts + the chosen orders (query/planner.Planner.explain())
+        self.planner: Dict[str, object] = {}
+        # result-cache outcome: enabled/hit tier + the watermark key
+        # (the entry points probe without serving on debug queries —
+        # EXPLAIN always executes)
+        self.result_cache: Dict[str, object] = {}
         self.meta: Dict[str, object] = {}
 
     def note_node(self, rec: dict) -> None:
@@ -1319,6 +1326,8 @@ class PlanCapture:
             "plan_cache": dict(self.plan_cache),
             "admission": dict(self.admission),
             "cache": dict(self.cache),
+            "planner": dict(self.planner),
+            "result_cache": dict(self.result_cache),
         }
         if self.setops_dropped:
             out["setops_dropped"] = self.setops_dropped
@@ -1926,9 +1935,35 @@ declare_metric(
     "(normalized-shape + literal-binding hit; parse skipped).",
 )
 declare_metric(
+    "counter", "planner_reorders_total",
+    "Evaluation-order decisions where the cost-based planner departed "
+    "from declaration order (AND-filter chains ordered cheapest/most-"
+    "selective first, var-free sibling expansion cheapest-first) — "
+    "observation-equivalent by construction (query/planner.py).",
+)
+declare_metric(
+    "counter", "pushdown_applied_total",
+    "Traversal levels whose @filter was pushed below the fan-out: the "
+    "planner evaluated the index-answerable filter tree rootless and "
+    "intersected the ragged level rows directly, skipping the merged-"
+    "frontier materialization and per-candidate verify "
+    "(query/planner.py pushdown_candidates).",
+)
+declare_metric(
     "counter", "plan_cache_miss_total",
     "Plan-cache lookups that had to parse (new shape, new literal "
     "binding, epoch-invalidated entry, or cache disabled).",
+)
+declare_metric(
+    "counter", "result_cache_hit_total",
+    "Queries served whole from the snapshot-keyed result cache "
+    "(serving/resultcache.py): byte-identical response bytes at an "
+    "unchanged snapshot watermark, execution and encode skipped.",
+)
+declare_metric(
+    "counter", "result_cache_miss_total",
+    "Result-cache-eligible queries that executed (new binding, "
+    "advanced watermark, TTL-expired or evicted entry).",
 )
 declare_metric(
     "counter", "restore_records_total",

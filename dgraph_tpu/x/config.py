@@ -317,6 +317,18 @@ _define(
     "through every remote read beneath it (worker/harness.py).",
 )
 _define(
+    "QUERY_PLANNER", "bool", True,
+    "Cost-based query planner (query/planner.py): orders AND-filter "
+    "chains and var-free sibling expansion cheapest-first from "
+    "StatsHolder selectivity + observed-cardinality EWMAs, narrows "
+    "later filter arms with the running intersection, and pushes "
+    "index-answerable level filters below the fan-out when the match "
+    "set is estimated smaller than the frontier. Observation-"
+    "equivalent by construction (golden-corpus-enforced byte "
+    "identity); 0 restores declaration-order execution — the A/B "
+    "escape hatch.",
+)
+_define(
     "REBALANCE_BY_TRAFFIC", "bool", False,
     "Auto-rebalance scoring mode: when on, the tablet picker weighs "
     "each tablet by size PLUS its observed traffic (decoded/result "
@@ -334,6 +346,33 @@ _define(
     "byte-load gap; uniform(0, 2i) jitter de-synchronizes a fleet). "
     "Matches the reference Zero's ~8-minute rebalance cadence "
     "(zero/tablet.go).",
+)
+_define(
+    "RESULT_CACHE_SIZE", "int", 0,
+    "Snapshot-keyed whole-response result cache (serving/"
+    "resultcache.py), in entries: responses are keyed on (normalized "
+    "plan shape, literal bindings, variables, namespace, snapshot "
+    "watermark), so a cached entry is provably byte-identical to "
+    "re-execution until a commit advances the watermark — the PR 7/11 "
+    "watermark proof (two reads covering the same watermark see "
+    "identical stores). 0 (default) disables result reuse, like the "
+    "other serving-front gates (ADMISSION, BATCH_WINDOW_US).",
+)
+_define(
+    "RESULT_CACHE_BYTES", "int", 64 << 20,
+    "Byte bound on the result cache's stored response payloads "
+    "(serving/resultcache.py): eviction runs until BOTH the entry "
+    "count (RESULT_CACHE_SIZE) and this byte total are under bound, "
+    "so wide-fan-out responses cannot grow the cache past what the "
+    "operator sized. 0 disables the byte bound (entry count only).",
+)
+_define(
+    "RESULT_CACHE_TTL_S", "float", 300.0,
+    "Age bound on a result-cache entry (serving/resultcache.py): "
+    "entries older than this are treated as misses even at an "
+    "unchanged watermark (a safety valve for long write-idle "
+    "deployments, not a correctness requirement — watermark keying "
+    "already guarantees freshness). 0 disables the TTL.",
 )
 _define(
     "SHARD_MIN_B", "int", 1 << 22,

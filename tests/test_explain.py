@@ -53,13 +53,17 @@ def validate_plan(plan):
         ("plan_cache", dict),
         ("admission", dict),
         ("cache", dict),
+        ("planner", dict),
+        ("result_cache", dict),
     ):
         assert key in plan and isinstance(plan[key], typ), key
     for node in plan["nodes"]:
         _validate_node(node)
     for s in plan["setops"]:
-        assert s.get("verdict") in ("packed", "decoded"), s
-        assert s.get("site") in ("pair", "index_intersect"), s
+        assert s.get("verdict") in ("packed", "decoded", "pushdown"), s
+        assert s.get("site") in (
+            "pair", "index_intersect", "level_filter"
+        ), s
     mb = plan["microbatch"]
     assert set(mb) == {"solo", "coalesced", "members_max"}
     assert {"cost", "degrade", "enabled"} <= set(plan["admission"])

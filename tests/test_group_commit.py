@@ -571,12 +571,24 @@ def test_chaos_group_commit_bank_fixed_seed():
       - TimeoutError acks are AMBIGUOUS (may or may not have applied)
         and are excluded from the exact-ledger claim, like the
         serial-path chaos bank.
+
+    Deflake (PR 15): under full-suite load the 1-core box schedules
+    three replica interpreters + four writer threads + the test runner
+    against everything else in tier-1 — the default 20s/15s
+    commit/query deadlines and the startup election waits tripped once
+    in the PR 11/12 runs (fixed seed, passes solo). The deadlines are
+    widened HERE (and the harness election waits globally) so a slow
+    box reads as slow, not broken; the ledger/idempotency claims are
+    untouched.
     """
     from dgraph_tpu.worker.harness import ProcCluster
 
-    c = ProcCluster(n_groups=1, replicas=3)
+    config.set_env("COMMIT_DEADLINE_S", 90)
+    config.set_env("QUERY_DEADLINE_S", 60)
+    c = None
     plan = None
     try:
+        c = ProcCluster(n_groups=1, replicas=3)
         c.alter("bal: int @upsert .")
         rdf = []
         for i in range(1, N_ACCOUNTS + 1):
@@ -672,4 +684,7 @@ def test_chaos_group_commit_bank_fixed_seed():
         faults.reset()
         if plan is not None:
             plan.heal()
-        c.close()
+        if c is not None:
+            c.close()
+        config.unset_env("COMMIT_DEADLINE_S")
+        config.unset_env("QUERY_DEADLINE_S")

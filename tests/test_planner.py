@@ -1,0 +1,534 @@
+"""Cost-based planner + snapshot-keyed result cache (ROADMAP item 2).
+
+The correctness gates:
+
+  - Golden-corpus byte equivalence with the planner on vs off
+    (DGRAPH_TPU_QUERY_PLANNER) — every ordering/narrowing/pushdown
+    decision must be observation-equivalent. Smoke subset tier-1; the
+    full 535-case sweep is slow-marked.
+  - Golden-corpus byte equivalence with the result cache on vs off
+    (DGRAPH_TPU_RESULT_CACHE_SIZE), including the repeat that actually
+    HITS the cache.
+  - No stale result is ever served past a watermark advance: the
+    deterministic mutate-then-query check and a concurrent-writer
+    monotonicity regression.
+
+Plus unit tests for the planner's ordering/pushdown decisions, the
+ResultCache LRU/TTL/key semantics, the EXPLAIN surfacing, and the
+ProcCluster wiring.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.utils.observe import METRICS
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "ref_golden")
+CASES = json.load(open(os.path.join(HERE, "cases.json")))
+SMOKE_CASES = CASES[::9]  # same stride as test_explain/test_parallel_exec
+
+
+@pytest.fixture(scope="module")
+def golden_server():
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter(open(os.path.join(HERE, "schema.txt")).read())
+    for rdf in ("triples.rdf", "triples_facets.rdf"):
+        t = s.new_txn()
+        t.mutate_rdf(
+            set_rdf=open(os.path.join(HERE, rdf)).read(), commit_now=True
+        )
+    return s
+
+
+def _data_bytes(server, q):
+    """Wire bytes of the response data, or the error repr — both
+    configurations must fail identically too."""
+    try:
+        d = server.query(q, want="raw")["data"]
+        raw = getattr(d, "raw", None)
+        if raw is not None:
+            return bytes(raw)
+        return json.dumps(d, sort_keys=True).encode()
+    except Exception as exc:
+        return f"{type(exc).__name__}: {exc}"
+
+
+def _with_env(server, q, **env):
+    saved = {}
+    for k, v in env.items():
+        name = f"DGRAPH_TPU_{k}"
+        saved[name] = os.environ.get(name)
+        os.environ[name] = str(v)
+    try:
+        return _data_bytes(server, q)
+    finally:
+        for name, old in saved.items():
+            if old is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = old
+
+
+# ---------------------------------------------------------------------------
+# golden-corpus byte equivalence: planner on/off
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "case", SMOKE_CASES, ids=[c["id"] for c in SMOKE_CASES]
+)
+def test_golden_planner_byte_equality_smoke(golden_server, case):
+    on = _with_env(golden_server, case["query"], QUERY_PLANNER=1)
+    off = _with_env(golden_server, case["query"], QUERY_PLANNER=0)
+    assert on == off
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("case", CASES, ids=[c["id"] for c in CASES])
+def test_golden_planner_byte_equality_full(golden_server, case):
+    on = _with_env(golden_server, case["query"], QUERY_PLANNER=1)
+    off = _with_env(golden_server, case["query"], QUERY_PLANNER=0)
+    assert on == off
+
+
+# ---------------------------------------------------------------------------
+# golden-corpus byte equivalence: result cache on/off (incl. the HIT)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "case", SMOKE_CASES, ids=[c["id"] for c in SMOKE_CASES]
+)
+def test_golden_result_cache_byte_equality_smoke(golden_server, case):
+    q = case["query"]
+    base = _with_env(golden_server, q, RESULT_CACHE_SIZE=0)
+    first = _with_env(golden_server, q, RESULT_CACHE_SIZE=4096)
+    second = _with_env(golden_server, q, RESULT_CACHE_SIZE=4096)
+    assert first == base  # the populating miss
+    assert second == base  # the hit (or a second miss) — never stale
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("case", CASES, ids=[c["id"] for c in CASES])
+def test_golden_result_cache_byte_equality_full(golden_server, case):
+    q = case["query"]
+    base = _with_env(golden_server, q, RESULT_CACHE_SIZE=0)
+    first = _with_env(golden_server, q, RESULT_CACHE_SIZE=4096)
+    second = _with_env(golden_server, q, RESULT_CACHE_SIZE=4096)
+    assert first == base and second == base
+
+
+# ---------------------------------------------------------------------------
+# planner decisions
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def hub_server():
+    """One hub entity with a wide friend fan-out — the level shape
+    where the intersect-vs-filter (pushdown) choice matters."""
+    from dgraph_tpu.api.server import Server
+
+    s = Server()
+    s.alter(
+        "name: string @index(exact, trigram) .\n"
+        "age: int @index(int) .\n"
+        "friend: [uid] @reverse .\n"
+    )
+    lines = []
+    for u in range(1, 301):
+        lines.append(f'<{hex(u)}> <name> "n{u}" .')
+        lines.append(f'<{hex(u)}> <age> "{u % 60}"^^<xs:int> .')
+    for v in range(2, 252):
+        lines.append(f"<0x1> <friend> <{hex(v)}> .")
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf="\n".join(lines), commit_now=True)
+    return s
+
+
+def test_pushdown_fires_and_matches_filter_strategy(hub_server):
+    q = (
+        '{ q(func: eq(name, "n1")) '
+        '{ name friend @filter(eq(name, "n17")) { name } } }'
+    )
+    p0 = METRICS.value("pushdown_applied_total")
+    on = _with_env(hub_server, q, QUERY_PLANNER=1)
+    assert METRICS.value("pushdown_applied_total") > p0, (
+        "selective indexed filter over a 250-wide frontier must push down"
+    )
+    off = _with_env(hub_server, q, QUERY_PLANNER=0)
+    assert on == off
+    assert b"n17" in on
+
+
+def test_pushdown_surfaces_in_explain(hub_server):
+    q = (
+        '{ q(func: eq(name, "n1")) '
+        '{ name friend @filter(eq(name, "n17")) { name } } }'
+    )
+    hub_server.query(q)  # warm the CardBook/stats
+    res = hub_server.query(q, debug=True)
+    plan = res["extensions"]["plan"]
+    assert plan["planner"]["enabled"] is True
+    assert plan["planner"]["pushdowns"] >= 1
+    recs = [s for s in plan["setops"] if s["site"] == "level_filter"]
+    assert recs and recs[0]["verdict"] == "pushdown"
+    assert recs[0]["frontier"] >= recs[0]["est"]
+    # est-vs-actual cardinality on the friend node (CardBook warmed by
+    # the first run)
+    (root,) = plan["nodes"]
+    friend = next(c for c in root["children"] if c["attr"] == "friend")
+    assert "est_out" in friend and friend["est_out"] is not None
+
+
+def test_and_chain_orders_cheap_arm_first(hub_server):
+    """regexp (verify-heavy) declared BEFORE an indexed eq must still
+    evaluate after it — and the narrowed chain is byte-identical."""
+    q = (
+        "{ q(func: has(age)) "
+        '@filter(regexp(name, /n1.*/) AND eq(name, "n17")) { name } }'
+    )
+    r0 = METRICS.value("planner_reorders_total")
+    on = _with_env(hub_server, q, QUERY_PLANNER=1)
+    assert METRICS.value("planner_reorders_total") > r0
+    off = _with_env(hub_server, q, QUERY_PLANNER=0)
+    assert on == off
+
+
+def test_and_chain_error_arms_still_raise(hub_server):
+    """An arm whose schema checks would raise must raise with the
+    planner on, even when a selective earlier arm empties the running
+    intersection first (the early-exit would otherwise turn an error
+    into an empty success)."""
+    q = (
+        '{ q(func: has(age)) '
+        '@filter(eq(name, "no-such-name") AND near(name, [1,1], 10)) '
+        "{ name } }"
+    )
+    on = _with_env(hub_server, q, QUERY_PLANNER=1)
+    off = _with_env(hub_server, q, QUERY_PLANNER=0)
+    assert on == off
+    assert isinstance(on, str) and "QueryError" in on, on
+
+
+def test_sibling_error_identity_under_reorder(hub_server):
+    """When siblings are reordered, the error raised must still be the
+    earliest-DECLARED failing sibling's — what the declaration-order
+    path surfaces."""
+    # ~name is invalid (reverse on a non-uid predicate) and scores as
+    # an expensive uid fan-out, so the planner moves the cheap value
+    # reads ahead of it; the response must still be ~name's error
+    q = (
+        '{ q(func: eq(name, "n1")) '
+        "{ ~name { name } name age } }"
+    )
+    on = _with_env(hub_server, q, QUERY_PLANNER=1)
+    off = _with_env(hub_server, q, QUERY_PLANNER=0)
+    assert on == off
+    assert isinstance(on, str) and "reverse" in on, on
+
+
+def test_planner_order_and_unit():
+    from dgraph_tpu.dql.parser import FilterTree, FuncSpec
+    from dgraph_tpu.query.planner import Planner
+    from dgraph_tpu.schema.schema import State
+
+    pl = Planner(State(), None, 0)
+    chain = FilterTree(
+        op="and",
+        children=[
+            FilterTree(func=FuncSpec(name="regexp", attr="name", args=[])),
+            FilterTree(func=FuncSpec(name="uid", attr="", args=[1, 2])),
+            FilterTree(func=FuncSpec(name="has", attr="name", args=[])),
+        ],
+    )
+    order = pl.order_and(chain.children, 1000)
+    # uid (class 0) first, has (class 2) second, regexp (class 3) last
+    assert order == [1, 2, 0]
+    assert pl.reorders == 1
+
+
+def test_planner_pushdown_gate_unit():
+    from dgraph_tpu.dql.parser import FilterTree, FuncSpec
+    from dgraph_tpu.query.planner import Planner
+    from dgraph_tpu.schema.schema import State
+
+    pl = Planner(State(), None, 0)
+    ok = FilterTree(
+        op="and",
+        children=[
+            FilterTree(func=FuncSpec(name="eq", attr="name", args=["x"])),
+            FilterTree(func=FuncSpec(name="has", attr="age", args=[])),
+        ],
+    )
+    assert pl.tree_pushdown_ok(ok)
+    # NOT needs the frontier as its universe: never root-evaluable
+    noted = FilterTree(op="not", children=[ok])
+    assert not pl.tree_pushdown_ok(noted)
+    assert not pl.tree_pushdown_ok(
+        FilterTree(op="and", children=[ok, noted])
+    )
+    # similar_to is a top-k (impure): no narrowing for its subtree
+    sim = FilterTree(
+        op="and",
+        children=[
+            FilterTree(
+                func=FuncSpec(name="similar_to", attr="v", args=[])
+            ),
+            ok,
+        ],
+    )
+    assert not pl.tree_pure(sim)
+    assert pl.tree_pure(ok)
+
+
+def test_sibling_reorder_preserves_output_order(hub_server):
+    """Cheap value predicates may EXECUTE before an expensive uid
+    fan-out, but the response field order must stay declaration
+    order."""
+    q = (
+        '{ q(func: eq(name, "n1")) '
+        "{ friend { name } name age } }"
+    )
+    hub_server.query(q)  # warm CardBook so friend scores expensive
+    on = json.loads(_with_env(hub_server, q, QUERY_PLANNER=1))
+    off = json.loads(_with_env(hub_server, q, QUERY_PLANNER=0))
+    assert on == off
+    assert list(on["q"][0].keys()) == ["friend", "name", "age"]
+
+
+# ---------------------------------------------------------------------------
+# result cache semantics
+# ---------------------------------------------------------------------------
+
+
+def test_result_cache_lru_and_ttl_unit():
+    from dgraph_tpu.serving.resultcache import ResultCache
+
+    rc = ResultCache(size=2, ttl_s=0.0)
+    k1 = rc.key("s", ("a",), None, 0, 7, epoch=1)
+    k2 = rc.key("s", ("b",), None, 0, 7, epoch=1)
+    k3 = rc.key("s", ("c",), None, 0, 7, epoch=1)
+    rc.put(k1, b"1")
+    rc.put(k2, b"2")
+    assert rc.get(k1) == b"1"
+    rc.put(k3, b"3")  # evicts k2 (k1 was refreshed by the get)
+    assert rc.get(k2) is None
+    assert rc.get(k1) == b"1" and rc.get(k3) == b"3"
+    # byte bound: eviction honors RESULT_CACHE_BYTES, and a single
+    # over-bound response never flushes the LRU (it just isn't cached)
+    rcb = ResultCache(size=100, ttl_s=0.0, max_bytes=10)
+    rcb.put(k1, b"aaaa")
+    rcb.put(k2, b"bbbb")
+    rcb.put(k3, b"cccc")  # 12 bytes total -> k1 evicted
+    assert rcb.get(k1) is None
+    assert rcb.get(k2) == b"bbbb" and rcb.get(k3) == b"cccc"
+    assert rcb.stats()["bytes"] == 8
+    rcb.put(rc.key("s", ("d",), None, 0, 7, 1), b"x" * 64)  # > bound
+    assert rcb.get(k2) == b"bbbb"  # LRU untouched
+    # TTL: an expired entry is a miss even at the same watermark
+    rc2 = ResultCache(size=8, ttl_s=1e-9)
+    rc2.put(k1, b"1")
+    import time
+
+    time.sleep(0.01)
+    assert rc2.get(k1) is None
+    # key separates watermarks, epochs, namespaces, and variables
+    assert rc.key("s", ("a",), None, 0, 7, 1) != rc.key(
+        "s", ("a",), None, 0, 8, 1
+    )
+    assert rc.key("s", ("a",), None, 0, 7, 1) != rc.key(
+        "s", ("a",), None, 0, 7, 2
+    )
+    assert rc.key("s", ("a",), None, 1, 7, 1) != rc.key(
+        "s", ("a",), None, 0, 7, 1
+    )
+    assert rc.key("s", ("a",), {"$x": "1"}, 0, 7, 1) != rc.key(
+        "s", ("a",), {"$x": "2"}, 0, 7, 1
+    )
+
+
+def test_result_cache_never_stale_after_mutation(monkeypatch):
+    from dgraph_tpu.api.server import Server
+
+    monkeypatch.setenv("DGRAPH_TPU_RESULT_CACHE_SIZE", "64")
+    s = Server()
+    s.alter("v: int .\nname: string @index(exact) .")
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf='<0x1> <name> "c" .\n<0x1> <v> "0"^^<xs:int> .',
+        commit_now=True,
+    )
+    q = '{ q(func: eq(name, "c")) { v } }'
+    assert s.query(q)["data"]["q"] == [{"v": 0}]
+    # second read HITS
+    h0 = METRICS.value("result_cache_hit_total")
+    assert s.query(q)["data"]["q"] == [{"v": 0}]
+    assert METRICS.value("result_cache_hit_total") == h0 + 1
+    for i in range(1, 6):
+        t = s.new_txn()
+        t.mutate_rdf(
+            set_rdf=f'<0x1> <v> "{i}"^^<xs:int> .', commit_now=True
+        )
+        assert s.query(q)["data"]["q"] == [{"v": i}], (
+            "stale result served past a watermark advance"
+        )
+
+
+def test_result_cache_invalidation_under_concurrent_mutation(monkeypatch):
+    """A writer advancing a counter races cached readers: observed
+    values must be monotonically non-decreasing (a stale serve past a
+    watermark advance would show as a decrease), and the final read
+    must see the final committed value."""
+    from dgraph_tpu.api.server import Server
+
+    monkeypatch.setenv("DGRAPH_TPU_RESULT_CACHE_SIZE", "256")
+    s = Server()
+    s.alter("v: int .\nname: string @index(exact) .")
+    t = s.new_txn()
+    t.mutate_rdf(
+        set_rdf='<0x1> <name> "c" .\n<0x1> <v> "0"^^<xs:int> .',
+        commit_now=True,
+    )
+    q = '{ q(func: eq(name, "c")) { v } }'
+    N = 60
+    per_reader = [[], []]
+    stop = threading.Event()
+
+    def reader(idx):
+        mine = per_reader[idx]
+        while not stop.is_set():
+            got = s.query(q)["data"]["q"]
+            if got:
+                mine.append(got[0]["v"])
+
+    ths = [
+        threading.Thread(target=reader, args=(i,)) for i in range(2)
+    ]
+    for th in ths:
+        th.start()
+    for i in range(1, N + 1):
+        t = s.new_txn()
+        t.mutate_rdf(
+            set_rdf=f'<0x1> <v> "{i}"^^<xs:int> .', commit_now=True
+        )
+    stop.set()
+    for th in ths:
+        th.join()
+    assert s.query(q)["data"]["q"] == [{"v": N}]
+    # one reader's sequential reads ride a monotonically advancing
+    # watermark: a stale serve past an advance would show as a value
+    # DECREASE in that reader's sequence
+    for mine in per_reader:
+        assert all(
+            a <= b for a, b in zip(mine, mine[1:])
+        ), "stale cached result served past a watermark advance"
+        assert all(0 <= v <= N for v in mine)
+
+
+def test_result_cache_pinned_read_ts_never_caches(monkeypatch):
+    from dgraph_tpu.api.server import Server
+
+    monkeypatch.setenv("DGRAPH_TPU_RESULT_CACHE_SIZE", "64")
+    s = Server()
+    s.alter("name: string @index(exact) .")
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='<0x1> <name> "a" .', commit_now=True)
+    q = '{ q(func: eq(name, "a")) { name } }'
+    ts = s.zero.read_ts()
+    m0 = METRICS.value("result_cache_miss_total")
+    h0 = METRICS.value("result_cache_hit_total")
+    s.query(q, read_ts=ts)
+    s.query(q, read_ts=ts)
+    assert METRICS.value("result_cache_miss_total") == m0
+    assert METRICS.value("result_cache_hit_total") == h0
+
+
+def test_result_cache_dict_hits_are_fresh_objects(monkeypatch):
+    """A caller mutating a dict-API response must never poison the
+    cache: hits rebuild from the immutable stored bytes."""
+    from dgraph_tpu.api.server import Server
+
+    monkeypatch.setenv("DGRAPH_TPU_RESULT_CACHE_SIZE", "64")
+    s = Server()
+    s.alter("name: string @index(exact) .")
+    t = s.new_txn()
+    t.mutate_rdf(set_rdf='<0x1> <name> "a" .', commit_now=True)
+    q = '{ q(func: eq(name, "a")) { name } }'
+    first = s.query(q)["data"]
+    second = s.query(q)["data"]  # populate → hit
+    second["q"][0]["name"] = "MUTATED"
+    third = s.query(q)["data"]
+    assert third["q"] == [{"name": "a"}]
+    assert first["q"] == [{"name": "a"}]
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN renderer
+# ---------------------------------------------------------------------------
+
+
+def test_render_plan_planner_and_result_cache_lines(hub_server, monkeypatch):
+    from dgraph_tpu.cli import render_plan
+
+    monkeypatch.setenv("DGRAPH_TPU_RESULT_CACHE_SIZE", "64")
+    q = (
+        '{ q(func: eq(name, "n1")) '
+        '{ name friend @filter(eq(name, "n17")) { name } } }'
+    )
+    hub_server.query(q)  # warm CardBook + populate the cache
+    res = hub_server.query(q, debug=True)
+    out = render_plan(res["extensions"]["plan"])
+    lines = out.splitlines()
+    assert any(l.startswith("  planner: on, ") for l in lines), out
+    assert any(l.startswith("  result cache: ") for l in lines), out
+    # the friend node carries est-vs-actual cardinality
+    (friend_line,) = [
+        l for l in lines if l.lstrip().startswith("friend level=")
+    ]
+    assert "(est " in friend_line, friend_line
+    assert "pushdown" in out, out
+
+
+# ---------------------------------------------------------------------------
+# ProcCluster wiring
+# ---------------------------------------------------------------------------
+
+
+def test_proc_cluster_result_cache_and_planner(monkeypatch):
+    from dgraph_tpu.worker.harness import ProcCluster
+
+    monkeypatch.setenv("DGRAPH_TPU_RESULT_CACHE_SIZE", "64")
+    c = ProcCluster(n_groups=1, replicas=1)
+    try:
+        c.alter("name: string @index(exact) .")
+        c.new_txn().mutate_rdf(
+            set_rdf='<0x1> <name> "A" .\n<0x2> <name> "B" .',
+            commit_now=True,
+        )
+        q = '{ q(func: has(name)) { name } }'
+        first = c.query(q, want="raw")
+        h0 = METRICS.value("result_cache_hit_total")
+        second = c.query(q, want="raw")
+        assert METRICS.value("result_cache_hit_total") == h0 + 1
+        assert first["data"].raw == second["data"].raw
+        assert second["extensions"]["result_cache"]["hit"] is True
+        # a commit advances the watermark: no stale serve
+        c.new_txn().mutate_rdf(
+            set_rdf='<0x3> <name> "C" .', commit_now=True
+        )
+        third = c.query(q)
+        assert len(third["data"]["q"]) == 3
+        # EXPLAIN surfaces both planes
+        dbg = c.query(q, want="raw", debug=True)
+        plan = dbg["extensions"]["plan"]
+        assert plan["planner"].get("enabled") in (True, False)
+        assert plan["result_cache"]["enabled"] is True
+    finally:
+        c.close()

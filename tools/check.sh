@@ -8,6 +8,11 @@
 #                           the ~5s ops-plane gate alone: backup/restore
 #                           crash-consistency + CDC ordering/replay
 #                           (tests/test_ops_plane.py)
+#   tools/check.sh --plan-sanity
+#                           the ~5s planner/result-reuse gate alone:
+#                           planner on/off + result-cache off/miss/hit
+#                           byte-equality over the golden smoke subset
+#                           (bench.py --plan-sanity)
 #
 # Exit code is nonzero on the first failing stage, so CI can consume it
 # directly. JAX is pinned to CPU: the gate must never dial an accelerator.
@@ -21,6 +26,13 @@ if [[ "${1:-}" == "--ops-sanity" ]]; then
     echo "== ops-plane sanity (~5s): backup/restore crash consistency + CDC =="
     python -m pytest tests/test_ops_plane.py -q -p no:cacheprovider
     echo "check.sh: ops-sanity passed"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--plan-sanity" ]]; then
+    echo "== planner/result-reuse sanity (~5s): A/B byte-equality =="
+    python bench.py --plan-sanity
+    echo "check.sh: plan-sanity passed"
     exit 0
 fi
 
@@ -50,11 +62,15 @@ else
         tests/test_vector_quant.py \
         tests/test_group_commit.py \
         tests/test_explain.py tests/test_telemetry.py \
+        tests/test_planner.py \
         tests/test_ops_plane.py \
         -q -p no:cacheprovider
 
     echo "== explain sanity (~5s) =="
     python bench.py --explain-sanity
+
+    echo "== planner/result-reuse sanity (~5s) =="
+    python bench.py --plan-sanity
 
     echo "== qps loadgen sanity (~5s) =="
     python benchmarks/qps_loadgen.py --sanity
