@@ -1062,6 +1062,15 @@ if __name__ == "__main__":
         _explain_sanity()
     elif "--plan-sanity" in sys.argv:
         _plan_sanity()
+    elif "--write-sanity" in sys.argv:
+        # mixed read/write smoke incl. the columnar batch-apply arm
+        # check (delegates to the loadgen's gate; host-path only)
+        from dgraph_tpu.devsetup import maybe_force_cpu
+
+        maybe_force_cpu()
+        from benchmarks import qps_loadgen
+
+        sys.exit(qps_loadgen.main(["--write-sanity"]))
     elif "--chaos-only" in sys.argv:
         # host-only capture: no device involved in the RPC plane
         _bench_chaos("cpu")

@@ -172,6 +172,12 @@ _ROW_COUNTERS = (
     "planner_reorders_total", "pushdown_applied_total",
     "group_commit_total", "group_commit_txns_total",
     "mutation_edges_total", "num_commits",
+    # PR 16: columnar batch-apply coverage + the commit-phase
+    # wall-time split (oracle verdict / encode+propose / apply
+    # barrier) — the write path's residual-bound breakdown per row
+    "mutation_batch_apply_edges_total", "mutation_native_fallback_total",
+    "commit_oracle_ns_total", "commit_propose_ns_total",
+    "commit_apply_ns_total",
 )
 
 
@@ -417,6 +423,43 @@ def run_mixed_point(server, clients: int, seconds: float, warmup: float,
 _WRITE_SEQ_LOCK = threading.Lock()
 
 
+def _assert_write_byte_identity(args) -> None:
+    """In-capture guard for the mixed A/B: the columnar batch-apply arm
+    must leave a byte-identical store to the serial per-edge arm over
+    the loadgen's own writer corpus (the speedup is only admissible as
+    the SAME write work done faster). Runs on two small throwaway
+    engines before the measured sweep; raises on any divergence."""
+    from dgraph_tpu.x import config
+
+    def capture(batch_apply: int):
+        config.set_env("BATCH_APPLY", batch_apply)
+        try:
+            s = build_server(0, 64)
+            t = s.new_txn()
+            objs = []
+            for seq in range(200):
+                objs.append({
+                    "uid": f"_:w{seq}",
+                    "name": f"wuser{seq}",
+                    "age": int(seq % 70),
+                    "city": f"city{seq % 12}",
+                    "knows": [{"uid": hex(seq % 64 + 1)}],
+                })
+            t.mutate_json(set_obj=objs, commit_now=True)
+            return {k: list(v) for k, v in s.kv._data.items()}
+        finally:
+            config.unset_env("BATCH_APPLY")
+
+    a, b = capture(1), capture(0)
+    assert a == b, (
+        "columnar batch-apply arm diverged from the serial arm: "
+        f"{len(a)} vs {len(b)} keys, "
+        f"{sum(1 for k in a.keys() & b.keys() if a[k] != b[k])} mismatched"
+    )
+    print("write byte-identity: OK "
+          f"({len(a)} keys identical across arms)", flush=True)
+
+
 def mixed_sweep(args) -> dict:
     """The live-write capture: ratios x client counts x commit modes,
     modes interleaved per point and medianed across reps (same
@@ -432,17 +475,24 @@ def mixed_sweep(args) -> dict:
         server.query(q)
     if args.baseline:
         # --baseline exists to run on a PRE-change checkout (where the
-        # GROUP_COMMIT knob is unregistered and must not be set); on a
-        # post-change tree it pins the serial escape hatch so the rows
-        # can never silently measure the new pipeline
-        env = {"GROUP_COMMIT": 0} if "GROUP_COMMIT" in config.REGISTRY \
-            else {}
+        # GROUP_COMMIT/BATCH_APPLY knobs are unregistered and must not
+        # be set); on a post-change tree it pins the serial escape
+        # hatches so the rows can never silently measure the new paths
+        env = {
+            k: 0
+            for k in ("GROUP_COMMIT", "BATCH_APPLY")
+            if k in config.REGISTRY
+        }
         modes = [("serial", env)]
     else:
+        # group_on = the full write pipeline (group commit + columnar
+        # native batch apply); group_off = the pre-PR-11 serial
+        # per-edge baseline — the A/B the mixed headline speedup reads
         modes = [
-            ("group_on", {"GROUP_COMMIT": 1}),
-            ("group_off", {"GROUP_COMMIT": 0}),
+            ("group_on", {"GROUP_COMMIT": 1, "BATCH_APPLY": 1}),
+            ("group_off", {"GROUP_COMMIT": 0, "BATCH_APPLY": 0}),
         ]
+        _assert_write_byte_identity(args)
     ratios = args.write_ratios
     samples = {
         name: {(r, c): [] for r in ratios for c in args.clients}
@@ -851,6 +901,23 @@ def main(argv=None):
             r["errors"] == 0
             for r in rows
         )
+        # the A arm must actually exercise the native columnar path:
+        # a silently-always-falling-back kernel would "pass" the QPS
+        # checks while measuring nothing new
+        on_rows = [
+            r
+            for modes in out["rows"].values()
+            for name, rws in modes.items()
+            if name == "group_on"
+            for r in rws
+        ]
+        batch_ok = any(
+            r.get("mutation_batch_apply_edges", 0) > 0 for r in on_rows
+        )
+        ok = ok and (batch_ok or not on_rows)
+        if on_rows and not batch_ok:
+            print("write-sanity: native batch-apply counter stayed "
+                  "zero in the group_on arm")
         print(f"write-sanity: {'OK' if ok else 'FAIL'} {out['headline']}")
         return 0 if ok else 1
     if args.sanity:

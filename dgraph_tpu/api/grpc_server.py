@@ -108,6 +108,7 @@ class DgraphServicer:
                 resp.txn.start_ts = 0
             else:
                 h = self._txn_for(request.start_ts)
+                h.txn.materialize_cols()  # read-your-writes over columns
                 out = self.engine._query_parsed(
                     __import__("dgraph_tpu.dql", fromlist=["parse"]).parse(
                         request.query, variables

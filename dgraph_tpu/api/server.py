@@ -14,15 +14,28 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from dgraph_tpu import dql
 from dgraph_tpu.loaders.rdf import NQuad, parse_rdf
+from dgraph_tpu.posting import colwrite
 from dgraph_tpu.posting.lists import LocalCache, Txn
-from dgraph_tpu.posting.mutation import DirectedEdge, apply_edge, delete_entity_attr
-from dgraph_tpu.posting.pl import OP_DEL, OP_SET
+from dgraph_tpu.posting.mutation import (
+    DirectedEdge,
+    apply_edge,
+    apply_edges,
+    delete_entity_attr,
+    ingest_vectors,
+)
+from dgraph_tpu.posting.pl import OP_DEL, OP_SET, encode_deltas
+from dgraph_tpu.worker.groupcommit import (
+    assign_verdicts,
+    columnar_writes,
+    commit_phase_ns,
+)
 from dgraph_tpu.query.streamjson import encode_response_data
 from dgraph_tpu.query.subgraph import Executor
 from dgraph_tpu.schema.schema import State, parse_schema
@@ -42,9 +55,12 @@ class TxnHandle:
         self.txn = Txn(server.kv, self.start_ts, mem=server.mem)
         self.read_only = read_only
         self.finished = False
+        if not read_only:
+            colwrite.maybe_enable(self.txn, server)
 
     def query(self, q: str, access_jwt: Optional[str] = None) -> dict:
         """Query within this txn's snapshot (sees own uncommitted writes)."""
+        self.txn.materialize_cols()  # read-your-writes over columns
         blocks = dql.parse(q)
         ns = keys.GALAXY_NS
         allowed = None
@@ -134,6 +150,7 @@ class TxnHandle:
         uid_vars: Dict[str, List[int]] = {}
         val_vars: Dict[str, dict] = {}
         if blocks:
+            self.txn.materialize_cols()  # upsert query reads own writes
             ex = Executor(
                 self.txn.cache,
                 self.server.schema,
@@ -346,8 +363,6 @@ class Server:
         return ns, user
 
     def _apply_nquads(self, txn, set_nqs, del_nqs, ns) -> Dict[str, str]:
-        from dgraph_tpu.posting.mutation import apply_edges
-
         blank: Dict[str, int] = {}
         fresh_uids: set = set()  # uids leased by THIS request
 
@@ -572,10 +587,14 @@ class Server:
 
         from dgraph_tpu.utils.observe import METRICS as _METRICS
 
+        # a commit-time consumer of Posting objects that appeared after
+        # txn creation (CDC sink, subscription, vector index) forces
+        # collected columns back to the serial representation
+        colwrite.commit_guard(txn, self)
         # admission costs writes too: a commit charges the same
         # in-flight token budget queries draw from (retryable 429 over
         # budget; no-op with DGRAPH_TPU_ADMISSION off)
-        n_edges = sum(len(p) for p in txn.cache.deltas.values())
+        n_edges = txn.pending_postings()
         ticket = self.serving.admit_write(n_edges)
         try:
             if not bool(_config.get("GROUP_COMMIT")):
@@ -600,8 +619,14 @@ class Server:
                 self._post_commit(txn, commit_ts)
             # counted for BOTH arms (only on success — the metric is
             # postings WRITTEN): the A/B escape hatch must not turn
-            # the edge-throughput denominator dark
-            _METRICS.inc("mutation_edges_total", n_edges)
+            # the edge-throughput denominator dark. Recounted after
+            # the commit: the columnar kernel reports its exact
+            # posting count (n_edges above was the admission estimate)
+            _METRICS.inc(
+                "mutation_edges_total",
+                sum(len(p) for p in txn.cache.deltas.values())
+                + getattr(txn, "col_nposts", 0),
+            )
             return commit_ts
         finally:
             self.serving.release_write(ticket)
@@ -613,9 +638,8 @@ class Server:
         barrier (watermark + zero.applied in commit-ts order)."""
         from dgraph_tpu.utils.observe import METRICS, TRACER
 
-        from dgraph_tpu.worker.groupcommit import assign_verdicts
-
         with TRACER.span("commit", batch=len(members)):
+            t0 = time.perf_counter_ns()
             committed = assign_verdicts(
                 members,
                 self.zero.commit_batch(
@@ -626,17 +650,23 @@ class Server:
                     track=True,
                 ),
             )
+            t1 = time.perf_counter_ns()
             try:
-                # encode OUTSIDE the lock (one native batched call per
-                # txn, posting/pl.encode_deltas), land all batch
-                # members' writes in ONE put_batch under one lock hold
-                from dgraph_tpu.posting.pl import encode_deltas
-
-                writes = [
-                    (key, m.commit_ts, recb)
-                    for m in committed
-                    for key, recb in encode_deltas(m.txn.cache.deltas)
-                ]
+                # encode OUTSIDE the lock — columnar members through
+                # ONE batch_apply kernel call (worker/groupcommit
+                # columnar_writes, which must precede encode_deltas: a
+                # materialized fallback lands in cache.deltas), the
+                # rest through posting/pl.encode_deltas (one native
+                # batched call per txn) — then all batch members'
+                # writes land in ONE put_batch under one lock hold
+                col_writes = columnar_writes(committed)
+                writes = []
+                for m in committed:
+                    cts = m.commit_ts
+                    for key, recb, _attr in col_writes.get(m, ()):
+                        writes.append((key, cts, recb))
+                    for key, recb in encode_deltas(m.txn.cache.deltas):
+                        writes.append((key, cts, recb))
                 with self._lock:
                     self.kv.put_batch(writes)
             except Exception as e:
@@ -648,8 +678,12 @@ class Server:
                 for m in committed:
                     if m.error is None:
                         m.error = e
+            commit_phase_ns(
+                oracle=t1 - t0, propose=time.perf_counter_ns() - t1
+            )
 
         def barrier():
+            tb = time.perf_counter_ns()
             try:
                 with self._lock:
                     for m in committed:
@@ -678,11 +712,15 @@ class Server:
                 ok = 0
                 for m in committed:
                     self.mem.invalidate(m.txn.cache.deltas.keys())
+                    ck = getattr(m.txn, "col_keys", None)
+                    if ck:
+                        self.mem.invalidate(ck)
                     if m.error is None:
                         ok += 1
                 if ok:
                     METRICS.inc("num_commits", ok)
                     self.serving.on_commit()  # ONE epoch bump per batch
+                commit_phase_ns(apply=time.perf_counter_ns() - tb)
 
         return barrier
 
@@ -690,9 +728,8 @@ class Server:
         """Per-txn post-commit work on the committer's own thread
         (stats feed, CDC, subscriptions, vector ingest) — everything
         after the apply barrier that doesn't need batch ordering."""
-        from dgraph_tpu.posting.mutation import ingest_vectors
-
         self._feed_stats(txn.cache.deltas)
+        colwrite.feed_col_stats(self.stats, txn)
         # CDC emission moved into the batch barrier (strict commit-ts
         # order across group-commit batches)
         subs = getattr(self, "_subscriptions", None)
@@ -706,23 +743,37 @@ class Server:
         # commit_ts whose deltas aren't written yet (ADVICE r1 #2)
         from dgraph_tpu.utils.observe import METRICS, TRACER
 
+        from dgraph_tpu.worker.groupcommit import commit_phase_ns
+
         with TRACER.span("commit"), METRICS.timer(
             "commit_latency_seconds"
         ), self._lock:
+            t0 = time.perf_counter_ns()
             commit_ts = self.zero.commit(txn.start_ts, txn.conflict_keys, track=True)
+            t1 = time.perf_counter_ns()
             try:
                 txn.write_deltas(self.kv, commit_ts)
             finally:
+                t2 = time.perf_counter_ns()
                 # watermark BEFORE the apply barrier: any read_ts
                 # allocated after this commit becomes visible observes
                 # the advanced watermark (micro-batcher snapshot key);
                 # max() guards a concurrent bump_snapshot
                 self._snapshot_ts = max(self._snapshot_ts, commit_ts)
                 self.zero.applied(commit_ts)
+                commit_phase_ns(
+                    oracle=t1 - t0,
+                    propose=t2 - t1,
+                    apply=time.perf_counter_ns() - t2,
+                )
         METRICS.inc("num_commits")
         self.mem.invalidate(txn.cache.deltas.keys())
+        ck = getattr(txn, "col_keys", None)
+        if ck:
+            self.mem.invalidate(ck)
         self.serving.on_commit()  # commit-epoch plan invalidation
         self._feed_stats(txn.cache.deltas)
+        colwrite.feed_col_stats(self.stats, txn)
         cdc = getattr(self, "_cdc", None)
         if cdc is not None:
             cdc.emit_commit(commit_ts, txn.cache.deltas)
@@ -939,8 +990,6 @@ class Server:
         # apply_edges (bulk reads + bulk tokens, posting/mutation.py);
         # every delete flushes first so it observes the edges that
         # preceded it in walk order
-        from dgraph_tpu.posting.mutation import apply_edges
-
         pending: List[DirectedEdge] = []
 
         def flush():
@@ -958,7 +1007,10 @@ class Server:
             )
 
         def walk(obj, op, top=False) -> List[int]:
-            subjects = resolve_many(obj.get("uid", f"_:auto{id(obj)}"))
+            uid_ref = obj.get("uid")
+            subjects = resolve_many(
+                uid_ref if uid_ref is not None else f"_:auto{id(obj)}"
+            )
             rest = [(k, v) for k, v in obj.items() if k != "uid"]
             if op == OP_DEL and not rest and top:
                 # bare top-level {"uid": U}: delete the node outright
@@ -971,7 +1023,10 @@ class Server:
                         txn, self.schema, subj, "dgraph.type", ns
                     )
                 return subjects
+            schema_get = self.schema.get
+            pending_append = pending.append
             for subj in subjects:
+                fresh = subj in fresh_uids
                 for k, v in rest:
                     if k == "dgraph.type":
                         for t in _as_list(v):
@@ -990,7 +1045,38 @@ class Server:
                                 txn, self.schema, subj, pred, ns
                             )
                         continue
-                    su = self.schema.get(pred)
+                    su = schema_get(pred)
+                    # flat-scalar fast path: the dominant live-loader
+                    # shape is {"pred": <str|int|float|bool>} — one
+                    # constructor each, skipping the list/geo/dict
+                    # dispatch below (per-edge GIL work on the write
+                    # hot path). DATETIME/PASSWORD convert in to_val.
+                    tv = type(v)
+                    if tv is str:
+                        if not v.startswith("val(") and (
+                            su is None
+                            or su.value_type not in _SLOW_JSON_TIDS
+                        ):
+                            pending_append(DirectedEdge(
+                                subj, pred, Val(TypeID.STRING, v),
+                                None, lang, None, op, ns, fresh,
+                            ))
+                            continue
+                    elif tv is bool or tv is int or tv is float:
+                        if (
+                            su is None
+                            or su.value_type not in _SLOW_JSON_TIDS
+                        ):
+                            pending_append(DirectedEdge(
+                                subj, pred,
+                                Val(
+                                    TypeID.BOOL if tv is bool
+                                    else TypeID.INT if tv is int
+                                    else TypeID.FLOAT, v,
+                                ),
+                                None, lang, None, op, ns, fresh,
+                            ))
+                            continue
                     if (
                         su is not None
                         and su.value_type == TypeID.VFLOAT
@@ -1004,6 +1090,15 @@ class Server:
                         if is_geo_literal(item):
                             edge(subj, pred, op, value=Val(TypeID.GEO, item))
                         elif isinstance(item, dict):
+                            if len(item) == 1 and "uid" in item:
+                                # bare nested ref: resolve without the
+                                # recursive walk frame
+                                for child in resolve_many(item["uid"]):
+                                    pending_append(DirectedEdge(
+                                        subj, pred, None, child, "",
+                                        None, op, ns, fresh,
+                                    ))
+                                continue
                             for child in walk(item, op):
                                 edge(subj, pred, op, value_id=child)
                         elif (
@@ -1591,6 +1686,11 @@ def _eval_cond(cond: str, uid_vars) -> bool:
     if pos != len(tokens):
         raise ValueError(f"trailing tokens in upsert condition {cond!r}")
     return out
+
+
+# schema value types whose JSON scalars need to_val's conversion work
+# (everything else takes the flat-scalar fast path in the JSON walker)
+_SLOW_JSON_TIDS = (TypeID.DATETIME, TypeID.PASSWORD)
 
 
 def _as_list(x):

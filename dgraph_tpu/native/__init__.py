@@ -101,6 +101,22 @@ DECLS = {
         _i64,
         [_u8p, _i64p, _i64, _int, _u8p, _i64p, _i64p],
     ),
+    # codec.cpp — columnar batch apply (posting/colwrite.py). void*
+    # params by design: the wrapper passes raw buffer addresses
+    # (array.array buffer_info / bytes), skipping the per-argument
+    # ctypes pointer casts that dominate small-batch commit cost
+    "batch_apply": (
+        _i64,
+        [
+            _vp, _i64, _vp, _vp, _vp, _vp, _vp, _vp, _vp,
+            _vp, _vp, _vp, _vp, _i64,
+            _vp, _vp, _vp, _vp, _vp, _vp, _vp, _vp, _i64,
+        ],
+    ),
+    "batch_apply_caps": (
+        _i64,
+        [_vp, _i64, _vp, _vp, _vp, _vp, _vp, _i64, _vp],
+    ),
     # codec.cpp — quantized vector scoring (models/vector.py)
     "vec_qi8_topk": (
         _i64,
@@ -648,6 +664,91 @@ def tok_terms_ascii(values, prefix: int):
         t += cnt
     assert t == ntok
     return result
+
+
+def _ba_addr(buf) -> int:
+    """Raw address of a writable buffer (bytearray) for the void*
+    batch-apply params; empty buffers pass 0 (never dereferenced —
+    every span over them is zero-length)."""
+    if not len(buf):
+        return 0
+    return ctypes.addressof(ctypes.c_char.from_buffer(buf))
+
+
+def batch_apply(
+    m_offs, shapes, entities, pred_ids, objects, vtypes, voffs,
+    vblob, pp_blob: bytes, pp_offs, pflags: bytes, pidents: bytes,
+):
+    """Columnar batch apply (posting/colwrite.py): ONE GIL-released
+    call turns a whole group-commit batch's collected edge columns
+    into ready-to-put (key, delta-record) pairs — key construction,
+    exact/int/bool/term tokenization and record encoding fused.
+
+    Columns arrive as the cheap typed buffers colwrite collects into —
+    array.array('q'/'Q'/'i') for the int columns and CSR offsets,
+    bytearray/bytes for the byte columns — and are passed by raw
+    address (no numpy conversion, no per-arg ctypes casts: this entry
+    runs once per commit batch and its Python-side fixed cost is what
+    the columnar path exists to delete). Returns (n_pairs, keys_blob,
+    key_offs, recs_blob, rec_offs, member, pred, kinds, counts) with
+    CSR blobs as bytes and the per-pair annotations as indexable
+    typed-array sequences, or None when the native lib is unavailable
+    (caller materializes to the Python path)."""
+    from array import array
+
+    if _LIB is None:
+        return None
+    n_members = len(m_offs) - 1
+    n_preds = len(pp_offs) - 1
+    if n_members <= 0 or m_offs[-1] == 0:
+        empty = array("q", (0,))
+        return (0, b"", empty, b"", empty, b"", b"", b"", b"")
+    a_m_offs = m_offs.buffer_info()[0]
+    a_entities = entities.buffer_info()[0]
+    a_pred_ids = pred_ids.buffer_info()[0]
+    a_objects = objects.buffer_info()[0]
+    a_voffs = voffs.buffer_info()[0]
+    a_pp_offs = pp_offs.buffer_info()[0]
+    a_shapes = _ba_addr(shapes)
+    a_vtypes = _ba_addr(vtypes)
+    a_vblob = _ba_addr(vblob) if isinstance(vblob, bytearray) else vblob
+    caps = array("q", (0, 0, 0))
+    _LIB.batch_apply_caps(
+        a_m_offs, n_members, a_shapes, a_pred_ids, a_voffs, a_pp_offs,
+        pflags, n_preds, caps.buffer_info()[0],
+    )
+    max_pairs, key_cap, rec_cap = caps
+    out_keys = bytearray(key_cap)
+    out_key_offs = array("q", bytes(8 * (max_pairs + 1)))
+    out_recs = bytearray(rec_cap)
+    out_rec_offs = array("q", bytes(8 * (max_pairs + 1)))
+    out_member = array("i", bytes(4 * max_pairs))
+    out_pred = array("i", bytes(4 * max_pairs))
+    out_kinds = bytearray(max_pairs)
+    out_counts = array("i", bytes(4 * max_pairs))
+    n_pairs = _LIB.batch_apply(
+        a_m_offs, n_members, a_shapes, a_entities, a_pred_ids,
+        a_objects, a_vtypes, a_voffs, a_vblob,
+        pp_blob, a_pp_offs, pflags, pidents, n_preds,
+        _ba_addr(out_keys), out_key_offs.buffer_info()[0],
+        _ba_addr(out_recs), out_rec_offs.buffer_info()[0],
+        out_member.buffer_info()[0], out_pred.buffer_info()[0],
+        _ba_addr(out_kinds), out_counts.buffer_info()[0],
+        max_pairs,
+    )
+    assert n_pairs >= 0, "batch_apply output caps overflowed"
+    n_pairs = int(n_pairs)
+    return (
+        n_pairs,
+        bytes(memoryview(out_keys)[: out_key_offs[n_pairs]]),
+        out_key_offs,
+        bytes(memoryview(out_recs)[: out_rec_offs[n_pairs]]),
+        out_rec_offs,
+        out_member,
+        out_pred,
+        out_kinds,
+        out_counts,
+    )
 
 
 def vec_qi8_topk(

@@ -15,7 +15,9 @@
 #include <cstdint>
 #include <cstring>
 #include <cmath>
+#include <string>
 #include <thread>
+#include <unordered_map>
 #include <utility>
 #include <vector>
 
@@ -1190,6 +1192,322 @@ int64_t enc_delta_records(
 // consecutive tokens. Caller capacities: out >= total input bytes +
 // one prefix byte per possible token; tok_offs >= 1 + sum over inputs
 // of (len/2 + 1). Returns total token count.
+// -------------------------------------------------------------------
+// Columnar batch apply: one call turns a whole group-commit batch's
+// collected edge columns into ready-to-put (key, delta-record) pairs —
+// fusing data/index/reverse key construction, exact/int/bool/term
+// tokenization, and posting-delta record encoding (the loops
+// enc_delta_records + tok_terms_ascii each did alone, plus the Python
+// key/posting assembly between them). Edge columns are flat over all
+// members; member m owns edges [m_offs[m], m_offs[m+1]).
+//
+// Per-predicate plan (pred_ids[j] indexes it): key prefix bytes
+// (x/keys.py PredicatePrefix — tag + len + ns + attr, NO kind byte; the
+// kernel appends kind + suffix), pflags bits (1=reverse 2=exact 4=int
+// 8=bool 16=term, mirrored in posting/colwrite.py), pidents = 4 bytes
+// per pred: the exact/int/bool/term tokenizer identifier bytes.
+//
+// Shapes: 0 = scalar-value SET — emits the data posting
+// (flags=3, uid=2^64-1, tid=vtypes[j], value=vblob slice) plus one
+// index posting (flags=2, uid=entity) per plan token; 1 = list-uid SET
+// — emits the data posting (flags=2, uid=objects[j]) plus the reverse
+// posting (flags=2, uid=entity) under PF_REVERSE. Postings group per
+// (member, key) in first-touch order, appended in edge order — the
+// exact per-key append order the serial Python path produces — and
+// each pair's record is pl.py encode_delta byte-exact (kind=1,
+// count u32 LE, 17-byte fixed posting fields, little-endian host
+// assumed like the codecs above).
+//
+// Outputs are CSR over pairs: key i = out_keys[out_key_offs[i]:
+// out_key_offs[i+1]], record i likewise in out_recs; out_member /
+// out_pred / out_kinds (0 data, 2 index, 4 reverse — x/keys.py kind
+// bytes) / out_counts (postings in the record) annotate each pair.
+// Caller sizes outputs from batch_apply_caps. Returns the pair count,
+// or -1 if any cap would overflow (allocation bug — caps are a true
+// upper bound).
+// void* parameters: the Python wrapper passes raw buffer addresses
+// (array.array / bytearray / bytes) — typed-pointer argtypes would
+// force a ctypes cast per argument per call, which profiling showed
+// dominating small-batch commits (23 pointer args on this entry).
+int64_t batch_apply(
+    const void* m_offs_v, int64_t n_members,
+    const void* shapes_v, const void* entities_v,
+    const void* pred_ids_v, const void* objects_v,
+    const void* vtypes_v, const void* voffs_v, const void* vblob_v,
+    const void* pp_blob_v, const void* pp_offs_v,
+    const void* pflags_v, const void* pidents_v, int64_t n_preds,
+    void* out_keys_v, void* out_key_offs_v,
+    void* out_recs_v, void* out_rec_offs_v,
+    void* out_member_v, void* out_pred_v, void* out_kinds_v,
+    void* out_counts_v, int64_t max_pairs) {
+    (void)n_preds;
+    const int64_t* m_offs = (const int64_t*)m_offs_v;
+    const uint8_t* shapes = (const uint8_t*)shapes_v;
+    const uint64_t* entities = (const uint64_t*)entities_v;
+    const int32_t* pred_ids = (const int32_t*)pred_ids_v;
+    const uint64_t* objects = (const uint64_t*)objects_v;
+    const uint8_t* vtypes = (const uint8_t*)vtypes_v;
+    const int64_t* voffs = (const int64_t*)voffs_v;
+    const uint8_t* vblob = (const uint8_t*)vblob_v;
+    const uint8_t* pp_blob = (const uint8_t*)pp_blob_v;
+    const int64_t* pp_offs = (const int64_t*)pp_offs_v;
+    const uint8_t* pflags = (const uint8_t*)pflags_v;
+    const uint8_t* pidents = (const uint8_t*)pidents_v;
+    uint8_t* out_keys = (uint8_t*)out_keys_v;
+    int64_t* out_key_offs = (int64_t*)out_key_offs_v;
+    uint8_t* out_recs = (uint8_t*)out_recs_v;
+    int64_t* out_rec_offs = (int64_t*)out_rec_offs_v;
+    int32_t* out_member = (int32_t*)out_member_v;
+    int32_t* out_pred = (int32_t*)out_pred_v;
+    uint8_t* out_kinds = (uint8_t*)out_kinds_v;
+    int32_t* out_counts = (int32_t*)out_counts_v;
+    struct Slot {
+        std::string key;
+        std::string posts;  // posting bytes (record body)
+        int32_t count = 0;
+        int32_t pred = 0;
+        uint8_t kind = 0;
+    };
+    int64_t npairs = 0;
+    int64_t key_w = 0, rec_w = 0;
+    std::vector<Slot> slots;
+    std::unordered_map<std::string, size_t> by_key;
+    std::string kbuf;
+    std::vector<uint8_t> low;
+    std::vector<std::pair<int64_t, int64_t>> words;
+    auto post17 = [](std::string& dst, uint8_t flags, uint64_t uid,
+                     uint8_t tid, const uint8_t* val, uint32_t vlen) {
+        char hdr[15];
+        hdr[0] = (char)flags;
+        memcpy(hdr + 1, &uid, 8);
+        hdr[9] = (char)tid;
+        hdr[10] = 0;  // lang_len
+        memcpy(hdr + 11, &vlen, 4);
+        dst.append(hdr, 15);
+        if (vlen) dst.append((const char*)val, vlen);
+        dst.push_back(0);
+        dst.push_back(0);  // nfacets u16
+    };
+    for (int64_t m = 0; m < n_members; m++) {
+        slots.clear();
+        by_key.clear();
+        auto touch = [&](const std::string& key, int32_t pred,
+                         uint8_t kind) -> Slot& {
+            auto it = by_key.find(key);
+            if (it == by_key.end()) {
+                it = by_key.emplace(key, slots.size()).first;
+                slots.emplace_back();
+                slots.back().key = key;
+                slots.back().pred = pred;
+                slots.back().kind = kind;
+            }
+            return slots[it->second];
+        };
+        for (int64_t j = m_offs[m]; j < m_offs[m + 1]; j++) {
+            int32_t pid = pred_ids[j];
+            const uint8_t* pp = pp_blob + pp_offs[pid];
+            size_t pplen = (size_t)(pp_offs[pid + 1] - pp_offs[pid]);
+            uint8_t pf = pflags[pid];
+            const uint8_t* idents = pidents + 4 * pid;
+            uint64_t ent = entities[j];
+            if (shapes[j] == 0) {
+                const uint8_t* val = vblob + voffs[j];
+                uint32_t vlen = (uint32_t)(voffs[j + 1] - voffs[j]);
+                // data key: prefix | 0x00 | uid u64 BE
+                kbuf.assign((const char*)pp, pplen);
+                kbuf.push_back((char)0x00);
+                for (int b = 7; b >= 0; b--)
+                    kbuf.push_back((char)((ent >> (8 * b)) & 0xff));
+                Slot& ds = touch(kbuf, pid, 0x00);
+                post17(ds.posts, 3, ~0ULL, vtypes[j], val, vlen);
+                ds.count++;
+                auto index_post = [&](const std::string& key) {
+                    Slot& is = touch(key, pid, 0x02);
+                    post17(is.posts, 2, ent, 0, nullptr, 0);
+                    is.count++;
+                };
+                if (pf & 2) {  // exact: ident + value bytes
+                    kbuf.assign((const char*)pp, pplen);
+                    kbuf.push_back((char)0x02);
+                    kbuf.push_back((char)idents[0]);
+                    kbuf.append((const char*)val, vlen);
+                    index_post(kbuf);
+                }
+                if (pf & 4) {  // int: ident + BE64(LE i64 + 2^63)
+                    int64_t iv;
+                    memcpy(&iv, val, 8);
+                    uint64_t biased = (uint64_t)iv + (1ULL << 63);
+                    kbuf.assign((const char*)pp, pplen);
+                    kbuf.push_back((char)0x02);
+                    kbuf.push_back((char)idents[1]);
+                    for (int b = 7; b >= 0; b--)
+                        kbuf.push_back(
+                            (char)((biased >> (8 * b)) & 0xff));
+                    index_post(kbuf);
+                }
+                if (pf & 8) {  // bool: ident + stored byte
+                    kbuf.assign((const char*)pp, pplen);
+                    kbuf.push_back((char)0x02);
+                    kbuf.push_back((char)idents[2]);
+                    kbuf.push_back((char)(val[0] ? 1 : 0));
+                    index_post(kbuf);
+                }
+                if (pf & 16) {  // term: tok_terms_ascii's algorithm
+                    low.resize(vlen);
+                    for (uint32_t c = 0; c < vlen; c++) {
+                        uint8_t ch = val[c];
+                        low[c] = (ch >= 'A' && ch <= 'Z')
+                                     ? (uint8_t)(ch + 32)
+                                     : ch;
+                    }
+                    words.clear();
+                    int64_t start = -1;
+                    for (int64_t c = 0; c <= (int64_t)vlen; c++) {
+                        uint8_t ch = c < (int64_t)vlen ? low[(size_t)c]
+                                                       : 0;
+                        bool w = (ch >= 'a' && ch <= 'z') ||
+                                 (ch >= '0' && ch <= '9') ||
+                                 ch == '_' || ch == '\'';
+                        if (w && start < 0) start = c;
+                        if (!w && start >= 0) {
+                            words.emplace_back(start, c - start);
+                            start = -1;
+                        }
+                    }
+                    const uint8_t* lo = low.data();
+                    std::sort(
+                        words.begin(), words.end(),
+                        [lo](const std::pair<int64_t, int64_t>& a,
+                             const std::pair<int64_t, int64_t>& b) {
+                            int64_t mn = a.second < b.second
+                                             ? a.second
+                                             : b.second;
+                            int c = memcmp(lo + a.first, lo + b.first,
+                                           (size_t)mn);
+                            if (c) return c < 0;
+                            return a.second < b.second;
+                        });
+                    for (size_t wi = 0; wi < words.size(); wi++) {
+                        if (wi > 0 &&
+                            words[wi].second == words[wi - 1].second &&
+                            memcmp(lo + words[wi].first,
+                                   lo + words[wi - 1].first,
+                                   (size_t)words[wi].second) == 0)
+                            continue;  // duplicate word
+                        kbuf.assign((const char*)pp, pplen);
+                        kbuf.push_back((char)0x02);
+                        kbuf.push_back((char)idents[3]);
+                        kbuf.append((const char*)(lo + words[wi].first),
+                                    (size_t)words[wi].second);
+                        index_post(kbuf);
+                    }
+                }
+            } else {
+                uint64_t obj = objects[j];
+                kbuf.assign((const char*)pp, pplen);
+                kbuf.push_back((char)0x00);
+                for (int b = 7; b >= 0; b--)
+                    kbuf.push_back((char)((ent >> (8 * b)) & 0xff));
+                Slot& ds = touch(kbuf, pid, 0x00);
+                post17(ds.posts, 2, obj, 0, nullptr, 0);
+                ds.count++;
+                if (pf & 1) {  // reverse: prefix | 0x04 | object BE
+                    kbuf.assign((const char*)pp, pplen);
+                    kbuf.push_back((char)0x04);
+                    for (int b = 7; b >= 0; b--)
+                        kbuf.push_back((char)((obj >> (8 * b)) & 0xff));
+                    Slot& rs = touch(kbuf, pid, 0x04);
+                    post17(rs.posts, 2, ent, 0, nullptr, 0);
+                    rs.count++;
+                }
+            }
+        }
+        // flush this member's pairs in first-touch order
+        for (const Slot& s : slots) {
+            if (npairs >= max_pairs) return -1;
+            out_key_offs[npairs] = key_w;
+            out_rec_offs[npairs] = rec_w;
+            memcpy(out_keys + key_w, s.key.data(), s.key.size());
+            key_w += (int64_t)s.key.size();
+            out_recs[rec_w] = 1;  // KIND_DELTA
+            uint32_t cnt = (uint32_t)s.count;
+            memcpy(out_recs + rec_w + 1, &cnt, 4);
+            memcpy(out_recs + rec_w + 5, s.posts.data(),
+                   s.posts.size());
+            rec_w += 5 + (int64_t)s.posts.size();
+            out_member[npairs] = (int32_t)m;
+            out_pred[npairs] = s.pred;
+            out_kinds[npairs] = s.kind;
+            out_counts[npairs] = s.count;
+            npairs++;
+        }
+    }
+    out_key_offs[npairs] = key_w;
+    out_rec_offs[npairs] = rec_w;
+    return npairs;
+}
+
+// Output-capacity upper bounds for batch_apply over the same columns:
+// caps[0] = pair count, caps[1] = key bytes, caps[2] = record bytes.
+// Term tokens are bounded by len/2 + 1 words of the value; everything
+// else is exact. Returns caps[0].
+int64_t batch_apply_caps(
+    const void* m_offs_v, int64_t n_members, const void* shapes_v,
+    const void* pred_ids_v, const void* voffs_v,
+    const void* pp_offs_v, const void* pflags_v, int64_t n_preds,
+    void* caps_v) {
+    (void)n_preds;
+    const int64_t* m_offs = (const int64_t*)m_offs_v;
+    const uint8_t* shapes = (const uint8_t*)shapes_v;
+    const int32_t* pred_ids = (const int32_t*)pred_ids_v;
+    const int64_t* voffs = (const int64_t*)voffs_v;
+    const int64_t* pp_offs = (const int64_t*)pp_offs_v;
+    const uint8_t* pflags = (const uint8_t*)pflags_v;
+    int64_t* caps = (int64_t*)caps_v;
+    int64_t pairs = 0, keyb = 0, posts = 0, valb = 0;
+    for (int64_t j = 0; j < m_offs[n_members]; j++) {
+        int32_t pid = pred_ids[j];
+        int64_t pplen = pp_offs[pid + 1] - pp_offs[pid];
+        int64_t vlen = voffs[j + 1] - voffs[j];
+        uint8_t pf = pflags[pid];
+        pairs++;  // data pair
+        keyb += pplen + 9;
+        posts++;
+        if (shapes[j] == 0) {
+            valb += vlen;
+            if (pf & 2) {
+                pairs++;
+                keyb += pplen + 2 + vlen;
+                posts++;
+            }
+            if (pf & 4) {
+                pairs++;
+                keyb += pplen + 10;
+                posts++;
+            }
+            if (pf & 8) {
+                pairs++;
+                keyb += pplen + 3;
+                posts++;
+            }
+            if (pf & 16) {
+                int64_t ntok = vlen / 2 + 1;
+                pairs += ntok;
+                keyb += ntok * (pplen + 2) + vlen;
+                posts += ntok;
+            }
+        } else if (pf & 1) {
+            pairs++;
+            keyb += pplen + 9;
+            posts++;
+        }
+    }
+    caps[0] = pairs;
+    caps[1] = keyb;
+    caps[2] = 5 * pairs + 17 * posts + valb;
+    return pairs;
+}
+
 int64_t tok_terms_ascii(
     const uint8_t* blob, const int64_t* offs, int64_t n, int prefix,
     uint8_t* out, int64_t* tok_offs, int64_t* tok_counts) {

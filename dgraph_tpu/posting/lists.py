@@ -264,14 +264,42 @@ class Txn:
         self.conflict_keys: set[int] = set()
         self.committed = False
         self.aborted = False
+        # columnar write set (posting/colwrite): engines attach one via
+        # colwrite.maybe_enable when the native batch-apply path may
+        # consume this txn's writes at commit; None = classic deltas
+        self.col = None
 
     def add_conflict_key(self, key: bytes, extra: bytes = b""):
         """Fingerprint written keys for oracle conflict detection
         (ref posting/list.go:842 GetConflictKey)."""
         self.conflict_keys.add(fingerprint64(key + b"|" + extra))
 
+    def materialize_cols(self):
+        """Read-your-writes hook: convert any collected columnar edges
+        back into Python deltas before this txn reads its own writes
+        (query / upsert entry points call this)."""
+        if self.col is not None:
+            from dgraph_tpu.posting import colwrite
+
+            if self.col.pending:
+                colwrite.count_fallback("read", len(self.col.shapes))
+            colwrite.materialize(self)
+
+    def pending_postings(self) -> int:
+        """Postings this txn will write at commit (admission control's
+        write-size signal): Python deltas plus the columnar estimate."""
+        n = sum(len(p) for p in self.cache.deltas.values())
+        if self.col is not None:
+            n += self.col.nposts_est
+        return n
+
     def write_deltas(self, kv: KV, commit_ts: int):
         """Persist all pending deltas at commit_ts (CommitToDisk)."""
+        if self.col is not None and self.col.pending:
+            from dgraph_tpu.posting import colwrite
+
+            for key, rec, _attr in colwrite.encode_txn(self):
+                kv.put(key, commit_ts, rec)
         for key, posts in self.cache.deltas.items():
             if posts:
                 kv.put(key, commit_ts, encode_delta(posts))

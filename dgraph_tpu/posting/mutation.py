@@ -15,6 +15,7 @@ from __future__ import annotations
 import struct
 from typing import List, Optional
 
+from dgraph_tpu.posting import colwrite
 from dgraph_tpu.posting.lists import LocalCache, Txn
 from dgraph_tpu.posting.pl import (
     OP_DEL,
@@ -88,6 +89,12 @@ def apply_edge(
     txn: Txn, st: State, edge: DirectedEdge, update_schema: bool = True
 ) -> None:
     """Apply one edge to the txn's local cache with index maintenance."""
+    if getattr(txn, "col", None) is not None:
+        # direct per-edge entry on a columnar txn: pending columns must
+        # land first (same-key ordering), and the txn goes serial
+        if txn.col.pending:
+            colwrite.count_fallback("direct", 1)
+        colwrite.materialize(txn)
     su = st.get(edge.attr)
     if su is None:
         if not update_schema:
@@ -135,6 +142,189 @@ def ingest_vectors(vector_indexes, deltas) -> None:
 
 
 def apply_edges(
+    txn: Txn, st: State, edges: List[DirectedEdge],
+    update_schema: bool = True,
+) -> None:
+    """Batched edge application. On a columnar txn (colwrite.maybe_enable)
+    the whole call is first offered to the columnar collector — edges
+    land as arrays for the commit-time native batch_apply kernel instead
+    of Posting objects. Any ineligible edge falls the call back: the
+    collected columns replay through the serial path (byte-identical),
+    then this call runs through the Python path — serial, or partitioned
+    by predicate across the exec-worker pool when wide enough
+    (_apply_edges_sharded; posting lists of distinct predicates live
+    under distinct keys, so shards commute)."""
+    if not edges:
+        return
+    col = getattr(txn, "col", None)
+    if col is not None:
+        reason = col.try_collect(txn, st, edges, update_schema)
+        if reason is None:
+            return
+        colwrite.count_fallback(reason, len(edges))
+        colwrite.materialize(txn)
+    _apply_edges_fallback(txn, st, edges, update_schema)
+
+
+def _apply_edges_fallback(
+    txn: Txn, st: State, edges: List[DirectedEdge],
+    update_schema: bool = True,
+) -> None:
+    """Python application of a batch the columnar path declined:
+    predicate-sharded across the exec pool when the batch is wide
+    enough, else the serial bulk path."""
+    shards = _shard_plan(edges)
+    if shards is None:
+        _apply_edges_serial(txn, st, edges, update_schema)
+    else:
+        _apply_edges_sharded(txn, st, edges, shards, update_schema)
+
+
+def _shard_plan(edges) -> Optional[List[List[DirectedEdge]]]:
+    """Partition a batch by predicate into shard worklists, or None to
+    run serially. APPLY_SHARDS forces a width (tests/chaos); otherwise
+    sharding engages only past APPLY_SHARD_MIN_EDGES with EXEC_WORKERS
+    threads configured. Per-(ns, attr) edge order is preserved inside a
+    shard; shards touch disjoint predicates, hence disjoint keys
+    (data/index/reverse/count keys all embed the attr)."""
+    from dgraph_tpu.x import config
+
+    forced = int(config.get("APPLY_SHARDS"))
+    if forced == 1 or len(edges) < 2:
+        return None
+    workers = forced if forced > 0 else int(config.get("EXEC_WORKERS"))
+    if workers < 2:
+        return None
+    if forced <= 0 and len(edges) < int(
+        config.get("APPLY_SHARD_MIN_EDGES")
+    ):
+        return None
+    by_attr: dict = {}
+    for e in edges:
+        by_attr.setdefault((e.ns, e.attr), []).append(e)
+    if len(by_attr) < 2:
+        return None
+    nshards = min(workers, len(by_attr))
+    shards: List[List[DirectedEdge]] = [[] for _ in range(nshards)]
+    for i, group in enumerate(by_attr.values()):
+        shards[i % nshards].extend(group)
+    return shards
+
+
+class _OverlayDeltas:
+    """Shard-local delta map layered over the txn's base deltas: reads
+    see base + local (earlier serial calls in this txn may have touched
+    the same predicate), writes go local only — the merge barrier moves
+    them into the base in shard-index order."""
+
+    __slots__ = ("base", "local")
+
+    def __init__(self, base):
+        self.base = base
+        self.local: dict = {}
+
+    def get(self, key, default=None):
+        b = self.base.get(key)
+        l = self.local.get(key)
+        if b and l:
+            return b + l
+        return l or b or default
+
+    def setdefault(self, key, default):
+        # add_delta's accessor: appends must stay shard-local
+        loc = self.local.get(key)
+        if loc is None:
+            loc = self.local[key] = []
+        return loc
+
+    def __contains__(self, key):
+        return key in self.local or key in self.base
+
+
+class _ShardCache(LocalCache):
+    """LocalCache view for one apply shard: shares the txn's kv /
+    read_ts / memlayer (thread-safe), private posting-list memo and
+    delta overlay."""
+
+    def __init__(self, base: LocalCache):
+        self.kv = base.kv
+        self.read_ts = base.read_ts
+        self.mem = base.mem
+        self._plists = {}
+        self.deltas = _OverlayDeltas(base.deltas)
+
+
+class _ShardTxn:
+    """Txn facade a shard worker writes through: buffers conflict-key
+    calls for deterministic replay at the merge barrier."""
+
+    __slots__ = ("cache", "start_ts", "cks")
+
+    def __init__(self, base: Txn, cache: _ShardCache):
+        self.cache = cache
+        self.start_ts = base.start_ts
+        self.cks: List[tuple] = []
+
+    def add_conflict_key(self, key: bytes, extra: bytes = b""):
+        self.cks.append((key, extra))
+
+
+def _apply_edges_sharded(
+    txn: Txn, st: State, edges, shards, update_schema: bool
+) -> None:
+    """Run the shard worklists through _apply_edges_serial on private
+    cache overlays — shard 0 on this thread, the rest on the exec pool
+    — then merge deterministically in shard-index order (append-order
+    inside a key is all the layered store observes, and shards never
+    share keys). Any shard error discards every overlay and replays the
+    ORIGINAL batch serially on the main txn, reproducing the serial
+    path's partial-application-then-raise semantics exactly (per-tablet
+    traffic gets counted twice on that path — an accounting smudge, not
+    a correctness issue)."""
+    from dgraph_tpu.query.subgraph import _expand_pool, _submit_bounded
+
+    nshards = len(shards)
+    caches = [_ShardCache(txn.cache) for _ in range(nshards)]
+    stxns = [_ShardTxn(txn, c) for c in caches]
+    pool = _expand_pool(nshards)
+    futs = []
+    for k in range(1, nshards):
+        futs.append(
+            (
+                k,
+                _submit_bounded(
+                    pool, nshards, _apply_edges_serial,
+                    stxns[k], st, shards[k], update_schema,
+                ),
+            )
+        )
+    err = None
+    try:
+        _apply_edges_serial(stxns[0], st, shards[0], update_schema)
+    except Exception as ex:
+        err = ex  # still join the pool shards before acting
+    for k, f in futs:
+        try:
+            if f is None:  # pool at its backpressure bound: run inline
+                _apply_edges_serial(stxns[k], st, shards[k], update_schema)
+            else:
+                f.result()
+        except Exception as ex:
+            if err is None:
+                err = ex
+    if err is not None:
+        _apply_edges_serial(txn, st, edges, update_schema)
+        return
+    base = txn.cache.deltas
+    for k in range(nshards):
+        for key, posts in caches[k].deltas.local.items():
+            base.setdefault(key, []).extend(posts)
+        for key, extra in stxns[k].cks:
+            txn.add_conflict_key(key, extra)
+    observe.METRICS.inc("mutation_sharded_apply_total")
+
+
+def _apply_edges_serial(
     txn: Txn, st: State, edges: List[DirectedEdge],
     update_schema: bool = True,
 ) -> None:
@@ -476,6 +666,14 @@ def _update_count_index(txn: Txn, su: SchemaUpdate, edge: DirectedEdge, data_key
 def delete_entity_attr(txn: Txn, st: State, entity: int, attr: str, ns=keys.GALAXY_NS):
     """S P * deletion: drop all postings of (entity, attr)
     (ref posting/index.go deleteEntries path for star deletes)."""
+    if getattr(txn, "col", None) is not None:
+        # the star delete reads current postings: collected columns for
+        # this (entity, attr) must be visible as deltas first
+        from dgraph_tpu.posting import colwrite
+
+        if txn.col.pending:
+            colwrite.count_fallback("delete_star", 1)
+        colwrite.materialize(txn)
     su = st.get(attr)
     data_key = keys.DataKey(attr, entity, ns)
     tokenizers = su.tokenizer_objs() if su else []

@@ -236,21 +236,42 @@ def encode_deltas(deltas: Dict[bytes, List[Posting]]):
     single rich edge never disables the kernel for the whole txn."""
     from dgraph_tpu import native
 
+    from dgraph_tpu.utils.observe import METRICS
+
     items = [(k, p) for k, p in deltas.items() if p]
     if not items:
         return []
     if not native.NATIVE_AVAILABLE:
+        METRICS.inc("mutation_native_fallback_total", len(items))
+        METRICS.inc(
+            'mutation_native_fallback_total{reason="no_native"}',
+            len(items),
+        )
         return [(k, encode_delta(p)) for k, p in items]
     fast: List[int] = []  # indices into items taking the native kernel
     out: List = [None] * len(items)
+    rich = 0
     for i, (k, posts) in enumerate(items):
         if any(p.facets or p.lang for p in posts):
             out[i] = (k, encode_delta(posts))
+            rich += 1
         else:
             fast.append(i)
+    if rich:
+        # the per-key Python encoder ran: kernel-coverage regression
+        # signal for the encode stage (keys, not edges, here)
+        METRICS.inc("mutation_native_fallback_total", rich)
+        METRICS.inc(
+            'mutation_native_fallback_total{reason="rich_posting"}', rich
+        )
     if fast:
         recs = _encode_deltas_native([items[i] for i in fast])
         if recs is None:  # native call unavailable after all
+            METRICS.inc("mutation_native_fallback_total", len(fast))
+            METRICS.inc(
+                'mutation_native_fallback_total{reason="no_native"}',
+                len(fast),
+            )
             for i in fast:
                 out[i] = (items[i][0], encode_delta(items[i][1]))
         else:

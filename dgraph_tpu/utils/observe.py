@@ -1910,6 +1910,56 @@ declare_metric(
     "denominator.",
 )
 declare_metric(
+    "counter", "mutation_batch_apply_total",
+    "Native columnar batch_apply kernel invocations (posting/"
+    "colwrite.py): one per group-commit batch (or serial commit) whose "
+    "members collected columnar write sets.",
+)
+declare_metric(
+    "counter", "mutation_batch_apply_edges_total",
+    "Edges encoded through the native columnar batch_apply kernel — "
+    "compare against mutation_native_fallback_total for kernel "
+    "coverage of the write path.",
+)
+declare_metric(
+    "counter", "mutation_native_fallback_total",
+    "Edges (collect/apply stages) or keys (encode_deltas stage) that "
+    "escaped the native mutation path to per-edge/per-key Python — "
+    "the kernel-coverage regression signal. Per-cause split in the "
+    'mutation_native_fallback_total{reason="*"} family.',
+)
+declare_metric(
+    "counter", 'mutation_native_fallback_total{reason="*"}',
+    "Per-reason split of mutation_native_fallback_total (delete, "
+    "lang, facets, tok, deindex, mixed_txn, rich_posting, no_native, "
+    "kernel, ... — see posting/colwrite.py and posting/pl.py call "
+    "sites).",
+)
+declare_metric(
+    "counter", "mutation_sharded_apply_total",
+    "apply_edges calls whose Python-fallback edges were applied "
+    "predicate-sharded across the exec-worker pool "
+    "(posting/mutation.py _apply_edges_sharded).",
+)
+declare_metric(
+    "counter", "commit_oracle_ns_total",
+    "Wall time (ns) group-commit leaders spent in the oracle verdict "
+    "exchange (fence check + zero.commit_batch) — the commit-phase "
+    "split qps_loadgen stamps into BENCH_QPS rows.",
+)
+declare_metric(
+    "counter", "commit_propose_ns_total",
+    "Wall time (ns) group-commit leaders spent encoding deltas and "
+    "dispatching write proposals (or the direct put_batch) — the "
+    "commit-phase split qps_loadgen stamps into BENCH_QPS rows.",
+)
+declare_metric(
+    "counter", "commit_apply_ns_total",
+    "Wall time (ns) group-commit leaders spent in the apply barrier "
+    "(group applies + watermark advance + zero.applied) — the "
+    "commit-phase split qps_loadgen stamps into BENCH_QPS rows.",
+)
+declare_metric(
     "counter", "num_commits",
     "Committed transactions (reference x/metrics NumMutations analog).",
 )

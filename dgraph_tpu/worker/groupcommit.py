@@ -81,6 +81,34 @@ def assign_verdicts(members, verdicts):
     return committed
 
 
+def columnar_writes(committed):
+    """Batch-level columnar encode, shared by every engine's
+    propose_fn: ONE native batch_apply call (posting/colwrite) turns
+    every committed member's collected edge columns into ready-to-put
+    (key, record, attr) triples, returned as {member: [...]} — members
+    whose columns had to materialize keep their Python deltas and are
+    simply absent. MUST run before the per-member encode_deltas loop:
+    a materialized member's writes come out of txn.cache.deltas."""
+    from dgraph_tpu.posting import colwrite  # lazy: engines without
+    # group commit never pay the columnar module (and its native load)
+
+    return colwrite.batch_encode(committed)
+
+
+def commit_phase_ns(oracle: int = 0, propose: int = 0, apply: int = 0):
+    """Commit-phase wall-time split (ns): where a group-commit batch
+    spent its time — the oracle verdict exchange, the encode+propose
+    (or put_batch) phase, and the apply barrier. qps_loadgen stamps
+    the deltas of these counters into every BENCH_QPS row so the
+    residual write-path bound is visible in-capture."""
+    if oracle:
+        METRICS.inc("commit_oracle_ns_total", oracle)
+    if propose:
+        METRICS.inc("commit_propose_ns_total", propose)
+    if apply:
+        METRICS.inc("commit_apply_ns_total", apply)
+
+
 def chunk_group_writes(plans, frame_budget: int):
     """Merge per-member per-group writes into bounded proposal chunks:
     yields (gid, writes, members) with the summed record bytes of each

@@ -747,8 +747,13 @@ class DistributedCluster:
         return ClusterTxn(self)
 
     def _commit(self, txn: Txn) -> int:
+        from dgraph_tpu.posting import colwrite
         from dgraph_tpu.x import config as _config
 
+        # a commit-time consumer of Posting objects that appeared after
+        # txn creation (CDC sink, vector index) forces collected
+        # columns back to the serial representation
+        colwrite.commit_guard(txn, self)
         if not bool(_config.get("GROUP_COMMIT")):
             # escape hatch (DGRAPH_TPU_GROUP_COMMIT=0): today's serial
             # per-txn path, byte-for-byte
@@ -779,12 +784,15 @@ class DistributedCluster:
         from dgraph_tpu.worker.groupcommit import (
             assign_verdicts,
             chunk_group_writes,
+            columnar_writes,
+            commit_phase_ns,
         )
         from dgraph_tpu.x import config as _config
 
         committed: list = []
         plans: list = []
         with self._commit_lock:
+            t0 = time.perf_counter_ns()
             live = []
             for m in members:
                 try:
@@ -804,9 +812,19 @@ class DistributedCluster:
                         track=True,
                     ),
                 )
+            t1 = time.perf_counter_ns()
             try:
+                # columnar members first (ONE batch_apply kernel call
+                # for the whole batch; must precede encode_deltas — a
+                # materialized fallback lands in cache.deltas)
+                col_writes = columnar_writes(committed)
                 for m in committed:
                     per_group: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
+                    for key, recb, attr in col_writes.get(m, ()):
+                        gid = self.zero.should_serve(attr)
+                        per_group.setdefault(gid, []).append(
+                            (key, m.commit_ts, recb)
+                        )
                     for key, recb in encode_deltas(m.txn.cache.deltas):
                         gid = self.zero.should_serve(
                             keys.parse_key(key).attr
@@ -858,14 +876,21 @@ class DistributedCluster:
             gc = self._group_commit
             if gc is not None:
                 gc.mark_proposed()
+            commit_phase_ns(
+                oracle=t1 - t0, propose=time.perf_counter_ns() - t1
+            )
 
         def barrier():
             from dgraph_tpu.posting.mutation import ingest_vectors
 
+            tb = time.perf_counter_ns()
             for m in committed:
                 self.zero.zero.applied(m.commit_ts)
             for m in committed:
                 self.mem.invalidate(m.txn.cache.deltas.keys())
+                ck = getattr(m.txn, "col_keys", None)
+                if ck:
+                    self.mem.invalidate(ck)
             # CDC in the FIFO barrier: members are commit-ts ascending
             # and barriers run in ticket order — the sink stream stays
             # strictly commit-ts ordered across batches
@@ -875,16 +900,25 @@ class DistributedCluster:
                     ingest_vectors(self.vector_indexes, m.txn.cache.deltas)
                     if cdc is not None:
                         cdc.emit_commit(m.commit_ts, m.txn.cache.deltas)
+            commit_phase_ns(apply=time.perf_counter_ns() - tb)
 
         return barrier
 
     def _commit_locked(self, txn: Txn) -> int:
+        from dgraph_tpu.posting import colwrite
+        from dgraph_tpu.worker.groupcommit import commit_phase_ns
+
+        t0 = time.perf_counter_ns()
         self._check_fences(txn)
         commit_ts = self.zero.zero.commit(txn.start_ts, txn.conflict_keys, track=True)
+        t1 = time.perf_counter_ns()
         # shard deltas by owning group (populateMutationMap analog)
         per_group: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
         from dgraph_tpu.posting.pl import encode_delta
 
+        for key, recb, attr in colwrite.encode_txn(txn):
+            gid = self.zero.should_serve(attr)
+            per_group.setdefault(gid, []).append((key, commit_ts, recb))
         for key, posts in txn.cache.deltas.items():
             if not posts:
                 continue
@@ -915,8 +949,17 @@ class DistributedCluster:
                 f"or restart completes it: {e}"
             ) from e
         finally:
+            t2 = time.perf_counter_ns()
             self.zero.zero.applied(commit_ts)
             self.mem.invalidate(txn.cache.deltas.keys())
+            ck = getattr(txn, "col_keys", None)
+            if ck:
+                self.mem.invalidate(ck)
+            commit_phase_ns(
+                oracle=t1 - t0,
+                propose=t2 - t1,
+                apply=time.perf_counter_ns() - t2,
+            )
         # vector ingestion
         from dgraph_tpu.posting.pl import OP_DEL, OP_SET
 
@@ -977,9 +1020,13 @@ class DistributedCluster:
     # drift); this cluster only supplies the read/propose primitives.
 
     def _check_fences(self, txn: Txn):
+        from dgraph_tpu.posting import colwrite
         from dgraph_tpu.worker.tabletmove import check_fences
 
-        check_fences(self.zero, txn.cache.deltas)
+        # fence_keys covers columnar members: one synthetic data key
+        # per collected predicate (the columns hold no concrete keys
+        # until the kernel runs)
+        check_fences(self.zero, colwrite.fence_keys(txn))
 
     def _move_leader_kv(self, gid: int, timeout: float = 5.0) -> KV:
         """The LEADER's KV, for move reads: _propose_and_wait only
@@ -1159,9 +1206,12 @@ class DistributedCluster:
 
 class ClusterTxn:
     def __init__(self, cluster: DistributedCluster):
+        from dgraph_tpu.posting import colwrite
+
         self.cluster = cluster
         self.start_ts = cluster.zero.zero.begin_txn()
         self.txn = Txn(cluster.read_kv(), self.start_ts, mem=cluster.mem)
+        colwrite.maybe_enable(self.txn, cluster)
 
     def mutate_rdf(self, set_rdf: str = "", del_rdf: str = "", commit_now=False):
         from dgraph_tpu.loaders.rdf import parse_rdf

@@ -361,10 +361,16 @@ class ProcCluster:
         return ClusterTxn(self)
 
     def _commit(self, txn: Txn) -> int:
+        from dgraph_tpu.posting import colwrite
+
+        # a commit-time consumer of Posting objects that appeared after
+        # txn creation (CDC sink) forces collected columns back to the
+        # serial representation before anything reads the txn
+        colwrite.commit_guard(txn, self)
         # admission costs writes too: a commit charges the same
         # in-flight token budget queries draw from (retryable 429 over
         # budget; no-op with DGRAPH_TPU_ADMISSION off)
-        n_edges = sum(len(p) for p in txn.cache.deltas.values())
+        n_edges = txn.pending_postings()
         ticket = self.serving.admit_write(n_edges)
         try:
             if not bool(config.get("GROUP_COMMIT")):
@@ -387,10 +393,17 @@ class ProcCluster:
                 with METRICS.timer("commit_latency_seconds"):
                     cts = gc.commit(txn)
                 self._feed_stats(txn.cache.deltas)
+                colwrite.feed_col_stats(self.stats, txn)
             # counted for BOTH arms (only on success — the metric is
             # postings WRITTEN): the A/B escape hatch must not turn
-            # the edge-throughput denominator dark
-            METRICS.inc("mutation_edges_total", n_edges)
+            # the edge-throughput denominator dark; recounted after the
+            # commit so the columnar kernel's exact posting count wins
+            # over the admission estimate
+            METRICS.inc(
+                "mutation_edges_total",
+                sum(len(p) for p in txn.cache.deltas.values())
+                + getattr(txn, "col_nposts", 0),
+            )
             return cts
         finally:
             self.serving.release_write(ticket)
@@ -408,6 +421,9 @@ class ProcCluster:
         METRICS.inc("num_commits")
         self.serving.on_commit()  # commit-epoch plan invalidation
         self._feed_stats(txn.cache.deltas)
+        from dgraph_tpu.posting import colwrite
+
+        colwrite.feed_col_stats(self.stats, txn)
         return cts
 
     def _gc_propose(self, members):
@@ -421,8 +437,13 @@ class ProcCluster:
         exchange and proposals are in flight before this batch's apply
         barrier completes (the pipeline); the snapshot watermark still
         advances in commit-ts order because barriers run FIFO."""
+        from dgraph_tpu.posting import colwrite
         from dgraph_tpu.posting.pl import encode_deltas
-        from dgraph_tpu.worker.groupcommit import assign_verdicts
+        from dgraph_tpu.worker.groupcommit import (
+            assign_verdicts,
+            columnar_writes,
+            commit_phase_ns,
+        )
         from dgraph_tpu.worker.tabletmove import check_fences
 
         budget = float(config.get("COMMIT_DEADLINE_S"))
@@ -433,13 +454,16 @@ class ProcCluster:
         with deadline_scope(dl), TRACER.span(
             "commit", batch=len(members)
         ), self._commit_lock:
+            t0 = time.perf_counter_ns()
             live = []
             for m in members:
                 try:
                     # fence bounces are retryable and PER MEMBER — a
                     # moving tablet never aborts its batchmates, and no
-                    # oracle verdict is burned for the bounced txn
-                    check_fences(self.zero, m.txn.cache.deltas)
+                    # oracle verdict is burned for the bounced txn.
+                    # colwrite.fence_keys covers columnar members: one
+                    # synthetic data key per collected predicate
+                    check_fences(self.zero, colwrite.fence_keys(m.txn))
                 except Exception as e:
                     m.error = e
                 else:
@@ -455,9 +479,21 @@ class ProcCluster:
                         track=True,
                     ),
                 )
+            t1 = time.perf_counter_ns()
             try:
+                # columnar members first (ONE batch_apply kernel call
+                # for the whole batch; must precede encode_deltas — a
+                # materialized fallback lands in cache.deltas). The
+                # kernel reports each pair's attr, so group routing
+                # needs no parse_key
+                col_writes = columnar_writes(committed)
                 for m in committed:
                     per_group: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
+                    for key, recb, attr in col_writes.get(m, ()):
+                        gid = self.zero.should_serve(attr)
+                        per_group.setdefault(gid, []).append(
+                            (key, m.commit_ts, recb)
+                        )
                     for key, recb in encode_deltas(m.txn.cache.deltas):
                         gid = self.zero.should_serve(
                             keys.parse_key(key).attr
@@ -505,8 +541,12 @@ class ProcCluster:
             gc = self._group_commit
             if gc is not None:
                 gc.mark_proposed()
+            commit_phase_ns(
+                oracle=t1 - t0, propose=time.perf_counter_ns() - t1
+            )
 
         def barrier():
+            tb = time.perf_counter_ns()
             try:
                 for fut, mset in futs:
                     try:
@@ -536,6 +576,9 @@ class ProcCluster:
                         ok += 1
                 for m in committed:
                     self.mem.invalidate(m.txn.cache.deltas.keys())
+                    ck = getattr(m.txn, "col_keys", None)
+                    if ck:
+                        self.mem.invalidate(ck)
                 # CDC in the FIFO barrier: members commit-ts ascending,
                 # barriers ticket-ordered — the sink stream stays
                 # strictly commit-ts ordered across batches
@@ -549,6 +592,7 @@ class ProcCluster:
                 if ok:
                     METRICS.inc("num_commits", ok)
                     self.serving.on_commit()  # ONE epoch bump per batch
+                commit_phase_ns(apply=time.perf_counter_ns() - tb)
 
         return barrier
 
@@ -574,17 +618,25 @@ class ProcCluster:
         feed_stats(self.stats, deltas)
 
     def _commit_locked(self, txn: Txn) -> int:
+        from dgraph_tpu.posting import colwrite
         from dgraph_tpu.posting.pl import encode_delta
+        from dgraph_tpu.worker.groupcommit import commit_phase_ns
         from dgraph_tpu.worker.tabletmove import check_fences
 
+        t0 = time.perf_counter_ns()
         # a commit into a move's Phase-2 fence bounces RETRYABLE before
         # the oracle burns a verdict (never wrong data, never a write
-        # the source drop would destroy)
-        check_fences(self.zero, txn.cache.deltas)
+        # the source drop would destroy); fence_keys adds one synthetic
+        # data key per columnar predicate
+        check_fences(self.zero, colwrite.fence_keys(txn))
         commit_ts = self.zero.zero.commit(
             txn.start_ts, txn.conflict_keys, track=True
         )
+        t1 = time.perf_counter_ns()
         per_group: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
+        for key, recb, attr in colwrite.encode_txn(txn):
+            gid = self.zero.should_serve(attr)
+            per_group.setdefault(gid, []).append((key, commit_ts, recb))
         for key, posts in txn.cache.deltas.items():
             if not posts:
                 continue
@@ -601,11 +653,20 @@ class ProcCluster:
             if self.intents is not None:
                 self.intents.mark_done(commit_ts)
         finally:
+            t2 = time.perf_counter_ns()
             # watermark BEFORE the apply barrier (batcher snapshot key);
             # max() guards concurrent watermark bumps (moves)
             self._snapshot_ts = max(self._snapshot_ts, commit_ts)
             self.zero.zero.applied(commit_ts)
             self.mem.invalidate(txn.cache.deltas.keys())
+            ck = getattr(txn, "col_keys", None)
+            if ck:
+                self.mem.invalidate(ck)
+            commit_phase_ns(
+                oracle=t1 - t0,
+                propose=t2 - t1,
+                apply=time.perf_counter_ns() - t2,
+            )
         cdc = getattr(self, "_cdc", None)
         if cdc is not None:
             # serial path runs under the commit lock: already ordered

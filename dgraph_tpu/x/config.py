@@ -90,11 +90,42 @@ _define(
     "default; the in-flight gauge is tracked regardless.",
 )
 _define(
+    "APPLY_SHARDS", "int", 0,
+    "Predicate-sharded residual mutation apply (posting/mutation.py "
+    "_apply_edges_sharded): edges that escape the columnar kernel are "
+    "partitioned by (namespace, predicate) and applied concurrently on "
+    "the exec-worker pool, merged back deterministically in shard-index "
+    "order (all key kinds embed the attr, so shards touch disjoint "
+    "keys). 0 (default) = automatic — shard when EXEC_WORKERS >= 2 and "
+    "the call clears DGRAPH_TPU_APPLY_SHARD_MIN_EDGES; 1 forces the "
+    "serial path; N>1 forces up to N shards regardless of size.",
+)
+_define(
+    "APPLY_SHARD_MIN_EDGES", "int", 64,
+    "Minimum edges in one apply_edges call before the automatic "
+    "predicate-sharding heuristic engages (posting/mutation.py): below "
+    "this, thread handoff costs more than the GIL-released tokenizer "
+    "work the shards would overlap.",
+)
+_define(
     "BACKUP_CHUNK_BYTES", "int", 4 << 20,
     "Byte bound on one backup chunk file's (uncompressed) record "
     "payload (admin/backup.py BackupWriter): a tablet of any size "
     "streams into bounded, individually-verifiable files instead of "
     "one unbounded stream a torn write could silently shorten.",
+)
+_define(
+    "BATCH_APPLY", "bool", True,
+    "Columnar native mutation apply (posting/colwrite.py + codec.cpp "
+    "batch_apply): fast-shape SET edges (scalar values with "
+    "exact/int/bool/term indexes, list-uid incl. @reverse) are "
+    "collected as columns instead of Posting objects and encoded at "
+    "commit by ONE native call per group-commit batch — fused "
+    "tokenization, index/reverse key emission and delta-record "
+    "encoding, byte-identical to the serial path. Ineligible edges "
+    "materialize back through the serial path automatically. 0 "
+    "restores the per-edge Python apply everywhere — the A/B escape "
+    "hatch.",
 )
 _define(
     "BATCH_WINDOW_US", "int", 0,
@@ -547,17 +578,59 @@ def get_raw(name: str) -> Optional[str]:
     return os.environ.get(REGISTRY[name].env)
 
 
+def _env_reader():
+    """Fast live env lookup: os.environ.get pays Mapping dispatch plus
+    key encode on every call, which adds up on knobs polled per commit
+    or per query (the write hot path reads ~10 knobs per txn). The
+    underlying os.environ._data dict sees every write made through
+    os.environ (set_env, monkeypatch.setenv, direct assignment), so a
+    plain dict.get against it keeps read-live-per-call semantics.
+    Falls back to os.environ.get when _data is missing or keyed
+    differently (non-CPython, Windows)."""
+    data = getattr(os.environ, "_data", None)
+    if isinstance(data, dict):
+        probe = PREFIX + "__PROBE__"
+        os.environ[probe] = "1"
+        try:
+            pb = probe.encode()
+            if pb in data:
+                dget = data.get
+
+                def read(env: str):
+                    raw = dget(env.encode())
+                    return raw if raw is None else raw.decode()
+
+                return read
+            if probe in data:
+                return data.get
+        finally:
+            del os.environ[probe]
+    return os.environ.get
+
+
+_env_read = _env_reader()
+# per-knob (raw, parsed) memo: env reads stay live; only the parse of
+# an unchanged raw string is skipped
+_parse_memo: Dict[str, tuple] = {}
+
+
 def get(name: str) -> Any:
     """Parsed value of a registered knob; the declared default when the
-    variable is unset or malformed."""
+    variable is unset or malformed. Reads the environment live on every
+    call (tests flip env vars mid-process and expect immediate effect)."""
     k = REGISTRY[name]
-    raw = os.environ.get(k.env)
+    raw = _env_read(k.env)
     if raw is None:
         return k.default
+    memo = _parse_memo.get(name)
+    if memo is not None and memo[0] == raw:
+        return memo[1]
     try:
-        return k.parse(raw)
+        val = k.parse(raw)
     except ValueError:
-        return k.default
+        val = k.default
+    _parse_memo[name] = (raw, val)
+    return val
 
 
 def set_env(name: str, value: Any) -> None:

@@ -80,10 +80,14 @@ def test_packed_setops_corpus_under_ubsan():
             # (enc_delta_records batched record serialization over the
             # randomized posting corpus incl. 0-length and max-u64
             # values, tok_terms_ascii over adversarial ASCII) through
-            # their byte-equality suites
+            # their byte-equality suites; test_batch_apply drives the
+            # columnar batch_apply/batch_apply_caps kernels (fused
+            # tokenize + index-key emission + record encode) through
+            # the randomized mixed-shape A/B byte-equality corpus
             "tests/test_packed_setops.py", "tests/test_uidpack.py",
             "tests/test_bitmap_setops.py", "tests/test_stream_encoder.py",
             "tests/test_vector_quant.py", "tests/test_group_commit.py",
+            "tests/test_batch_apply.py",
             "-q", "-m", "not slow", "-p", "no:cacheprovider",
         ],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=900,

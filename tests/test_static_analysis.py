@@ -503,7 +503,12 @@ def test_abi_covers_mutation_kernel_exports():
         os.path.join(REPO, "dgraph_tpu", "native", "codec.cpp")
     ) as f:
         exports = check_ctypes_abi.parse_cpp_exports(f.read())
-    for name in ("enc_delta_records", "tok_terms_ascii"):
+    for name in (
+        "enc_delta_records",
+        "tok_terms_ascii",
+        "batch_apply",
+        "batch_apply_caps",
+    ):
         assert name in exports, name
         assert name in native.DECLS, name
         assert len(exports[name][1]) == len(native.DECLS[name][1]), name

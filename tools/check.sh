@@ -61,6 +61,7 @@ else
         tests/test_stream_encoder.py \
         tests/test_vector_quant.py \
         tests/test_group_commit.py \
+        tests/test_batch_apply.py \
         tests/test_explain.py tests/test_telemetry.py \
         tests/test_planner.py \
         tests/test_ops_plane.py \
