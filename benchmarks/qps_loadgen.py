@@ -178,6 +178,11 @@ _ROW_COUNTERS = (
     "mutation_batch_apply_edges_total", "mutation_native_fallback_total",
     "commit_oracle_ns_total", "commit_propose_ns_total",
     "commit_apply_ns_total",
+    # PR 17: multi-process apply plane + adaptive group-commit bypass —
+    # how many batches crossed the process boundary, how long the
+    # shared-memory round trips took, and whether anything fell back
+    "apply_shard_batches_total", "apply_shard_fallback_total",
+    "apply_shard_ipc_seconds", "group_commit_bypass_total",
 )
 
 
@@ -201,6 +206,13 @@ def stamp_metric_deltas(row: dict, base: dict) -> dict:
 
     for k in _ROW_COUNTERS:
         row[k.replace("_total", "")] = int(METRICS.value(k) - base[k])
+    # the IPC counter is float seconds; the generic int() delta would
+    # truncate every sub-second window to 0 — stamp it as ns instead
+    row["apply_shard_ipc_ns"] = int(
+        (METRICS.value("apply_shard_ipc_seconds")
+         - base["apply_shard_ipc_seconds"]) * 1e9
+    )
+    row.pop("apply_shard_ipc_seconds", None)
     looked = row["plan_cache_hit"] + row["plan_cache_miss"]
     row["plan_cache_hit_rate"] = (
         round(row["plan_cache_hit"] / looked, 4) if looked else 0.0
@@ -424,15 +436,22 @@ _WRITE_SEQ_LOCK = threading.Lock()
 
 
 def _assert_write_byte_identity(args) -> None:
-    """In-capture guard for the mixed A/B: the columnar batch-apply arm
-    must leave a byte-identical store to the serial per-edge arm over
-    the loadgen's own writer corpus (the speedup is only admissible as
-    the SAME write work done faster). Runs on two small throwaway
-    engines before the measured sweep; raises on any divergence."""
+    """In-capture guard for the mixed A/B: every write-pipeline arm —
+    columnar batch apply, the multi-process apply plane (APPLY_PROCS
+    forced to 2), and the adaptive group-commit bypass — must leave a
+    byte-identical store to the serial per-edge arm over the loadgen's
+    own writer corpus (a speedup is only admissible as the SAME write
+    work done faster), and each arm must demonstrably take its path
+    (counter gates), not silently fall back to the one being measured
+    against. Runs on small throwaway engines before the measured
+    sweep; raises on any divergence."""
+    from dgraph_tpu.utils.observe import METRICS
+    from dgraph_tpu.worker import applyshard
     from dgraph_tpu.x import config
 
-    def capture(batch_apply: int):
-        config.set_env("BATCH_APPLY", batch_apply)
+    def capture(env):
+        for k, v in env.items():
+            config.set_env(k, v)
         try:
             s = build_server(0, 64)
             t = s.new_txn()
@@ -448,16 +467,45 @@ def _assert_write_byte_identity(args) -> None:
             t.mutate_json(set_obj=objs, commit_now=True)
             return {k: list(v) for k, v in s.kv._data.items()}
         finally:
-            config.unset_env("BATCH_APPLY")
+            for k in env:
+                config.unset_env(k)
+            applyshard.shutdown()
 
-    a, b = capture(1), capture(0)
-    assert a == b, (
-        "columnar batch-apply arm diverged from the serial arm: "
-        f"{len(a)} vs {len(b)} keys, "
-        f"{sum(1 for k in a.keys() & b.keys() if a[k] != b[k])} mismatched"
+    arms = [
+        ("serial", {"BATCH_APPLY": 0}, None),
+        ("batch", {"BATCH_APPLY": 1, "APPLY_PROCS": 0},
+         "mutation_batch_apply_total"),
+        ("proc_shard", {"BATCH_APPLY": 1, "APPLY_PROCS": 2},
+         "apply_shard_batches_total"),
+        ("bypass",
+         {"BATCH_APPLY": 1, "GROUP_COMMIT": 1, "GROUP_COMMIT_BYPASS": 1},
+         "group_commit_bypass_total"),
+    ]
+    dumps = {}
+    fb_before = METRICS.value("apply_shard_fallback_total")
+    for name, env, gate in arms:
+        before = METRICS.value(gate) if gate else 0
+        dumps[name] = capture(env)
+        if gate:
+            assert METRICS.value(gate) > before, (
+                f"write-sanity {name} arm never took its path "
+                f"({gate} unchanged)"
+            )
+    assert METRICS.value("apply_shard_fallback_total") == fb_before, (
+        "proc-shard arm fell back during the byte-identity corpus"
     )
+    ref = dumps["serial"]
+    for name, _, _ in arms[1:]:
+        a = dumps[name]
+        assert a == ref, (
+            f"{name} arm diverged from the serial arm: "
+            f"{len(a)} vs {len(ref)} keys, "
+            f"{sum(1 for k in a.keys() & ref.keys() if a[k] != ref[k])} "
+            "mismatched"
+        )
     print("write byte-identity: OK "
-          f"({len(a)} keys identical across arms)", flush=True)
+          f"({len(ref)} keys identical across {len(arms)} arms)",
+          flush=True)
 
 
 def mixed_sweep(args) -> dict:
@@ -485,11 +533,21 @@ def mixed_sweep(args) -> dict:
         }
         modes = [("serial", env)]
     else:
-        # group_on = the full write pipeline (group commit + columnar
-        # native batch apply); group_off = the pre-PR-11 serial
-        # per-edge baseline — the A/B the mixed headline speedup reads
+        # group_on = the full in-process write pipeline (group commit +
+        # columnar native batch apply, APPLY_PROCS pinned 0 so the arm
+        # is a stable reference on any box); procs_on = the same
+        # pipeline with the multi-process apply plane forced on (cores-1
+        # shard workers, min 1 — "auto" resolves to 0 on small boxes
+        # and would silently measure the same arm twice); group_off =
+        # the pre-PR-11 serial per-edge baseline. procs_on/group_on is
+        # the same-run APPLY_PROCS on/off A/B the headline reads.
+        nprocs = max(1, (os.cpu_count() or 2) - 1)
         modes = [
-            ("group_on", {"GROUP_COMMIT": 1, "BATCH_APPLY": 1}),
+            ("group_on",
+             {"GROUP_COMMIT": 1, "BATCH_APPLY": 1, "APPLY_PROCS": 0}),
+            ("procs_on",
+             {"GROUP_COMMIT": 1, "BATCH_APPLY": 1,
+              "APPLY_PROCS": nprocs}),
             ("group_off", {"GROUP_COMMIT": 0, "BATCH_APPLY": 0}),
         ]
         _assert_write_byte_identity(args)
@@ -559,10 +617,54 @@ def mixed_sweep(args) -> dict:
             key = f"mix_{int(ratio * 100)}"
             off = headline.get(f"{key}_group_off_mutation_qps") or 0
             on = headline.get(f"{key}_group_on_mutation_qps") or 0
+            procs = headline.get(f"{key}_procs_on_mutation_qps") or 0
             headline[f"{key}_speedup_x"] = (
                 round(on / off, 2) if off else None
             )
+            # the APPLY_PROCS on/off A/B, same run, same weather
+            headline[f"{key}_procs_speedup_x"] = (
+                round(procs / on, 2) if on else None
+            )
     return {"rows": results, "headline": headline}
+
+
+def stamp_vs_baseline(out: dict, merged: dict) -> None:
+    """Stamp the cross-capture headline: best live arm vs the recorded
+    pre-change mixed_baseline (serial single-mode capture), overall and
+    per client count. Mutates out['headline'] in place; silently a
+    no-op when no baseline capture exists in the artifact."""
+    base = (merged.get("mixed_baseline") or {})
+    bhead = base.get("headline") or {}
+    brows = base.get("rows") or {}
+    head = out["headline"]
+    for key in out["rows"]:
+        bqps = bhead.get(f"{key}_serial_mutation_qps")
+        if not bqps:
+            continue
+        head[f"{key}_baseline_mutation_qps"] = bqps
+        live = max(
+            (head.get(f"{key}_{arm}_mutation_qps") or 0)
+            for arm in ("group_on", "procs_on")
+        )
+        head[f"{key}_vs_baseline_x"] = round(live / bqps, 2)
+        bby = {
+            r["clients"]: r["mutation_qps"]
+            for r in (brows.get(key, {}).get("serial") or [])
+            if r.get("mutation_qps")
+        }
+        by = {}
+        for arm in ("group_on", "procs_on"):
+            for r in out["rows"][key].get(arm, []):
+                c = r["clients"]
+                if c in bby and r.get("mutation_qps"):
+                    by[c] = max(
+                        by.get(c, 0),
+                        round(r["mutation_qps"] / bby[c], 2),
+                    )
+        if by:
+            head[f"{key}_vs_baseline_by_clients_x"] = {
+                str(c): v for c, v in sorted(by.items())
+            }
 
 
 def _reuse_modes(args):
@@ -918,6 +1020,21 @@ def main(argv=None):
         if on_rows and not batch_ok:
             print("write-sanity: native batch-apply counter stayed "
                   "zero in the group_on arm")
+        # the proc arm must actually cross the process boundary
+        proc_rows = [
+            r
+            for modes in out["rows"].values()
+            for name, rws in modes.items()
+            if name == "procs_on"
+            for r in rws
+        ]
+        proc_ok = any(
+            r.get("apply_shard_batches", 0) > 0 for r in proc_rows
+        )
+        ok = ok and (proc_ok or not proc_rows)
+        if proc_rows and not proc_ok:
+            print("write-sanity: shard-process kernel counter stayed "
+                  "zero in the procs_on arm")
         print(f"write-sanity: {'OK' if ok else 'FAIL'} {out['headline']}")
         return 0 if ok else 1
     if args.sanity:
@@ -949,6 +1066,8 @@ def main(argv=None):
             with open(path) as f:
                 merged = json.load(f)
             merged.pop("provenance", None)
+            if args.mix and not args.baseline:
+                stamp_vs_baseline(out, merged)
             merged.update(out_keys)
         except Exception:
             merged = out_keys

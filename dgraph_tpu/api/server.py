@@ -612,11 +612,15 @@ class Server:
                             )
 
                             gc = self._group_commit = GroupCommit(
-                                self._gc_propose
+                                self._gc_propose,
+                                serial_fn=self._gc_serial,
                             )
                 with _METRICS.timer("commit_latency_seconds"):
                     commit_ts = gc.commit(txn)
-                self._post_commit(txn, commit_ts)
+                if not getattr(txn, "gc_bypassed", False):
+                    # the bypass ran the serial path, whose inline
+                    # post-commit work already happened
+                    self._post_commit(txn, commit_ts)
             # counted for BOTH arms (only on success — the metric is
             # postings WRITTEN): the A/B escape hatch must not turn
             # the edge-throughput denominator dark. Recounted after
@@ -738,15 +742,28 @@ class Server:
         # vector index ingestion at commit (shared factory seam)
         ingest_vectors(self.vector_indexes, txn.cache.deltas)
 
-    def _commit_serial(self, txn: Txn) -> int:
+    def _gc_serial(self, txn: Txn) -> int:
+        """Adaptive group-commit bypass target (worker/groupcommit.py):
+        the serial path minus its own latency timer (gc.commit's
+        caller already runs one), with the txn marked so _commit skips
+        the batch-path _post_commit — the serial path does that work
+        inline."""
+        txn.gc_bypassed = True
+        return self._commit_serial(txn, timed=False)
+
+    def _commit_serial(self, txn: Txn, timed: bool = True) -> int:
         # serialized: MemKV is single-writer, and readers must not see a
         # commit_ts whose deltas aren't written yet (ADVICE r1 #2)
+        import contextlib
+
         from dgraph_tpu.utils.observe import METRICS, TRACER
 
         from dgraph_tpu.worker.groupcommit import commit_phase_ns
 
-        with TRACER.span("commit"), METRICS.timer(
-            "commit_latency_seconds"
+        with TRACER.span("commit"), (
+            METRICS.timer("commit_latency_seconds")
+            if timed
+            else contextlib.nullcontext()
         ), self._lock:
             t0 = time.perf_counter_ns()
             commit_ts = self.zero.commit(txn.start_ts, txn.conflict_keys, track=True)

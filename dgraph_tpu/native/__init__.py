@@ -703,15 +703,33 @@ def batch_apply(
     if n_members <= 0 or m_offs[-1] == 0:
         empty = array("q", (0,))
         return (0, b"", empty, b"", empty, b"", b"", b"", b"")
-    a_m_offs = m_offs.buffer_info()[0]
-    a_entities = entities.buffer_info()[0]
-    a_pred_ids = pred_ids.buffer_info()[0]
-    a_objects = objects.buffer_info()[0]
-    a_voffs = voffs.buffer_info()[0]
-    a_pp_offs = pp_offs.buffer_info()[0]
-    a_shapes = _ba_addr(shapes)
-    a_vtypes = _ba_addr(vtypes)
-    a_vblob = _ba_addr(vblob) if isinstance(vblob, bytearray) else vblob
+    return batch_apply_addrs(
+        m_offs.buffer_info()[0], n_members,
+        _ba_addr(shapes), entities.buffer_info()[0],
+        pred_ids.buffer_info()[0], objects.buffer_info()[0],
+        _ba_addr(vtypes), voffs.buffer_info()[0],
+        _ba_addr(vblob) if isinstance(vblob, bytearray) else vblob,
+        pp_blob, pp_offs.buffer_info()[0], pflags, pidents, n_preds,
+    )
+
+
+def batch_apply_addrs(
+    a_m_offs: int, n_members: int, a_shapes: int, a_entities: int,
+    a_pred_ids: int, a_objects: int, a_vtypes: int, a_voffs: int,
+    a_vblob, pp_blob: bytes, a_pp_offs: int, pflags: bytes,
+    pidents: bytes, n_preds: int,
+):
+    """Address-level core of `batch_apply`: every big input column
+    arrives as a raw address, so the apply-shard worker processes
+    (worker/applyshard.py) can point the kernel straight into their
+    shared-memory ring — zero input copies on the worker side. Same
+    return tuple as `batch_apply`; None when the lib is unavailable.
+    Callers own the empty-batch short-circuit (a zero-row call here
+    would dereference nothing but still pays the caps exchange)."""
+    from array import array
+
+    if _LIB is None:
+        return None
     caps = array("q", (0, 0, 0))
     _LIB.batch_apply_caps(
         a_m_offs, n_members, a_shapes, a_pred_ids, a_voffs, a_pp_offs,

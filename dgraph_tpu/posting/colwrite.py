@@ -462,25 +462,23 @@ def _pred_blobs(pred_tab: List[_Pred]):
     )
 
 
-def _run_kernel(colsets: List[ColumnarWriteSet]):
-    """Flatten the colsets (members of one group-commit batch) into the
-    batch arrays and run ONE codec.cpp batch_apply call. Returns the
-    wrapper's raw result plus the merged pred table, or None when the
-    native library refuses. Single-colset calls (serial commits,
-    1-member batches) pass the collected buffers straight through —
-    zero concatenation."""
-    from dgraph_tpu import native
-
+def flatten_colsets(colsets: List[ColumnarWriteSet]):
+    """The merged batch arrays the kernel (and the apply-shard
+    processes' wire payload) consume: ((m_offs, shapes, entities,
+    pids, objects, vtypes, voffs, vblob), pred_tab) with the members'
+    pred ids remapped onto one deduplicated pred table. Single-colset
+    calls (serial commits, 1-member batches) pass the collected
+    buffers straight through — zero concatenation."""
     if len(colsets) == 1:
         cs = colsets[0]
-        pred_tab = cs.pred_list
-        pp_blob, pp_offs, pflags, pidents = _pred_blobs(pred_tab)
-        res = native.batch_apply(
-            array("q", (0, len(cs.shapes))), cs.shapes, cs.entities,
-            cs.pids, cs.objects, cs.vtypes, cs.voffs, cs.vblob,
-            pp_blob, pp_offs, pflags, pidents,
+        return (
+            (
+                array("q", (0, len(cs.shapes))), cs.shapes,
+                cs.entities, cs.pids, cs.objects, cs.vtypes,
+                cs.voffs, cs.vblob,
+            ),
+            cs.pred_list,
         )
-        return None if res is None else (res, pred_tab)
     merged: Dict[tuple, int] = {}
     pred_tab = []
     remaps: List[List[int]] = []
@@ -518,10 +516,23 @@ def _run_kernel(colsets: List[ColumnarWriteSet]):
         else:
             voffs += cs.voffs[1:]
         m_offs.append(len(shapes))
+    return (
+        (m_offs, shapes, entities, pids, objects, vtypes, voffs, vblob),
+        pred_tab,
+    )
+
+
+def _run_kernel(colsets: List[ColumnarWriteSet]):
+    """Flatten the colsets (members of one group-commit batch) into the
+    batch arrays and run ONE codec.cpp batch_apply call. Returns the
+    wrapper's raw result plus the merged pred table, or None when the
+    native library refuses."""
+    from dgraph_tpu import native
+
+    flat, pred_tab = flatten_colsets(colsets)
     pp_blob, pp_offs, pflags, pidents = _pred_blobs(pred_tab)
     res = native.batch_apply(
-        m_offs, shapes, entities, pids, objects, vtypes, voffs, vblob,
-        pp_blob, pp_offs, pflags, pidents,
+        *flat, pp_blob, pp_offs, pflags, pidents,
     )
     if res is None:
         return None
@@ -531,7 +542,23 @@ def _run_kernel(colsets: List[ColumnarWriteSet]):
 def _encode_colsets(colsets: List[ColumnarWriteSet]):
     """Per-colset [(key, record, attr)] lists plus per-colset
     (keys, stats_rows, n_postings) side info, or None when the kernel
-    is unavailable (caller materializes)."""
+    is unavailable (caller materializes). With DGRAPH_TPU_APPLY_PROCS
+    workers live, the kernel runs in the apply-shard processes
+    (worker/applyshard.py) — same result shape, byte-identical pairs;
+    any escape from that plane falls through to the in-process call
+    below (exact serial semantics, counted per reason)."""
+    from dgraph_tpu.worker import applyshard
+
+    pool = applyshard.maybe_pool()
+    if pool is not None:
+        got = pool.encode(colsets)
+        if got is not None:
+            METRICS.inc("mutation_batch_apply_total")
+            METRICS.inc(
+                "mutation_batch_apply_edges_total",
+                sum(len(cs.shapes) for cs in colsets),
+            )
+            return got
     got = _run_kernel(colsets)
     if got is None:
         return None

@@ -180,6 +180,15 @@ def _apply_edges_fallback(
         _apply_edges_sharded(txn, st, edges, shards, update_schema)
 
 
+def shard_assign(n_groups: int, nshards: int) -> List[int]:
+    """The (ns, attr)-disjoint shard rule, shared between the
+    thread-sharded residual apply below and the apply-shard worker
+    processes (worker/applyshard.py): group i — in first-appearance
+    order — lands on shard i % nshards. One definition, so the two
+    planes can never partition the same batch differently."""
+    return [i % nshards for i in range(n_groups)]
+
+
 def _shard_plan(edges) -> Optional[List[List[DirectedEdge]]]:
     """Partition a batch by predicate into shard worklists, or None to
     run serially. APPLY_SHARDS forces a width (tests/chaos); otherwise
@@ -206,8 +215,9 @@ def _shard_plan(edges) -> Optional[List[List[DirectedEdge]]]:
         return None
     nshards = min(workers, len(by_attr))
     shards: List[List[DirectedEdge]] = [[] for _ in range(nshards)]
+    assign = shard_assign(len(by_attr), nshards)
     for i, group in enumerate(by_attr.values()):
-        shards[i % nshards].extend(group)
+        shards[assign[i]].extend(group)
     return shards
 
 

@@ -1735,6 +1735,36 @@ declare_metric(
     "cost budget (DGRAPH_TPU_MAX_INFLIGHT) was exhausted.",
 )
 declare_metric(
+    "counter", "apply_shard_batches_total",
+    "Group-commit batches whose columnar write-set was encoded by the "
+    "multi-process apply plane (worker/applyshard.py): columns "
+    "partitioned by (namespace, predicate), shipped over per-worker "
+    "shared-memory rings, kernels run in apply-shard worker processes "
+    "outside the serving GIL, results merged in shard-index order.",
+)
+declare_metric(
+    "counter", "apply_shard_fallback_total",
+    "Batches that escaped the multi-process apply plane back to the "
+    "in-process kernel (exact serial semantics preserved) — worker "
+    "crash/timeout, ring overflow, or the sticky disable after "
+    "repeated strikes. Per-cause split in the "
+    'apply_shard_fallback_total{reason="*"} family.',
+)
+declare_metric(
+    "counter", 'apply_shard_fallback_total{reason="*"}',
+    "Per-reason split of apply_shard_fallback_total (crash, timeout, "
+    "ring_full, error, spawn, sticky — see worker/applyshard.py call "
+    "sites).",
+)
+declare_metric(
+    "counter", "apply_shard_ipc_seconds",
+    "Wall seconds group-commit leaders spent shipping columns into "
+    "the shared-memory rings and waiting on apply-shard worker "
+    "responses — the shard-IPC cost qps_loadgen stamps into "
+    "BENCH_QPS rows (compare against commit_propose_ns_total for the "
+    "IPC share of the propose phase).",
+)
+declare_metric(
     "counter", "backup_bytes_total",
     "Uncompressed record-payload bytes written into backup chunk "
     "files (admin/backup.py BackupWriter).",
@@ -1879,6 +1909,15 @@ declare_metric(
 declare_metric(
     "counter", "metrics_scrape_errors_total",
     "Per-instance scrape failures during cluster metrics aggregation.",
+)
+declare_metric(
+    "counter", "group_commit_bypass_total",
+    "Commits that took the adaptive group-commit bypass "
+    "(worker/groupcommit.py): the width-EWMA said no batchmate was "
+    "waiting and the coalescer was idle, so the committer ran the "
+    "engine's serial path directly — skipping the condvar handoffs "
+    "that lose to serial at batch width ~1.05. Disable with "
+    "DGRAPH_TPU_GROUP_COMMIT_BYPASS=0.",
 )
 declare_metric(
     "counter", "group_commit_total",

@@ -1,0 +1,627 @@
+"""Multi-process apply shards behind the raft apply loop.
+
+PR 16's commit-phase split proved the write path's residual bound is
+reader/writer GIL interference, not apply cost: at c>=4 the serial and
+columnar arms converge in wall-clock while diverging in CPU, because
+queries and the batch_apply kernel's Python prologue share one
+interpreter lock. This module moves the kernel out of the serving
+interpreter entirely: N apply-shard worker processes
+(DGRAPH_TPU_APPLY_PROCS, default auto = cores-1) each own a
+shared-memory ring; a group-commit leader partitions the batch's
+columnar write-set by (namespace, predicate) — the SAME disjoint
+partitioning as posting/mutation._apply_edges_sharded, via its
+shard_assign — memcpy's each shard's flat columns into its worker's
+ring (no pickling of edges; the columns ARE the wire format), and the
+workers run native.batch_apply_addrs pointing straight into the ring.
+Ready-to-put (key, record) pairs come back through the same ring and
+are merged deterministically in shard-index order, so the caller still
+issues ONE kv.put_batch and the FIFO-barrier / snapshot-watermark /
+byte-identity contracts survive unchanged.
+
+Why (ns, attr) sharding is the correctness boundary: the kernel
+aggregates same-key rows of one member into ONE record (two list-uid
+SETs on the same (attr, entity), two terms hashing to one index key
+— MemKV overwrites same-(key, ts) puts, so splitting them would lose
+postings). Every key kind embeds the attr, so predicate-disjoint
+shards are key-disjoint and per-member aggregation is preserved; and
+because each member's pairs are emitted member-major per shard, the
+shard-index-order merge keeps per-key version order identical to the
+single-kernel path (fuzz-asserted across APPLY_PROCS arms in
+tests/test_batch_apply.py).
+
+Robustness contract (tentpole, chaos-gated): a worker that crashes
+(SIGKILL mid-batch) or blows DGRAPH_TPU_APPLY_PROC_TIMEOUT_MS is
+killed and respawned, the batch falls back to the in-process kernel
+with exact serial semantics (nothing was consumed before the merge
+commits), and the escape is counted per-reason in
+apply_shard_fallback_total{reason}. Three consecutive strikes disable
+the plane stickily until the knobs change. drain() fences the rings
+before the tablet mover's delta catch-up, and close() reaps workers
+and unlinks every segment.
+
+The residual Python apply (edges that escape the columnar collect)
+stays on the in-process thread-sharded path (_apply_edges_sharded):
+Posting objects and live txn state don't cross process boundaries
+without pickling — exactly what this ring exists to avoid.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import time
+from array import array
+from typing import List, Optional, Tuple
+
+from dgraph_tpu.utils.observe import METRICS
+from dgraph_tpu.x import config, keys
+
+_STRIKE_LIMIT = 3  # consecutive failed batches before sticky disable
+
+
+def resolve_procs() -> int:
+    """DGRAPH_TPU_APPLY_PROCS: 'auto' -> cores-1, else the int; 0 is
+    the in-process escape hatch (and the only possible answer on a
+    1-core box — the plane cannot add CPU there)."""
+    v = str(config.get("APPLY_PROCS")).strip().lower()
+    if v in ("auto", ""):
+        return max(0, (os.cpu_count() or 1) - 1)
+    try:
+        return max(0, int(v))
+    except ValueError:
+        return 0
+
+
+def _count_fallback(reason: str) -> None:
+    METRICS.inc("apply_shard_fallback_total")
+    METRICS.inc(f'apply_shard_fallback_total{{reason="{reason}"}}')
+
+
+# ---------------------------------------------------------------------------
+# worker process
+# ---------------------------------------------------------------------------
+
+# request sections, in wire order (all 8-aligned in the ring):
+#   m_offs(q) shapes(B) entities(Q) pids(i) objects(Q) vtypes(B)
+#   voffs(q) vblob(B) pp_blob(B) pp_offs(q) pflags(B) pidents(B)
+_N_REQ_SECS = 12
+# response sections, in wire order:
+#   keys_blob(B) key_offs(q) recs_blob(B) rec_offs(q) member(i)
+#   pred(i) kinds(B) counts(i)
+_N_RES_SECS = 8
+
+
+def _attach_shm(name: str, start_method: str):
+    """Attach the worker side of a ring without double-registering it
+    with the resource tracker.  The parent owns the unlink; under
+    spawn the child gets its OWN tracker process, which would destroy
+    the segment when the child exits, so we must untrack the attach.
+    Under fork the tracker is shared and registration is idempotent —
+    untracking there would strip the parent's entry and make its
+    eventual unlink a noisy double-unregister."""
+    from multiprocessing import shared_memory
+
+    try:
+        return shared_memory.SharedMemory(name=name, track=False)
+    except TypeError:  # < 3.13: no track kwarg — unregister by hand
+        shm = shared_memory.SharedMemory(name=name)
+        if start_method != "fork":
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(shm._name, "shared_memory")
+            except Exception:
+                pass
+        return shm
+
+
+def _worker_main(idx: int, conn, shm_name: str, start_method: str) -> None:
+    """Apply-shard worker loop: wait for a shard descriptor, point the
+    native kernel straight into the ring (zero input copies), write
+    the flat result sections back into the ring, reply with their
+    offsets. Exits on EOF/('q',) — and any uncaught error kills the
+    process, which the parent treats as a crash (respawn + in-process
+    replay)."""
+    from dgraph_tpu import native
+
+    shm = _attach_shm(shm_name, start_method)
+    buf = shm.buf
+    anchor = ctypes.c_char.from_buffer(buf)  # keeps the base mapped
+    base = ctypes.addressof(anchor)
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except EOFError:
+                break
+            if msg[0] == "q":
+                break
+            _tag, seq, n_members, n_preds, secs = msg
+            (
+                s_moffs, s_shapes, s_ents, s_pids, s_objs, s_vtypes,
+                s_voffs, s_vblob, s_ppblob, s_ppoffs, s_pflags,
+                s_pidents,
+            ) = secs
+            res = native.batch_apply_addrs(
+                base + s_moffs[0], n_members,
+                base + s_shapes[0], base + s_ents[0],
+                base + s_pids[0], base + s_objs[0],
+                base + s_vtypes[0], base + s_voffs[0],
+                base + s_vblob[0],
+                bytes(buf[s_ppblob[0]:s_ppblob[0] + s_ppblob[1]]),
+                base + s_ppoffs[0],
+                bytes(buf[s_pflags[0]:s_pflags[0] + s_pflags[1]]),
+                bytes(buf[s_pidents[0]:s_pidents[0] + s_pidents[1]]),
+                n_preds,
+            )
+            if res is None:
+                conn.send(("e", seq, "no_native"))
+                continue
+            (
+                n_pairs, keys_blob, key_offs, recs_blob, rec_offs,
+                member, pred, kinds, counts,
+            ) = res
+            # response overwrites the request region (the kernel has
+            # already read everything it needs into its outputs)
+            views = (
+                memoryview(keys_blob),
+                memoryview(key_offs).cast("B")[: 8 * (n_pairs + 1)],
+                memoryview(recs_blob),
+                memoryview(rec_offs).cast("B")[: 8 * (n_pairs + 1)],
+                memoryview(member).cast("B")[: 4 * n_pairs],
+                memoryview(pred).cast("B")[: 4 * n_pairs],
+                memoryview(kinds)[:n_pairs],
+                memoryview(counts).cast("B")[: 4 * n_pairs],
+            )
+            pos = 0
+            out_secs = []
+            fit = True
+            for mv in views:
+                pos = (pos + 7) & ~7
+                n = len(mv)
+                if pos + n > len(buf):
+                    fit = False
+                    break
+                if n:
+                    buf[pos:pos + n] = mv
+                out_secs.append((pos, n))
+                pos += n
+            if not fit:
+                conn.send(("e", seq, "ring_full"))
+                continue
+            conn.send(("r", seq, int(n_pairs), out_secs))
+    finally:
+        try:
+            del anchor
+            buf.release()
+            shm.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# parent-side pool
+# ---------------------------------------------------------------------------
+
+
+class _Worker:
+    __slots__ = ("idx", "proc", "conn", "shm", "buf")
+
+    def __init__(self, idx, proc, conn, shm):
+        self.idx = idx
+        self.proc = proc
+        self.conn = conn
+        self.shm = shm
+        self.buf = shm.buf
+
+
+class ApplyShardPool:
+    """N apply-shard worker processes, one shared-memory ring each.
+    encode(colsets) is the drop-in cross-process twin of
+    posting/colwrite._encode_colsets: same (out, side) result, or None
+    when the batch must fall back to the in-process kernel (counted
+    per reason; nothing was consumed, so the replay is exact)."""
+
+    def __init__(self, nprocs: int, ring_bytes: int):
+        import multiprocessing as mp
+
+        self._ctx = mp.get_context(
+            "fork" if "fork" in mp.get_all_start_methods() else "spawn"
+        )
+        self.nprocs = nprocs
+        self.ring_bytes = ring_bytes
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._strikes = 0
+        self.disabled: Optional[str] = None
+        self._workers: List[_Worker] = [
+            self._spawn(i) for i in range(nprocs)
+        ]
+
+    def _spawn(self, idx: int) -> _Worker:
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(
+            create=True, size=self.ring_bytes
+        )
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        proc = self._ctx.Process(
+            target=_worker_main,
+            args=(idx, child_conn, shm.name,
+                  self._ctx.get_start_method()),
+            daemon=True,
+            name=f"applyshard-{idx}",
+        )
+        proc.start()
+        child_conn.close()  # parent's copy — EOF surfaces child death
+        return _Worker(idx, proc, parent_conn, shm)
+
+    def worker_pids(self) -> List[int]:
+        return [w.proc.pid for w in self._workers]
+
+    def _respawn(self, idx: int) -> None:
+        w = self._workers[idx]
+        try:
+            w.proc.kill()
+        except Exception:
+            pass
+        w.proc.join(timeout=5)
+        try:
+            w.conn.close()
+        except Exception:
+            pass
+        try:
+            w.buf.release()
+            w.shm.close()
+            w.shm.unlink()
+        except Exception:
+            pass
+        self._workers[idx] = self._spawn(idx)
+
+    # -- wire helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _pack(buf, pos: int, mv) -> Tuple[int, int, int]:
+        """Write one section 8-aligned; returns (off, nbytes, newpos)
+        or raises IndexError past the ring end."""
+        pos = (pos + 7) & ~7
+        n = len(mv)
+        if pos + n > len(buf):
+            raise IndexError("ring_full")
+        if n:
+            buf[pos:pos + n] = mv
+        return pos, n, pos + n
+
+    def _ship(self, w: _Worker, seq: int, n_members: int,
+              n_preds: int, cols, pp) -> None:
+        """Memcpy one shard's flat columns + pred table into the
+        worker's ring and send the tiny descriptor."""
+        m_offs, shapes, entities, pids, objects, vtypes, voffs, vblob = cols
+        pp_blob, pp_offs, pflags, pidents = pp
+        buf = w.buf
+        pos = 0
+        secs = []
+        for mv in (
+            memoryview(m_offs).cast("B"),
+            memoryview(shapes),
+            memoryview(entities).cast("B"),
+            memoryview(pids).cast("B"),
+            memoryview(objects).cast("B"),
+            memoryview(vtypes),
+            memoryview(voffs).cast("B"),
+            memoryview(vblob),
+            memoryview(pp_blob),
+            memoryview(pp_offs).cast("B"),
+            memoryview(pflags),
+            memoryview(pidents),
+        ):
+            off, n, pos = self._pack(buf, pos, mv)
+            secs.append((off, n))
+        w.conn.send(("a", seq, n_members, n_preds, secs))
+
+    def _collect(self, w: _Worker, seq: int, deadline: float):
+        """One shard result off a worker's ring: (n_pairs, keys_blob,
+        key_offs, recs_blob, rec_offs, member, pred, kinds, counts).
+        Raises on timeout/crash/worker-reported error."""
+        while True:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                raise TimeoutError("timeout")
+            if not w.conn.poll(remain):
+                raise TimeoutError("timeout")
+            msg = w.conn.recv()  # EOFError here == crash
+            if msg[1] != seq:
+                continue  # stale reply from before a failed batch
+            if msg[0] == "e":
+                raise RuntimeError(msg[2])
+            _tag, _seq, n_pairs, secs = msg
+            buf = w.buf
+            out = [n_pairs]
+            for i, (off, n) in enumerate(secs):
+                view = buf[off:off + n]
+                if i in (1, 3):  # key_offs / rec_offs
+                    a = array("q")
+                    a.frombytes(view)
+                    out.append(a)
+                elif i in (4, 5, 7):  # member / pred / counts
+                    a = array("i")
+                    a.frombytes(view)
+                    out.append(a)
+                else:  # keys_blob / recs_blob / kinds
+                    out.append(bytes(view))
+            return tuple(out)
+
+    # -- the batch entry ------------------------------------------------------
+
+    def encode(self, colsets):
+        """Cross-process twin of colwrite._encode_colsets (minus the
+        metric stamps, which the caller owns): returns (out, side) or
+        None to fall back — in which case NO colset state was touched
+        and the in-process kernel replays the batch exactly."""
+        from dgraph_tpu.posting import colwrite
+
+        flat, pred_tab = colwrite.flatten_colsets(colsets)
+        m_offs = flat[0]
+        n_members = len(m_offs) - 1
+        n_rows = m_offs[-1]
+        if n_rows == 0:
+            return None
+        nshards = min(self.nprocs, len(pred_tab))
+        pp = colwrite._pred_blobs(pred_tab)
+        with self._lock:
+            if self.disabled is not None:
+                return None
+            self._seq += 1
+            seq = self._seq
+            try:
+                if nshards <= 1:
+                    shards = [flat]
+                else:
+                    shards = _partition(flat, pred_tab, nshards)
+                t0 = time.monotonic()
+                live = []  # (shard_index, worker)
+                failed = None
+                for s, cols in enumerate(shards):
+                    if cols[0][-1] == 0:
+                        continue  # every row hashed elsewhere
+                    w = self._workers[s]
+                    try:
+                        self._ship(
+                            w, seq, n_members, len(pred_tab), cols, pp
+                        )
+                    except BaseException as e:
+                        # a dead worker surfaces HERE as EPIPE on the
+                        # very next ship, not just at collect time —
+                        # respawn now or the shard stays dead and three
+                        # strikes disable the pool for one crash
+                        failed = e
+                        if not isinstance(e, IndexError):  # ring_full
+                            self._respawn(s)
+                        continue
+                    live.append((s, w))
+                deadline = time.monotonic() + (
+                    int(config.get("APPLY_PROC_TIMEOUT_MS")) / 1000.0
+                )
+                results: dict = {}
+                for s, w in live:
+                    try:
+                        results[s] = self._collect(w, seq, deadline)
+                    except BaseException as e:
+                        failed = e
+                        self._respawn(s)
+                if failed is not None:
+                    raise failed
+                METRICS.inc(
+                    "apply_shard_ipc_seconds", time.monotonic() - t0
+                )
+            except (TimeoutError, EOFError, OSError, IndexError,
+                    RuntimeError) as e:
+                reason = (
+                    "timeout" if isinstance(e, TimeoutError)
+                    else "crash" if isinstance(e, (EOFError, OSError))
+                    else "ring_full" if isinstance(e, IndexError)
+                    else str(e) if str(e) in ("ring_full", "no_native")
+                    else "error"
+                )
+                _count_fallback(reason)
+                self._strikes += 1
+                if self._strikes >= _STRIKE_LIMIT:
+                    self.disabled = reason
+                return None
+            self._strikes = 0
+            got = _merge(results, n_members, len(shards), pred_tab)
+            METRICS.inc("apply_shard_batches_total")
+            return got
+
+    def drain(self) -> None:
+        """Fence: no shard request is in flight once this returns (the
+        pool runs one batch at a time under its lock). The tablet
+        mover calls this right after GroupCommit.drain() — its delta
+        catch-up must not race a ring-resident write-set."""
+        with self._lock:
+            pass
+
+    def close(self) -> None:
+        # detach the worker list under the lock (so no encode can race
+        # a dying worker), then join OUTSIDE it — joins are blocking
+        # and must never be held against the apply path's lock
+        with self._lock:
+            workers, self._workers = self._workers, []
+            if self.disabled is None:
+                self.disabled = "closed"
+        for w in workers:
+            try:
+                w.conn.send(("q",))
+            except Exception:
+                pass
+        for w in workers:
+            w.proc.join(timeout=2)
+            if w.proc.exitcode is None:
+                try:
+                    w.proc.kill()
+                    w.proc.join(timeout=5)
+                except Exception:
+                    pass
+            try:
+                w.conn.close()
+            except Exception:
+                pass
+            try:
+                w.buf.release()
+                w.shm.close()
+                w.shm.unlink()
+            except Exception:
+                pass
+
+
+def _partition(flat, pred_tab, nshards: int):
+    """Split the flat batch columns into nshards disjoint column sets
+    by (ns, attr) — shard_assign is the SAME round-robin-over-
+    first-appearance rule _apply_edges_sharded uses, and the pred
+    table is first-appearance ordered, so the partitions match the
+    thread-sharded residual path's exactly. Every shard keeps the full
+    member structure (n_members+1 m_offs entries, empty spans where a
+    member had no rows in the shard) so result member indices stay
+    global."""
+    from dgraph_tpu.posting.mutation import shard_assign
+
+    shard_of = shard_assign(len(pred_tab), nshards)
+    m_offs, shapes, entities, pids, objects, vtypes, voffs, vblob = flat
+    n_members = len(m_offs) - 1
+    stag = [
+        (
+            array("q", (0,)),  # m_offs
+            bytearray(),       # shapes
+            array("Q"),        # entities
+            array("i"),        # pids
+            array("Q"),        # objects
+            bytearray(),       # vtypes
+            array("q", (0,)),  # voffs
+            bytearray(),       # vblob
+        )
+        for _ in range(nshards)
+    ]
+    for mi in range(n_members):
+        for j in range(m_offs[mi], m_offs[mi + 1]):
+            sh = stag[shard_of[pids[j]]]
+            sh[1].append(shapes[j])
+            sh[2].append(entities[j])
+            sh[3].append(pids[j])
+            sh[4].append(objects[j])
+            sh[5].append(vtypes[j])
+            sh[7].extend(vblob[voffs[j]:voffs[j + 1]])
+            sh[6].append(len(sh[7]))
+        for sh in stag:
+            sh[0].append(len(sh[1]))
+    return stag
+
+
+def _merge(results: dict, n_members: int, nshards: int, pred_tab):
+    """Deterministic shard-index-order merge back into the
+    _encode_colsets result shape: per-member [(key, record, attr)]
+    pairs plus (mkeys, stats_rows, nposts) side info. Each shard's
+    pairs are member-major (the kernel walks m_offs in order), so one
+    cursor per shard suffices, and per-key version order matches the
+    single-kernel path (keys never cross shards)."""
+    kidx = keys.KIND_INDEX
+    attrs = [p.attr for p in pred_tab]
+    plens = [len(p.prefix) + 1 for p in pred_tab]
+    cur = [0] * nshards
+    out = []
+    side = []
+    for mi in range(n_members):
+        pairs = []
+        pappend = pairs.append
+        mkeys = []
+        kappend = mkeys.append
+        stats_rows = []
+        nposts = 0
+        for s in range(nshards):
+            r = results.get(s)
+            if r is None:
+                continue
+            (
+                n_pairs, kb, ko, rb, ro, mem, prd, knd, cnt,
+            ) = r
+            i = cur[s]
+            while i < n_pairs and mem[i] == mi:
+                key = kb[ko[i]:ko[i + 1]]
+                pid = prd[i]
+                pappend((key, rb[ro[i]:ro[i + 1]], attrs[pid]))
+                kappend(key)
+                if knd[i] == kidx:
+                    stats_rows.append(
+                        (attrs[pid], key[plens[pid]:], cnt[i])
+                    )
+                nposts += cnt[i]
+                i += 1
+            cur[s] = i
+        out.append(pairs)
+        side.append((mkeys, stats_rows, nposts))
+    return out, side
+
+
+# ---------------------------------------------------------------------------
+# module singleton (shared by every engine in the process — the pool is
+# a pure function of columns, not of engine state)
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_POOL: Optional[ApplyShardPool] = None
+_POOL_KEY = None
+
+
+def maybe_pool() -> Optional[ApplyShardPool]:
+    """The process-wide pool per the current knobs, or None when the
+    plane is off (APPLY_PROCS=0 / auto on a 1-core box), native is
+    unavailable, or the pool sticky-disabled itself. Knob changes
+    rebuild the pool and clear stickiness (the tests' arm flips)."""
+    from dgraph_tpu import native
+
+    global _POOL, _POOL_KEY
+    n = resolve_procs()
+    if n <= 0 or not native.NATIVE_AVAILABLE:
+        if _POOL is not None:
+            shutdown()
+        return None
+    ring = int(config.get("APPLY_RING_BYTES"))
+    key = (n, ring)
+    with _LOCK:
+        if _POOL is not None and _POOL_KEY != key:
+            _POOL.close()
+            _POOL = None
+        if _POOL is None:
+            _POOL_KEY = key
+            try:
+                _POOL = ApplyShardPool(n, ring)
+            except Exception:
+                _count_fallback("spawn")
+                return None
+        if _POOL.disabled is not None:
+            return None
+        return _POOL
+
+
+def drain() -> None:
+    """Ring fence for the tablet mover: returns only when no shard
+    request is in flight (see ApplyShardPool.drain)."""
+    p = _POOL
+    if p is not None:
+        p.drain()
+
+
+def shutdown() -> None:
+    """Reap the workers and unlink every ring segment. Engines call
+    this from close(); a later maybe_pool() lazily rebuilds."""
+    global _POOL, _POOL_KEY
+    with _LOCK:
+        if _POOL is not None:
+            _POOL.close()
+        _POOL = None
+        _POOL_KEY = None
+
+
+import atexit  # noqa: E402  (registration wants the defs above)
+
+atexit.register(shutdown)

@@ -138,9 +138,21 @@ def chunk_group_writes(plans, frame_budget: int):
     return out
 
 
+_BYPASS_WIDTH = 1.05  # EWMA batch width below which cv handoffs lose
+_EWMA_ALPHA = 0.2
+
+
 class GroupCommit:
-    def __init__(self, propose_fn: Callable[[List[Member]], Optional[Callable[[], None]]]):
+    def __init__(
+        self,
+        propose_fn: Callable[[List[Member]], Optional[Callable[[], None]]],
+        serial_fn: Optional[Callable] = None,
+    ):
         self._propose_fn = propose_fn
+        # the engine's serial per-txn commit (its GROUP_COMMIT=0
+        # semantics): the adaptive bypass target. None disables the
+        # bypass for engines that haven't wired one.
+        self._serial_fn = serial_fn
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: deque = deque()
@@ -148,6 +160,8 @@ class GroupCommit:
         self._next_ticket = 0  # propose-phase order == commit-ts order
         self._proposed = 0  # propose phases whose proposals are dispatched
         self._barrier_done = 0  # barriers completed (FIFO)
+        self._width_ewma = 1.0  # realized batch width (coalesced only)
+        self._bypassing = 0  # serial-path commits currently in flight
 
     def mark_proposed(self) -> None:
         """Called by a cluster engine's propose_fn WHILE STILL HOLDING
@@ -171,7 +185,48 @@ class GroupCommit:
         """Commit through the coalescer: returns the member's commit_ts
         or raises its per-member error (conflict abort, fence bounce,
         proposal failure). Blocks until this txn's apply barrier has
-        completed — same post-conditions as the serial path."""
+        completed — same post-conditions as the serial path.
+
+        Adaptive bypass (PR 16 capture: at realized batch width ~1.05
+        the coalescer's cv handoffs measurably LOSE to serial
+        commits): when the width EWMA says no batchmate ever waits and
+        the coalescer is completely idle — no leader, empty queue, no
+        pipelined barrier outstanding, no other bypass in flight — the
+        commit runs the engine's serial path directly. Any form of
+        concurrency fails the idle check, so the first simultaneous
+        committer re-engages coalescing and the EWMA (fed only by
+        coalesced batches) re-opens the bypass when traffic thins
+        again. Idle-pipeline precondition keeps the ordering story
+        trivial: no batch barrier is outstanding, so the serial path's
+        watermark/applied advance cannot pass an unapplied batch."""
+        if (
+            self._serial_fn is not None
+            and self._width_ewma <= _BYPASS_WIDTH
+            and bool(config.get("GROUP_COMMIT_BYPASS"))
+        ):
+            took = False
+            with self._cv:
+                if (
+                    not self._leader_busy
+                    and not self._queue
+                    and self._bypassing == 0
+                    and self._next_ticket == self._barrier_done
+                ):
+                    self._bypassing = 1
+                    took = True
+            if took:
+                try:
+                    METRICS.inc("group_commit_bypass_total")
+                    # a bypassed commit is still a txn admitted through
+                    # the group-commit front — keep the txn accounting
+                    # complete (batch count + width histogram stay
+                    # coalesce-only by design)
+                    METRICS.inc("group_commit_txns_total")
+                    return self._serial_fn(txn)
+                finally:
+                    with self._cv:
+                        self._bypassing = 0
+                        self._cv.notify_all()
         m = Member(txn)
         with self._cv:
             self._queue.append(m)
@@ -230,6 +285,13 @@ class GroupCommit:
                 while self._queue and len(batch) < cap:
                     batch.append(self._queue.popleft())
         with self._cv:
+            # a bypassed commit is effectively a width-1 batch already
+            # holding the serial path: it must lease its ts AND publish
+            # before this batch's propose phase leases a later ts, or
+            # the CDC stream / watermark could observe commit
+            # timestamps out of order
+            while self._bypassing:
+                self._cv.wait(timeout=0.5)
             ticket = self._next_ticket
             self._next_ticket += 1
             METRICS.set_gauge(
@@ -250,6 +312,12 @@ class GroupCommit:
                     self._proposed = ticket + 1
                 self._leader_busy = False
                 self._cv.notify_all()
+        # width EWMA feeds the adaptive bypass: only coalesced batches
+        # count (bypass commits are width-1 by construction and would
+        # pin the estimate at 1 forever)
+        self._width_ewma += _EWMA_ALPHA * (
+            len(batch) - self._width_ewma
+        )
         METRICS.inc("group_commit_total")
         METRICS.inc("group_commit_txns_total", len(batch))
         METRICS.observe(

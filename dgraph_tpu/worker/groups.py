@@ -666,6 +666,10 @@ class DistributedCluster:
             self._rebalance_thread.join(timeout=15)
         self._stop = True
         self._pump_thread.join(timeout=2)
+        # reap the apply-shard worker processes and unlink their rings
+        from dgraph_tpu.worker import applyshard
+
+        applyshard.shutdown()
         if self.intents is not None:
             self.intents.close()
         if self.zero.journal is not None:

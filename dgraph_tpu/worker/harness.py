@@ -313,6 +313,12 @@ class ProcCluster:
             self._rebalance_thread.join(timeout=15)
         if self._commit_prop_pool is not None:
             self._commit_prop_pool.shutdown(wait=False)
+        # reap the apply-shard worker processes and unlink their rings
+        # (no commit can be in flight here — callers stop traffic
+        # before close; drain() inside shutdown is the backstop)
+        from dgraph_tpu.worker import applyshard
+
+        applyshard.shutdown()
         for nid in list(self.procs):
             self.kill(nid)
         self.pool.close()
@@ -388,12 +394,16 @@ class ProcCluster:
                             )
 
                             gc = self._group_commit = GroupCommit(
-                                self._gc_propose
+                                self._gc_propose,
+                                serial_fn=self._gc_serial,
                             )
                 with METRICS.timer("commit_latency_seconds"):
                     cts = gc.commit(txn)
-                self._feed_stats(txn.cache.deltas)
-                colwrite.feed_col_stats(self.stats, txn)
+                if not getattr(txn, "gc_bypassed", False):
+                    # the bypass ran the serial path, which feeds the
+                    # stats inline
+                    self._feed_stats(txn.cache.deltas)
+                    colwrite.feed_col_stats(self.stats, txn)
             # counted for BOTH arms (only on success — the metric is
             # postings WRITTEN): the A/B escape hatch must not turn
             # the edge-throughput denominator dark; recounted after the
@@ -408,13 +418,25 @@ class ProcCluster:
         finally:
             self.serving.release_write(ticket)
 
-    def _commit_serial(self, txn: Txn) -> int:
+    def _gc_serial(self, txn: Txn) -> int:
+        """Adaptive group-commit bypass target (worker/groupcommit.py):
+        the serial path minus its own latency timer (gc.commit's
+        caller already runs one); the mark tells _commit the stats
+        were fed inline."""
+        txn.gc_bypassed = True
+        return self._commit_serial(txn, timed=False)
+
+    def _commit_serial(self, txn: Txn, timed: bool = True) -> int:
+        import contextlib
+
         # the mutation entry point stamps ONE deadline that flows through
         # zero.commit and every group proposal beneath it
         budget = float(config.get("COMMIT_DEADLINE_S"))
         with deadline_scope(current_deadline() or Deadline.after(budget)):
-            with TRACER.span("commit"), METRICS.timer(
-                "commit_latency_seconds"
+            with TRACER.span("commit"), (
+                METRICS.timer("commit_latency_seconds")
+                if timed
+                else contextlib.nullcontext()
             ):
                 with self._commit_lock:
                     cts = self._commit_locked(txn)

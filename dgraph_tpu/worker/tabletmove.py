@@ -574,6 +574,14 @@ class TabletMover:
                     gc = getattr(self.c, "_group_commit", None)
                     if gc is not None:
                         gc.drain()
+                    # and the apply-shard rings: a shard request runs
+                    # inside the propose phase (commit lock held), but
+                    # the explicit fence makes "no write-set is ring-
+                    # resident when the delta catch-up starts" a
+                    # checked invariant, not an inference
+                    from dgraph_tpu.worker import applyshard
+
+                    applyshard.drain()
                     with METRICS.timer("tablet_move_fence_seconds"):
                         zero.move_fence(pred)
                         faults.syncpoint("move.fence", pred)

@@ -90,6 +90,33 @@ _define(
     "default; the in-flight gauge is tracked regardless.",
 )
 _define(
+    "APPLY_PROCS", "str", "auto",
+    "Multi-process apply shards behind the raft apply loop "
+    "(worker/applyshard.py): the group-commit columnar write-set is "
+    "partitioned by (namespace, predicate) and shipped over per-worker "
+    "shared-memory rings to this many apply-shard worker processes, "
+    "whose batch_apply kernels run outside the serving interpreter's "
+    "GIL. 'auto' resolves to cpu_count-1; 0 is the in-process escape "
+    "hatch (the kernel runs on the committing thread, exactly the "
+    "pre-proc path).",
+)
+_define(
+    "APPLY_PROC_TIMEOUT_MS", "int", 5000,
+    "Per-batch deadline (ms) for an apply-shard worker process to "
+    "return its encoded shard (worker/applyshard.py): a worker that "
+    "blows it is killed and respawned, and the batch replays through "
+    "the in-process kernel with exact serial semantics "
+    "(apply_shard_fallback_total{reason=\"timeout\"}).",
+)
+_define(
+    "APPLY_RING_BYTES", "int", 16 << 20,
+    "Size of each apply-shard worker's shared-memory ring "
+    "(worker/applyshard.py): one flat request/response region the "
+    "columnar batch columns are memcpy'd into (no pickling of edges). "
+    "A batch whose columns or encoded output exceed it falls back to "
+    "the in-process kernel (reason=\"ring_full\").",
+)
+_define(
     "APPLY_SHARDS", "int", 0,
     "Predicate-sharded residual mutation apply (posting/mutation.py "
     "_apply_edges_sharded): edges that escape the columnar kernel are "
@@ -239,6 +266,18 @@ _define(
     "exchange and ONE bounded raft proposal per owning group, with the "
     "snapshot watermark advanced in commit-ts order. 0 restores the "
     "serial per-txn commit path byte-for-byte (the A/B escape hatch).",
+)
+_define(
+    "GROUP_COMMIT_BYPASS", "bool", True,
+    "Adaptive group-commit bypass (worker/groupcommit.py): when the "
+    "realized batch-width EWMA is ~1 (no batchmate is ever waiting) a "
+    "committer that finds the coalescer completely idle commits "
+    "straight through the engine's serial path, skipping the "
+    "queue/ticket/condvar handoffs that measurably lose to serial at "
+    "width ~1.05. Concurrency re-engages coalescing automatically "
+    "(an arrival during a bypass or a busy leader always queues). 0 "
+    "forces every commit through the coalescer (the A/B escape "
+    "hatch).",
 )
 _define(
     "GROUP_COMMIT_MAX_TXNS", "int", 64,

@@ -386,3 +386,154 @@ def test_chaos_bank_sharded_apply():
         _unset_knobs("APPLY_SHARDS", "APPLY_SHARD_MIN_EDGES", "EXEC_WORKERS")
         if c is not None:
             c.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-process apply plane (worker/applyshard)
+# ---------------------------------------------------------------------------
+
+
+@requires_native
+@pytest.mark.parametrize("shards", [1, 2, 8])
+@pytest.mark.parametrize("procs", [0, 1, 2])
+def test_proc_shard_byte_equality(procs, shards):
+    """The multi-process apply plane is a pure transport substitution:
+    for every APPLY_PROCS width the full KV dump must match the serial
+    per-edge arm byte-for-byte, the shard-process kernel counter must
+    move iff procs > 0 (and the in-process path must run iff procs == 0),
+    and no batch may fall back. APPLY_SHARDS varies independently so the
+    thread-sharded residual path and the proc plane are exercised in
+    every combination."""
+    from dgraph_tpu.worker import applyshard
+
+    before = dict(METRICS.snapshot())
+    _set_knobs(
+        BATCH_APPLY=1,
+        APPLY_PROCS=procs,
+        APPLY_SHARDS=shards,
+        APPLY_SHARD_MIN_EDGES=1,
+        EXEC_WORKERS=4,
+    )
+    try:
+        native_dump = _run_corpus(7)
+        mid = dict(METRICS.snapshot())
+        config.set_env("BATCH_APPLY", 0)
+        serial_dump = _run_corpus(7)
+    finally:
+        _unset_knobs(
+            "BATCH_APPLY",
+            "APPLY_PROCS",
+            "APPLY_SHARDS",
+            "APPLY_SHARD_MIN_EDGES",
+            "EXEC_WORKERS",
+        )
+        applyshard.shutdown()
+    diff = {
+        k
+        for k in native_dump.keys() | serial_dump.keys()
+        if native_dump.get(k) != serial_dump.get(k)
+    }
+    assert not diff, f"{len(diff)} divergent keys, e.g. {sorted(diff)[:3]}"
+
+    def delta(name):
+        return mid.get(name, 0) - before.get(name, 0)
+
+    assert delta("mutation_batch_apply_total") > 0, "kernel never ran"
+    if procs > 0:
+        assert delta("apply_shard_batches_total") > 0, (
+            "proc plane never took a batch"
+        )
+        assert delta("apply_shard_fallback_total") == 0, (
+            "proc plane fell back during a healthy run"
+        )
+    else:
+        assert delta("apply_shard_batches_total") == 0, (
+            "APPLY_PROCS=0 escape hatch still dispatched to processes"
+        )
+
+
+@requires_native
+@pytest.mark.chaos
+def test_chaos_proc_shard_sigkill_bank():
+    """SIGKILL an apply-shard worker between bank transfers: the dead
+    shard surfaces as a crash fallback, the batch replays through the
+    serial in-process kernel (so the ledger stays exact — 0 lost, 0
+    duplicated edges), the worker is respawned, and later batches flow
+    through the pool again."""
+    import os
+    import signal
+    import time
+
+    from dgraph_tpu.worker import applyshard
+
+    _set_knobs(
+        BATCH_APPLY=1,
+        APPLY_PROCS=2,
+        APPLY_SHARD_MIN_EDGES=1,
+    )
+    before = dict(METRICS.snapshot())
+    try:
+        s = _bank_server()
+        ledger = {i: START_BAL for i in range(1, N_ACCOUNTS + 1)}
+        rng = np.random.default_rng(17)
+
+        def transfer(step):
+            frm, to = (
+                int(x) + 1
+                for x in rng.choice(N_ACCOUNTS, 2, replace=False)
+            )
+            amt = int(rng.integers(1, 15))
+            t = s.new_txn()
+            t.mutate_rdf(
+                set_rdf=(
+                    f'<0x{frm:x}> <bal> "{ledger[frm] - amt}"'
+                    f"^^<xs:int> .\n"
+                    f'<0x{frm:x}> <last> "s{step}" .\n'
+                    f'<0x{to:x}> <bal> "{ledger[to] + amt}"'
+                    f"^^<xs:int> .\n"
+                    f'<0x{to:x}> <last> "s{step}" .'
+                ),
+                commit_now=True,
+            )
+            ledger[frm] -= amt
+            ledger[to] += amt
+
+        for step in range(6):
+            transfer(step)
+        pool = applyshard.maybe_pool()
+        assert pool is not None, "pool never came up"
+        victim = pool.worker_pids()[0]
+        os.kill(victim, signal.SIGKILL)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                os.kill(victim, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.01)
+        for step in range(6, 12):
+            transfer(step)
+
+        out = s.query("{ q(func: has(bal)) { uid bal } }")
+        bals = {int(x["uid"], 16): x["bal"] for x in out["data"]["q"]}
+        assert sum(bals.values()) == N_ACCOUNTS * START_BAL, bals
+        assert bals == ledger, (bals, ledger)
+
+        after = dict(METRICS.snapshot())
+
+        def delta(name):
+            return after.get(name, 0) - before.get(name, 0)
+
+        assert delta("apply_shard_fallback_total") >= 1, (
+            "killed worker never surfaced as a fallback"
+        )
+        assert delta('apply_shard_fallback_total{reason="crash"}') >= 1
+        # respawned: the pool is healthy again and took post-kill batches
+        pool = applyshard.maybe_pool()
+        assert pool is not None and pool.disabled is None
+        assert victim not in pool.worker_pids()
+        for pid in pool.worker_pids():
+            os.kill(pid, 0)  # raises if the respawn died
+    finally:
+        _unset_knobs("BATCH_APPLY", "APPLY_PROCS", "APPLY_SHARD_MIN_EDGES")
+        applyshard.shutdown()

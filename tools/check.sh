@@ -36,11 +36,14 @@ if [[ "${1:-}" == "--plan-sanity" ]]; then
     exit 0
 fi
 
-echo "== dgraph-tpu lint =="
-python -m dgraph_tpu.cli lint
-
+# analyzers FIRST: a registry violation (undeclared metric/config, new
+# allowlist entry) must fail in seconds, before lint and long before the
+# smoke subset or the ~5s sanity gates get a chance to run
 echo "== analyzer + config-registry self-tests =="
 python -m pytest tests/test_static_analysis.py -q -p no:cacheprovider
+
+echo "== dgraph-tpu lint =="
+python -m dgraph_tpu.cli lint
 
 if [[ "${1:-}" == "--full" ]]; then
     echo "== full tier-1 suite =="
@@ -66,6 +69,10 @@ else
         tests/test_planner.py \
         tests/test_ops_plane.py \
         -q -p no:cacheprovider
+
+    echo "== proc-shard chaos smoke: worker SIGKILL + respawn, ledger exact =="
+    python -m pytest tests/test_batch_apply.py -q -m chaos \
+        -p no:cacheprovider
 
     echo "== explain sanity (~5s) =="
     python bench.py --explain-sanity
