@@ -24,6 +24,7 @@ from dgraph_tpu.query import streamjson
 from dgraph_tpu.query.functions import QueryError
 from dgraph_tpu.api.server import Server, TxnHandle
 from dgraph_tpu.serving import TooManyRequestsError
+from dgraph_tpu.worker.remote import RetryBudgetExhausted
 from dgraph_tpu.worker.tabletmove import TabletFencedError
 from dgraph_tpu.zero.zero import TxnConflictError
 
@@ -490,6 +491,23 @@ class _Handler(BaseHTTPRequestHandler):
                             "message": str(e),
                             "extensions": {
                                 "code": TabletFencedError.code,
+                                "retryable": True,
+                            },
+                        }
+                    ]
+                },
+                503,
+            )
+        except RetryBudgetExhausted as e:
+            # the query's retry/hedge budget ran dry (brownout): shed
+            # retryable instead of letting clients amplify the storm
+            self._reply(
+                {
+                    "errors": [
+                        {
+                            "message": str(e),
+                            "extensions": {
+                                "code": RetryBudgetExhausted.code,
                                 "retryable": True,
                             },
                         }

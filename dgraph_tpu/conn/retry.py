@@ -96,11 +96,47 @@ class RetryPolicy:
         return d
 
 
+class RetryBudget:
+    """A shared token budget for the retries AND hedges of ONE logical
+    operation (a query, a soak step). Every layer that would re-issue an
+    RPC — the outer rotation loop in RemoteGroup.read, a hedge fire, a
+    retrying_call attempt — draws from the same pool, so a brownout
+    (every replica slow, every call timing out) costs at most
+    `tokens` extra RPCs instead of multiplying per layer into a retry
+    storm. The FIRST attempt of anything is free; only re-issues spend.
+
+    Thread-safe: hedge workers and the calling thread spend
+    concurrently."""
+
+    __slots__ = ("capacity", "_left", "_lock")
+
+    def __init__(self, tokens: int):
+        self.capacity = int(tokens)
+        self._left = int(tokens)
+        self._lock = threading.Lock()
+
+    def try_spend(self, n: int = 1) -> bool:
+        """Take `n` tokens; False (and takes nothing) when fewer remain."""
+        with self._lock:
+            if self._left < n:
+                return False
+            self._left -= n
+            return True
+
+    def remaining(self) -> int:
+        with self._lock:
+            return self._left
+
+    def __repr__(self):
+        return f"RetryBudget({self.remaining()}/{self.capacity})"
+
+
 def retrying_call(
     fn,
     policy: Optional[RetryPolicy] = None,
     deadline: Optional[Deadline] = None,
     retryable: tuple = (),
+    budget: Optional[RetryBudget] = None,
 ):
     """Run `fn()` with backoff-retry on `retryable` exception types —
     the client-side contract of the serving front's admission gate
@@ -108,7 +144,10 @@ def retrying_call(
     Also retries any exception whose `retryable` attribute is true.
     Always bounded: the default policy caps attempts, and a policy with
     max_attempts=0 MUST come with a deadline (an unbounded retry loop
-    against a persistently-shedding server would never return)."""
+    against a persistently-shedding server would never return). With a
+    `budget`, each retry additionally spends one token from the shared
+    per-operation RetryBudget and the last exception re-raises when the
+    pool is dry — the first attempt is always free."""
     policy = policy or RetryPolicy(base=0.005, cap=0.25, max_attempts=8)
     if not policy.max_attempts and deadline is None:
         raise ValueError(
@@ -127,6 +166,8 @@ def retrying_call(
             if not is_retryable or policy.exhausted(attempt) or (
                 deadline is not None and deadline.expired()
             ):
+                raise
+            if budget is not None and not budget.try_spend():
                 raise
             policy.sleep(attempt, deadline)
 

@@ -35,7 +35,11 @@ amortizable; this package amortizes it *across* queries:
 
   ServingFront   — the per-engine bundle of the four, constructed by
                    api/server.Server and worker/harness.ProcCluster
-                   (serving/front.py).
+                   (serving/front.py). Also mints the per-query
+                   ReadContext (read_context()) for the resilient read
+                   plane: one shared retry/hedge RetryBudget per query
+                   plus the leaderless-serving notes that become the
+                   `degraded: leaderless` extension (worker/remote.py).
 """
 
 from dgraph_tpu.serving.admission import (  # noqa: F401

@@ -158,6 +158,22 @@ class ServingFront:
 
         return max(0.01, float(config.get("SLOW_QUERY_MS")) / 1e3)
 
+    # -- read-plane context ---------------------------------------------------
+
+    def read_context(self):
+        """Fresh per-query ReadContext for the resilient read plane:
+        ONE RetryBudget (DGRAPH_TPU_READ_RETRY_BUDGET tokens) that every
+        group-read retry and hedge of the query draws from, plus the
+        leaderless-serving notes the entry point surfaces as the
+        `degraded: leaderless` extension. Budget 0 disables budgeting
+        (never exhausted)."""
+        from dgraph_tpu.conn.retry import RetryBudget
+        from dgraph_tpu.worker.remote import ReadContext
+        from dgraph_tpu.x import config
+
+        n = int(config.get("READ_RETRY_BUDGET"))
+        return ReadContext(budget=RetryBudget(n) if n > 0 else None)
+
     # -- micro-batcher --------------------------------------------------------
 
     def batcher_for(self, cache) -> Optional[MicroBatcher]:

@@ -1222,6 +1222,9 @@ _PROFILE_EVENT_KEYS = (
     "degraded_group_reads_total", "group_unavailable_failfast_total",
     "hedge_fired_total", "faults_injected_total", "idem_hits_total",
     "circuit_failfast_total", "setop_pairs_total", "setop_packed_total",
+    "follower_reads_total", "leaderless_reads_total",
+    "read_breaker_open_total", "read_retry_budget_exhausted_total",
+    "hedge_skipped_saturated_total",
 )
 
 
@@ -1875,8 +1878,29 @@ declare_metric(
     "Group reads refused fast because every replica circuit was open.",
 )
 declare_metric(
+    "counter", "follower_reads_total",
+    "Group reads served by a replica other than the known leader under "
+    "the watermark-verification rule (worker/remote.py follower "
+    "routing + worker/groups.py read_replica): the serving replica's "
+    "applied index covered the group's read floor, so the bytes are "
+    "provably identical to a leader-served read at the same ts.",
+)
+declare_metric(
+    "counter", "follower_read_stale_skips_total",
+    "Follower candidates the picker skipped because their cached "
+    "applied index was stale/unknown or below the group's read floor "
+    "(worker/replicapick.py) — stale-or-unknown never serves.",
+)
+declare_metric(
     "counter", "hedge_fired_total",
     "Hedged reads that raced a second replica.",
+)
+declare_metric(
+    "counter", "hedge_skipped_saturated_total",
+    "Hedges skipped because all shared hedge-pool workers were busy "
+    "(worker/remote.py): a queued hedge would fire after its own "
+    "deadline and only waste a replica read, so saturation degrades to "
+    "the primary (or a sequential rotation on the calling thread).",
 )
 declare_metric(
     "counter", "hedge_losses_joined",
@@ -2042,6 +2066,35 @@ declare_metric(
     "counter", "plan_cache_miss_total",
     "Plan-cache lookups that had to parse (new shape, new literal "
     "binding, epoch-invalidated entry, or cache disabled).",
+)
+declare_metric(
+    "counter", "leaderless_reads_total",
+    "Group reads served while the group had NO known leader: a "
+    "watermark-verified follower answered anyway (worker/remote.py), "
+    "surfaced to clients as the `degraded: leaderless` extension.",
+)
+declare_metric(
+    "counter", "read_breaker_open_total",
+    "Read-plane circuit breakers tripped OPEN: a replica hit "
+    "DGRAPH_TPU_READ_BREAKER_ERRORS consecutive read failures and is "
+    "skipped until a half-open probe succeeds (worker/replicapick.py).",
+)
+declare_metric(
+    "counter", "read_breaker_close_total",
+    "Read-plane breakers closed again: a half-open probe read "
+    "succeeded and the replica rejoined the rotation.",
+)
+declare_metric(
+    "counter", "read_breaker_probe_total",
+    "Half-open probe reads admitted through an OPEN read-plane breaker "
+    "(at most ~one per jittered DGRAPH_TPU_READ_BREAKER_PROBE_S window).",
+)
+declare_metric(
+    "counter", "read_retry_budget_exhausted_total",
+    "Reads refused because the query's shared retry/hedge RetryBudget "
+    "ran dry (DGRAPH_TPU_READ_RETRY_BUDGET tokens per query) — "
+    "surfaced as a retryable 503 so clients back off instead of the "
+    "cluster retry-storming itself (conn/retry.py, worker/remote.py).",
 )
 declare_metric(
     "counter", "result_cache_hit_total",

@@ -31,8 +31,9 @@ from dgraph_tpu.posting.lists import LocalCache, Txn
 from dgraph_tpu.raft.raft import InProcNetwork, RaftNode
 from dgraph_tpu.schema.schema import State, parse_schema
 from dgraph_tpu.storage.kv import KV, MemKV
+from dgraph_tpu.utils.observe import METRICS
 from dgraph_tpu.worker.tabletmove import AppendLog
-from dgraph_tpu.x import keys
+from dgraph_tpu.x import config, keys
 from dgraph_tpu.zero.zero import TxnConflictError, ZeroLite
 
 
@@ -318,6 +319,12 @@ class AlphaGroup:
             for nid in node_ids
         ]
 
+        # read floor (same rule as RemoteGroup): the max raft index any
+        # completed proposal waited out, recorded before the snapshot
+        # watermark advances — a replica with applied_index >= floor
+        # provably serves the same bytes at the watermark
+        self.read_floor = 0
+
     def leader(self) -> Optional[AlphaNode]:
         # a downed node may still believe it is leader — skip it, and
         # prefer the highest term among live claimants (stale leaders
@@ -331,9 +338,35 @@ class AlphaGroup:
             return None
         return max(live, key=lambda n: n.raft.term)
 
+    def note_floor(self, idx: int):
+        if idx > self.read_floor:
+            self.read_floor = idx
+
     def any_replica(self) -> AlphaNode:
         live = [n for n in self.nodes if n.id not in self.net.down]
         return self.leader() or (live[0] if live else self.nodes[0])
+
+    def read_replica(self) -> AlphaNode:
+        """Watermark-verified read pick: the leader when one is live;
+        otherwise the most-applied live replica whose applied index
+        covers the read floor (follower_reads_total — byte-identical at
+        the watermark by the PR 11 rule). A leaderless group with no
+        verified replica falls back to the most-applied live one (old
+        any_replica behavior, counted leaderless_reads_total) rather
+        than failing the read."""
+        lead = self.leader()
+        if lead is not None:
+            return lead
+        live = [n for n in self.nodes if n.id not in self.net.down]
+        if not live:
+            return self.nodes[0]
+        best = max(live, key=lambda n: n.applied_index)
+        if bool(config.get("FOLLOWER_READS")) and (
+            best.applied_index >= self.read_floor
+        ):
+            METRICS.inc("follower_reads_total")
+        METRICS.inc("leaderless_reads_total")
+        return best
 
 
 class RoutingKV(KV):
@@ -348,7 +381,7 @@ class RoutingKV(KV):
         gid = self.cluster.zero.belongs_to(pk.attr)
         if gid is None:
             return None
-        return self.cluster.groups[gid].any_replica().kv
+        return self.cluster.groups[gid].read_replica().kv
 
     def get(self, key, read_ts):
         kv = self._kv_for(key)
@@ -364,20 +397,20 @@ class RoutingKV(KV):
             gid = self.cluster.zero.belongs_to(attr)
             if gid is None:
                 return iter(())
-            return self.cluster.groups[gid].any_replica().kv.iterate(
+            return self.cluster.groups[gid].read_replica().kv.iterate(
                 prefix, read_ts
             )
 
         def _all():
             for g in self.cluster.groups.values():
-                yield from g.any_replica().kv.iterate(prefix, read_ts)
+                yield from g.read_replica().kv.iterate(prefix, read_ts)
 
         return _all()
 
     def iterate_versions(self, prefix, read_ts):
         def _all():
             for g in self.cluster.groups.values():
-                yield from g.any_replica().kv.iterate_versions(prefix, read_ts)
+                yield from g.read_replica().kv.iterate_versions(prefix, read_ts)
 
         return _all()
 
@@ -995,6 +1028,10 @@ class DistributedCluster:
                 target = leader.raft.last_index()
                 while time.time() < deadline:
                     if leader.applied_index >= target:
+                        # floor BEFORE the watermark can advance: any
+                        # replica applied past `target` now serves this
+                        # write at any ts the caller publishes next
+                        group.note_floor(target)
                         return
                     apply_poll.sleep(1)
                 break

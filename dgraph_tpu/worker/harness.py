@@ -293,6 +293,7 @@ class ProcCluster:
                 for a in g.addrs:
                     try:
                         h = self.pool.call(a, "health", timeout=1.0)
+                        g._note_health(a, h)  # warm the replica picker
                         if h.is_leader:
                             g._leader = tuple(a)
                             g._leader_at = time.time()
@@ -354,7 +355,11 @@ class ProcCluster:
         )
 
     def read_kv(self, partial_ok: bool = False):
-        kv = RemoteKV(self, partial_ok=partial_ok)
+        # one ReadContext per logical read operation: every group this
+        # KV fans out to shares its retry/hedge budget, and leaderless
+        # serving is recorded here for the response extensions
+        kv = RemoteKV(self, partial_ok=partial_ok,
+                      ctx=self.serving.read_context())
         # stable identity for the micro-batcher: a fresh RemoteKV is
         # built per query, but any two over this cluster (same
         # partial_ok) read identically at equal snapshots — without
@@ -1060,6 +1065,14 @@ class ProcCluster:
                 ext["degraded"] = True
                 ext["partial"] = True
                 ext["unreachable_groups"] = sorted(kv.degraded_groups)
+            elif kv.ctx is not None and kv.ctx.leaderless_gids:
+                # served COMPLETE and byte-identical (every read came
+                # from a watermark-verified replica) but one or more
+                # groups had no leader — freshness is bounded by the
+                # snapshot watermark, which cannot advance while the
+                # group is leaderless. NOT partial: the data is whole.
+                ext["degraded"] = "leaderless"
+                ext["leaderless_groups"] = sorted(kv.ctx.leaderless_gids)
             slow = observe.maybe_log_slow(
                 "query", q, (t_done - t_start) * 1e3, root,
                 extra={"degraded": sorted(kv.degraded_groups)}
@@ -1070,6 +1083,9 @@ class ProcCluster:
                 rc_key is not None
                 and completed
                 and not kv.degraded_groups  # never cache a partial view
+                # leaderless-served results are byte-identical but the
+                # window is short — stay conservative, don't cache
+                and not (kv.ctx is not None and kv.ctx.leaderless_gids)
             ):
                 raw = getattr(out.get("data"), "raw", None)
                 if raw is not None:

@@ -12,8 +12,8 @@ Failure semantics (PR 3 resilience layer):
     backoff) under the ambient Deadline stamped by the query/commit
     entry point (conn/retry.py) instead of fixed 50ms sleeps and
     per-layer 5s/15s budgets;
-  - proposals go out `idem=True`, so a reconnect-and-resend cannot
-    double-apply through the server's idempotency LRU;
+  - proposals go out `idem=True`, so a transport-level resend after a
+    lost ack dedupes in the server's idempotency LRU;
   - a group whose every replica has an open circuit fails fast with
     GroupUnavailableError instead of burning the caller's deadline, and
     RemoteKV (in `partial_ok` mode, used by queries) converts that into
@@ -21,7 +21,28 @@ Failure semantics (PR 3 resilience layer):
     the response extensions;
   - hedged reads run on one shared bounded executor; losing futures are
     cancelled or reaped via done-callbacks (never abandoned), with
-    `hedge_wins` / `hedge_losses_joined` counters.
+    `hedge_wins` / `hedge_losses_joined` counters. When every pool
+    worker is busy the hedge is SKIPPED (`hedge_skipped_saturated_
+    total`) — a queued hedge fires after its deadline and only wastes
+    a replica read.
+
+Resilient read plane (this PR):
+  - follower read routing under the PR 11 watermark rule: each group
+    tracks a read FLOOR (the max raft index any completed proposal
+    returned — recorded before the snapshot watermark advances), and
+    any replica whose TTL-fresh applied index covers the floor serves
+    provably identical bytes at the watermark. A leaderless group
+    (election, SIGKILL, partition) keeps serving watermark reads; the
+    query surfaces `degraded: leaderless` instead of erroring.
+  - candidates are ordered by the health-aware ReplicaPicker
+    (worker/replicapick.py): latency EWMA + per-replica circuit
+    breaker, replacing the blind leader-then-one-follower hedge order,
+    and one failed attempt rotates through ALL remaining candidates
+    before the outer loop backs off.
+  - retries and hedges draw from ONE per-query RetryBudget carried on
+    the ReadContext; exhaustion raises RetryBudgetExhausted, a
+    retryable 503 at the HTTP edge — brownouts shed instead of
+    retry-storming.
 
 The RemoteKV satisfies the same KV read interface the executor uses, so
 the whole query engine runs unchanged against OS-process alphas.
@@ -37,11 +58,14 @@ from typing import Dict, List, Optional, Tuple
 
 from dgraph_tpu.conn.frame import pack_body
 from dgraph_tpu.conn.messages import GetRequest, IterateRequest, Proposal
-from dgraph_tpu.conn.retry import Deadline, RetryPolicy, effective_deadline
+from dgraph_tpu.conn.retry import (
+    Deadline, RetryBudget, RetryPolicy, effective_deadline,
+)
 from dgraph_tpu.conn.rpc import PeerDownError, RpcError, RpcPool
 from dgraph_tpu.storage.kv import KV
 from dgraph_tpu.utils.observe import METRICS
-from dgraph_tpu.x import keys
+from dgraph_tpu.worker.replicapick import ReplicaPicker
+from dgraph_tpu.x import config, keys
 
 
 class GroupUnavailableError(RpcError):
@@ -53,8 +77,59 @@ class GroupUnavailableError(RpcError):
         self.gid = gid
 
 
+class RetryBudgetExhausted(RpcError):
+    """The query's shared retry/hedge budget ran dry mid-read. Retryable
+    by contract: the CLIENT backs off and re-issues with a fresh budget;
+    this process refuses to amplify a brownout any further."""
+
+    retryable = True
+    code = "retry_budget_exhausted"
+
+    def __init__(self, gid: int, detail: str = ""):
+        super().__init__(
+            f"group {gid}: read retry budget exhausted: {detail}"
+        )
+        self.gid = gid
+
+
+class ReadContext:
+    """Per-query read-plane state, shared by every group read the query
+    fans out to: ONE RetryBudget (retries and hedges all draw from it)
+    plus degradation notes the entry point surfaces in the response
+    extensions. Thread-safe — sibling executor workers and hedge
+    threads share it."""
+
+    __slots__ = ("budget", "leaderless_gids", "follower_reads", "_lock")
+
+    def __init__(self, budget: Optional[RetryBudget] = None):
+        self.budget = budget
+        self.leaderless_gids: set = set()
+        self.follower_reads = 0
+        self._lock = threading.Lock()
+
+    def charge(self, n: int = 1) -> bool:
+        """Spend budget for a re-issue (retry or hedge). True when no
+        budget is installed — budgeting off means never exhausted."""
+        if self.budget is None:
+            return True
+        return self.budget.try_spend(n)
+
+    def note_leaderless(self, gid: int):
+        with self._lock:
+            self.leaderless_gids.add(gid)
+
+    def note_follower_read(self):
+        with self._lock:
+            self.follower_reads += 1
+
+
 _HEDGE_LOCK = threading.Lock()
 _HEDGE_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_HEDGE_WORKERS = 16
+# free hedge-pool slots: acquired non-blocking before every submit, so a
+# saturated pool SKIPS the hedge instead of queueing it behind 16 slow
+# reads (released by the future's done-callback)
+_HEDGE_SLOTS = threading.BoundedSemaphore(_HEDGE_WORKERS)
 
 
 def _hedge_pool() -> concurrent.futures.ThreadPoolExecutor:
@@ -65,7 +140,7 @@ def _hedge_pool() -> concurrent.futures.ThreadPoolExecutor:
     with _HEDGE_LOCK:
         if _HEDGE_POOL is None:
             _HEDGE_POOL = concurrent.futures.ThreadPoolExecutor(
-                max_workers=16, thread_name_prefix="hedge"
+                max_workers=_HEDGE_WORKERS, thread_name_prefix="hedge"
             )
         return _HEDGE_POOL
 
@@ -91,6 +166,16 @@ class RemoteGroup:
         self.pool = pool
         self._leader: Optional[Tuple[str, int]] = None
         self._leader_at = 0.0
+        self.picker = ReplicaPicker(gid, self.addrs)
+        # read floor: the highest raft index any completed proposal
+        # returned (plus any applied index seen ON the leader). Recorded
+        # before the coordinator advances its snapshot watermark, so by
+        # the time a watermark is visible to queries the floor covering
+        # it is too — a follower with applied >= floor provably serves
+        # identical bytes at that watermark.
+        self._floor = 0
+        self._floor_lock = threading.Lock()
+        self._refresh_gate = threading.Lock()  # one health refresh in flight
 
     def healthy_addrs(self) -> List[Tuple[str, int]]:
         healthy = [a for a in self.addrs if self.pool.healthy(a)]
@@ -98,6 +183,29 @@ class RemoteGroup:
 
     def all_down(self) -> bool:
         return not any(self.pool.healthy(a) for a in self.addrs)
+
+    def read_floor(self) -> int:
+        return self._floor
+
+    def note_floor(self, idx: int):
+        if idx > self._floor:
+            with self._floor_lock:
+                if idx > self._floor:
+                    self._floor = idx
+
+    def _note_health(self, addr, h):
+        """Feed one health reply into the picker; a LEADER reply also
+        raises the floor to its applied index — after a coordinator
+        restart (floor reset to 0) the first leader probe restores a
+        floor that covers all pre-restart data, so a snapshotting-behind
+        follower cannot serve it stale."""
+        try:
+            applied = int(getattr(h, "applied", 0) or 0)
+        except (TypeError, ValueError):
+            return
+        self.picker.note_health(addr, applied, bool(h.is_leader))
+        if h.is_leader:
+            self.note_floor(applied)
 
     def leader_addr(self, timeout: float = 5.0,
                     deadline: Optional[Deadline] = None) -> Optional[Tuple[str, int]]:
@@ -111,6 +219,10 @@ class RemoteGroup:
         attempt = 0
         while True:
             all_failfast = True
+            found: Optional[Tuple[str, int]] = None
+            # probe the WHOLE replica set even after the leader answers:
+            # each reply feeds the picker's applied-index cache, which is
+            # what makes followers eligible under the watermark rule
             for a in self.healthy_addrs():
                 try:
                     h = self.pool.call(
@@ -123,10 +235,13 @@ class RemoteGroup:
                     all_failfast = False
                     continue
                 all_failfast = False
-                if h.is_leader:
-                    self._leader = a
-                    self._leader_at = time.time()
-                    return a
+                self._note_health(a, h)
+                if h.is_leader and found is None:
+                    found = a
+            if found is not None:
+                self._leader = found
+                self._leader_at = time.time()
+                return found
             if all_failfast:
                 return None  # every probe hit an open circuit: bail now
             attempt += 1
@@ -178,6 +293,10 @@ class RemoteGroup:
                 self.retry.sleep(attempt, dl)
                 continue
             if out.ok:
+                try:
+                    self.note_floor(int(out.index or 0))
+                except (TypeError, ValueError):
+                    pass
                 return {"ok": True, "index": out.index}
             last = f"not leader / timeout from {addr}: {out}"
             self._leader = None  # force re-discovery next attempt
@@ -187,12 +306,16 @@ class RemoteGroup:
 
     def read(self, method: str, args: dict, hedge_after: float = 0.15,
              deadline: Optional[Deadline] = None, timeout: float = 5.0,
-             leader_only: bool = False):
+             leader_only: bool = False,
+             ctx: Optional[ReadContext] = None):
         """Hedged read (worker/task.go:60) with replica rotation: single
         attempts fail fast (refusals, open circuits), and this loop
         re-discovers the leader and retries with jittered backoff until
         the deadline — so one dead/rebooting replica costs milliseconds,
-        not a stacked per-layer timeout.
+        not a stacked per-layer timeout. Each retry (like each hedge
+        inside an attempt) spends one token from `ctx`'s per-query
+        RetryBudget; a dry budget raises RetryBudgetExhausted
+        (retryable) instead of amplifying a brownout.
 
         `leader_only=True` (the tablet-move copy stream) never touches
         a follower: a follower may lag the leader's applied index, and
@@ -210,15 +333,21 @@ class RemoteGroup:
                 )
             try:
                 return self._read_once(
-                    method, args, hedge_after, dl, leader_only=leader_only
+                    method, args, hedge_after, dl,
+                    leader_only=leader_only, ctx=ctx,
                 )
             except GroupUnavailableError:
+                raise
+            except RetryBudgetExhausted:
                 raise
             except RpcError as e:
                 last = e
                 attempt += 1
                 if dl.remaining() <= 0:
                     break
+                if ctx is not None and not ctx.charge():
+                    METRICS.inc("read_retry_budget_exhausted_total")
+                    raise RetryBudgetExhausted(self.gid, str(e))
                 self._leader = None  # re-discover before the next try
                 self.retry.sleep(attempt, dl)
                 if dl.expired():
@@ -228,73 +357,225 @@ class RemoteGroup:
             f"{attempt} attempts: {last}"
         )
 
+    def _refresh_health_async(self):
+        """Keep the picker's applied-index cache fresh without blocking
+        reads: when any replica's health row has aged past half the TTL,
+        kick ONE background probe sweep (gated, slot-free — a sweep is a
+        handful of sub-second health RPCs)."""
+        ttl = float(config.get("FOLLOWER_READ_TTL_S"))
+        if not self.picker.refresh_due(self.addrs, ttl):
+            return
+        if not self._refresh_gate.acquire(blocking=False):
+            return
+
+        def sweep():
+            try:
+                for a in self.addrs:
+                    if not self.pool.healthy(a):
+                        continue
+                    try:
+                        h = self.pool.call(a, "health", timeout=0.5)
+                    except RpcError:
+                        continue
+                    self._note_health(a, h)
+            finally:
+                self._refresh_gate.release()
+
+        _hedge_pool().submit(sweep)
+
+    def _timed_call(self, addr, method, args, call_dl):
+        """One replica call, its outcome + latency fed to the picker."""
+        t0 = time.monotonic()
+        try:
+            out = self.pool.call(addr, method, args, deadline=call_dl)
+        except Exception:
+            self.picker.observe(addr, ok=False)
+            raise
+        self.picker.observe(addr, ok=True, lat_s=time.monotonic() - t0)
+        return out
+
+    def _served(self, addr, lead, ctx: Optional[ReadContext]):
+        """Winner bookkeeping: a read answered by anyone other than the
+        known leader is a (watermark-verified) follower read."""
+        if lead is not None and tuple(addr) == tuple(lead):
+            return
+        METRICS.inc("follower_reads_total")
+        if ctx is not None:
+            ctx.note_follower_read()
+
     def _read_once(self, method: str, args: dict, hedge_after: float,
-                   dl: Deadline, leader_only: bool = False):
-        """One hedged attempt: leader first; if it hasn't answered within
-        `hedge_after`, race a follower and take whichever returns first.
-        Losing futures are cancelled/reaped, never abandoned. With
-        `leader_only` the follower fallback/hedge is disabled entirely
-        (a no-leader window raises for the outer loop to retry)."""
-        addrs = self.healthy_addrs()
-        lead = self.leader_addr(
-            deadline=Deadline.after(dl.clamp(2.0))
+                   dl: Deadline, leader_only: bool = False,
+                   ctx: Optional[ReadContext] = None):
+        """One picker-ordered attempt: fire the best candidate; if it
+        hasn't answered within `hedge_after`, race the next one; any
+        failure immediately rotates to the NEXT candidate until the
+        whole plan is exhausted (a 3-replica group never fails a read
+        with a healthy replica untried). Losing futures are cancelled or
+        reaped, never abandoned. With `leader_only` the follower
+        fallback/hedge is disabled entirely (a no-leader window raises
+        for the outer loop to retry)."""
+        follower_ok = (not leader_only) and bool(
+            config.get("FOLLOWER_READS")
         )
-        if lead is not None:
-            addrs = [lead] + [a for a in addrs if a != lead]
+        # with follower serving available, leader discovery gets ONE fast
+        # probe round (which also refreshes the picker's applied cache) —
+        # an election window must not stall reads that a verified
+        # follower could answer right now
+        lead = self.leader_addr(
+            deadline=Deadline.after(dl.clamp(0.35 if follower_ok else 2.0))
+        )
         if leader_only:
             if lead is None:
                 raise RpcError(
                     f"group {self.gid}: no leader for leader-only read"
                 )
             addrs = [lead]
+        else:
+            if follower_ok:
+                self._refresh_health_async()
+                addrs = self.picker.plan(
+                    self.addrs, lead, self.read_floor(),
+                    healthy=self.pool.healthy,
+                )
+                if not addrs and lead is not None:
+                    addrs = [lead]  # breaker never locks out the leader
+            else:
+                # legacy order: leader first, blind follower hedge
+                addrs = self.healthy_addrs()
+                if lead is not None:
+                    addrs = [lead] + [a for a in addrs if a != lead]
+            if not addrs:
+                raise RpcError(
+                    f"group {self.gid}: no leader and no watermark-"
+                    f"verified follower (floor={self.read_floor()})"
+                )
+            if lead is None:
+                METRICS.inc("leaderless_reads_total")
+                if ctx is not None:
+                    ctx.note_leaderless(self.gid)
         if dl.expired():
             raise GroupUnavailableError(self.gid, "deadline exhausted")
         # one attempt never gets the whole read budget — the outer retry
-        # loop owns rotation across replicas
+        # loop owns backoff between rotations
         call_dl = Deadline.after(dl.clamp(self.pool.timeout))
         if len(addrs) == 1:
-            return self.pool.call(addrs[0], method, args, deadline=call_dl)
-        ex = _hedge_pool()
-        # hedge futures run under a COPY of this context so the rpc
-        # layer sees the same trace parent + query profile the calling
-        # thread holds (pool workers otherwise start orphan traces)
-        f1 = ex.submit(
-            contextvars.copy_context().run,
-            self.pool.call, addrs[0], method, args, deadline=call_dl,
+            out = self._timed_call(addrs[0], method, args, call_dl)
+            self._served(addrs[0], lead, ctx)
+            return out
+        return self._hedged_rotation(
+            addrs, lead, method, args, hedge_after, call_dl, dl, ctx
         )
-        try:
-            return f1.result(timeout=dl.clamp(hedge_after))
-        except concurrent.futures.TimeoutError:
-            pass
-        except RpcError:
-            return self.pool.call(addrs[1], method, args, deadline=call_dl)
-        f2 = ex.submit(
-            contextvars.copy_context().run,
-            self.pool.call, addrs[1], method, args, deadline=call_dl,
-        )
-        METRICS.inc("hedge_fired_total")
-        pending = {f1, f2}
+
+    def _sequential_rotation(self, addrs, lead, method, args, call_dl,
+                             ctx: Optional[ReadContext]):
+        """Hedge-pool-saturated fallback: walk the plan on the calling
+        thread, no parallelism. Re-issues past the first still spend
+        retry budget."""
         errs: List[Exception] = []
+        for i, addr in enumerate(addrs):
+            if call_dl.expired():
+                break
+            if i > 0 and ctx is not None and not ctx.charge():
+                METRICS.inc("read_retry_budget_exhausted_total")
+                raise RetryBudgetExhausted(self.gid, str(errs[-1]))
+            try:
+                out = self._timed_call(addr, method, args, call_dl)
+            except Exception as e:
+                errs.append(e)
+                continue
+            self._served(addr, lead, ctx)
+            return out
+        raise RpcError(
+            f"read {method} on group {self.gid} failed on all "
+            f"{len(addrs)} candidates: {errs or 'deadline exhausted'}"
+        )
+
+    def _hedged_rotation(self, addrs, lead, method, args, hedge_after,
+                         call_dl, dl, ctx: Optional[ReadContext]):
+        ex = _hedge_pool()
+        pending: Dict[concurrent.futures.Future, Tuple[str, int]] = {}
+        errs: List[Exception] = []
+        nxt = 0
+
+        def launch(charge: bool) -> str:
+            """Submit the next candidate; returns ok | saturated |
+            budget | exhausted."""
+            nonlocal nxt
+            if nxt >= len(addrs):
+                return "exhausted"
+            if charge and ctx is not None and not ctx.charge():
+                return "budget"
+            if not _HEDGE_SLOTS.acquire(blocking=False):
+                METRICS.inc("hedge_skipped_saturated_total")
+                return "saturated"
+            addr = addrs[nxt]
+            nxt += 1
+            # hedge futures run under a COPY of this context so the rpc
+            # layer sees the same trace parent + query profile the
+            # calling thread holds (pool workers otherwise start orphan
+            # traces)
+            f = ex.submit(
+                contextvars.copy_context().run,
+                self._timed_call, addr, method, args, call_dl,
+            )
+            f.add_done_callback(lambda _f: _HEDGE_SLOTS.release())
+            pending[f] = addr
+            return "ok"
+
+        if launch(False) != "ok":
+            # saturated before the primary even launched: degrade to a
+            # plain sequential walk on the calling thread
+            return self._sequential_rotation(
+                addrs, lead, method, args, call_dl, ctx
+            )
+        hedged = False
         while pending:
+            if not hedged:
+                wait_s = min(dl.clamp(hedge_after),
+                             call_dl.clamp(self.pool.timeout))
+            else:
+                wait_s = call_dl.clamp(self.pool.timeout)
             done, _ = concurrent.futures.wait(
-                pending, timeout=call_dl.clamp(self.pool.timeout),
+                pending, timeout=wait_s,
                 return_when=concurrent.futures.FIRST_COMPLETED,
             )
             if not done:
-                break  # deadline exhausted with calls still in flight
+                if not hedged:
+                    # hedge timer fired with the primary still in flight
+                    hedged = True
+                    if launch(True) == "ok":
+                        METRICS.inc("hedge_fired_total")
+                    continue
+                if call_dl.expired() or dl.expired():
+                    break  # deadline exhausted with calls in flight
+                continue
+            won = None
             for f in done:
-                pending.discard(f)
+                addr = pending.pop(f)
                 try:
                     out = f.result()
                 except Exception as e:
                     errs.append(e)
                     continue
-                if f is f2:
+                won = (addr, out)
+                break
+            if won is not None:
+                addr, out = won
+                if addrs and tuple(addr) != tuple(addrs[0]):
                     METRICS.inc("hedge_wins")
                 for loser in pending:
                     if not loser.cancel():
                         loser.add_done_callback(_reap_loser)
+                self._served(addr, lead, ctx)
                 return out
+            # everything that completed failed: rotate to the next
+            # candidate (don't wait for the hedge timer)
+            st = launch(True)
+            if st == "budget" and not pending:
+                METRICS.inc("read_retry_budget_exhausted_total")
+                raise RetryBudgetExhausted(self.gid, str(errs[-1]))
+            if st in ("exhausted", "saturated") and not pending:
+                break
         for f in pending:
             if not f.cancel():
                 f.add_done_callback(_reap_loser)
@@ -312,11 +593,20 @@ class RemoteKV(KV):
     EMPTY results instead of an exception; the group id is recorded in
     `degraded_groups` so the entry point can mark the response
     degraded/partial — queries over healthy predicates keep answering
-    while one group is partitioned."""
+    while one group is partitioned. RetryBudgetExhausted is NEVER
+    swallowed into a partial result: a dry budget means the cluster is
+    browning out and the client must back off (retryable 503), not get
+    silently empty data.
 
-    def __init__(self, cluster, partial_ok: bool = False):
+    Every group read shares the one per-query ReadContext (`ctx`): its
+    RetryBudget bounds total re-issues across the whole fan-out, and
+    its leaderless notes drive the `degraded: leaderless` extension."""
+
+    def __init__(self, cluster, partial_ok: bool = False,
+                 ctx: Optional[ReadContext] = None):
         self.cluster = cluster
         self.partial_ok = partial_ok
+        self.ctx = ctx
         self.degraded_groups: set = set()
 
     def _group_for(self, attr: str) -> Optional[RemoteGroup]:
@@ -334,7 +624,10 @@ class RemoteKV(KV):
         if g is None:
             return None
         try:
-            got = g.read("kv.get", GetRequest(key=key, ts=read_ts))
+            got = g.read("kv.get", GetRequest(key=key, ts=read_ts),
+                         ctx=self.ctx)
+        except RetryBudgetExhausted:
+            raise
         except RpcError:
             if not self.partial_ok:
                 raise
@@ -347,7 +640,10 @@ class RemoteKV(KV):
         if g is None:
             return []
         try:
-            got = g.read("kv.versions", GetRequest(key=key, ts=read_ts))
+            got = g.read("kv.versions", GetRequest(key=key, ts=read_ts),
+                         ctx=self.ctx)
+        except RetryBudgetExhausted:
+            raise
         except RpcError:
             if not self.partial_ok:
                 raise
@@ -367,8 +663,11 @@ class RemoteKV(KV):
                 continue
             try:
                 got = g.read(
-                    "kv.iterate", IterateRequest(prefix=prefix, ts=read_ts)
+                    "kv.iterate", IterateRequest(prefix=prefix, ts=read_ts),
+                    ctx=self.ctx,
                 )
+            except RetryBudgetExhausted:
+                raise
             except RpcError:
                 if not self.partial_ok:
                     raise
@@ -383,7 +682,10 @@ class RemoteKV(KV):
                 got = g.read(
                     "kv.iterate_versions",
                     IterateRequest(prefix=prefix, ts=read_ts),
+                    ctx=self.ctx,
                 )
+            except RetryBudgetExhausted:
+                raise
             except RpcError:
                 if not self.partial_ok:
                     raise

@@ -260,6 +260,23 @@ _define(
     "thresholds (query/dispatch.py) — benchmarking hook.",
 )
 _define(
+    "FOLLOWER_READS", "bool", True,
+    "Watermark-verified follower read routing (worker/remote.py, "
+    "worker/groups.py): read-only calls may be served by any replica "
+    "whose raft applied index covers the query's snapshot watermark "
+    "(PR 11 rule — provably byte-identical), picked by latency EWMA "
+    "with a per-replica circuit breaker; a leaderless group keeps "
+    "serving watermark reads marked `degraded: leaderless`. 0 restores "
+    "strict leader-first routing with the blind follower hedge.",
+)
+_define(
+    "FOLLOWER_READ_TTL_S", "float", 0.5,
+    "Freshness window for a replica's cached applied-index/health row "
+    "(worker/replicapick.py): a follower whose row is older than this "
+    "is skipped (stale-or-unknown never serves) and a background "
+    "re-probe is kicked off.",
+)
+_define(
     "GROUP_COMMIT", "bool", True,
     "Group-commit write pipeline (worker/groupcommit.py): concurrent "
     "committers coalesce into batches that share ONE oracle verdict "
@@ -397,6 +414,30 @@ _define(
     "equivalent by construction (golden-corpus-enforced byte "
     "identity); 0 restores declaration-order execution — the A/B "
     "escape hatch.",
+)
+_define(
+    "READ_BREAKER_ERRORS", "int", 3,
+    "Consecutive read failures that trip a replica's read-plane "
+    "circuit breaker OPEN (worker/replicapick.py); an open replica is "
+    "skipped by the picker until a jittered half-open probe succeeds. "
+    "0 disables the breaker (every replica always eligible).",
+)
+_define(
+    "READ_BREAKER_PROBE_S", "float", 1.0,
+    "Mean interval between half-open probes of an OPEN read-plane "
+    "breaker (worker/replicapick.py); each probe window is jittered "
+    "uniform(0.5x, 1.5x) so a fleet of coordinators de-synchronizes. "
+    "Bounds the availability gap after a replica dies: within ~one "
+    "probe interval traffic has routed around it.",
+)
+_define(
+    "READ_RETRY_BUDGET", "int", 16,
+    "Per-query retry/hedge token budget (conn/retry.py RetryBudget, "
+    "carried on the ReadContext): every group-read retry and every "
+    "hedge fire across the whole query spends one token, so a "
+    "brownout costs at most this many extra RPCs instead of "
+    "multiplying per layer. Exhaustion surfaces as a retryable 503 "
+    "(read_retry_budget_exhausted_total). 0 disables budgeting.",
 )
 _define(
     "REBALANCE_BY_TRAFFIC", "bool", False,

@@ -1,0 +1,409 @@
+"""Resilient read plane: watermark-verified follower reads, the
+health-aware replica picker, retry budgets, and hedge-pool saturation.
+
+Unit layer: ReplicaPicker eligibility under the watermark rule (floor
+gating, TTL staleness, leader never locked out), the latency EWMA and
+the closed/open/half-open breaker state machine, RetryBudget accounting
+through `retrying_call`, the bounded hedge-slot pool (saturated =>
+sequential fallback + counter, never queue-behind-pool), full-rotation
+fallback after leader + hedge both fail, leaderless follower serving,
+and the `leader_only` contract (move/backup streams NEVER touch a
+follower, however slow the leader is).
+
+Cluster layer (marked `chaos`): the fixed-seed sanity slice of
+tools/chaos_soak.py — leader SIGKILL mid-workload with byte-identity
+and ledger checks — runs as a subprocess, wiring the soak into tier-1.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu.conn.messages import HealthInfo
+from dgraph_tpu.conn.retry import RetryBudget, retrying_call
+from dgraph_tpu.conn.rpc import RpcError, RpcPool, RpcServer
+from dgraph_tpu.utils.observe import METRICS
+from dgraph_tpu.worker import remote as remote_mod
+from dgraph_tpu.worker.remote import (
+    ReadContext,
+    RemoteGroup,
+    RetryBudgetExhausted,
+)
+from dgraph_tpu.worker.replicapick import CLOSED, OPEN, ReplicaPicker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+A1 = ("127.0.0.1", 7001)
+A2 = ("127.0.0.1", 7002)
+A3 = ("127.0.0.1", 7003)
+
+_UP = lambda a: True  # noqa: E731  — transport circuit always closed
+
+
+# ---------------------------------------------------------------------------
+# ReplicaPicker: watermark eligibility
+# ---------------------------------------------------------------------------
+
+
+def test_picker_floor_gates_followers():
+    p = ReplicaPicker(1, [A1, A2, A3])
+    p.note_health(A2, applied=10, is_leader=False)
+    p.note_health(A3, applied=4, is_leader=False)
+    s0 = METRICS.value("follower_read_stale_skips_total")
+    # floor 7: A2 (applied 10) qualifies, A3 (applied 4) is provably
+    # behind the read watermark and must be skipped
+    plan = p.plan([A1, A2, A3], leader=A1, floor=7, healthy=_UP)
+    assert A2 in plan and A3 not in plan and plan[0] == A1
+    assert METRICS.value("follower_read_stale_skips_total") == s0 + 1
+
+
+def test_picker_unknown_health_is_stale():
+    p = ReplicaPicker(1, [A1, A2])
+    # no health row at all for A2 => not eligible, even at floor 0
+    plan = p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)
+    assert plan == [A1]
+
+
+def test_picker_ttl_expiry_skips_follower(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_FOLLOWER_READ_TTL_S", "0.05")
+    p = ReplicaPicker(1, [A1, A2])
+    p.note_health(A2, applied=10, is_leader=False)
+    assert A2 in p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)
+    time.sleep(0.08)
+    assert p.plan([A1, A2], leader=A1, floor=0, healthy=_UP) == [A1]
+    assert p.applied_of(A2, ttl=0.05) is None
+
+
+def test_picker_leader_only_mode_and_leaderless():
+    p = ReplicaPicker(1, [A1, A2])
+    p.note_health(A2, applied=10, is_leader=False)
+    assert p.plan([A1, A2], leader=A1, floor=0, healthy=_UP,
+                  follower_ok=False) == [A1]
+    # no leader at all: verified followers still serve
+    assert p.plan([A1, A2], leader=None, floor=5, healthy=_UP) == [A2]
+
+
+def test_picker_ewma_orders_fast_replica_first():
+    p = ReplicaPicker(1, [A1, A2, A3])
+    for a in (A2, A3):
+        p.note_health(a, applied=10, is_leader=False)
+    for _ in range(6):
+        p.observe(A2, ok=True, lat_s=0.200)
+        p.observe(A3, ok=True, lat_s=0.002)
+    # leaderless: candidates sort by latency score, fast follower first
+    assert p.plan([A1, A2, A3], leader=None, floor=0, healthy=_UP)[0] == A3
+    # unknown EWMA (the leader here) sorts FIRST — exploration beats
+    # a replica with a known-bad latency
+    p.note_health(A1, applied=10, is_leader=True)
+    plan = p.plan([A1, A2, A3], leader=A1, floor=0, healthy=_UP)
+    assert plan[0] == A1
+
+
+# ---------------------------------------------------------------------------
+# ReplicaPicker: circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_opens_probes_and_closes(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_READ_BREAKER_ERRORS", "3")
+    monkeypatch.setenv("DGRAPH_TPU_READ_BREAKER_PROBE_S", "0.05")
+    p = ReplicaPicker(1, [A1, A2])
+    p.note_health(A2, applied=10, is_leader=False)
+    o0 = METRICS.value("read_breaker_open_total")
+    p.observe(A2, ok=False)
+    p.observe(A2, ok=False)
+    assert p._stat(A2).state == CLOSED  # two fails: still closed
+    p.observe(A2, ok=False)
+    assert p._stat(A2).state == OPEN
+    assert METRICS.value("read_breaker_open_total") == o0 + 1
+    # freshly OPEN: skipped outright (probe window not elapsed yet)
+    assert A2 not in p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)
+    # window elapses: appended at the END as a half-open probe
+    time.sleep(0.09)
+    pr0 = METRICS.value("read_breaker_probe_total")
+    plan = p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)
+    assert plan[-1] == A2 and plan[0] == A1
+    assert METRICS.value("read_breaker_probe_total") == pr0 + 1
+    # the probe window was CLAIMED: an immediate second plan skips it
+    assert A2 not in p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)
+    # a successful probe closes the breaker
+    c0 = METRICS.value("read_breaker_close_total")
+    p.observe(A2, ok=True, lat_s=0.01)
+    assert p._stat(A2).state == CLOSED
+    assert METRICS.value("read_breaker_close_total") == c0 + 1
+
+
+def test_breaker_failed_probe_pushes_window_out(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_READ_BREAKER_ERRORS", "1")
+    monkeypatch.setenv("DGRAPH_TPU_READ_BREAKER_PROBE_S", "0.01")
+    p = ReplicaPicker(1, [A1, A2])
+    p.observe(A2, ok=False)
+    assert p._stat(A2).state == OPEN
+    time.sleep(0.03)  # first jittered window (5-15ms) elapses
+    # the failed half-open probe re-arms a FULL window at the current
+    # knob — the replica must not be probe-eligible again immediately
+    monkeypatch.setenv("DGRAPH_TPU_READ_BREAKER_PROBE_S", "60.0")
+    p.observe(A2, ok=False)
+    assert p._stat(A2).state == OPEN
+    assert p._stat(A2).next_probe_at > time.monotonic() + 1.0
+    assert A2 not in p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)
+
+
+def test_breaker_never_locks_out_leader(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_READ_BREAKER_ERRORS", "1")
+    monkeypatch.setenv("DGRAPH_TPU_READ_BREAKER_PROBE_S", "60.0")
+    p = ReplicaPicker(1, [A1])
+    p.observe(A1, ok=False)
+    assert p._stat(A1).state == OPEN
+    # picker-level: an OPEN leader outside its probe window yields an
+    # empty plan; _read_once falls back to [leader] in that case
+    assert p.plan([A1], leader=A1, floor=0, healthy=_UP) == []
+    # a health reply (restart recovery path) closes it again
+    p.note_health(A1, applied=3, is_leader=True)
+    assert p._stat(A1).state == CLOSED
+
+
+def test_breaker_disabled_with_zero_threshold(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_READ_BREAKER_ERRORS", "0")
+    p = ReplicaPicker(1, [A1, A2])
+    p.note_health(A2, applied=10, is_leader=False)
+    for _ in range(10):
+        p.observe(A2, ok=False)
+    assert p._stat(A2).state == CLOSED
+    assert A2 in p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)
+
+
+# ---------------------------------------------------------------------------
+# RetryBudget
+# ---------------------------------------------------------------------------
+
+
+def test_retry_budget_accounting():
+    b = RetryBudget(3)
+    assert b.remaining() == 3
+    assert b.try_spend() and b.try_spend(2)
+    assert b.remaining() == 0
+    assert not b.try_spend()
+    assert b.remaining() == 0  # failed spend does not go negative
+
+
+def test_retrying_call_spends_budget_per_retry():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        raise TimeoutError("nope")
+
+    b = RetryBudget(2)
+    with pytest.raises(TimeoutError):
+        retrying_call(flaky, retryable=(TimeoutError,), budget=b)
+    # first attempt free, then exactly `budget` retries
+    assert calls["n"] == 3
+    assert b.remaining() == 0
+
+
+def test_read_context_without_budget_never_exhausts():
+    ctx = ReadContext(budget=None)
+    assert all(ctx.charge() for _ in range(100))
+    ctx = ReadContext(budget=RetryBudget(1))
+    assert ctx.charge() and not ctx.charge()
+
+
+# ---------------------------------------------------------------------------
+# RemoteGroup wiring: fake replica processes over real sockets
+# ---------------------------------------------------------------------------
+
+
+def _replica(is_leader, node, payload=None, delay=0.0, touched=None,
+             fail=False, applied=100):
+    srv = RpcServer().start()
+    srv.register(
+        "health",
+        lambda a: HealthInfo(ok=True, is_leader=is_leader, node=node,
+                             group=1, applied=applied),
+    )
+
+    def get(a):
+        if touched is not None:
+            touched.append(node)
+        if delay:
+            time.sleep(delay)
+        if fail:
+            raise RuntimeError(f"replica {node} read failure")
+        return {"who": payload}
+
+    srv.register("kv.get", get)
+    return srv
+
+
+def test_leaderless_group_serves_watermark_reads():
+    f1 = _replica(False, 1, "f1")
+    f2 = _replica(False, 2, "f2")
+    pool = RpcPool(timeout=2.0)
+    try:
+        g = RemoteGroup(1, [f1.addr, f2.addr], pool)
+        g.note_floor(50)  # both report applied=100 >= floor
+        ll0 = METRICS.value("leaderless_reads_total")
+        fr0 = METRICS.value("follower_reads_total")
+        ctx = ReadContext()
+        out = g.read("kv.get", {}, timeout=5.0, ctx=ctx)
+        assert out["who"] in ("f1", "f2")
+        assert METRICS.value("leaderless_reads_total") == ll0 + 1
+        assert METRICS.value("follower_reads_total") == fr0 + 1
+        assert ctx.leaderless_gids == {1}
+        assert ctx.follower_reads == 1
+    finally:
+        pool.close()
+        f1.close()
+        f2.close()
+
+
+def test_leaderless_group_with_stale_followers_errors():
+    f1 = _replica(False, 1, "f1", applied=3)
+    pool = RpcPool(timeout=1.0)
+    try:
+        g = RemoteGroup(1, [f1.addr], pool)
+        g.note_floor(50)  # follower applied=3 < floor: NOT servable
+        with pytest.raises(RpcError, match="watermark-verified"):
+            g.read("kv.get", {}, timeout=1.2, ctx=ReadContext())
+    finally:
+        pool.close()
+        f1.close()
+
+
+def test_read_rotates_past_leader_and_hedge_failures():
+    # satellite (a): leader fails, first hedge fails, the LAST replica
+    # must still be tried — the old code gave up after two
+    lead = _replica(True, 1, fail=True)
+    bad = _replica(False, 2, fail=True)
+    good = _replica(False, 3, "good")
+    pool = RpcPool(timeout=2.0)
+    try:
+        g = RemoteGroup(1, [lead.addr, bad.addr, good.addr], pool)
+        out = g.read("kv.get", {}, hedge_after=0.02, timeout=8.0,
+                     ctx=ReadContext())
+        assert out["who"] == "good"
+    finally:
+        pool.close()
+        for s in (lead, bad, good):
+            s.close()
+
+
+def test_leader_only_never_touches_follower():
+    # satellite (c): move/backup streams pin to the leader — a SLOW
+    # leader must not tempt the hedge onto a follower
+    touched = []
+    lead = _replica(True, 1, "leader", delay=0.25, touched=touched)
+    fast = _replica(False, 2, "follower", touched=touched)
+    pool = RpcPool(timeout=5.0)
+    try:
+        g = RemoteGroup(1, [lead.addr, fast.addr], pool)
+        out = g.read("kv.get", {}, hedge_after=0.03, timeout=8.0,
+                     leader_only=True, ctx=ReadContext())
+        assert out["who"] == "leader"
+        assert touched == [1]  # the follower handler NEVER ran
+    finally:
+        pool.close()
+        lead.close()
+        fast.close()
+
+
+def test_leader_only_without_leader_raises():
+    f1 = _replica(False, 1, "f1")
+    pool = RpcPool(timeout=1.0)
+    try:
+        g = RemoteGroup(1, [f1.addr], pool)
+        with pytest.raises(RpcError, match="leader-only"):
+            g.read("kv.get", {}, timeout=1.2, leader_only=True)
+    finally:
+        pool.close()
+        f1.close()
+
+
+def test_read_budget_exhaustion_is_retryable_503_shape():
+    # a live leader whose reads always fail: each outer retry spends a
+    # budget token, and the dry budget surfaces as the retryable error
+    sick = _replica(True, 1, fail=True)
+    pool = RpcPool(timeout=2.0)
+    try:
+        g = RemoteGroup(1, [sick.addr], pool)
+        e0 = METRICS.value("read_retry_budget_exhausted_total")
+        ctx = ReadContext(budget=RetryBudget(1))
+        with pytest.raises(RetryBudgetExhausted) as ei:
+            g.read("kv.get", {}, timeout=10.0, ctx=ctx)
+        assert ei.value.retryable is True
+        assert ei.value.code == "retry_budget_exhausted"
+        assert METRICS.value("read_retry_budget_exhausted_total") > e0
+    finally:
+        pool.close()
+        sick.close()
+
+
+def test_hedge_saturated_pool_skips_hedge_and_still_answers():
+    # satellite (b): drain every hedge slot, the read must fall back to
+    # the calling thread (sequential rotation) instead of queueing
+    lead = _replica(True, 1, "leader", delay=0.05)
+    fast = _replica(False, 2, "follower")
+    pool = RpcPool(timeout=2.0)
+    taken = 0
+    try:
+        while remote_mod._HEDGE_SLOTS.acquire(blocking=False):
+            taken += 1
+        assert taken == remote_mod._HEDGE_WORKERS
+        s0 = METRICS.value("hedge_skipped_saturated_total")
+        g = RemoteGroup(1, [lead.addr, fast.addr], pool)
+        out = g.read("kv.get", {}, hedge_after=0.01, timeout=8.0,
+                     ctx=ReadContext())
+        assert out["who"] in ("leader", "follower")
+        assert METRICS.value("hedge_skipped_saturated_total") > s0
+    finally:
+        for _ in range(taken):
+            remote_mod._HEDGE_SLOTS.release()
+        pool.close()
+        lead.close()
+        fast.close()
+
+
+def test_follower_reads_flag_off_is_leader_first_legacy(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_FOLLOWER_READS", "0")
+    lead = _replica(True, 1, "leader")
+    fast = _replica(False, 2, "follower")
+    pool = RpcPool(timeout=2.0)
+    try:
+        g = RemoteGroup(1, [lead.addr, fast.addr], pool)
+        fr0 = METRICS.value("follower_reads_total")
+        out = g.read("kv.get", {}, timeout=5.0)
+        assert out["who"] == "leader"
+        assert METRICS.value("follower_reads_total") == fr0
+    finally:
+        pool.close()
+        lead.close()
+        fast.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster chaos: the soak's fixed-seed sanity slice in tier-1
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_soak_sanity_slice():
+    """tools/chaos_soak.py --sanity: ProcCluster bank + query mix with
+    the group leader SIGKILLed mid-workload; asserts byte-identity of
+    follower-served responses against a leader-routed control replay,
+    ledger exactness, bounded availability gap, and that the kill
+    window actually served follower/leaderless reads."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--sanity"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, (
+        f"chaos soak sanity failed:\n{out.stdout}\n{out.stderr}"
+    )
+    assert "chaos_soak: PASS" in out.stdout

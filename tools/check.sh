@@ -13,6 +13,12 @@
 #                           planner on/off + result-cache off/miss/hit
 #                           byte-equality over the golden smoke subset
 #                           (bench.py --plan-sanity)
+#   tools/check.sh --read-chaos-sanity
+#                           the read-plane chaos gate alone: fixed-seed
+#                           chaos soak slice — leader SIGKILL under the
+#                           bank + query mix, follower-served responses
+#                           byte-checked against a leader-routed control
+#                           replay (tools/chaos_soak.py --sanity)
 #
 # Exit code is nonzero on the first failing stage, so CI can consume it
 # directly. JAX is pinned to CPU: the gate must never dial an accelerator.
@@ -33,6 +39,13 @@ if [[ "${1:-}" == "--plan-sanity" ]]; then
     echo "== planner/result-reuse sanity (~5s): A/B byte-equality =="
     python bench.py --plan-sanity
     echo "check.sh: plan-sanity passed"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--read-chaos-sanity" ]]; then
+    echo "== read-plane chaos sanity: leader kill + byte-identity replay =="
+    python tools/chaos_soak.py --sanity
+    echo "check.sh: read-chaos-sanity passed"
     exit 0
 fi
 
@@ -68,11 +81,15 @@ else
         tests/test_explain.py tests/test_telemetry.py \
         tests/test_planner.py \
         tests/test_ops_plane.py \
+        tests/test_follower_reads.py \
         -q -p no:cacheprovider
 
     echo "== proc-shard chaos smoke: worker SIGKILL + respawn, ledger exact =="
     python -m pytest tests/test_batch_apply.py -q -m chaos \
         -p no:cacheprovider
+
+    echo "== read-plane chaos sanity: leader kill + byte-identity replay =="
+    python tools/chaos_soak.py --sanity
 
     echo "== explain sanity (~5s) =="
     python bench.py --explain-sanity
