@@ -1886,6 +1886,14 @@ declare_metric(
     "provably identical to a leader-served read at the same ts.",
 )
 declare_metric(
+    "counter", "follower_read_floor_unknown_skips_total",
+    "Follower candidates skipped because the group's read floor is "
+    "still UNKNOWN (worker/replicapick.py, worker/groups.py): a "
+    "freshly started/restarted coordinator serves leader-only until a "
+    "leader health reply or completed proposal establishes a real "
+    "floor — floor 0 would otherwise cover pre-restart writes.",
+)
+declare_metric(
     "counter", "follower_read_stale_skips_total",
     "Follower candidates the picker skipped because their cached "
     "applied index was stale/unknown or below the group's read floor "
@@ -1908,7 +1916,10 @@ declare_metric(
 )
 declare_metric(
     "counter", "hedge_wins",
-    "Hedged reads won by the backup (second) request.",
+    "Reads won by a request the hedge timer launched (worker/remote.py"
+    " _hedged_rotation). Plain failure rotations never count, so "
+    "hedge_wins <= hedge_fired_total and the ratio measures hedge "
+    "effectiveness.",
 )
 declare_metric(
     "counter", "idem_hits_total",

@@ -37,6 +37,20 @@ from dgraph_tpu.x import config, keys
 from dgraph_tpu.zero.zero import TxnConflictError, ZeroLite
 
 
+class GroupLeaderlessError(RuntimeError):
+    """In-proc read plane: a group has no live leader and no
+    watermark-verified replica (stale, or the read floor is still
+    unknown). Mirrors the remote plane's no-candidates RpcError —
+    refusing beats silently serving a provably stale view."""
+
+    def __init__(self, gid: int, detail: str = ""):
+        super().__init__(
+            f"group {gid}: no leader and no watermark-verified replica"
+            f" ({detail})"
+        )
+        self.gid = gid
+
+
 class ZeroService:
     """Coordinator: leases, oracle, tablet map, membership.
 
@@ -322,8 +336,13 @@ class AlphaGroup:
         # read floor (same rule as RemoteGroup): the max raft index any
         # completed proposal waited out, recorded before the snapshot
         # watermark advances — a replica with applied_index >= floor
-        # provably serves the same bytes at the watermark
+        # provably serves the same bytes at the watermark. UNKNOWN
+        # until the first proposal or leader-served read establishes it
+        # (floor_known): with nodes restoring applied state from WAL, a
+        # zero floor would "cover" pre-restart writes it knows nothing
+        # about.
         self.read_floor = 0
+        self.floor_known = False
 
     def leader(self) -> Optional[AlphaNode]:
         # a downed node may still believe it is leader — skip it, and
@@ -339,6 +358,7 @@ class AlphaGroup:
         return max(live, key=lambda n: n.raft.term)
 
     def note_floor(self, idx: int):
+        self.floor_known = True
         if idx > self.read_floor:
             self.read_floor = idx
 
@@ -347,26 +367,36 @@ class AlphaGroup:
         return self.leader() or (live[0] if live else self.nodes[0])
 
     def read_replica(self) -> AlphaNode:
-        """Watermark-verified read pick: the leader when one is live;
-        otherwise the most-applied live replica whose applied index
-        covers the read floor (follower_reads_total — byte-identical at
-        the watermark by the PR 11 rule). A leaderless group with no
-        verified replica falls back to the most-applied live one (old
-        any_replica behavior, counted leaderless_reads_total) rather
-        than failing the read."""
+        """Watermark-verified read pick: the leader when one is live
+        (its applied index also establishes/refreshes the floor, same
+        as the remote plane's leader health replies, so a later
+        leaderless window can verify followers); otherwise the
+        most-applied live replica IF follower reads are enabled, the
+        floor is KNOWN, and that replica's applied index covers it —
+        byte-identical at the watermark by the PR 11 rule, counted
+        follower_reads_total + leaderless_reads_total. Anything else
+        raises GroupLeaderlessError: stale-or-unknown never serves,
+        mirroring the remote plane, and FOLLOWER_READS=0 restores
+        strict leader-only routing here too."""
         lead = self.leader()
         if lead is not None:
+            self.note_floor(lead.applied_index)
             return lead
         live = [n for n in self.nodes if n.id not in self.net.down]
-        if not live:
-            return self.nodes[0]
-        best = max(live, key=lambda n: n.applied_index)
-        if bool(config.get("FOLLOWER_READS")) and (
-            best.applied_index >= self.read_floor
-        ):
-            METRICS.inc("follower_reads_total")
-        METRICS.inc("leaderless_reads_total")
-        return best
+        if live and bool(config.get("FOLLOWER_READS")):
+            if not self.floor_known:
+                METRICS.inc("follower_read_floor_unknown_skips_total")
+            else:
+                best = max(live, key=lambda n: n.applied_index)
+                if best.applied_index >= self.read_floor:
+                    METRICS.inc("follower_reads_total")
+                    METRICS.inc("leaderless_reads_total")
+                    return best
+                METRICS.inc("follower_read_stale_skips_total")
+        raise GroupLeaderlessError(
+            self.id,
+            f"floor={self.read_floor if self.floor_known else 'unknown'}",
+        )
 
 
 class RoutingKV(KV):

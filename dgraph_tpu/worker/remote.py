@@ -31,9 +31,16 @@ Resilient read plane (this PR):
     tracks a read FLOOR (the max raft index any completed proposal
     returned — recorded before the snapshot watermark advances), and
     any replica whose TTL-fresh applied index covers the floor serves
-    provably identical bytes at the watermark. A leaderless group
-    (election, SIGKILL, partition) keeps serving watermark reads; the
-    query surfaces `degraded: leaderless` instead of erroring.
+    provably identical bytes at the watermark. The floor is TRI-STATE:
+    it starts UNKNOWN (a freshly started or restarted coordinator), and
+    while unknown NO follower is eligible — a zero floor would
+    otherwise "cover" pre-restart writes this process knows nothing
+    about, letting a lagging follower serve stale bytes at a watermark
+    the caller already observed. The first leader health reply or
+    completed proposal establishes a real floor and re-enables follower
+    serving. A leaderless group (election, SIGKILL, partition) keeps
+    serving watermark reads; the query surfaces `degraded: leaderless`
+    instead of erroring.
   - candidates are ordered by the health-aware ReplicaPicker
     (worker/replicapick.py): latency EWMA + per-replica circuit
     breaker, replacing the blind leader-then-one-follower hedge order,
@@ -145,6 +152,26 @@ def _hedge_pool() -> concurrent.futures.ThreadPoolExecutor:
         return _HEDGE_POOL
 
 
+_SWEEP_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+
+def _sweep_pool() -> concurrent.futures.ThreadPoolExecutor:
+    """Dedicated executor for background health sweeps, separate from
+    the hedge pool for two reasons: hedge-slot accounting stays
+    truthful (a hedge that won a _HEDGE_SLOTS slot must never queue
+    behind a sweep), and sweep latency stays bounded — queued behind 16
+    slow hedged reads, a sweep could let every health row age past the
+    TTL and silently disable follower reads exactly when an overloaded
+    cluster needs them."""
+    global _SWEEP_POOL
+    with _HEDGE_LOCK:
+        if _SWEEP_POOL is None:
+            _SWEEP_POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="healthsweep"
+            )
+        return _SWEEP_POOL
+
+
 def _reap_loser(f: concurrent.futures.Future):
     """Done-callback joining a losing hedge future: consume its result
     or exception so nothing dangles on the client locks unobserved."""
@@ -172,8 +199,13 @@ class RemoteGroup:
         # before the coordinator advances its snapshot watermark, so by
         # the time a watermark is visible to queries the floor covering
         # it is too — a follower with applied >= floor provably serves
-        # identical bytes at that watermark.
+        # identical bytes at that watermark. UNKNOWN until the first
+        # leader reply / completed proposal (`_floor_known`): a fresh
+        # coordinator must not treat 0 as a floor, because watermarks
+        # from persisted Zero state can cover pre-restart writes that
+        # a behind follower at "applied >= 0" does not hold.
         self._floor = 0
+        self._floor_known = False
         self._floor_lock = threading.Lock()
         self._refresh_gate = threading.Lock()  # one health refresh in flight
 
@@ -184,21 +216,28 @@ class RemoteGroup:
     def all_down(self) -> bool:
         return not any(self.pool.healthy(a) for a in self.addrs)
 
-    def read_floor(self) -> int:
-        return self._floor
+    def read_floor(self) -> Optional[int]:
+        """The verified read floor, or None while it is UNKNOWN (no
+        leader reply / completed proposal yet on this process). None
+        makes every follower ineligible in the picker."""
+        return self._floor if self._floor_known else None
 
     def note_floor(self, idx: int):
-        if idx > self._floor:
-            with self._floor_lock:
-                if idx > self._floor:
-                    self._floor = idx
+        """Record a verified floor source: a completed proposal's index
+        or a leader's applied index. Marks the floor KNOWN — this is
+        the only way follower serving turns on."""
+        with self._floor_lock:
+            self._floor_known = True
+            if idx > self._floor:
+                self._floor = idx
 
     def _note_health(self, addr, h):
         """Feed one health reply into the picker; a LEADER reply also
-        raises the floor to its applied index — after a coordinator
-        restart (floor reset to 0) the first leader probe restores a
-        floor that covers all pre-restart data, so a snapshotting-behind
-        follower cannot serve it stale."""
+        establishes/raises the floor from its applied index — after a
+        coordinator restart (floor UNKNOWN, followers ineligible) the
+        first leader probe restores a floor that covers all pre-restart
+        data, so a snapshotting-behind follower cannot serve it stale;
+        until that reply arrives no follower serves at all."""
         try:
             applied = int(getattr(h, "applied", 0) or 0)
         except (TypeError, ValueError):
@@ -360,8 +399,9 @@ class RemoteGroup:
     def _refresh_health_async(self):
         """Keep the picker's applied-index cache fresh without blocking
         reads: when any replica's health row has aged past half the TTL,
-        kick ONE background probe sweep (gated, slot-free — a sweep is a
-        handful of sub-second health RPCs)."""
+        kick ONE background probe sweep (gated; runs on the dedicated
+        sweep thread so it neither consumes a hedge slot nor queues
+        behind slow hedged reads)."""
         ttl = float(config.get("FOLLOWER_READ_TTL_S"))
         if not self.picker.refresh_due(self.addrs, ttl):
             return
@@ -381,7 +421,7 @@ class RemoteGroup:
             finally:
                 self._refresh_gate.release()
 
-        _hedge_pool().submit(sweep)
+        _sweep_pool().submit(sweep)
 
     def _timed_call(self, addr, method, args, call_dl):
         """One replica call, its outcome + latency fed to the picker."""
@@ -445,9 +485,11 @@ class RemoteGroup:
                 if lead is not None:
                     addrs = [lead] + [a for a in addrs if a != lead]
             if not addrs:
+                floor = self.read_floor()
                 raise RpcError(
                     f"group {self.gid}: no leader and no watermark-"
-                    f"verified follower (floor={self.read_floor()})"
+                    f"verified follower (floor="
+                    f"{'unknown' if floor is None else floor})"
                 )
             if lead is None:
                 METRICS.inc("leaderless_reads_total")
@@ -494,10 +536,15 @@ class RemoteGroup:
                          call_dl, dl, ctx: Optional[ReadContext]):
         ex = _hedge_pool()
         pending: Dict[concurrent.futures.Future, Tuple[str, int]] = {}
+        # futures launched BY THE HEDGE TIMER, as opposed to failure
+        # rotations: only these count toward hedge_wins, so
+        # hedge_wins <= hedge_fired_total holds and the metric measures
+        # hedge effectiveness, not ordinary failover
+        hedge_futs: set = set()
         errs: List[Exception] = []
         nxt = 0
 
-        def launch(charge: bool) -> str:
+        def launch(charge: bool, is_hedge: bool = False) -> str:
             """Submit the next candidate; returns ok | saturated |
             budget | exhausted."""
             nonlocal nxt
@@ -520,6 +567,8 @@ class RemoteGroup:
             )
             f.add_done_callback(lambda _f: _HEDGE_SLOTS.release())
             pending[f] = addr
+            if is_hedge:
+                hedge_futs.add(f)
             return "ok"
 
         if launch(False) != "ok":
@@ -543,7 +592,7 @@ class RemoteGroup:
                 if not hedged:
                     # hedge timer fired with the primary still in flight
                     hedged = True
-                    if launch(True) == "ok":
+                    if launch(True, is_hedge=True) == "ok":
                         METRICS.inc("hedge_fired_total")
                     continue
                 if call_dl.expired() or dl.expired():
@@ -557,11 +606,11 @@ class RemoteGroup:
                 except Exception as e:
                     errs.append(e)
                     continue
-                won = (addr, out)
+                won = (f, addr, out)
                 break
             if won is not None:
-                addr, out = won
-                if addrs and tuple(addr) != tuple(addrs[0]):
+                wf, addr, out = won
+                if wf in hedge_futs:
                     METRICS.inc("hedge_wins")
                 for loser in pending:
                     if not loser.cancel():

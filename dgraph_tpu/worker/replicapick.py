@@ -20,11 +20,16 @@ ingredients of a tail-tolerant, watermark-correct read plane:
                  watermark advances. Raft applies the log as a prefix,
                  so applied >= floor means every write visible at the
                  watermark is present; MVCC hides anything newer than
-                 the read ts. Stale-or-unknown rows never serve: a
-                 conservative floor only skips an eligible follower,
-                 it cannot serve stale bytes. The leader (when known)
-                 is always eligible — it is the fallback, not the
-                 default.
+                 the read ts. Stale-or-unknown rows never serve, and an
+                 UNKNOWN floor (floor=None — a freshly started or
+                 restarted coordinator that has not yet heard a leader
+                 health reply or completed a proposal) makes EVERY
+                 follower ineligible: floor 0 would otherwise "cover"
+                 pre-restart writes this process knows nothing about.
+                 A known floor is conservative — it only skips an
+                 eligible follower, it cannot serve stale bytes. The
+                 leader (when known) is always eligible — it is the
+                 fallback, not the default.
 
 Ordering among eligible closed-breaker candidates is by latency EWMA
 (unknown sorts first: an unmeasured-but-verified replica is explored
@@ -113,15 +118,17 @@ class ReplicaPicker:
             self._health[addr] = _HealthRow(
                 int(applied), bool(is_leader), time.monotonic()
             )
-            # a replica that answers health is alive: let a successful
-            # probe-by-health close a breaker that only opened because
-            # the process was down (read probes would do it too, but
-            # health answers first after a restart)
+            # a health reply proves the PROCESS answers, not that the
+            # data path works (sick disk, deserialization bug, overload
+            # all keep answering health). An OPEN breaker therefore
+            # goes HALF-OPEN — immediately probe-eligible — instead of
+            # closing; only a successful read (observe(ok=True)) closes
+            # it. Health must not touch consec_fails either: the
+            # background sweep fires every TTL/2 and would otherwise
+            # reset the count faster than a flaky data path can trip it.
             st = self._stat(addr)
-            st.consec_fails = 0
             if st.state == OPEN:
-                st.state = CLOSED
-                METRICS.inc("read_breaker_close_total")
+                st.next_probe_at = 0.0
 
     def observe(self, addr: Addr, ok: bool, lat_s: float = 0.0):
         """Feed one read outcome into the EWMA + breaker."""
@@ -174,13 +181,16 @@ class ReplicaPicker:
                     return True
         return False
 
-    def plan(self, addrs: List[Addr], leader: Optional[Addr], floor: int,
-             healthy, follower_ok: bool = True) -> List[Addr]:
+    def plan(self, addrs: List[Addr], leader: Optional[Addr],
+             floor: Optional[int], healthy,
+             follower_ok: bool = True) -> List[Addr]:
         """Ordered read candidates for one attempt.
 
         Eligibility: transport circuit closed (`healthy`), AND (is the
         known leader OR `follower_ok` with a fresh applied index >= the
-        group read floor). Breaker-OPEN replicas are skipped unless
+        group read floor). `floor=None` means the floor is UNKNOWN
+        (restarted coordinator): no follower is eligible, whatever its
+        applied index claims. Breaker-OPEN replicas are skipped unless
         their jittered probe window elapsed, in which case they append
         at the end as half-open probes."""
         ttl = float(config.get("FOLLOWER_READ_TTL_S"))
@@ -199,6 +209,11 @@ class ReplicaPicker:
                     continue
                 if a != leader:
                     if not follower_ok:
+                        continue
+                    if floor is None:
+                        METRICS.inc(
+                            "follower_read_floor_unknown_skips_total"
+                        )
                         continue
                     row = self._health.get(a)
                     fresh = row is not None and now - row.at <= ttl

@@ -266,8 +266,11 @@ _define(
     "whose raft applied index covers the query's snapshot watermark "
     "(PR 11 rule — provably byte-identical), picked by latency EWMA "
     "with a per-replica circuit breaker; a leaderless group keeps "
-    "serving watermark reads marked `degraded: leaderless`. 0 restores "
-    "strict leader-first routing with the blind follower hedge.",
+    "serving watermark reads marked `degraded: leaderless` (only once "
+    "the read floor is KNOWN — a restarted coordinator serves "
+    "leader-only until a leader reply/proposal re-establishes it). 0 "
+    "restores strict leader-first routing: the blind follower hedge "
+    "on the remote plane, leader-only in-proc.",
 )
 _define(
     "FOLLOWER_READ_TTL_S", "float", 0.5,

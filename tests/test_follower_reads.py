@@ -20,6 +20,7 @@ import subprocess
 import sys
 import threading
 import time
+import types
 
 import pytest
 
@@ -28,6 +29,7 @@ from dgraph_tpu.conn.retry import RetryBudget, retrying_call
 from dgraph_tpu.conn.rpc import RpcError, RpcPool, RpcServer
 from dgraph_tpu.utils.observe import METRICS
 from dgraph_tpu.worker import remote as remote_mod
+from dgraph_tpu.worker.groups import AlphaGroup, GroupLeaderlessError
 from dgraph_tpu.worker.remote import (
     ReadContext,
     RemoteGroup,
@@ -66,6 +68,21 @@ def test_picker_unknown_health_is_stale():
     # no health row at all for A2 => not eligible, even at floor 0
     plan = p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)
     assert plan == [A1]
+
+
+def test_picker_unknown_floor_gates_all_followers():
+    # floor=None (restarted coordinator): a TTL-fresh follower claiming
+    # ANY applied index is ineligible — applied >= 0 would "cover"
+    # pre-restart writes this process knows nothing about
+    p = ReplicaPicker(1, [A1, A2])
+    p.note_health(A2, applied=1 << 40, is_leader=False)
+    u0 = METRICS.value("follower_read_floor_unknown_skips_total")
+    assert p.plan([A1, A2], leader=A1, floor=None, healthy=_UP) == [A1]
+    assert (
+        METRICS.value("follower_read_floor_unknown_skips_total") == u0 + 1
+    )
+    # leaderless + unknown floor: nobody may serve
+    assert p.plan([A1, A2], leader=None, floor=None, healthy=_UP) == []
 
 
 def test_picker_ttl_expiry_skips_follower(monkeypatch):
@@ -162,9 +179,46 @@ def test_breaker_never_locks_out_leader(monkeypatch):
     # picker-level: an OPEN leader outside its probe window yields an
     # empty plan; _read_once falls back to [leader] in that case
     assert p.plan([A1], leader=A1, floor=0, healthy=_UP) == []
-    # a health reply (restart recovery path) closes it again
+    # a health reply (restart recovery path) does NOT close the
+    # breaker — it goes half-open, immediately probe-eligible
     p.note_health(A1, applied=3, is_leader=True)
+    assert p._stat(A1).state == OPEN
+    assert p.plan([A1], leader=A1, floor=0, healthy=_UP) == [A1]
+    # only the successful probe read closes it
+    p.observe(A1, ok=True, lat_s=0.01)
     assert p._stat(A1).state == CLOSED
+
+
+def test_breaker_health_reply_goes_half_open_not_closed(monkeypatch):
+    # a replica that answers health RPCs but fails data reads (sick
+    # disk, overloaded read path) must STAY routed around: health
+    # replies arrive every TTL/2 sweep and used to force-close the
+    # breaker within a quarter second of tripping
+    monkeypatch.setenv("DGRAPH_TPU_READ_BREAKER_ERRORS", "2")
+    monkeypatch.setenv("DGRAPH_TPU_READ_BREAKER_PROBE_S", "60.0")
+    p = ReplicaPicker(1, [A1, A2])
+    p.note_health(A2, applied=10, is_leader=False)
+    p.observe(A2, ok=False)
+    # a health reply between failures must not reset the consecutive
+    # count (the sweep would otherwise outpace any flaky data path)
+    p.note_health(A2, applied=10, is_leader=False)
+    p.observe(A2, ok=False)
+    assert p._stat(A2).state == OPEN
+    # health keeps answering: breaker stays OPEN, but becomes
+    # probe-eligible (half-open) — appended LAST in the plan
+    p.note_health(A2, applied=11, is_leader=False)
+    assert p._stat(A2).state == OPEN
+    plan = p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)
+    assert plan == [A1, A2]
+    # the probe read fails: a full window re-arms, skip it again
+    p.observe(A2, ok=False)
+    assert A2 not in p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)
+    # the next health reply re-opens the half-open window...
+    p.note_health(A2, applied=12, is_leader=False)
+    assert p.plan([A1, A2], leader=A1, floor=0, healthy=_UP)[-1] == A2
+    # ...and only a SUCCESSFUL read finally closes the breaker
+    p.observe(A2, ok=True, lat_s=0.01)
+    assert p._stat(A2).state == CLOSED
 
 
 def test_breaker_disabled_with_zero_threshold(monkeypatch):
@@ -275,6 +329,29 @@ def test_leaderless_group_with_stale_followers_errors():
         f1.close()
 
 
+def test_restarted_coordinator_unknown_floor_refuses_followers():
+    # a fresh RemoteGroup models a coordinator restarted during a
+    # leaderless window: its floor is UNKNOWN, and a TTL-fresh follower
+    # claiming a huge applied index must NOT serve — at floor "0" it
+    # would pass the check while possibly missing pre-restart writes
+    f1 = _replica(False, 1, "f1", applied=1 << 40)
+    pool = RpcPool(timeout=1.0)
+    try:
+        g = RemoteGroup(1, [f1.addr], pool)
+        assert g.read_floor() is None
+        with pytest.raises(RpcError, match="floor=unknown"):
+            g.read("kv.get", {}, timeout=1.2, ctx=ReadContext())
+        # a completed proposal (or leader health reply) re-establishes
+        # the floor and turns follower serving back on
+        g.note_floor(5)
+        assert g.read_floor() == 5
+        out = g.read("kv.get", {}, timeout=2.0, ctx=ReadContext())
+        assert out["who"] == "f1"
+    finally:
+        pool.close()
+        f1.close()
+
+
 def test_read_rotates_past_leader_and_hedge_failures():
     # satellite (a): leader fails, first hedge fails, the LAST replica
     # must still be tried — the old code gave up after two
@@ -366,6 +443,118 @@ def test_hedge_saturated_pool_skips_hedge_and_still_answers():
         pool.close()
         lead.close()
         fast.close()
+
+
+def test_hedge_wins_not_counted_for_failure_rotations():
+    # the primary fails fast and the NEXT candidate answers — no hedge
+    # timer ever fired, so hedge_wins must not move (it measures hedge
+    # effectiveness: hedge_wins <= hedge_fired_total)
+    lead = _replica(True, 1, fail=True)
+    good = _replica(False, 2, "good")
+    pool = RpcPool(timeout=2.0)
+    try:
+        g = RemoteGroup(1, [lead.addr, good.addr], pool)
+        w0 = METRICS.value("hedge_wins")
+        f0 = METRICS.value("hedge_fired_total")
+        out = g.read("kv.get", {}, hedge_after=30.0, timeout=8.0,
+                     ctx=ReadContext())
+        assert out["who"] == "good"
+        assert METRICS.value("hedge_fired_total") == f0
+        assert METRICS.value("hedge_wins") == w0
+    finally:
+        pool.close()
+        lead.close()
+        good.close()
+
+
+def test_hedge_wins_counted_when_timer_hedge_wins():
+    # slow-but-healthy leader, fast follower: the hedge timer fires and
+    # the hedge wins the race — exactly what hedge_wins measures
+    lead = _replica(True, 1, "leader", delay=0.5)
+    fast = _replica(False, 2, "fast")
+    pool = RpcPool(timeout=5.0)
+    try:
+        g = RemoteGroup(1, [lead.addr, fast.addr], pool)
+        w0 = METRICS.value("hedge_wins")
+        f0 = METRICS.value("hedge_fired_total")
+        out = g.read("kv.get", {}, hedge_after=0.03, timeout=8.0,
+                     ctx=ReadContext())
+        assert out["who"] == "fast"
+        assert METRICS.value("hedge_fired_total") == f0 + 1
+        assert METRICS.value("hedge_wins") == w0 + 1
+    finally:
+        pool.close()
+        lead.close()
+        fast.close()
+
+
+# ---------------------------------------------------------------------------
+# in-proc plane (AlphaGroup.read_replica): same stale-never-serves rule
+# ---------------------------------------------------------------------------
+
+
+def _stub_node(nid, applied, is_leader=False, term=1):
+    return types.SimpleNamespace(
+        id=nid,
+        applied_index=applied,
+        raft=types.SimpleNamespace(
+            is_leader=lambda lead=is_leader: lead, term=term
+        ),
+    )
+
+
+def _stub_group(nodes, down=()):
+    g = AlphaGroup.__new__(AlphaGroup)
+    g.id = 1
+    g.net = types.SimpleNamespace(down=set(down))
+    g.nodes = list(nodes)
+    g.read_floor = 0
+    g.floor_known = False
+    return g
+
+
+def test_inproc_leader_serve_establishes_floor_then_follower_serves():
+    lead = _stub_node(1, applied=10, is_leader=True)
+    fol = _stub_node(2, applied=10)
+    g = _stub_group([lead, fol])
+    # a leader-served read refreshes the floor (mirrors the remote
+    # plane's leader health replies)
+    assert g.read_replica() is lead
+    assert g.floor_known and g.read_floor == 10
+    # leaderless with a covering replica: serves, counted as a
+    # follower + leaderless read
+    g.net.down.add(1)
+    fr0 = METRICS.value("follower_reads_total")
+    ll0 = METRICS.value("leaderless_reads_total")
+    assert g.read_replica() is fol
+    assert METRICS.value("follower_reads_total") == fr0 + 1
+    assert METRICS.value("leaderless_reads_total") == ll0 + 1
+
+
+def test_inproc_read_replica_refuses_stale_and_unknown(monkeypatch):
+    # behind the floor: refuse instead of silently serving stale bytes
+    g = _stub_group(
+        [_stub_node(1, applied=10, is_leader=True), _stub_node(2, applied=4)],
+        down={1},
+    )
+    g.read_floor, g.floor_known = 7, True
+    s0 = METRICS.value("follower_read_stale_skips_total")
+    with pytest.raises(GroupLeaderlessError, match="floor=7"):
+        g.read_replica()
+    assert METRICS.value("follower_read_stale_skips_total") == s0 + 1
+    # unknown floor: refuse even a caught-up-looking replica
+    g2 = _stub_group([_stub_node(2, applied=1 << 40)])
+    with pytest.raises(GroupLeaderlessError, match="floor=unknown"):
+        g2.read_replica()
+    # FOLLOWER_READS=0: strict leader-only — leaderless raises
+    monkeypatch.setenv("DGRAPH_TPU_FOLLOWER_READS", "0")
+    g3 = _stub_group(
+        [_stub_node(1, applied=10, is_leader=True), _stub_node(2, applied=99)],
+        down={1},
+    )
+    g3.read_floor, g3.floor_known = 5, True
+    with pytest.raises(GroupLeaderlessError):
+        g3.read_replica()
 
 
 def test_follower_reads_flag_off_is_leader_first_legacy(monkeypatch):
