@@ -1,11 +1,18 @@
 """Project-invariant static-analysis suite (`dgraph-tpu lint`).
 
-Five AST/source-level checkers, each enforcing an invariant PRs 1-3
-introduced by convention and this PR makes machine-checked:
+Eight AST/source-level checkers, each enforcing an invariant that was
+first introduced by convention and is here machine-checked:
 
   config-registry   every DGRAPH_TPU_* env knob goes through x/config
   lock-discipline   no blocking work / native decodes under known
-                    locks; consistent lock acquisition order
+                    locks; pairwise intra-file acquisition order
+  lock-order        the CROSS-module lock-acquisition graph (lexical
+                    nesting + resolved call chains) has no cycles —
+                    a cycle is a potential deadlock
+  shared-state      instance/module state written from thread-entry
+                    functions (Thread targets, pool submits) is either
+                    lock-guarded or carries a `# race-ok: <reason>`
+                    ownership annotation
   deadline-hygiene  retry loops use conn/retry.RetryPolicy; no
                     call-site settimeout constants (conn/worker/zero/raft)
   ctypes-abi        native DECLS match the extern "C" C++ signatures
@@ -32,8 +39,10 @@ from dgraph_tpu.analysis import (
     check_ctypes_abi,
     check_deadline,
     check_jax,
+    check_lockorder,
     check_locks,
     check_metrics,
+    check_shared_state,
 )
 from dgraph_tpu.analysis.allowlist import ALLOWLIST
 from dgraph_tpu.analysis.core import (
@@ -48,6 +57,8 @@ from dgraph_tpu.analysis.core import (
 CHECKERS = {
     check_config.NAME: check_config.check,
     check_locks.NAME: check_locks.check,
+    check_lockorder.NAME: check_lockorder.check,
+    check_shared_state.NAME: check_shared_state.check,
     check_deadline.NAME: check_deadline.check,
     check_ctypes_abi.NAME: check_ctypes_abi.check,
     check_jax.NAME: check_jax.check,

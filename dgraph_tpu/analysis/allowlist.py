@@ -5,6 +5,12 @@ reason. An entry that stops matching anything makes the gate FAIL
 Match semantics (core.Allow): checker + exact repo-relative path +
 (`match` == violation code, or `match` is a substring of the message).
 One entry may cover several violations of the same class in one file.
+
+shared-state findings do NOT belong here: their sanctioned exception
+is the in-source `# race-ok: <ownership reason>` annotation, which
+keeps the justification next to the write it excuses. lock-order
+cycles have no exception mechanism at all — a real cycle is a
+deadlock waiting for a schedule, so fix the ordering.
 """
 
 from __future__ import annotations

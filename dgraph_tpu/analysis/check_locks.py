@@ -28,7 +28,10 @@ Defect classes:
     locations.
 
 Analysis is lexical and intra-function: locks passed across call
-boundaries are out of scope (documented limitation).
+boundaries are out of scope here — check_lockorder.py builds the
+cross-module acquisition graph (call-chain resolution included) and
+catches the inversions whose halves live in different files; this
+checker's lock-order-cycle stays as the fast intra-file form.
 """
 
 from __future__ import annotations

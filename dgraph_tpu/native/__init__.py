@@ -198,6 +198,11 @@ _SAN_FLAGS = {
     # ASan .so needs the asan runtime loaded FIRST: run python under
     # LD_PRELOAD=$(g++ -print-file-name=libasan.so) (see README)
     "asan": ["-fsanitize=address"],
+    # TSan is the only tool that sees races inside the std::thread
+    # fan-outs (vec_qi8_topk_lists, vec_qi8_quantize, batch_apply
+    # under concurrent group-commit batches); same LD_PRELOAD story
+    # with libtsan.so — tests/test_native_san.py drives the matrix
+    "tsan": ["-fsanitize=thread"],
 }
 
 

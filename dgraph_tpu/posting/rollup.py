@@ -93,6 +93,8 @@ class RollupDaemon:
     def _loop(self):
         while not self._stop.wait(self.interval):
             try:
+                # race-ok: single-writer stats counter — only this daemon
+                # thread increments; readers see a GIL-atomic int
                 self.rolled_total += rollup_all(self.server, self.min_deltas)
             except Exception:
                 pass  # rollups are best-effort; next tick retries

@@ -373,9 +373,12 @@ _define(
 )
 _define(
     "NATIVE_SAN", "str", "",
-    "Sanitizer build mode for the native library: 'asan' or 'ubsan' "
-    "compile the .so with the matching -fsanitize= flags under a "
-    "separate cache key; empty = plain -O3 (native/__init__.py).",
+    "Sanitizer build mode for the native library: 'asan', 'tsan' or "
+    "'ubsan' compile the .so with the matching -fsanitize= flags under "
+    "a separate cache key; empty = plain -O3. asan/tsan need the "
+    "runtime preloaded (LD_PRELOAD=$(g++ -print-file-name=libasan.so / "
+    "libtsan.so)) — tests/test_native_san.py and tools/check.sh "
+    "--san-matrix handle this (native/__init__.py).",
 )
 _define(
     "PACKED_MIN_RATIO", "int", 8,
@@ -417,6 +420,15 @@ _define(
     "equivalent by construction (golden-corpus-enforced byte "
     "identity); 0 restores declaration-order execution — the A/B "
     "escape hatch.",
+)
+_define(
+    "RACE_FUZZ", "bool", False,
+    "GIL-fuzz race harness: when set, tests/conftest.py pins "
+    "sys.setswitchinterval(1e-6) so the interpreter forces a thread "
+    "switch roughly every bytecode, surfacing latent Python-level "
+    "races in the fixed-seed concurrency suites deterministically "
+    "instead of once a month under full-suite load. Run via "
+    "tools/check.sh --race-sanity.",
 )
 _define(
     "READ_BREAKER_ERRORS", "int", 3,

@@ -41,6 +41,21 @@ _xb._backend_factories.pop("axon", None)
 jax.config.update("jax_platforms", "cpu")
 
 
+# GIL-fuzz race harness (DGRAPH_TPU_RACE_FUZZ / check.sh --race-sanity):
+# a ~1µs switch interval forces a thread switch roughly every bytecode,
+# so a read-modify-write race that needs an unlucky preemption between
+# LOAD and STORE hits on nearly every iteration instead of once a month
+# under full-suite load. Env read is raw on purpose — conftest runs
+# before dgraph_tpu imports are safe, and tests/ is outside the
+# config-registry analyzer's scan root.
+if os.environ.get("DGRAPH_TPU_RACE_FUZZ", "").strip().lower() in (
+    "1", "true", "yes", "on"
+):
+    import sys as _sys
+
+    _sys.setswitchinterval(1e-6)
+
+
 def pytest_configure(config):
     # tier-1 runs `-m 'not slow'`: the parallel-executor smoke subset
     # (test_parallel_exec.py, DGRAPH_TPU_EXEC_WORKERS=4 over sampled DQL

@@ -1,20 +1,35 @@
-"""Sanitizer builds of the native kernels (DGRAPH_TPU_NATIVE_SAN).
+"""Sanitizer matrix for the native kernels (DGRAPH_TPU_NATIVE_SAN).
 
-The randomized packed-setops equivalence corpus is the best UB probe we
-have for the C++ hot paths (block-skip intersect, partial decode,
-bulk reduce): it drives adversarial block alignments, UINT32_MAX uids
-and empty/singleton blocks through the same ctypes bindings production
-uses. Here it re-runs in a subprocess whose native .so is compiled
-with -fsanitize=undefined -fno-sanitize-recover=all, so ANY signed
-overflow / misaligned access / OOB shift aborts the interpreter and
-fails the test. slow-marked: it recompiles the library and re-runs a
-whole test module.
+Three instrumented builds of codec.cpp/bulkload.cpp, each re-running
+the byte-equality corpora through the same ctypes bindings production
+uses:
+
+  ubsan  -fsanitize=undefined -fno-sanitize-recover=all — any signed
+         overflow / misaligned access / OOB shift aborts;
+  asan   -fsanitize=address — heap/stack OOB and use-after-free in the
+         kernels abort (leak checking off: the interpreter itself is
+         not instrumented);
+  tsan   -fsanitize=thread — data races inside the std::thread
+         fan-outs (vec_qi8_topk_lists, vec_qi8_quantize, batch_apply)
+         abort; the GIL is released for the whole native call, so this
+         is the only tool that can see them. Runs the threaded stress
+         corpus (test_native_threads.py) plus the kernels' own suites.
+
+asan/tsan instrument a .so loaded into an UNinstrumented python, so
+the matching runtime must be LD_PRELOADed; `_preload_env` resolves it
+via `g++ -print-file-name=...` and the tests skip when the toolchain
+lacks it. Each mode also carries a seeded-defect proof: a deliberately
+racy / overflowing mini-library built the same way must make the run
+FAIL — the matrix is demonstrably able to detect its defect class,
+not just green by silence. All slow-marked: each mode recompiles the
+library and re-runs whole test modules (tools/check.sh --san-matrix).
 """
 
 import os
 import shutil
 import subprocess
 import sys
+import textwrap
 
 import pytest
 
@@ -22,12 +37,75 @@ pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# the byte-equality corpus: test_bitmap_setops drives the adaptive-
+# engine kernels (bitmap AND/ANDNOT windows, probes, galloping merges)
+# through the adversarial corpus; test_stream_encoder covers the arena
+# encoder entry points (enc_uid_objs / enc_int_objs) incl. the
+# INT64_MIN negation and 0xfff... hex edge values; test_vector_quant
+# drives the quantized vector kernels (vec_qi8_topk / vec_qi8_topk_idx,
+# the threaded vec_qi8_topk_lists CSR scan, the vec_qi8_quantize row
+# quantizer) through adversarial scales, duplicates, tombstones,
+# empty/aliased slices; test_group_commit drives the mutation
+# write-path kernels (enc_delta_records over the randomized posting
+# corpus incl. 0-length and max-u64 values, tok_terms_ascii) through
+# their byte-equality suites; test_batch_apply drives the columnar
+# batch_apply/batch_apply_caps kernels (fused tokenize + index-key
+# emission + record encode) through the randomized mixed-shape A/B
+# corpus; test_native_threads hammers the -pthread kernels from many
+# Python threads at once (the TSan target shape).
+_FULL_CORPUS = [
+    "tests/test_packed_setops.py", "tests/test_uidpack.py",
+    "tests/test_bitmap_setops.py", "tests/test_stream_encoder.py",
+    "tests/test_vector_quant.py", "tests/test_group_commit.py",
+    "tests/test_batch_apply.py", "tests/test_native_threads.py",
+]
+# tsan runs 5-20x slower, so its slice is the threaded kernels only —
+# races in the single-threaded kernels are impossible by construction
+# (no threads), and ubsan/asan already cover their memory behaviour
+_THREADED_CORPUS = [
+    "tests/test_native_threads.py", "tests/test_vector_quant.py",
+    "tests/test_batch_apply.py",
+]
+
+
+def _runtime_lib(name: str):
+    """Absolute path of the sanitizer runtime, or None if the
+    toolchain doesn't ship it."""
+    try:
+        r = subprocess.run(
+            ["g++", f"-print-file-name={name}"],
+            capture_output=True, text=True, timeout=30,
+        )
+    except Exception:
+        return None
+    path = r.stdout.strip()
+    return path if os.path.isabs(path) and os.path.exists(path) else None
+
 
 def _san_env(mode: str) -> dict:
     env = dict(os.environ)
     env["DGRAPH_TPU_NATIVE_SAN"] = mode
     env["JAX_PLATFORMS"] = "cpu"
     env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
+    # the interpreter is uninstrumented: intercepted allocations can't
+    # be leak-tracked meaningfully, and halt_on_error is the contract
+    env["ASAN_OPTIONS"] = "detect_leaks=0:halt_on_error=1"
+    # suppressions: only our .so is instrumented — XLA's uninstrumented
+    # internal synchronization is invisible to TSan and reports as
+    # races the moment a test dispatches real XLA work (tools/tsan.supp)
+    supp = os.path.join(REPO, "tools", "tsan.supp")
+    env["TSAN_OPTIONS"] = f"halt_on_error=1:suppressions={supp}"
+    if mode in ("asan", "tsan"):
+        lib = _runtime_lib(f"lib{mode}.so")
+        if lib is None:
+            pytest.skip(f"toolchain lacks lib{mode}.so")
+        # co-preload libstdc++: python itself doesn't link it, so the
+        # sanitizer's __cxa_throw interceptor would find no real fn at
+        # init and CHECK-fail the first time jax's MLIR bindings throw
+        stdcpp = _runtime_lib("libstdc++.so.6") or _runtime_lib(
+            "libstdc++.so"
+        )
+        env["LD_PRELOAD"] = f"{lib} {stdcpp}" if stdcpp else lib
     return env
 
 
@@ -43,9 +121,32 @@ def _native_available(env: dict) -> bool:
     return r.returncode == 0 and r.stdout.strip() == "1"
 
 
-def test_ubsan_build_is_separate_cache_entry(tmp_path):
+def _require_toolchain():
     if shutil.which("g++") is None:
         pytest.skip("no g++ in this environment")
+
+
+def _run_corpus(mode: str, modules, timeout=1800):
+    _require_toolchain()
+    env = _san_env(mode)
+    if not _native_available(env):
+        pytest.skip(f"{mode} build unavailable in this toolchain")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", *modules,
+            "-q", "-m", "not slow", "-p", "no:cacheprovider",
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    assert r.returncode == 0, (
+        f"corpus failed under {mode}:\n"
+        + r.stdout[-4000:] + r.stderr[-4000:]
+    )
+
+
+def test_ubsan_build_is_separate_cache_entry(tmp_path):
+    _require_toolchain()
     env = _san_env("ubsan")
     env["DGRAPH_TPU_NATIVE_CACHE"] = str(tmp_path)
     if not _native_available(env):
@@ -58,41 +159,99 @@ def test_ubsan_build_is_separate_cache_entry(tmp_path):
 
 
 def test_packed_setops_corpus_under_ubsan():
-    if shutil.which("g++") is None:
-        pytest.skip("no g++ in this environment")
-    env = _san_env("ubsan")  # default cache dir: reuses the -ubsan .so
-    if not _native_available(env):
-        pytest.skip("ubsan build unavailable (toolchain lacks libubsan)")
+    _run_corpus("ubsan", _FULL_CORPUS, timeout=900)
+
+
+def test_corpus_under_asan():
+    _run_corpus("asan", _FULL_CORPUS, timeout=1800)
+
+
+def test_threaded_corpus_under_tsan():
+    _run_corpus("tsan", _THREADED_CORPUS, timeout=1800)
+
+
+# ---------------------------------------------------------------------------
+# seeded-defect proofs: the matrix must DETECT, not just stay green
+# ---------------------------------------------------------------------------
+
+_RACY_CPP = """
+#include <cstdint>
+#include <thread>
+
+extern "C" int64_t racy_count(int64_t iters) {
+    int64_t shared = 0;  // unsynchronized: both threads hammer it
+    auto body = [&]() {
+        for (int64_t i = 0; i < iters; i++) shared++;
+    };
+    std::thread a(body), b(body);
+    a.join();
+    b.join();
+    return shared;
+}
+"""
+
+_OOB_CPP = """
+#include <cstdint>
+
+extern "C" int64_t oob_read(int64_t n) {
+    int64_t* buf = new int64_t[n];
+    for (int64_t i = 0; i < n; i++) buf[i] = i;
+    int64_t got = buf[n];  // one past the end
+    delete[] buf;
+    return got;
+}
+"""
+
+
+def _seeded_defect_run(tmp_path, mode: str, cpp: str, fn: str, arg: int):
+    """Build a mini .so the exact way native/_build_and_load does
+    (same flags, uninstrumented python + LD_PRELOAD), call the seeded
+    function through ctypes, and return the subprocess result."""
+    _require_toolchain()
+    env = _san_env(mode)
+    src = tmp_path / "seeded.cpp"
+    so = tmp_path / "seeded.so"
+    src.write_text(textwrap.dedent(cpp))
+    flags = {
+        "tsan": ["-fsanitize=thread"],
+        "asan": ["-fsanitize=address"],
+    }[mode]
     r = subprocess.run(
         [
-            sys.executable, "-m", "pytest",
-            # test_bitmap_setops drives the adaptive-engine kernels
-            # (bitmap AND/ANDNOT windows, probes, galloping merges)
-            # through the same adversarial corpus; test_stream_encoder
-            # covers the arena encoder entry points (enc_uid_objs /
-            # enc_int_objs) incl. the INT64_MIN negation and 0xfff...
-            # hex edge values; test_vector_quant drives the quantized
-            # vector kernels (vec_qi8_topk / vec_qi8_topk_idx, the
-            # threaded vec_qi8_topk_lists CSR scan, and the
-            # vec_qi8_quantize row quantizer) through adversarial
-            # scales, duplicates, tombstones, empty/aliased slices
-            # test_group_commit drives the mutation write-path kernels
-            # (enc_delta_records batched record serialization over the
-            # randomized posting corpus incl. 0-length and max-u64
-            # values, tok_terms_ascii over adversarial ASCII) through
-            # their byte-equality suites; test_batch_apply drives the
-            # columnar batch_apply/batch_apply_caps kernels (fused
-            # tokenize + index-key emission + record encode) through
-            # the randomized mixed-shape A/B byte-equality corpus
-            "tests/test_packed_setops.py", "tests/test_uidpack.py",
-            "tests/test_bitmap_setops.py", "tests/test_stream_encoder.py",
-            "tests/test_vector_quant.py", "tests/test_group_commit.py",
-            "tests/test_batch_apply.py",
-            "-q", "-m", "not slow", "-p", "no:cacheprovider",
+            "g++", "-O1", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            *flags, "-o", str(so), str(src),
         ],
-        env=env, cwd=REPO, capture_output=True, text=True, timeout=900,
+        capture_output=True, text=True, timeout=120,
     )
-    assert r.returncode == 0, (
-        "packed-setops corpus failed under UBSan:\n"
-        + r.stdout[-4000:] + r.stderr[-4000:]
+    if r.returncode != 0:
+        pytest.skip(f"{mode} compile unavailable: {r.stderr[-500:]}")
+    return subprocess.run(
+        [
+            sys.executable, "-c",
+            "import ctypes, sys; "
+            f"lib = ctypes.CDLL({str(so)!r}); "
+            f"lib.{fn}.restype = ctypes.c_int64; "
+            f"lib.{fn}.argtypes = [ctypes.c_int64]; "
+            f"print(lib.{fn}({arg}))",
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
     )
+
+
+def test_tsan_detects_seeded_race(tmp_path):
+    r = _seeded_defect_run(tmp_path, "tsan", _RACY_CPP,
+                           "racy_count", 200000)
+    assert r.returncode != 0, (
+        "TSan missed a seeded data race — the matrix is blind:\n"
+        + r.stdout[-2000:] + r.stderr[-2000:]
+    )
+    assert "data race" in (r.stdout + r.stderr).lower()
+
+
+def test_asan_detects_seeded_overflow(tmp_path):
+    r = _seeded_defect_run(tmp_path, "asan", _OOB_CPP, "oob_read", 64)
+    assert r.returncode != 0, (
+        "ASan missed a seeded heap overflow — the matrix is blind:\n"
+        + r.stdout[-2000:] + r.stderr[-2000:]
+    )
+    assert "heap-buffer-overflow" in (r.stdout + r.stderr)

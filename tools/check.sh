@@ -19,6 +19,22 @@
 #                           bank + query mix, follower-served responses
 #                           byte-checked against a leader-routed control
 #                           replay (tools/chaos_soak.py --sanity)
+#   tools/check.sh --race-sanity
+#                           GIL-fuzz race slice (~30s): re-runs the
+#                           fixed-seed concurrency suites (group commit,
+#                           apply shards, follower reads, serving front,
+#                           native-thread stress) with
+#                           DGRAPH_TPU_RACE_FUZZ=1, which pins
+#                           sys.setswitchinterval(1e-6) so latent
+#                           Python-level races surface deterministically
+#   tools/check.sh --san-matrix
+#                           the full sanitizer matrix (SLOW: recompiles
+#                           the native library 3x and re-runs whole
+#                           corpora): UBSan + ASan over the byte-equality
+#                           corpus, TSan over the threaded kernel stress
+#                           corpus, plus the seeded-defect proofs that
+#                           each sanitizer actually detects its class
+#                           (tests/test_native_san.py)
 #
 # Exit code is nonzero on the first failing stage, so CI can consume it
 # directly. JAX is pinned to CPU: the gate must never dial an accelerator.
@@ -46,6 +62,25 @@ if [[ "${1:-}" == "--read-chaos-sanity" ]]; then
     echo "== read-plane chaos sanity: leader kill + byte-identity replay =="
     python tools/chaos_soak.py --sanity
     echo "check.sh: read-chaos-sanity passed"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--race-sanity" ]]; then
+    echo "== GIL-fuzz race slice (~30s): switchinterval=1e-6 concurrency suites =="
+    DGRAPH_TPU_RACE_FUZZ=1 python -m pytest \
+        tests/test_group_commit.py tests/test_batch_apply.py \
+        tests/test_follower_reads.py tests/test_serving_front.py \
+        tests/test_native_threads.py \
+        -q -m 'not slow' -p no:cacheprovider
+    echo "check.sh: race-sanity passed"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--san-matrix" ]]; then
+    echo "== sanitizer matrix (slow): ubsan + asan corpus, tsan threaded =="
+    python -m pytest tests/test_native_san.py -q -m slow \
+        -p no:cacheprovider
+    echo "check.sh: san-matrix passed"
     exit 0
 fi
 
