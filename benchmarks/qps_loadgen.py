@@ -189,12 +189,19 @@ _ROW_COUNTERS = (
 def metric_base() -> dict:
     """Counter + batch-width-histogram snapshot before a measurement
     window (pair with stamp_metric_deltas)."""
+    from dgraph_tpu.serving.digest import DIGESTS
     from dgraph_tpu.utils.observe import METRICS
 
     base = {k: METRICS.value(k) for k in _ROW_COUNTERS}
     base["_gc_sum"], base["_gc_count"] = METRICS.hist_stats(
         "group_commit_batch_size"
     )
+    # digest-store totals: every BENCH_QPS row reports how many calls
+    # the flight recorder aggregated during its window plus the top
+    # shape's latency share (skew visibility per point)
+    dt = DIGESTS.totals()
+    base["_digest_calls"] = dt["calls"]
+    base["_digest_errors"] = dt["errors"]
     return base
 
 
@@ -225,6 +232,15 @@ def stamp_metric_deltas(row: dict, base: dict) -> dict:
     dc = c - base["_gc_count"]
     row["group_commit_batch_width"] = (
         round((s - base["_gc_sum"]) / dc, 2) if dc else 0.0
+    )
+    from dgraph_tpu.serving.digest import DIGESTS
+
+    dt = DIGESTS.totals()
+    row["digest_calls"] = int(dt["calls"] - base["_digest_calls"])
+    row["digest_errors"] = int(dt["errors"] - base["_digest_errors"])
+    row["digest_shapes"] = int(dt["shapes"])
+    row["digest_top_shape_lat_share"] = round(
+        dt["top_shape_lat_share"], 4
     )
     return row
 

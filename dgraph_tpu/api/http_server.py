@@ -73,7 +73,9 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes ---------------------------------------------------------------
 
     def do_GET(self):
-        path = urlparse(self.path).path
+        parsed_url = urlparse(self.path)
+        path = parsed_url.path
+        get_qs = parse_qs(parsed_url.query)
         if path == "/graphql":
             from dgraph_tpu.api import ws
 
@@ -141,6 +143,82 @@ class _Handler(BaseHTTPRequestHandler):
 
             health = getattr(self.engine, "health", None)
             self._reply(health() if health is not None else observe.healthz())
+        elif path == "/debug/digests":
+            # cluster engines merge every process's digest store
+            # (rows summed by (ns, shape)); single-process engines
+            # serve the local store
+            merged_digests = getattr(self.engine, "merged_digests", None)
+            if merged_digests is not None:
+                self._reply(merged_digests())
+            else:
+                from dgraph_tpu.serving.digest import DIGESTS
+
+                self._reply({"digests": DIGESTS.snapshot()})
+        elif path == "/debug/history":
+            from dgraph_tpu.utils.observe import HISTORY
+
+            try:
+                window = float(get_qs.get("window", ["600"])[0])
+            except ValueError:
+                window = 600.0
+            merged_history = getattr(self.engine, "merged_history", None)
+            if merged_history is not None:
+                self._reply(merged_history(window))
+            else:
+                self._reply(HISTORY.report(window))
+        elif path == "/debug/profile":
+            from dgraph_tpu.utils.profiler import AUTO, PROFILER
+
+            if get_qs.get("last"):
+                folded = AUTO.last() or ""
+                data = folded.encode()
+                self.send_response(200 if folded else 404)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                try:
+                    seconds = float(get_qs.get("seconds", ["5"])[0])
+                except ValueError:
+                    seconds = 5.0
+                data = PROFILER.profile(
+                    min(max(seconds, 0.05), 60.0)
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+        elif path == "/debug/slowlog":
+            from dgraph_tpu.utils.observe import slow_query_log
+
+            body = b""
+            log = slow_query_log()
+            if log is not None:
+                try:
+                    with open(log.path, "rb") as f:
+                        body = f.read()
+                except OSError:
+                    body = b""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/debug/config":
+            from dgraph_tpu.x import config as _cfg
+
+            self._reply(_cfg.resolved())
+        elif path == "/debug/bundle":
+            bundle = getattr(self.engine, "debug_bundle", None)
+            if bundle is None:
+                return self._error("no cluster engine behind this facade", 404)
+            try:
+                window = float(get_qs.get("window", ["600"])[0])
+            except ValueError:
+                window = 600.0
+            self._reply(bundle(window))
         elif path == "/debug/openmetrics":
             from dgraph_tpu.utils.observe import METRICS
 

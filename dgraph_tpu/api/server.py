@@ -38,6 +38,7 @@ from dgraph_tpu.worker.groupcommit import (
 )
 from dgraph_tpu.query.streamjson import encode_response_data
 from dgraph_tpu.query.subgraph import Executor
+from dgraph_tpu.serving.digest import DIGESTS
 from dgraph_tpu.schema.schema import State, parse_schema
 from dgraph_tpu.storage.kv import KV, open_kv
 from dgraph_tpu.types.types import TypeID, Val
@@ -93,6 +94,7 @@ class TxnHandle:
             sorted({nq.predicate for nq in set_nqs + del_nqs}),
             body,
         )
+        self.txn.tenant_ns = ns  # per-tenant commit SLO slice
         uids = self.server._apply_nquads(self.txn, set_nqs, del_nqs, ns)
         if commit_now:
             self.commit()
@@ -118,6 +120,7 @@ class TxnHandle:
                 sorted(_json_preds(set_obj) | _json_preds(del_obj)),
                 body,
             )
+        self.txn.tenant_ns = ns  # per-tenant commit SLO slice
         uids = self.server._apply_json(self.txn, set_obj, del_obj, ns)
         if commit_now:
             self.commit()
@@ -596,6 +599,7 @@ class Server:
         # budget; no-op with DGRAPH_TPU_ADMISSION off)
         n_edges = txn.pending_postings()
         ticket = self.serving.admit_write(n_edges)
+        t_commit0 = time.monotonic()
         try:
             if not bool(_config.get("GROUP_COMMIT")):
                 # escape hatch (DGRAPH_TPU_GROUP_COMMIT=0): today's
@@ -630,6 +634,14 @@ class Server:
                 "mutation_edges_total",
                 sum(len(p) for p in txn.cache.deltas.values())
                 + getattr(txn, "col_nposts", 0),
+            )
+            # per-tenant SLO slice: mutate paths stamp the resolved
+            # namespace onto the txn; untagged txns (direct _commit
+            # callers) count against the galaxy default
+            observe.note_tenant(
+                "commit",
+                getattr(txn, "tenant_ns", keys.GALAXY_NS),
+                time.monotonic() - t_commit0,
             )
             return commit_ts
         finally:
@@ -1195,11 +1207,25 @@ class Server:
         import time as _time
 
         t_begin = _time.monotonic()
-        parse_info: Optional[dict] = {} if debug else None
-        # plan cache: repeated query shapes skip parse entirely
-        blocks, shape, literals = self.serving.parse(
-            q, variables, info=parse_info
-        )
+        # info is now always collected: the digest store records the
+        # plan-cache outcome per shape, not just EXPLAIN requests (the
+        # fill is three dict writes — observation-only either way)
+        parse_info: dict = {}
+        digested = False  # one digest record per query, on every path
+        try:
+            # plan cache: repeated query shapes skip parse entirely
+            blocks, shape, literals = self.serving.parse(
+                q, variables, info=parse_info
+            )
+        except Exception:
+            # unparseable queries accrue to the per-ns `other` bucket —
+            # a flood of malformed text is an operator-visible shape
+            if DIGESTS.enabled():
+                DIGESTS.record(
+                    keys.GALAXY_NS, None,
+                    _time.monotonic() - t_begin, error=True,
+                )
+            raise
         t_parsed = _time.monotonic()
         # admission gate BEFORE the read-ts allocation: a shed must be
         # FAST and side-effect-free — under overload the oracle's
@@ -1313,6 +1339,15 @@ class Server:
                 # shape only when `completed`): a hit's latency
                 # describes the cache, not the shape's execution cost
                 # the admission gate estimates
+                if DIGESTS.enabled():
+                    DIGESTS.record(
+                        ns, shape, t_done - t_begin,
+                        nbytes=len(raw_hit),
+                        plan_hit=bool(parse_info.get("hit")),
+                        result_hit=True,
+                    )
+                    digested = True
+                observe.note_tenant("query", ns, t_done - t_assigned)
                 return hit_response(
                     raw_hit, want,
                     parsing_ns=int((t_parsed - t_begin) * 1e9),
@@ -1415,11 +1450,39 @@ class Server:
                 METRICS.inc("degraded_queries_total")
                 ext["degraded"] = True
                 ext["partial"] = True
+            if DIGESTS.enabled():
+                data = out.get("data")
+                rows = (
+                    sum(
+                        len(v)
+                        for v in data.values()
+                        if isinstance(v, list)
+                    )
+                    if isinstance(data, dict)
+                    else 0
+                )
+                DIGESTS.record(
+                    ns, shape, t_done - t_begin,
+                    rows=rows,
+                    nbytes=int(prof.encode.get("bytes", 0)),
+                    error=truncated,
+                    plan_hit=bool(parse_info.get("hit")),
+                    setop_pairs=int(
+                        prof.events.get("setop_pairs_total", 0)
+                    ),
+                    setop_packed=int(
+                        prof.events.get("setop_packed_total", 0)
+                    ),
+                )
+                digested = True
+            observe.note_tenant("query", ns, t_done - t_assigned)
             # structured slow-query log (ref x/log.go LogSlowOperation,
-            # edgraph/server.go:1448): force-sample + bounded JSONL
+            # edgraph/server.go:1448): force-sample + bounded JSONL —
+            # the digest shape key rides along so a slow entry joins
+            # its aggregate row in /debug/digests
             slow = observe.maybe_log_slow(
                 "query", q, took_ms, root,
-                extra={"ns": ns},
+                extra={"ns": ns, "shape": shape},
                 threshold_ms=self.slow_query_ms,
             )
             completed = not truncated
@@ -1429,6 +1492,14 @@ class Server:
                     self.serving.results.put(rc_key, raw)
             return out
         finally:
+            # a query that entered execution but never reached a digest
+            # record (ACL denial, semantic error, client deadline)
+            # still counts against its shape — errors are a first-class
+            # digest column
+            if not digested and DIGESTS.enabled():
+                DIGESTS.record(
+                    ns, shape, _time.monotonic() - t_begin, error=True,
+                )
             # only clean completions feed the shape cost EWMA: a
             # truncated/denied/failed run's latency describes the
             # failure, not the shape

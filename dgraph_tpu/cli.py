@@ -18,6 +18,9 @@ Subcommands mirror the reference's cobra tree (root.go:80):
   conv     — geo/JSON -> RDF conversion (ref cmd/conv)
   migrate  — relational CSV -> RDF + schema (ref cmd/migrate)
   debuginfo — support bundle (ref cmd/debuginfo)
+  top      — top query shapes by latency share (/debug/digests)
+  debug-bundle — one-command flight-recorder tarball (metrics,
+             digests, history, health, traces, lock graph, config)
   upgrade  — on-disk layout migrations (ref upgrade/)
   version
 
@@ -29,6 +32,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 
 def _server(args):
@@ -791,6 +795,193 @@ def cmd_health(args):
     return 0
 
 
+def _render_top(rows, n: int) -> str:
+    """Top-N digest rows by latency share — one line per (ns, shape)."""
+    total_lat = sum(r.get("lat_sum", 0.0) for r in rows) or 1.0
+    lines = [
+        "%8s %6s %7s %7s %9s %6s %6s %4s  %s"
+        % (
+            "CALLS", "ERR", "LAT%", "MEAN_MS", "ROWS", "PHIT%",
+            "RHIT%", "NS", "SHAPE",
+        )
+    ]
+    for r in rows[:n]:
+        calls = r.get("calls", 0) or 0
+        lat = r.get("lat_sum", 0.0)
+        shape = r.get("shape", "")
+        if len(shape) > 88:
+            shape = shape[:85] + "..."
+        lines.append(
+            "%8d %6d %6.1f%% %7.2f %9d %5.0f%% %5.0f%% %4s  %s"
+            % (
+                calls,
+                r.get("errors", 0),
+                100.0 * lat / total_lat,
+                (lat / calls * 1e3) if calls else 0.0,
+                r.get("rows", 0),
+                100.0 * r.get("plan_hits", 0) / calls if calls else 0.0,
+                100.0 * r.get("result_hits", 0) / calls if calls else 0.0,
+                r.get("ns", "?"),
+                shape,
+            )
+        )
+    return "\n".join(lines)
+
+
+def cmd_top(args):
+    """pg_stat_statements for the cluster: scrape /debug/digests of a
+    running alpha (cluster-merged per-(namespace, shape) aggregates)
+    and render the top-N query shapes by latency share. `--watch`
+    refreshes in place every --interval seconds."""
+    import urllib.request
+
+    url = args.addr.rstrip("/") + "/debug/digests"
+
+    def fetch():
+        body = json.loads(
+            urllib.request.urlopen(url, timeout=args.timeout).read()
+        )
+        return body
+
+    try:
+        while True:
+            try:
+                body = fetch()
+            except Exception as e:
+                print(f"scrape of {url} failed: {e}", file=sys.stderr)
+                return 1
+            rows = body.get("digests", [])
+            if args.json:
+                print(json.dumps(body, indent=2, sort_keys=True))
+            else:
+                if args.watch:
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                unreachable = body.get("unreachable_instances") or []
+                if unreachable:
+                    print(
+                        "WARNING: partial merge, unreachable: "
+                        + ", ".join(unreachable)
+                    )
+                print(_render_top(rows, args.n))
+            if not args.watch:
+                return 0
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_debug_bundle(args):
+    """One-command flight-recorder capture: fetch merged metrics,
+    digests, a history window, health, traces, tablets, the slow-query
+    log, and the resolved config from a running alpha, compute the
+    static lock graph locally, and pack everything into one tarball. A
+    dead alpha (or any failing endpoint) yields a PARTIAL bundle with
+    the failure recorded in MANIFEST.json — never an empty exit."""
+    import io
+    import tarfile
+    import urllib.parse
+    import urllib.request
+
+    base = args.addr.rstrip("/")
+    window = float(args.window)
+    endpoints = {
+        "metrics.prom": "/debug/prometheus_metrics",
+        "digests.json": "/debug/digests",
+        "history.json": (
+            "/debug/history?" + urllib.parse.urlencode({"window": window})
+        ),
+        "health.json": "/debug/healthz",
+        "traces.json": "/debug/traces",
+        "tablets.json": "/debug/tablets",
+        "slowlog.jsonl": "/debug/slowlog",
+        "config.json": "/debug/config",
+    }
+    files: dict = {}
+    manifest: dict = {
+        "generated": time.time(),
+        "addr": base,
+        "window_s": window,
+        "files": {},
+        "unreachable_instances": [],
+    }
+    unreachable = set()
+    for name, path in endpoints.items():
+        url = base + path
+        try:
+            data = urllib.request.urlopen(
+                url, timeout=args.timeout
+            ).read()
+            files[name] = data
+            manifest["files"][name] = {"ok": True, "bytes": len(data)}
+            if name.endswith(".json"):
+                try:
+                    body = json.loads(data)
+                    unreachable.update(
+                        body.get("unreachable_instances") or []
+                    )
+                except ValueError:
+                    pass
+        except Exception as e:
+            manifest["files"][name] = {"ok": False, "error": str(e)}
+            print(f"  {name}: FAILED ({e})", file=sys.stderr)
+    # the static lock graph (PR 19's analyzer) and resolved config are
+    # computed locally — they describe the code/process, not the
+    # cluster, so a dead alpha cannot take them down
+    try:
+        from dgraph_tpu.analysis import load_sources, package_root
+        from dgraph_tpu.analysis.check_lockorder import lock_graph
+
+        edges = [
+            {
+                "outer": outer,
+                "inner": inner,
+                "path": path,
+                "line": line,
+                "kind": kind,
+            }
+            for (outer, inner), (path, line, kind) in sorted(
+                lock_graph(load_sources(package_root())).items()
+            )
+        ]
+        files["lockgraph.json"] = json.dumps(
+            {"edges": edges}, indent=2
+        ).encode()
+        manifest["files"]["lockgraph.json"] = {"ok": True}
+    except Exception as e:
+        manifest["files"]["lockgraph.json"] = {
+            "ok": False, "error": str(e),
+        }
+    if "config.json" not in files:
+        from dgraph_tpu.x import config as _cfg
+
+        files["config.json"] = json.dumps(
+            _cfg.resolved(), indent=2, default=str
+        ).encode()
+        manifest["files"]["config.json"] = {"ok": True, "local": True}
+    manifest["unreachable_instances"] = sorted(unreachable)
+    out_path = args.out or time.strftime("debug-bundle-%Y%m%d-%H%M%S.tar.gz")
+    files["MANIFEST.json"] = json.dumps(
+        manifest, indent=2, sort_keys=True
+    ).encode()
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name in sorted(files):
+            data = files[name]
+            info = tarfile.TarInfo(name=f"debug-bundle/{name}")
+            info.size = len(data)
+            info.mtime = int(manifest["generated"])
+            tar.addfile(info, io.BytesIO(data))
+    ok = sum(1 for f in manifest["files"].values() if f.get("ok"))
+    total = len(manifest["files"])
+    partial = "" if ok == total else f" (PARTIAL: {ok}/{total} sections)"
+    print(f"wrote {out_path}{partial}")
+    if manifest["unreachable_instances"]:
+        print(
+            "unreachable instances: "
+            + ", ".join(manifest["unreachable_instances"])
+        )
+    return 0
+
+
 def cmd_metrics_ref(args):
     """Regenerate (or print) the METRICS.md metric-name reference."""
     from dgraph_tpu.utils import observe
@@ -1081,6 +1272,54 @@ def main(argv=None):
     )
     p.add_argument("--timeout", type=float, default=5.0)
     p.set_defaults(fn=cmd_health)
+
+    p = sub.add_parser(
+        "top",
+        help="top query shapes by latency share (cluster-merged "
+        "/debug/digests — pg_stat_statements for DQL)",
+    )
+    p.add_argument(
+        "--addr", default="http://localhost:8080",
+        help="base URL of a running alpha",
+    )
+    p.add_argument(
+        "-n", type=int, default=20, help="rows to show (default 20)"
+    )
+    p.add_argument(
+        "--watch", action="store_true",
+        help="refresh in place until interrupted",
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh interval with --watch (seconds)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="raw digest JSON instead of the rendered table",
+    )
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "debug-bundle",
+        help="capture metrics, digests, history, health, traces, "
+        "slow-query log, lock graph, and resolved config into one "
+        "tarball (partial bundle when instances are down)",
+    )
+    p.add_argument(
+        "--addr", default="http://localhost:8080",
+        help="base URL of a running alpha",
+    )
+    p.add_argument(
+        "-o", "--out", default=None,
+        help="output tarball path (default debug-bundle-<ts>.tar.gz)",
+    )
+    p.add_argument(
+        "--window", type=float, default=600.0,
+        help="history window to capture (seconds, default 600)",
+    )
+    p.add_argument("--timeout", type=float, default=10.0)
+    p.set_defaults(fn=cmd_debug_bundle)
 
     p = sub.add_parser(
         "metrics-ref",

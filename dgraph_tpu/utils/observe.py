@@ -224,6 +224,13 @@ class Metrics:
             h = self._hists.get(name)
             return (h.sum, h.total) if h is not None else (0.0, 0)
 
+    def hist_snapshot(self) -> Dict[str, Tuple[float, int]]:
+        """(sum, count) of EVERY histogram — the metrics-history ring's
+        histogram component (per-bucket counts stay out of the ring;
+        windowed mean latency needs only sum/count deltas)."""
+        with self._lock:
+            return {k: (h.sum, h.total) for k, h in self._hists.items()}
+
     def exemplars(self, name: str) -> List[dict]:
         """The retained exemplars of one histogram: [{le, value,
         trace_id, ts}] — what the slow-query log embeds to close the
@@ -440,6 +447,68 @@ _SLO_TRACKED: Dict[str, SloWindows] = {
 
 def slo_report() -> dict:
     return {name: slo.report() for name, slo in _SLO_TRACKED.items()}
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant SLO slices (flight recorder)
+# ---------------------------------------------------------------------------
+
+# bounded per-(kind, namespace) burn windows: the entry points call
+# note_tenant on every served query/commit with the resolved namespace,
+# so one noisy tenant's burn is visible in healthz before any isolation
+# work lands. The cap bounds healthz payload and memory under namespace
+# churn — namespaces past it are simply not sliced (the global SLO
+# still counts them).
+_TENANT_LOCK = threading.Lock()
+_TENANT_SLO: Dict[Tuple[str, str], SloWindows] = {}
+_TENANT_CAP = 64
+
+
+def note_tenant(kind: str, ns, seconds: float) -> None:
+    """Fold one served operation into its per-namespace SLO window.
+    `kind` is "query" or "commit" (mirroring _SLO_TRACKED); `ns` is the
+    resolved namespace (any int/str). SloWindows.note locks internally,
+    so nothing blocking runs under _TENANT_LOCK."""
+    key = (str(kind), str(ns))
+    with _TENANT_LOCK:
+        slo = _TENANT_SLO.get(key)
+        if slo is None:
+            if len(_TENANT_SLO) >= _TENANT_CAP:
+                return
+            slo = _TENANT_SLO[key] = SloWindows()
+    slo.note(seconds)
+
+
+def tenant_slo_report() -> dict:
+    """{kind: {ns: SloWindows.report()}} for every sliced tenant."""
+    with _TENANT_LOCK:
+        items = list(_TENANT_SLO.items())
+    out: Dict[str, dict] = {}
+    for (kind, ns), slo in sorted(items):
+        out.setdefault(kind, {})[ns] = slo.report()
+    return out
+
+
+def tenant_traffic_rollup() -> dict:
+    """Per-namespace traffic totals aggregated from the tablet traffic
+    table: {ns: {reads, read_uids, mutation_edges, result_bytes}} — the
+    healthz tenants section's volume view next to the burn rates."""
+    out: Dict[str, dict] = {}
+    for r in TABLETS.snapshot():
+        t = out.setdefault(
+            str(r["ns"]),
+            {
+                "reads": 0,
+                "read_uids": 0,
+                "mutation_edges": 0,
+                "result_bytes": 0,
+            },
+        )
+        t["reads"] += r["reads"]
+        t["read_uids"] += r["read_uids"]
+        t["mutation_edges"] += r["mutation_edges"]
+        t["result_bytes"] += r["result_bytes"]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1035,6 +1104,13 @@ def init_from_env(instance: str = "") -> Tracer:
         path = os.path.join(sink_dir, f"spans-{label}.jsonl")
         if TRACER.sink_path != path:
             TRACER.set_sink(path)
+    # flight recorder: the metrics-history sampler runs in every
+    # bootstrapped process (replaying any on-disk ring first so the
+    # retro view survives a restart)
+    HISTORY.set_label(instance or f"pid{os.getpid()}")
+    if HISTORY.enabled():
+        HISTORY.load_disk()
+        HISTORY.start()
     return TRACER
 
 
@@ -1195,6 +1271,12 @@ def healthz(instance: str = "") -> dict:
         "commit_pipeline_depth": METRICS.value("commit_pipeline_depth"),
         "slo": slo_report(),
     }
+    # per-tenant slices: burn rates + traffic rollups keyed by namespace
+    # (empty on single-tenant processes that never resolved an ns)
+    tslo = tenant_slo_report()
+    ttraffic = tenant_traffic_rollup()
+    if tslo or ttraffic:
+        out["tenants"] = {"slo": tslo, "traffic": ttraffic}
     sources = {}
     for name, fn in sorted(_HEALTH_SOURCES.items()):
         try:
@@ -1204,6 +1286,276 @@ def healthz(instance: str = "") -> dict:
     if sources:
         out["sources"] = sources
     return out
+
+
+# ---------------------------------------------------------------------------
+# Metrics history ring (flight recorder)
+# ---------------------------------------------------------------------------
+
+
+class HistoryLog:
+    """On-disk metrics-history ring: one AppendLog record (the shared
+    torn-tail-truncating pickle format from worker/tabletmove.py) per
+    snapshot, so a crash mid-append costs at most the torn record.
+    When the file exceeds DGRAPH_TPU_HISTORY_DISK_MAX_BYTES it is
+    rewritten keeping the newest half of its records — the slow-query
+    log's hysteresis, so a rotation never happens on consecutive
+    appends."""
+
+    K_SNAP = 1
+
+    def __init__(self, path: str):
+        # lazy import: tabletmove imports observe at module level, so
+        # observe must not import it back at import time
+        from dgraph_tpu.worker.tabletmove import AppendLog
+
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._log = AppendLog(path, kinds=(self.K_SNAP,), sync=False)
+
+    def append(self, snap: dict) -> int:
+        """Append one snapshot; returns rotations performed (0 or 1)."""
+        from dgraph_tpu.worker.tabletmove import AppendLog
+        from dgraph_tpu.x import config
+
+        self._log._append(self.K_SNAP, snap)
+        cap = int(config.get("HISTORY_DISK_MAX_BYTES"))
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return 0
+        if cap <= 0 or size <= cap:
+            return 0
+        snaps = self.scan()
+        keep = snaps[len(snaps) // 2:] or snaps[-1:]
+        self._log.close()
+        tmp = self.path + ".rewrite"
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        new = AppendLog(tmp, kinds=(self.K_SNAP,), sync=False)
+        for s in keep:
+            new._append(self.K_SNAP, s)
+        new.close()
+        os.replace(tmp, self.path)
+        self._log = AppendLog(self.path, kinds=(self.K_SNAP,), sync=False)
+        return 1
+
+    def scan(self) -> List[dict]:
+        """All complete snapshots on disk (a torn tail ends the replay,
+        never crashes it — AppendLog._scan's contract)."""
+        return [obj for _, obj in self._log._scan()]
+
+    def close(self) -> None:
+        self._log.close()
+
+
+class MetricsHistory:
+    """Bounded ring of periodic metrics snapshots — the retrospective
+    half of the metrics surface. Each snapshot is {ts, values
+    (counters+gauges), hists ({name: [sum, count]})}; `report(window)`
+    answers "what changed in the last N seconds" as counter/histogram
+    deltas, computable AFTER a spike without a rerun (/debug/history).
+
+    A background sampler appends one snapshot per
+    DGRAPH_TPU_HISTORY_INTERVAL_S and mirrors it to the on-disk
+    HistoryLog when DGRAPH_TPU_HISTORY_DIR is set (replayed into the
+    ring at startup, so the retro view survives a restart). Retention
+    is DGRAPH_TPU_HISTORY_RETENTION snapshots. METRICS is never called
+    while a history lock is held (lock-order discipline)."""
+
+    def __init__(self, retention: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._ring: "deque" = deque()
+        self._retention = retention
+        self._label = ""
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._disk_lock = threading.Lock()
+        self._disk: Optional[HistoryLog] = None
+        self._disk_path: Optional[str] = None
+
+    def retention(self) -> int:
+        if self._retention is not None:
+            return max(1, int(self._retention))
+        from dgraph_tpu.x import config
+
+        return max(1, int(config.get("HISTORY_RETENTION")))
+
+    @staticmethod
+    def enabled() -> bool:
+        from dgraph_tpu.x import config
+
+        return bool(config.get("HISTORY"))
+
+    def set_label(self, label: str) -> None:
+        """Instance label for the on-disk ring's filename (one file per
+        process, like the trace sink)."""
+        with self._disk_lock:
+            self._label = str(label)
+
+    # -- sampling --------------------------------------------------------------
+
+    def record_now(self) -> dict:
+        """Take one snapshot now (the sampler's tick; tests call it
+        directly). Appends to the in-memory ring and mirrors to disk
+        when configured."""
+        snap = {
+            "ts": time.time(),
+            "values": METRICS.snapshot(),
+            "hists": {
+                k: [s, c]
+                for k, (s, c) in METRICS.hist_snapshot().items()
+            },
+        }
+        keep = self.retention()
+        with self._lock:
+            self._ring.append(snap)
+            while len(self._ring) > keep:
+                self._ring.popleft()
+            n = len(self._ring)
+        rotations = self._disk_append(snap)
+        METRICS.inc("history_snapshots_total")
+        METRICS.set_gauge("history_samples", float(n))
+        if rotations:
+            METRICS.inc("history_disk_rotations_total", rotations)
+        return snap
+
+    def _disk_log_locked(self) -> Optional[HistoryLog]:
+        from dgraph_tpu.x import config
+
+        d = config.get("HISTORY_DIR")
+        if not d:
+            return None
+        label = self._label or f"pid{os.getpid()}"
+        path = os.path.join(d, f"history-{label}.log")
+        if self._disk is None or self._disk_path != path:
+            if self._disk is not None:
+                self._disk.close()
+            self._disk = HistoryLog(path)
+            self._disk_path = path
+        return self._disk
+
+    def _disk_append(self, snap: dict) -> int:
+        with self._disk_lock:
+            try:
+                log = self._disk_log_locked()
+                return log.append(snap) if log is not None else 0
+            except OSError:
+                return 0
+
+    def load_disk(self) -> int:
+        """Replay the on-disk ring into an EMPTY in-memory ring (the
+        post-restart retro view). Returns snapshots loaded."""
+        with self._disk_lock:
+            try:
+                log = self._disk_log_locked()
+                snaps = log.scan() if log is not None else []
+            except OSError:
+                snaps = []
+        if not snaps:
+            return 0
+        keep = self.retention()
+        loaded = 0
+        with self._lock:
+            if not self._ring:
+                for s in snaps[-keep:]:
+                    self._ring.append(s)
+                loaded = len(self._ring)
+        if loaded:
+            METRICS.set_gauge("history_samples", float(loaded))
+        return loaded
+
+    def start(self) -> None:
+        """Start the background sampler (idempotent). Interval is
+        re-read each tick so tests can shrink it live."""
+        with self._lock:
+            self._stop.clear()
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="metrics-history"
+            )
+            t = self._thread
+        t.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop.set()
+
+    def _run(self) -> None:
+        from dgraph_tpu.x import config
+
+        stop = self._stop
+        while not stop.is_set():
+            iv = max(0.05, float(config.get("HISTORY_INTERVAL_S")))
+            if stop.wait(iv):
+                return
+            if not self.enabled():
+                continue
+            try:
+                self.record_now()
+            except Exception:
+                pass
+            try:
+                # sustained-burn auto-profile check rides the history
+                # tick (one timer thread for the whole flight recorder)
+                from dgraph_tpu.utils import profiler
+
+                profiler.AUTO.check()
+            except Exception:
+                pass
+
+    # -- queries ---------------------------------------------------------------
+
+    def snapshots(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def report(self, window_s: float = 600.0) -> dict:
+        """Windowed deltas between the oldest and newest snapshot inside
+        `window_s`: {window_s, samples, retained, from_ts, to_ts,
+        deltas {counter/gauge: delta}, hist_deltas {name: {sum,
+        count}}}. Zero deltas are dropped (payload stays proportional
+        to what actually changed)."""
+        with self._lock:
+            snaps = list(self._ring)
+        lo = time.time() - max(0.0, float(window_s))
+        win = [s for s in snaps if s["ts"] >= lo]
+        out: Dict[str, object] = {
+            "window_s": float(window_s),
+            "samples": len(win),
+            "retained": len(snaps),
+        }
+        if len(win) < 2:
+            return out
+        a, b = win[0], win[-1]
+        out["from_ts"] = a["ts"]
+        out["to_ts"] = b["ts"]
+        deltas = {}
+        for k, v in b["values"].items():
+            d = v - a["values"].get(k, 0.0)
+            if d:
+                deltas[k] = d
+        out["deltas"] = deltas
+        hd = {}
+        for k, sc in b["hists"].items():
+            s0 = a["hists"].get(k, [0.0, 0])
+            ds, dc = sc[0] - s0[0], sc[1] - s0[1]
+            if ds or dc:
+                hd[k] = {"sum": ds, "count": dc}
+        out["hist_deltas"] = hd
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+HISTORY = MetricsHistory()
 
 
 # ---------------------------------------------------------------------------
@@ -1665,6 +2017,65 @@ def start_debug_http(host: str = "127.0.0.1", port: int = 0):
                     ).encode(),
                     "application/json",
                 )
+            elif self.path.startswith("/debug/digests"):
+                from dgraph_tpu.serving.digest import DIGESTS
+
+                self._send(
+                    json.dumps(
+                        {"digests": DIGESTS.snapshot()}
+                    ).encode(),
+                    "application/json",
+                )
+            elif self.path.startswith("/debug/history"):
+                from urllib.parse import parse_qs, urlparse
+
+                qs = parse_qs(urlparse(self.path).query)
+                try:
+                    window = float(qs.get("window", ["600"])[0])
+                except ValueError:
+                    window = 600.0
+                self._send(
+                    json.dumps(HISTORY.report(window)).encode(),
+                    "application/json",
+                )
+            elif self.path.startswith("/debug/profile"):
+                from urllib.parse import parse_qs, urlparse
+
+                from dgraph_tpu.utils.profiler import AUTO, PROFILER
+
+                qs = parse_qs(urlparse(self.path).query)
+                if qs.get("last"):
+                    folded = AUTO.last() or ""
+                    self._send(
+                        folded.encode(), "text/plain",
+                        200 if folded else 404,
+                    )
+                else:
+                    try:
+                        seconds = float(qs.get("seconds", ["5"])[0])
+                    except ValueError:
+                        seconds = 5.0
+                    folded = PROFILER.profile(
+                        min(max(seconds, 0.05), 60.0)
+                    )
+                    self._send(folded.encode(), "text/plain")
+            elif self.path.startswith("/debug/slowlog"):
+                log = slow_query_log()
+                body = b""
+                if log is not None:
+                    try:
+                        with open(log.path, "rb") as f:
+                            body = f.read()
+                    except OSError:
+                        body = b""
+                self._send(body, "application/x-ndjson")
+            elif self.path == "/debug/config":
+                from dgraph_tpu.x import config as _cfg
+
+                self._send(
+                    json.dumps(_cfg.resolved(), default=str).encode(),
+                    "application/json",
+                )
             elif self.path in ("/healthz", "/debug/healthz"):
                 self._send(
                     json.dumps(healthz()).encode(), "application/json"
@@ -1716,6 +2127,23 @@ def attach_debug_surface(rpc_server):
     rpc_server.register("debug.tablets", _tablets)
     rpc_server.register(
         "debug.health", lambda a: healthz(rpc_server.instance)
+    )
+
+    def _digests(a):
+        from dgraph_tpu.serving.digest import DIGESTS
+
+        return {
+            "digests": DIGESTS.snapshot(),
+            "instance": rpc_server.instance,
+        }
+
+    rpc_server.register("debug.digests", _digests)
+    rpc_server.register(
+        "debug.history",
+        lambda a: dict(
+            HISTORY.report(float((a or {}).get("window", 600.0))),
+            instance=rpc_server.instance,
+        ),
     )
     rpc_server.register("debug.info", lambda a: dict(info))
     return srv, port
@@ -1851,6 +2279,12 @@ declare_metric(
     "Queries that returned a degraded/partial response.",
 )
 declare_metric(
+    "counter", "digest_evicted_total",
+    "Digest-store rows evicted past DGRAPH_TPU_DIGEST_SHAPES and "
+    "folded into the sticky per-namespace `other` bucket "
+    "(serving/digest.py) — totals stay exact under shape churn.",
+)
+declare_metric(
     "counter", "exec_parallel_siblings",
     "Sibling subtrees submitted to the parallel executor pool.",
 )
@@ -1920,6 +2354,18 @@ declare_metric(
     " _hedged_rotation). Plain failure rotations never count, so "
     "hedge_wins <= hedge_fired_total and the ratio measures hedge "
     "effectiveness.",
+)
+declare_metric(
+    "counter", "history_snapshots_total",
+    "Metrics-history snapshots taken by the background sampler "
+    "(utils/observe.py MetricsHistory) — in-memory ring appends; the "
+    "on-disk ring mirrors them when DGRAPH_TPU_HISTORY_DIR is set.",
+)
+declare_metric(
+    "counter", "history_disk_rotations_total",
+    "On-disk history-ring rotations: the log exceeded "
+    "DGRAPH_TPU_HISTORY_DISK_MAX_BYTES and was rewritten keeping the "
+    "newest half of its records.",
 )
 declare_metric(
     "counter", "idem_hits_total",
@@ -2064,6 +2510,18 @@ declare_metric(
     "from declaration order (AND-filter chains ordered cheapest/most-"
     "selective first, var-free sibling expansion cheapest-first) — "
     "observation-equivalent by construction (query/planner.py).",
+)
+declare_metric(
+    "counter", "profiler_auto_triggers_total",
+    "Sampling-profiler captures auto-triggered by sustained SLO burn "
+    "(utils/profiler.py): the 300s query burn rate exceeded "
+    "DGRAPH_TPU_PROFILE_BURN at a history tick outside the cooldown.",
+)
+declare_metric(
+    "counter", "profiler_samples_total",
+    "Stack samples taken by the wall-clock sampling profiler across "
+    "all captures (utils/profiler.py): one sys._current_frames() walk "
+    "per sampled thread per tick.",
 )
 declare_metric(
     "counter", "pushdown_applied_total",
@@ -2280,6 +2738,22 @@ declare_metric(
 declare_metric(
     "gauge", "cache_point_reads",
     "Point LocalCache reads (READ_COUNTERS mirror).",
+)
+declare_metric(
+    "gauge", "digest_shapes",
+    "Distinct (namespace, shape) rows currently tracked by this "
+    "process's query digest store (serving/digest.py; published at "
+    "scrape time like tablet_traffic_tablets).",
+)
+declare_metric(
+    "gauge", "history_samples",
+    "Snapshots currently retained in this process's in-memory metrics "
+    "history ring (bounded by DGRAPH_TPU_HISTORY_RETENTION).",
+)
+declare_metric(
+    "gauge", "profiler_active",
+    "1 while a sampling-profiler capture is running on this process "
+    "(on-demand or auto-triggered), else 0.",
 )
 declare_metric(
     "gauge", "tablet_traffic_tablets",

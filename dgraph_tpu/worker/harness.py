@@ -29,6 +29,7 @@ from dgraph_tpu.conn.retry import (
 )
 from dgraph_tpu.conn.rpc import RpcError, RpcPool
 from dgraph_tpu.posting.lists import Txn
+from dgraph_tpu.serving.digest import DIGESTS
 from dgraph_tpu.utils import observe
 from dgraph_tpu.utils.observe import METRICS, TRACER, profile_scope
 from dgraph_tpu.schema.schema import State, parse_schema
@@ -383,6 +384,7 @@ class ProcCluster:
         # budget; no-op with DGRAPH_TPU_ADMISSION off)
         n_edges = txn.pending_postings()
         ticket = self.serving.admit_write(n_edges)
+        t_commit0 = time.monotonic()
         try:
             if not bool(config.get("GROUP_COMMIT")):
                 # escape hatch (DGRAPH_TPU_GROUP_COMMIT=0): today's
@@ -418,6 +420,13 @@ class ProcCluster:
                 "mutation_edges_total",
                 sum(len(p) for p in txn.cache.deltas.values())
                 + getattr(txn, "col_nposts", 0),
+            )
+            # per-tenant SLO slice (cluster writes are galaxy-ns today;
+            # the tag mirrors api/server.py so the healthz shape is one)
+            observe.note_tenant(
+                "commit",
+                getattr(txn, "tenant_ns", keys.GALAXY_NS),
+                time.monotonic() - t_commit0,
             )
             return cts
         finally:
@@ -888,8 +897,11 @@ class ProcCluster:
         shape = None
         slow = False
         completed = False  # clean, untruncated execution
-        parse_info: Optional[dict] = {} if debug else None
+        # info always collected: the digest store records the plan-
+        # cache outcome per shape, not just EXPLAIN requests
+        parse_info: dict = {}
         cache_base = cache_tier_snapshot(self.mem) if debug else None
+        digested = False  # one digest record per query, on every path
         try:
             with deadline_scope(
                 current_deadline() or Deadline.after(budget)
@@ -949,6 +961,17 @@ class ProcCluster:
 
                     METRICS.inc("num_queries")
                     t_done = time.perf_counter()
+                    if DIGESTS.enabled():
+                        DIGESTS.record(
+                            keys.GALAXY_NS, shape, t_done - t_start,
+                            nbytes=len(raw_hit),
+                            plan_hit=bool(parse_info.get("hit")),
+                            result_hit=True,
+                        )
+                        digested = True
+                    observe.note_tenant(
+                        "query", keys.GALAXY_NS, t_done - t_ts
+                    )
                     return hit_response(
                         raw_hit, want,
                         parsing_ns=int((t_parsed - t_start) * 1e9),
@@ -1073,10 +1096,40 @@ class ProcCluster:
                 # group is leaderless. NOT partial: the data is whole.
                 ext["degraded"] = "leaderless"
                 ext["leaderless_groups"] = sorted(kv.ctx.leaderless_gids)
+            if DIGESTS.enabled():
+                data = out.get("data")
+                nrows = (
+                    sum(
+                        len(v)
+                        for v in data.values()
+                        if isinstance(v, list)
+                    )
+                    if isinstance(data, dict)
+                    else 0
+                )
+                DIGESTS.record(
+                    keys.GALAXY_NS, shape, t_done - t_start,
+                    rows=nrows,
+                    nbytes=int(prof.encode.get("bytes", 0)),
+                    error=truncated or bool(kv.degraded_groups),
+                    plan_hit=bool(parse_info.get("hit")),
+                    setop_pairs=int(
+                        prof.events.get("setop_pairs_total", 0)
+                    ),
+                    setop_packed=int(
+                        prof.events.get("setop_packed_total", 0)
+                    ),
+                )
+                digested = True
+            observe.note_tenant("query", keys.GALAXY_NS, t_done - t_ts)
+            # slow records carry the digest shape key so a slow entry
+            # joins its aggregate row in /debug/digests
+            _slow_extra = {"shape": shape}
+            if kv.degraded_groups:
+                _slow_extra["degraded"] = sorted(kv.degraded_groups)
             slow = observe.maybe_log_slow(
                 "query", q, (t_done - t_start) * 1e3, root,
-                extra={"degraded": sorted(kv.degraded_groups)}
-                if kv.degraded_groups else None,
+                extra=_slow_extra,
             )
             completed = not truncated
             if (
@@ -1092,6 +1145,13 @@ class ProcCluster:
                     self.serving.results.put(rc_key, raw)
             return out
         finally:
+            # errors/sheds still count against their shape in the
+            # digest store (errors are a first-class digest column)
+            if not digested and DIGESTS.enabled():
+                DIGESTS.record(
+                    keys.GALAXY_NS, shape,
+                    time.perf_counter() - t_start, error=True,
+                )
             # only clean completions feed the shape cost EWMA: a shed,
             # error, or budget-truncated run's latency describes the
             # failure mode, not the shape — feeding it would decay the
@@ -1217,6 +1277,120 @@ class ProcCluster:
             "unreachable_instances": unreachable,
         }
 
+    def merged_digests(self) -> dict:
+        """Cluster-wide query digest rows: every replica's
+        debug.digests snapshot plus the coordinator's own store, summed
+        by (ns, shape) bucket-wise — so merged call counts equal the
+        sum of per-process scrapes (the `dgraph-tpu top` body). Partial
+        on replica outage, dead instances named."""
+        from dgraph_tpu.serving.digest import DIGESTS, merge_rows
+
+        per_instance = [("client", DIGESTS.snapshot())]
+        replies, unreachable = self._scrape_all("debug.digests")
+        for label, got in replies.items():
+            per_instance.append((label, got.get("digests", [])))
+        return {
+            "digests": merge_rows(
+                [rows for _label, rows in per_instance]
+            ),
+            "instances": [label for label, _rows in per_instance],
+            "unreachable_instances": unreachable,
+        }
+
+    def merged_history(self, window_s: float = 600.0) -> dict:
+        """Cluster-wide windowed metrics deltas: each process's history
+        report kept per-instance (per-process rings don't share a
+        clock) plus one cluster sum of the counter deltas — "what
+        changed in the last N seconds, cluster-wide". Partial on
+        replica outage, dead instances named."""
+        per_instance = {"client": observe.HISTORY.report(window_s)}
+        replies, unreachable = self._scrape_all(
+            "debug.history", {"window": float(window_s)}
+        )
+        for label, got in replies.items():
+            per_instance[label] = {
+                k: v for k, v in got.items() if k != "instance"
+            }
+        summed: Dict[str, float] = {}
+        for rep in per_instance.values():
+            for k, v in (rep.get("deltas") or {}).items():
+                summed[k] = summed.get(k, 0.0) + v
+        return {
+            "window_s": float(window_s),
+            "history": per_instance,
+            "deltas": summed,
+            "instances": sorted(per_instance),
+            "unreachable_instances": unreachable,
+        }
+
+    def debug_bundle(self, window_s: float = 600.0) -> dict:
+        """Everything an operator needs to diagnose the cluster after
+        the fact, in one dict (the `dgraph-tpu debug-bundle` body):
+        merged metrics, digests, a history window, health, traces,
+        tablets, the slow-query log, the static lock graph, and the
+        resolved config. Built on the degraded-scrape machinery — a
+        dead alpha yields a partial bundle plus its name in
+        unreachable_instances, never a raise."""
+        metrics, m_unreach = self.merged_metrics(with_meta=True)
+        digests = self.merged_digests()
+        history = self.merged_history(window_s)
+        traces, t_unreach = self.merged_traces(with_meta=True)
+        tablets = self.merged_tablets()
+        health = self.health()
+        slow: List[dict] = []
+        log = observe.slow_query_log()
+        if log is not None:
+            try:
+                with open(log.path) as f:
+                    slow = [
+                        json.loads(line)
+                        for line in f
+                        if line.strip()
+                    ]
+            except (OSError, ValueError):
+                slow = []
+        lock_edges: List[dict] = []
+        try:
+            from dgraph_tpu.analysis import load_sources, package_root
+            from dgraph_tpu.analysis.check_lockorder import lock_graph
+
+            for (outer, inner), (path, line, kind) in sorted(
+                lock_graph(load_sources(package_root())).items()
+            ):
+                lock_edges.append(
+                    {
+                        "outer": outer,
+                        "inner": inner,
+                        "path": path,
+                        "line": line,
+                        "kind": kind,
+                    }
+                )
+        except Exception as e:  # analyzer absence must not sink a bundle
+            lock_edges = [{"error": f"{type(e).__name__}: {e}"}]
+        unreachable = sorted(
+            set(m_unreach)
+            | set(t_unreach)
+            | set(digests.get("unreachable_instances") or [])
+            | set(history.get("unreachable_instances") or [])
+            | set(tablets.get("unreachable_instances") or [])
+            | set(health.get("unreachable_instances") or [])
+        )
+        return {
+            "generated_ts": time.time(),
+            "window_s": float(window_s),
+            "unreachable_instances": unreachable,
+            "metrics": metrics,
+            "digests": digests,
+            "history": history,
+            "health": health,
+            "traces": traces,
+            "tablets": tablets,
+            "slow_queries": slow,
+            "lock_graph": lock_edges,
+            "config": config.resolved(),
+        }
+
     def health(self) -> dict:
         """The cluster health/SLO rollup behind `dgraph-tpu health`:
         the coordinator's own healthz (admission rates, commit pipeline
@@ -1296,6 +1470,30 @@ class ProcCluster:
         out["processes"] = {
             label: got for label, got in sorted(replies.items())
         }
+        # cluster-wide per-tenant traffic rollup from the merged tablet
+        # rows (the per-tenant SLO slices ride in each process's
+        # healthz "tenants" section above)
+        merged = self.merged_tablets()
+        traffic: Dict[str, dict] = {}
+        for r in merged["tablets"]:
+            t = traffic.setdefault(
+                str(r["ns"]),
+                {
+                    "reads": 0,
+                    "read_uids": 0,
+                    "mutation_edges": 0,
+                    "result_bytes": 0,
+                },
+            )
+            t["reads"] += r["reads"]
+            t["read_uids"] += r["read_uids"]
+            t["mutation_edges"] += r["mutation_edges"]
+            t["result_bytes"] += r["result_bytes"]
+        if traffic:
+            out["tenant_traffic"] = traffic
+        unreachable = sorted(
+            set(unreachable) | set(merged["unreachable_instances"])
+        )
         out["unreachable_instances"] = unreachable
         if unreachable or any(
             not g["healthy"] for g in groups.values()

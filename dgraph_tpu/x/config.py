@@ -226,6 +226,22 @@ _define(
     "0 means ALWAYS use the device (query/dispatch.py).",
 )
 _define(
+    "DIGEST", "bool", True,
+    "Always-on query digest store (serving/digest.py): per-(namespace, "
+    "normalized-shape) aggregate statistics — calls, errors, latency "
+    "histogram, result rows/bytes, plan/result-cache hits, packed-"
+    "kernel deltas — fed at the query entry points and served at "
+    "/debug/digests (the pg_stat_statements analog). 0 disables the "
+    "accounting — the flight-recorder A/B escape hatch.",
+)
+_define(
+    "DIGEST_SHAPES", "int", 512,
+    "Digest-store capacity in distinct (namespace, shape) rows "
+    "(serving/digest.py); LRU beyond it, with evicted rows folded "
+    "into the sticky per-namespace `other` bucket so totals stay "
+    "exact under churn.",
+)
+_define(
     "EXEC_WORKERS", "int", 0,
     "Sibling fan-out width for the parallel query executor; 0/1 = "
     "serial escape hatch (query/subgraph.py). Re-read per Executor so "
@@ -311,6 +327,39 @@ _define(
     "barrier is still in flight (an idle engine always commits "
     "immediately, like the PR 7 batcher's natural batching). 0 "
     "disables the wait; batches still form from whatever is queued.",
+)
+_define(
+    "HISTORY", "bool", True,
+    "Metrics history ring (utils/observe.py MetricsHistory): a "
+    "background sampler snapshots every counter/gauge + histogram "
+    "sum/count once per HISTORY_INTERVAL_S into a bounded in-memory "
+    "ring, so windowed deltas (/debug/history?window=) are computable "
+    "after an incident without reruns. 0 disables sampling — the "
+    "flight-recorder A/B escape hatch.",
+)
+_define(
+    "HISTORY_DIR", "str", "",
+    "When set, history snapshots are also appended to an on-disk ring "
+    "(history-<instance|pid>.log inside this directory) in the shared "
+    "AppendLog record format — torn tails truncated at open, rotation "
+    "at HISTORY_DISK_MAX_BYTES — so the recorded window survives a "
+    "process restart. Empty = in-memory only.",
+)
+_define(
+    "HISTORY_DISK_MAX_BYTES", "int", 8 << 20,
+    "Rotation bound for the on-disk history ring: past it the file is "
+    "rewritten keeping the newest half of its records (the slow-query-"
+    "log hysteresis, amortized rewrites).",
+)
+_define(
+    "HISTORY_INTERVAL_S", "float", 60.0,
+    "Seconds between metrics-history snapshots (minute buckets by "
+    "default; tests dial it down).",
+)
+_define(
+    "HISTORY_RETENTION", "int", 180,
+    "In-memory history snapshots retained (oldest dropped beyond it): "
+    "180 x 60s = a 3h window at the default interval.",
 )
 _define(
     "LAMBDA_URL", "str", "",
@@ -403,6 +452,36 @@ _define(
     "are invalidated by commit epoch (no plan survives a commit "
     "unrevalidated). 0 disables plan caching; per-shape cost stats for "
     "admission are disabled with it.",
+)
+_define(
+    "PROFILE_AUTO", "bool", True,
+    "Auto-trigger the sampling profiler on sustained SLO burn "
+    "(utils/profiler.py): when the 300s query burn rate exceeds "
+    "PROFILE_BURN at a history tick, a PROFILE_AUTO_S capture runs in "
+    "the background and is retained for /debug/profile?last=1 — the "
+    "GIL-bound residual gets attributed while it is happening. 0 "
+    "disables auto-triggering (on-demand captures still work).",
+)
+_define(
+    "PROFILE_AUTO_S", "float", 5.0,
+    "Duration, in seconds, of an auto-triggered profiler capture.",
+)
+_define(
+    "PROFILE_BURN", "float", 2.0,
+    "SLO burn-rate threshold (300s window) past which the profiler "
+    "auto-triggers; burn 1.0 = exactly consuming the error budget.",
+)
+_define(
+    "PROFILE_COOLDOWN_S", "float", 600.0,
+    "Minimum seconds between auto-triggered profiler captures — one "
+    "sustained incident must not stack samplers.",
+)
+_define(
+    "PROFILE_HZ", "int", 100,
+    "Sampling frequency of the wall-clock profiler "
+    "(utils/profiler.py): sys._current_frames() walks per second "
+    "while a capture is active. The sampler runs ONLY during a "
+    "capture; idle cost is zero.",
 )
 _define(
     "QUERY_DEADLINE_S", "float", 15.0,
@@ -745,6 +824,21 @@ def unset_env(name: str) -> None:
 
 def is_set(name: str) -> bool:
     return REGISTRY[name].env in os.environ
+
+
+def resolved() -> Dict[str, Any]:
+    """{knob: {env, value, set}} for every registered knob — the
+    effective configuration as the process sees it right now. Served at
+    /debug/config and captured into debug bundles, so "what was this
+    knob during the incident" is answerable from recorded evidence."""
+    return {
+        name: {
+            "env": REGISTRY[name].env,
+            "value": get(name),
+            "set": is_set(name),
+        }
+        for name in sorted(REGISTRY)
+    }
 
 
 # ---------------------------------------------------------------------------

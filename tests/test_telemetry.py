@@ -438,6 +438,27 @@ def test_proc_cluster_telemetry_and_degraded_scrape():
         assert h["processes"]  # per-replica healthz via debug.health
         for ph in h["processes"].values():
             assert "slo" in ph and "uptime_s" in ph
+        assert "tenant_traffic" in h  # per-namespace cluster rollup
+
+        # flight recorder, healthy path: merged digests whose call
+        # counts equal the sum of the per-process scrapes (the
+        # `dgraph-tpu top` contract), plus a merged history window
+        dg = c.merged_digests()
+        assert dg["unreachable_instances"] == []
+        assert dg["digests"], "no digest rows after a live query"
+        replies, unreach = c._scrape_all("debug.digests")
+        assert unreach == []
+        from dgraph_tpu.serving.digest import DIGESTS as _DG
+        per_scrape = sum(
+            r["calls"]
+            for reply in replies.values()
+            for r in reply.get("digests", [])
+        ) + sum(r["calls"] for r in _DG.snapshot())
+        assert sum(r["calls"] for r in dg["digests"]) == per_scrape
+        hist = c.merged_history(window_s=600.0)
+        assert hist["unreachable_instances"] == []
+        assert "client" in hist["history"]
+        assert set(replies) <= set(hist["history"])
 
         # kill one alpha mid-scrape: PARTIAL merge + the dead instance
         # named — never an exception out of the aggregation path
@@ -461,6 +482,24 @@ def test_proc_cluster_telemetry_and_degraded_scrape():
         # legacy no-meta signatures still return the bare merge
         assert isinstance(c.merged_metrics(), str)
         assert isinstance(c.merged_traces(10), list)
+
+        # flight recorder, degraded path: digests/history/bundle all
+        # stay PARTIAL merges naming the dead instance — never a raise
+        dg2 = c.merged_digests()
+        assert dg2["unreachable_instances"] == [f"alpha-{dead}"]
+        assert dg2["digests"]  # surviving rows still merged
+        hist2 = c.merged_history(window_s=600.0)
+        assert hist2["unreachable_instances"] == [f"alpha-{dead}"]
+        assert f"alpha-{dead}" not in hist2["history"]
+        bundle = c.debug_bundle(window_s=600.0)
+        assert f"alpha-{dead}" in bundle["unreachable_instances"]
+        assert bundle["digests"]["digests"]
+        assert "dgraph_tpu_num_queries" in bundle["metrics"]
+        assert bundle["health"]["status"] == "degraded"
+        assert bundle["lock_graph"] and "error" not in bundle[
+            "lock_graph"
+        ][0]
+        assert bundle["config"]["DIGEST"]["env"] == "DGRAPH_TPU_DIGEST"
     finally:
         c.close()
 

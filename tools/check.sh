@@ -13,6 +13,11 @@
 #                           planner on/off + result-cache off/miss/hit
 #                           byte-equality over the golden smoke subset
 #                           (bench.py --plan-sanity)
+#   tools/check.sh --obs-sanity
+#                           the ~5s flight-recorder gate alone: digest +
+#                           history on/off byte-equality over the golden
+#                           smoke subset, digest store and history ring
+#                           asserted live (bench.py --obs-sanity)
 #   tools/check.sh --read-chaos-sanity
 #                           the read-plane chaos gate alone: fixed-seed
 #                           chaos soak slice — leader SIGKILL under the
@@ -55,6 +60,13 @@ if [[ "${1:-}" == "--plan-sanity" ]]; then
     echo "== planner/result-reuse sanity (~5s): A/B byte-equality =="
     python bench.py --plan-sanity
     echo "check.sh: plan-sanity passed"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--obs-sanity" ]]; then
+    echo "== flight-recorder sanity (~5s): digest/history A/B byte-equality =="
+    python bench.py --obs-sanity
+    echo "check.sh: obs-sanity passed"
     exit 0
 fi
 
@@ -131,6 +143,9 @@ else
 
     echo "== planner/result-reuse sanity (~5s) =="
     python bench.py --plan-sanity
+
+    echo "== flight-recorder sanity (~5s) =="
+    python bench.py --obs-sanity
 
     echo "== qps loadgen sanity (~5s) =="
     python benchmarks/qps_loadgen.py --sanity
